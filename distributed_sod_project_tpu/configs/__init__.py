@@ -1,0 +1,24 @@
+from .base import (
+    DataConfig,
+    ExperimentConfig,
+    LossConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    get_config,
+    list_configs,
+    register_config,
+)
+from . import experiments  # noqa: F401  (populates the registry)
+
+__all__ = [
+    "DataConfig",
+    "ExperimentConfig",
+    "LossConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "OptimConfig",
+    "get_config",
+    "list_configs",
+    "register_config",
+]

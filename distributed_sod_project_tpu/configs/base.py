@@ -1,0 +1,134 @@
+"""Experiment configuration system.
+
+Capability parity: SURVEY.md §2 C13 (per-experiment config dicts +
+dataset path registry in the reference's ``config/``).  Re-designed as
+typed, frozen dataclasses so a config can be hashed into a jit cache key
+and serialized into a checkpoint for exact-resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Input pipeline configuration (SURVEY.md §2 C7)."""
+
+    dataset: str = "synthetic"  # synthetic | duts | nju2k | nlpr
+    root: Optional[str] = None  # directory with <name>-Image/ and <name>-Mask/
+    image_size: Tuple[int, int] = (320, 320)  # H, W — static for XLA
+    use_depth: bool = False  # RGB-D datasets carry a depth channel
+    hflip: bool = True
+    normalize_mean: Tuple[float, float, float] = (0.485, 0.456, 0.406)
+    normalize_std: Tuple[float, float, float] = (0.229, 0.224, 0.225)
+    num_workers: int = 4  # host-side prefetch threads
+    prefetch_batches: int = 2
+    synthetic_size: int = 256  # virtual dataset length when dataset=synthetic
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Model zoo selection (SURVEY.md §2 C5/C6)."""
+
+    name: str = "minet"  # minet | hdfnet | u2net | basnet | swin_sod
+    backbone: str = "vgg16"  # vgg16 | resnet50 | swin_t | none (u2net is self-contained)
+    out_stride: int = 1  # saliency logits at input resolution
+    sync_bn: bool = True  # cross-replica BatchNorm stats over the data axis
+    bn_momentum: float = 0.9
+    compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
+    param_dtype: str = "float32"
+    remat: bool = False  # jax.checkpoint the backbone stages
+
+
+@dataclasses.dataclass(frozen=True)
+class LossConfig:
+    """Loss weighting (SURVEY.md §2 C8)."""
+
+    bce: float = 1.0
+    iou: float = 1.0
+    ssim: float = 1.0
+    cel: float = 0.0  # MINet's consistency-enhanced loss
+    ssim_window: int = 11
+    deep_supervision: bool = True  # sum loss over every side output
+    fused_kernel: bool = False  # route through the Pallas fused loss
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimConfig:
+    """Optimizer + schedule (SURVEY.md §2 C9)."""
+
+    optimizer: str = "sgd"  # sgd | adamw
+    lr: float = 0.005
+    momentum: float = 0.9
+    weight_decay: float = 5e-4
+    nesterov: bool = True
+    schedule: str = "poly"  # poly | cosine | constant
+    poly_power: float = 0.9
+    warmup_steps: int = 0
+    grad_clip_norm: float = 0.0  # 0 disables
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh layout (SURVEY.md §2.3).
+
+    The load-bearing axis is ``data`` (DP parity with the reference's
+    DDP/NCCL).  ``model`` shards attention heads / wide dense layers for
+    the Swin path; ``seq`` is the ring-attention sequence-parallel axis.
+    Axis size ``-1`` means "all remaining devices".
+    """
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    name: str = "default"
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    loss: LossConfig = dataclasses.field(default_factory=LossConfig)
+    optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    global_batch_size: int = 8
+    num_epochs: int = 50
+    steps_per_epoch: Optional[int] = None  # None → derived from dataset size
+    seed: int = 0
+    log_every_steps: int = 20
+    checkpoint_every_steps: int = 500
+    checkpoint_dir: str = "checkpoints"
+    keep_checkpoints: int = 3
+
+    def replace(self, **kw) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+
+_REGISTRY: Dict[str, Callable[[], ExperimentConfig]] = {}
+
+
+def register_config(name: str):
+    """Decorator: register a zero-arg factory under ``name``."""
+
+    def deco(fn: Callable[[], ExperimentConfig]):
+        if name in _REGISTRY:
+            raise KeyError(f"config {name!r} already registered")
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_config(name: str, **overrides) -> ExperimentConfig:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown config {name!r}; known: {sorted(_REGISTRY)}")
+    cfg = _REGISTRY[name]()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+def list_configs():
+    return sorted(_REGISTRY)

@@ -1,0 +1,103 @@
+"""The five driver configs from BASELINE.json:7-11 (see SURVEY.md §2).
+
+1. MINet-VGG16, DUTS-TR 320×320, batch=1 single-image forward (CPU ref)
+2. MINet-ResNet50, DUTS-TR full data-parallel train
+3. HDFNet RGB-D (NJU2K / NLPR) — two-stream depth-fusion encoder
+4. U²-Net / BASNet — nested U-decoder + 7-level deep supervision
+5. Swin-T backbone SOD (stretch — transformer encoder on TPU)
+"""
+
+from .base import (
+    DataConfig,
+    ExperimentConfig,
+    LossConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    register_config,
+)
+
+
+@register_config("minet_vgg16_ref")
+def minet_vgg16_ref() -> ExperimentConfig:
+    """Config 1: MINet-VGG16 single-image forward reference."""
+    return ExperimentConfig(
+        name="minet_vgg16_ref",
+        data=DataConfig(dataset="synthetic", image_size=(320, 320)),
+        model=ModelConfig(name="minet", backbone="vgg16", sync_bn=False),
+        loss=LossConfig(cel=1.0),
+        optim=OptimConfig(lr=0.001),
+        global_batch_size=1,
+        mesh=MeshConfig(data=1),
+    )
+
+
+@register_config("minet_r50_dp")
+def minet_r50_dp() -> ExperimentConfig:
+    """Config 2: MINet-ResNet50 full data-parallel training (flagship)."""
+    return ExperimentConfig(
+        name="minet_r50_dp",
+        data=DataConfig(dataset="duts", image_size=(320, 320)),
+        model=ModelConfig(name="minet", backbone="resnet50", sync_bn=True),
+        loss=LossConfig(cel=1.0),
+        optim=OptimConfig(lr=0.005, schedule="poly"),
+        global_batch_size=32,
+        num_epochs=50,
+    )
+
+
+@register_config("hdfnet_rgbd")
+def hdfnet_rgbd() -> ExperimentConfig:
+    """Config 3: HDFNet two-stream RGB-D on NJU2K/NLPR."""
+    return ExperimentConfig(
+        name="hdfnet_rgbd",
+        data=DataConfig(dataset="nju2k", image_size=(320, 320), use_depth=True),
+        model=ModelConfig(name="hdfnet", backbone="vgg16", sync_bn=True),
+        loss=LossConfig(),
+        optim=OptimConfig(lr=0.005),
+        global_batch_size=16,
+        num_epochs=40,
+    )
+
+
+@register_config("u2net_ds")
+def u2net_ds() -> ExperimentConfig:
+    """Config 4a: U²-Net — nested U decoder, 7-level deep supervision."""
+    return ExperimentConfig(
+        name="u2net_ds",
+        data=DataConfig(dataset="duts", image_size=(320, 320)),
+        model=ModelConfig(name="u2net", backbone="none", sync_bn=True),
+        loss=LossConfig(bce=1.0, iou=0.0, ssim=0.0, deep_supervision=True),
+        optim=OptimConfig(optimizer="adamw", lr=1e-3, weight_decay=0.0),
+        global_batch_size=16,
+        num_epochs=100,
+    )
+
+
+@register_config("basnet_ds")
+def basnet_ds() -> ExperimentConfig:
+    """Config 4b: BASNet — predict+refine, BCE+SSIM+IoU hybrid loss."""
+    return ExperimentConfig(
+        name="basnet_ds",
+        data=DataConfig(dataset="duts", image_size=(320, 320)),
+        model=ModelConfig(name="basnet", backbone="resnet34", sync_bn=True),
+        loss=LossConfig(bce=1.0, iou=1.0, ssim=1.0, deep_supervision=True),
+        optim=OptimConfig(optimizer="adamw", lr=1e-3, weight_decay=0.0),
+        global_batch_size=16,
+        num_epochs=100,
+    )
+
+
+@register_config("swin_sod")
+def swin_sod() -> ExperimentConfig:
+    """Config 5 (stretch): Swin-T transformer encoder SOD."""
+    return ExperimentConfig(
+        name="swin_sod",
+        data=DataConfig(dataset="duts", image_size=(320, 320)),
+        model=ModelConfig(name="swin_sod", backbone="swin_t", sync_bn=False),
+        loss=LossConfig(),
+        optim=OptimConfig(optimizer="adamw", lr=3e-4, weight_decay=0.01,
+                          warmup_steps=500),
+        global_batch_size=16,
+        mesh=MeshConfig(data=-1, model=1, seq=1),
+    )

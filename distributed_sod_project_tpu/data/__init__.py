@@ -1,0 +1,11 @@
+from .synthetic import SyntheticSOD
+from .folder import FolderSOD, resolve_dataset
+from .pipeline import HostDataLoader, prefetch_to_device
+
+__all__ = [
+    "SyntheticSOD",
+    "FolderSOD",
+    "resolve_dataset",
+    "HostDataLoader",
+    "prefetch_to_device",
+]

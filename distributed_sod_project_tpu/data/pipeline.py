@@ -1,0 +1,181 @@
+"""Host-side input pipeline with per-host sharding.
+
+Replaces the reference's ``DataLoader`` + ``DistributedSampler`` pair
+(SURVEY.md §2 C4/C7) with the TPU idiom: every host materialises only
+its 1/num_shards slice of each global batch, and epoch-seeded shuffling
+plays the role of ``sampler.set_epoch`` — identical permutations on all
+hosts without any cross-host coordination.
+
+Decode/augment runs in a thread pool (the C++ runtime in ``native/``
+provides the heavy kernels when built); ``prefetch_to_device`` overlaps
+host work with device steps.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import queue
+import threading
+from typing import Dict, Iterator
+
+import numpy as np
+
+
+class HostDataLoader:
+    """Epoch-based, shard-aware, deterministic batch iterator.
+
+    Yields dicts of numpy arrays with leading dim = per-host batch size
+    (= global_batch_size // num_shards).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        global_batch_size: int,
+        shard_id: int = 0,
+        num_shards: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        hflip: bool = False,
+        num_workers: int = 0,
+    ):
+        if global_batch_size % num_shards != 0:
+            raise ValueError(
+                f"global_batch_size={global_batch_size} not divisible by "
+                f"num_shards={num_shards}"
+            )
+        self.dataset = dataset
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // num_shards
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.hflip = hflip
+        self.num_workers = num_workers
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    @property
+    def steps_per_epoch(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.global_batch_size
+        return -(-n // self.global_batch_size)
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
+            order = rng.permutation(n)
+        else:
+            order = np.arange(n)
+        if not self.drop_last and n % self.global_batch_size:
+            pad = self.global_batch_size - n % self.global_batch_size
+            order = np.concatenate([order, order[:pad]])
+        return order
+
+    def _fetch(self, idx: int, aug_seed: int) -> Dict[str, np.ndarray]:
+        sample = dict(self.dataset[int(idx)])
+        if self.hflip:
+            rng = np.random.default_rng(np.random.SeedSequence([aug_seed, int(idx)]))
+            if rng.random() < 0.5:
+                for k in ("image", "mask", "depth"):
+                    if k in sample:
+                        sample[k] = np.ascontiguousarray(sample[k][:, ::-1])
+        return sample
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        epoch = self._epoch
+        order = self._epoch_order(epoch)
+        steps = self.steps_per_epoch
+        aug_seed = hash((self.seed, epoch)) & 0x7FFFFFFF
+
+        pool = (
+            cf.ThreadPoolExecutor(max_workers=self.num_workers)
+            if self.num_workers > 0
+            else None
+        )
+        try:
+            for step in range(steps):
+                lo = step * self.global_batch_size + self.shard_id * self.local_batch_size
+                idxs = order[lo : lo + self.local_batch_size]
+                if pool is not None:
+                    samples = list(pool.map(lambda i: self._fetch(i, aug_seed), idxs))
+                else:
+                    samples = [self._fetch(i, aug_seed) for i in idxs]
+                batch = {
+                    k: np.stack([s[k] for s in samples]) for k in samples[0]
+                }
+                yield batch
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False)
+
+
+def prefetch_to_device(iterator, size: int = 2, sharding=None):
+    """Wrap a host batch iterator with a background thread that stages
+    batches onto device ahead of consumption (H2D overlap, the TPU
+    analogue of the reference's pinned-memory ``non_blocking`` H2D copies
+    in SURVEY.md §3.1).
+
+    Producer-thread exceptions propagate to the consumer; closing the
+    generator early unblocks and stops the producer.
+    """
+    import jax
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+    _END = object()
+
+    def worker():
+        try:
+            for batch in iterator:
+                if stop.is_set():
+                    return
+                if sharding is not None:
+                    batch = jax.device_put(batch, sharding)
+                else:
+                    batch = jax.device_put(batch)
+                while not stop.is_set():
+                    try:
+                        q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            q.put(_END)
+        except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+            while not stop.is_set():
+                try:
+                    q.put(e, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        # Drain so a producer blocked on a full queue can observe `stop`,
+        # then join: a daemon thread torn down mid device transfer at
+        # interpreter exit aborts the process with a C++ exception.
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5.0)

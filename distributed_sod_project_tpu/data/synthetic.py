@@ -1,0 +1,81 @@
+"""Deterministic synthetic SOD dataset.
+
+The environment has no network and no real DUTS/NJU2K/NLPR data
+(SURVEY.md §7.3 hard part 2), so CI and smoke training run on synthetic
+image/mask pairs.  Samples are *learnable*, not noise: each image is a
+textured background plus 1–3 bright elliptical "salient objects"; the
+mask is the union of the ellipses.  A small CNN can overfit a batch of
+these, which is what the integration tests assert (SURVEY.md §4).
+
+Deterministic per (seed, index) so every host/worker regenerates
+identical samples without coordination.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+class SyntheticSOD:
+    def __init__(
+        self,
+        size: int = 256,
+        image_size: Tuple[int, int] = (320, 320),
+        use_depth: bool = False,
+        seed: int = 0,
+        normalize_mean: Tuple[float, float, float] = (0.485, 0.456, 0.406),
+        normalize_std: Tuple[float, float, float] = (0.229, 0.224, 0.225),
+    ):
+        self.size = size
+        self.image_size = image_size
+        self.use_depth = use_depth
+        self.seed = seed
+        # Same mean/std normalization as FolderSOD, so the model input
+        # distribution does not depend on the data source.
+        self.mean = np.asarray(normalize_mean, np.float32)
+        self.std = np.asarray(normalize_std, np.float32)
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, index: int) -> Dict[str, np.ndarray]:
+        h, w = self.image_size
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, int(index)])
+        )
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+
+        # Background: low-frequency texture from a coarse noise grid.
+        coarse = rng.normal(0.35, 0.12, size=(h // 16 + 1, w // 16 + 1, 3))
+        bg = np.kron(coarse, np.ones((16, 16, 1)))[:h, :w, :].astype(np.float32)
+
+        mask = np.zeros((h, w), dtype=np.float32)
+        img = bg.copy()
+        for _ in range(int(rng.integers(1, 4))):
+            cy, cx = rng.uniform(0.2, 0.8) * h, rng.uniform(0.2, 0.8) * w
+            ry, rx = rng.uniform(0.08, 0.25) * h, rng.uniform(0.08, 0.25) * w
+            theta = rng.uniform(0, np.pi)
+            ct, st = np.cos(theta), np.sin(theta)
+            u = (xx - cx) * ct + (yy - cy) * st
+            v = -(xx - cx) * st + (yy - cy) * ct
+            inside = (u / rx) ** 2 + (v / ry) ** 2 <= 1.0
+            mask[inside] = 1.0
+            color = rng.uniform(0.6, 1.0, size=3).astype(np.float32)
+            img[inside] = 0.25 * img[inside] + 0.75 * color
+
+        img = np.clip(img + rng.normal(0, 0.02, size=img.shape), 0.0, 1.0)
+        img = (img - self.mean) / self.std
+        out = {
+            "image": img.astype(np.float32),
+            "mask": mask[..., None],
+            "index": np.int32(index),
+        }
+        if self.use_depth:
+            # Depth: objects nearer (smaller depth) than background, with a
+            # gradient — enough structure for the fusion path to exploit.
+            depth = 0.8 - 0.5 * mask + 0.1 * (yy / h)
+            depth += rng.normal(0, 0.02, size=depth.shape)
+            out["depth"] = np.clip(depth, 0.0, 1.0).astype(np.float32)[..., None]
+        return out

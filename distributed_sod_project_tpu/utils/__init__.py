@@ -1,0 +1,4 @@
+from .logging import get_logger, is_primary_process
+from .timing import StepTimer
+
+__all__ = ["get_logger", "is_primary_process", "StepTimer"]

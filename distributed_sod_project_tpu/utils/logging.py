@@ -1,0 +1,53 @@
+"""Rank-0-gated logging (SURVEY.md §2 C12).
+
+The reference gates its console/file logger and TensorBoard writer on
+rank 0; here the gate is ``jax.process_index() == 0``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+
+def is_primary_process() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def get_logger(name: str = "dsod", log_file: Optional[str] = None) -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    if not is_primary_process():
+        if not logger.handlers:
+            logger.addHandler(logging.NullHandler())
+        return logger
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname).1s %(name)s] %(message)s", "%H:%M:%S"
+    )
+    if not any(isinstance(h, logging.StreamHandler) and not isinstance(h, logging.FileHandler)
+               for h in logger.handlers):
+        sh = logging.StreamHandler(sys.stderr)
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
+    if log_file:
+        # Attach the file handler even when the logger already exists —
+        # later calls may be the first to name a log file.
+        existing = {
+            getattr(h, "baseFilename", None)
+            for h in logger.handlers
+            if isinstance(h, logging.FileHandler)
+        }
+        import os
+
+        if os.path.abspath(log_file) not in existing:
+            fh = logging.FileHandler(log_file)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+    return logger

@@ -1,0 +1,36 @@
+"""Throughput accounting — the imgs/sec counter the governing metric
+(BASELINE.json:2, images/sec/chip) is computed from."""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+
+class StepTimer:
+    """Sliding-window step timer; excludes the first ``warmup`` steps so
+    XLA compilation time never pollutes throughput numbers."""
+
+    def __init__(self, window: int = 50, warmup: int = 2):
+        self.window = window
+        self.warmup = warmup
+        self._times: deque = deque(maxlen=window)
+        self._last = None
+        self._count = 0
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        self._count += 1
+        if self._last is not None and self._count > self.warmup:
+            self._times.append(now - self._last)
+        self._last = now
+
+    @property
+    def mean_step_time(self) -> float:
+        if not self._times:
+            return float("nan")
+        return sum(self._times) / len(self._times)
+
+    def images_per_sec(self, batch_size: int) -> float:
+        st = self.mean_step_time
+        return batch_size / st if st == st and st > 0 else float("nan")
