@@ -1,0 +1,59 @@
+"""Deep-supervision loss wrapper (SURVEY.md §2 C8, §3.1 hot loop).
+
+The zoo convention is that every model returns a list of
+full-resolution logit maps (U²-Net/BASNet: 7 side outputs; MINet: 1).
+The wrapper sums the configured hybrid loss over every level — the
+whole thing stays inside the compiled train step, so multi-level loss
+costs one fused reduction pass, not N kernel launches.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from .elementwise import bce_with_logits
+from .region import cel_loss, iou_loss
+from .ssim import ssim_loss
+
+
+def deep_supervision_loss(
+    logits_list: Sequence[jnp.ndarray],
+    target: jnp.ndarray,
+    *,
+    bce_w: float = 1.0,
+    iou_w: float = 1.0,
+    ssim_w: float = 1.0,
+    cel_w: float = 0.0,
+    ssim_window: int = 11,
+    level_weights: Sequence[float] | None = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Σ_levels w_l · (bce_w·BCE + iou_w·IoU + ssim_w·SSIM + cel_w·CEL).
+
+    Returns (total, components) where components holds the per-term sums
+    across levels for logging.
+    """
+    if level_weights is None:
+        level_weights = [1.0] * len(logits_list)
+    total = jnp.float32(0.0)
+    comps: Dict[str, jnp.ndarray] = {}
+
+    def add(name, value, weight):
+        nonlocal total
+        if weight == 0.0:
+            return
+        comps[name] = comps.get(name, jnp.float32(0.0)) + value
+        total = total + weight * value
+
+    for logit, lw in zip(logits_list, level_weights):
+        if bce_w:
+            add("bce", lw * bce_with_logits(logit, target), bce_w)
+        if iou_w:
+            add("iou", lw * iou_loss(logit, target), iou_w)
+        if ssim_w:
+            add("ssim", lw * ssim_loss(logit, target, window_size=ssim_window), ssim_w)
+        if cel_w:
+            add("cel", lw * cel_loss(logit, target), cel_w)
+    comps["total"] = total
+    return total, comps

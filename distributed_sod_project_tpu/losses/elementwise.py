@@ -1,0 +1,28 @@
+"""Pixel-wise losses (SURVEY.md §2 C8).
+
+All losses take full-resolution logits [B,H,W,1] and binary targets of
+the same shape, reduce in float32 (bf16 activations upstream are fine;
+reductions are where precision dies on TPU), and return scalars.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bce_with_logits(logits, targets, *, reduction: str = "mean"):
+    """Numerically stable sigmoid binary cross-entropy.
+
+    max(x,0) - x*t + log(1+exp(-|x|)) — the standard stable form; never
+    materialises sigmoid(x), so it is fusion-friendly under XLA.
+    """
+    x = logits.astype(jnp.float32)
+    t = targets.astype(jnp.float32)
+    per_pixel = jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if reduction == "mean":
+        return per_pixel.mean()
+    if reduction == "sum":
+        return per_pixel.sum()
+    if reduction == "none":
+        return per_pixel
+    raise ValueError(f"unknown reduction {reduction!r}")

@@ -1,0 +1,38 @@
+"""Region-level losses: soft IoU and MINet's consistency-enhanced loss
+(SURVEY.md §2 C8; the BASNet hybrid-loss IoU term and the CEL term from
+the MINet paper — reference unreadable, see SURVEY.md banner)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _flatten_per_image(x):
+    return x.reshape(x.shape[0], -1)
+
+
+def iou_loss(logits, targets, *, eps: float = 1.0):
+    """Soft Jaccard loss, per image then averaged: 1 − (∩+ε)/(∪+ε)."""
+    p = jnp.asarray(jnp.reciprocal(1.0 + jnp.exp(-logits.astype(jnp.float32))))
+    t = targets.astype(jnp.float32)
+    p, t = _flatten_per_image(p), _flatten_per_image(t)
+    inter = (p * t).sum(-1)
+    union = p.sum(-1) + t.sum(-1) - inter
+    return (1.0 - (inter + eps) / (union + eps)).mean()
+
+
+def cel_loss(logits, targets, *, eps: float = 1e-6):
+    """Consistency-enhanced loss (MINet):
+
+        CEL = (Σp + Σt − 2Σpt) / (Σp + Σt)
+
+    i.e. symmetric-difference mass over total mass, per image then
+    averaged.  Differentiable and scale-invariant, pushing predictions
+    toward whole-object consistency rather than per-pixel agreement.
+    """
+    p = jnp.asarray(jnp.reciprocal(1.0 + jnp.exp(-logits.astype(jnp.float32))))
+    t = targets.astype(jnp.float32)
+    p, t = _flatten_per_image(p), _flatten_per_image(t)
+    inter = (p * t).sum(-1)
+    total = p.sum(-1) + t.sum(-1)
+    return ((total - 2.0 * inter) / (total + eps)).mean()

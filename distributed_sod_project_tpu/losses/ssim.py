@@ -1,0 +1,67 @@
+"""SSIM structural loss (SURVEY.md §2 C8, §7.3 hard part 4).
+
+The BASNet-style hybrid loss uses 1 − SSIM with an 11×11 Gaussian
+window (σ=1.5) computed on sigmoid probabilities.  TPU-first design:
+the windowed means/variances are depthwise convolutions (one fused
+``lax.conv_general_dilated`` with ``feature_group_count=C`` per moment),
+which XLA maps straight onto the MXU; everything reduces in float32.
+
+A hand-fused Pallas variant lives in ``ops/`` for the training hot
+path; this module is the reference implementation the oracle tests pin
+down (torch-cpu oracle in tests/test_losses.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+_C1 = 0.01**2
+_C2 = 0.03**2
+
+
+def gaussian_window(size: int = 11, sigma: float = 1.5, dtype=jnp.float32):
+    """1-D Gaussian taps, normalised to sum 1 (matches the de-facto
+    pytorch_ssim construction: gauss(x) ∝ exp(−(x−⌊s/2⌋)²/2σ²))."""
+    x = jnp.arange(size, dtype=dtype) - size // 2
+    g = jnp.exp(-(x**2) / (2.0 * sigma**2))
+    return g / g.sum()
+
+
+def _blur(x, win1d):
+    """Separable depthwise Gaussian blur, NHWC, 'SAME' zero padding."""
+    c = x.shape[-1]
+    kh = jnp.tile(win1d[:, None, None, None], (1, 1, 1, c))  # HWIO, I=1
+    kw = jnp.tile(win1d[None, :, None, None], (1, 1, 1, c))
+    dn = lax.conv_dimension_numbers(x.shape, kh.shape, ("NHWC", "HWIO", "NHWC"))
+    pad_h = [(win1d.shape[0] // 2,) * 2, (0, 0)]
+    pad_w = [(0, 0), (win1d.shape[0] // 2,) * 2]
+    x = lax.conv_general_dilated(
+        x, kh, (1, 1), pad_h, dimension_numbers=dn, feature_group_count=c
+    )
+    x = lax.conv_general_dilated(
+        x, kw, (1, 1), pad_w, dimension_numbers=dn, feature_group_count=c
+    )
+    return x
+
+
+def ssim(a, b, *, window_size: int = 11, sigma: float = 1.5):
+    """Mean SSIM map between ``a`` and ``b`` (NHWC, any channel count)."""
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    win = gaussian_window(window_size, sigma)
+    mu_a, mu_b = _blur(a, win), _blur(b, win)
+    mu_aa, mu_bb, mu_ab = mu_a * mu_a, mu_b * mu_b, mu_a * mu_b
+    var_a = _blur(a * a, win) - mu_aa
+    var_b = _blur(b * b, win) - mu_bb
+    cov = _blur(a * b, win) - mu_ab
+    num = (2.0 * mu_ab + _C1) * (2.0 * cov + _C2)
+    den = (mu_aa + mu_bb + _C1) * (var_a + var_b + _C2)
+    return (num / den).mean()
+
+
+def ssim_loss(logits, targets, *, window_size: int = 11, sigma: float = 1.5):
+    """1 − SSIM(sigmoid(logits), targets)."""
+    p = jnp.reciprocal(1.0 + jnp.exp(-logits.astype(jnp.float32)))
+    return 1.0 - ssim(p, targets.astype(jnp.float32),
+                      window_size=window_size, sigma=sigma)

@@ -1,0 +1,20 @@
+from .streaming import (
+    FBetaState,
+    fbeta_curve,
+    init_fbeta_state,
+    max_fbeta,
+    update_fbeta_state,
+)
+from .structure import e_measure, s_measure
+from .aggregator import SODMetrics
+
+__all__ = [
+    "FBetaState",
+    "fbeta_curve",
+    "init_fbeta_state",
+    "max_fbeta",
+    "update_fbeta_state",
+    "e_measure",
+    "s_measure",
+    "SODMetrics",
+]

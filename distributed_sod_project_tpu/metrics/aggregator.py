@@ -1,0 +1,57 @@
+"""PySODMetrics-style aggregator (SURVEY.md §2 C10).
+
+Host-level API used by the eval path (test.py): feed per-image
+(pred, gt) pairs at ORIGINAL resolution, read a dict of the standard
+SOD numbers at the end.  Fβ/MAE accumulate through the jnp streaming
+state (device-friendly); S/E-measure are host numpy per image.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .streaming import (
+    FBetaState,
+    init_fbeta_state,
+    max_fbeta,
+    update_fbeta_state,
+    fbeta_curve,
+)
+from .structure import e_measure, s_measure
+
+
+class SODMetrics:
+    def __init__(self, compute_structure: bool = True):
+        self._state: FBetaState = init_fbeta_state()
+        self._compute_structure = compute_structure
+        self._sm: list = []
+        self._em: list = []
+
+    def add(self, pred: np.ndarray, gt: np.ndarray) -> None:
+        """pred in [0,1], gt binary; any of [H,W], [H,W,1]."""
+        p = np.asarray(pred, np.float32).squeeze()
+        g = np.asarray(gt).squeeze()
+        if p.shape != g.shape:
+            raise ValueError(f"pred {p.shape} vs gt {g.shape}")
+        self._state = update_fbeta_state(
+            self._state, p[None, ..., None], g[None, ..., None].astype(np.float32)
+        )
+        if self._compute_structure:
+            self._sm.append(s_measure(p, g))
+            self._em.append(e_measure(p, g))
+
+    def results(self) -> Dict[str, float]:
+        maxf, mae = max_fbeta(self._state)
+        precision, recall, f = fbeta_curve(self._state)
+        out = {
+            "max_fbeta": float(maxf),
+            "mean_fbeta": float(f.mean()),
+            "mae": float(mae),
+            "num_images": int(self._state.count),
+        }
+        if self._compute_structure and self._sm:
+            out["s_measure"] = float(np.mean(self._sm))
+            out["e_measure"] = float(np.mean(self._em))
+        return out

@@ -1,0 +1,81 @@
+"""Device-side streaming SOD metrics (SURVEY.md §2 C10, §5).
+
+The governing quality metric is DUTS-TE max-Fβ + MAE (BASELINE.json:2).
+TPU-first formulation: instead of looping 255 thresholds per image (the
+classic evaluator), each image contributes two 256-bin histograms —
+prediction values quantised to k=⌊p·255⌋ split by ground-truth class.
+Cumulative sums from the top then give TP/FP at every threshold at
+once: O(H·W + 256) per image, fully vectorised, accumulable across
+images/hosts with a single psum.  maxFβ from the streamed state is
+exact (bit-identical to the brute-force 256-threshold sweep — the
+oracle test checks this).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+NUM_BINS = 256
+BETA2 = 0.3  # β² for Fβ, the SOD-standard 0.3
+
+
+class FBetaState(NamedTuple):
+    """Accumulated sufficient statistics; a pytree → psum/checkpoint-able."""
+
+    pos_hist: jnp.ndarray  # [256] prediction-bin counts where gt==1
+    neg_hist: jnp.ndarray  # [256] prediction-bin counts where gt==0
+    mae_sum: jnp.ndarray  # Σ per-image MAE
+    count: jnp.ndarray  # number of images
+
+
+def init_fbeta_state() -> FBetaState:
+    return FBetaState(
+        pos_hist=jnp.zeros((NUM_BINS,), jnp.float32),
+        neg_hist=jnp.zeros((NUM_BINS,), jnp.float32),
+        mae_sum=jnp.zeros((), jnp.float32),
+        count=jnp.zeros((), jnp.float32),
+    )
+
+
+def update_fbeta_state(state: FBetaState, pred, gt) -> FBetaState:
+    """Accumulate a batch.  pred ∈ [0,1] float, gt binary, both [B,H,W,1]
+    (or [B,H,W]); static shapes, no host sync."""
+    p = pred.astype(jnp.float32).reshape(pred.shape[0], -1)
+    t = (gt.astype(jnp.float32) > 0.5).reshape(gt.shape[0], -1)
+    bins = jnp.clip((p * (NUM_BINS - 1)).astype(jnp.int32), 0, NUM_BINS - 1)
+    # Bincount via scatter-add, split by ground-truth class (histograms
+    # are additive across images, so the whole batch merges into one).
+    pos = jnp.zeros((NUM_BINS,), jnp.float32)
+    neg = jnp.zeros((NUM_BINS,), jnp.float32)
+    flat_bins = bins.reshape(-1)
+    flat_t = t.reshape(-1)
+    pos = pos.at[flat_bins].add(flat_t)
+    neg = neg.at[flat_bins].add(1.0 - flat_t)
+    mae = jnp.abs(p - t).mean(axis=-1).sum()
+    return FBetaState(
+        pos_hist=state.pos_hist + pos,
+        neg_hist=state.neg_hist + neg,
+        mae_sum=state.mae_sum + mae,
+        count=state.count + p.shape[0],
+    )
+
+
+def fbeta_curve(state: FBetaState, *, beta2: float = BETA2, eps: float = 1e-8):
+    """Precision/recall/Fβ at every threshold k/255 (prediction ≥ k/255
+    counts as positive).  Returns (precision[256], recall[256], f[256])."""
+    # TP at threshold k = # of positives with bin ≥ k  → reverse cumsum.
+    tp = jnp.cumsum(state.pos_hist[::-1])[::-1]
+    fp = jnp.cumsum(state.neg_hist[::-1])[::-1]
+    n_pos = state.pos_hist.sum()
+    precision = tp / (tp + fp + eps)
+    recall = tp / (n_pos + eps)
+    f = (1.0 + beta2) * precision * recall / (beta2 * precision + recall + eps)
+    return precision, recall, f
+
+
+def max_fbeta(state: FBetaState, *, beta2: float = BETA2):
+    """(max-Fβ, mean MAE) from accumulated state."""
+    _, _, f = fbeta_curve(state, beta2=beta2)
+    return f.max(), state.mae_sum / jnp.maximum(state.count, 1.0)

@@ -1,0 +1,108 @@
+"""S-measure and E-measure (SURVEY.md §2 C10) — host-side per-image.
+
+These run on the eval path only (once per image, not in the train hot
+loop), so they are plain numpy for clarity and easy auditing against
+the published formulations:
+
+- S-measure (Fan et al., ICCV 2017): Sm = α·S_object + (1−α)·S_region,
+  α = 0.5, with the standard degenerate-GT conventions.
+- E-measure (Fan et al., IJCAI 2018): mean enhanced-alignment of the
+  *binarised* (2×mean-pred adaptive threshold variant is NOT used here;
+  this is the curve-free mean-φ over the continuous map convention used
+  by PySODMetrics' `adp=False, curve=False` mean case is intricate —
+  we implement the adaptive-threshold Em, the number usually reported).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _ssim_region(pred: np.ndarray, gt: np.ndarray) -> float:
+    """SSIM-style similarity of one region (means/vars/cov form)."""
+    x, y = pred.astype(np.float64), gt.astype(np.float64)
+    n = x.size
+    if n <= 1:
+        return 1.0
+    mx, my = x.mean(), y.mean()
+    vx = ((x - mx) ** 2).sum() / (n - 1)
+    vy = ((y - my) ** 2).sum() / (n - 1)
+    cxy = ((x - mx) * (y - my)).sum() / (n - 1)
+    alpha = 4.0 * mx * my * cxy
+    beta = (mx**2 + my**2) * (vx + vy)
+    if alpha != 0:
+        return alpha / (beta + 1e-20)
+    return 1.0 if (alpha == 0 and beta == 0) else 0.0
+
+
+def _object_score(x: np.ndarray) -> float:
+    """Object-aware similarity of a (foreground or background) region."""
+    if x.size == 0:
+        return 0.0
+    mean = x.mean()
+    std = x.std()
+    return 2.0 * mean / (mean * mean + 1.0 + std + 1e-20)
+
+
+def s_measure(pred: np.ndarray, gt: np.ndarray, alpha: float = 0.5) -> float:
+    """Structure measure of a single prediction in [0,1] vs binary gt."""
+    pred = np.asarray(pred, np.float64).squeeze()
+    gt = np.asarray(gt).squeeze() > 0.5
+    mu = gt.mean()
+    if mu == 0:  # empty GT → reward all-black prediction
+        return 1.0 - pred.mean()
+    if mu == 1:  # full GT → reward all-white prediction
+        return pred.mean()
+
+    # S_object: fg similarity weighted by μ, bg by (1-μ).
+    s_obj = mu * _object_score(pred[gt]) + (1 - mu) * _object_score(
+        1.0 - pred[~gt]
+    )
+
+    # S_region: split at the GT centroid into 4 quadrants; weighted SSIM.
+    h, w = gt.shape
+    ys, xs = np.nonzero(gt)
+    cy = int(round(ys.mean())) + 1
+    cx = int(round(xs.mean())) + 1
+    cy = min(max(cy, 1), h - 1)
+    cx = min(max(cx, 1), w - 1)
+    quads = [
+        (slice(0, cy), slice(0, cx)),
+        (slice(0, cy), slice(cx, w)),
+        (slice(cy, h), slice(0, cx)),
+        (slice(cy, h), slice(cx, w)),
+    ]
+    total = float(h * w)
+    s_reg = 0.0
+    for sl in quads:
+        g_q, p_q = gt[sl], pred[sl]
+        weight = g_q.size / total
+        s_reg += weight * _ssim_region(p_q, g_q.astype(np.float64))
+
+    score = alpha * s_obj + (1 - alpha) * s_reg
+    return float(max(score, 0.0))
+
+
+def e_measure(pred: np.ndarray, gt: np.ndarray) -> float:
+    """Adaptive-threshold E-measure of one prediction vs binary gt.
+
+    Binarise at 2×mean(pred) (the standard adaptive rule), then compute
+    the enhanced-alignment score φ = (2·a_p·a_g/(a_p²+a_g²)+1)²/4 where
+    a_p/a_g are the bias-from-mean maps of the binarised pred and gt.
+    """
+    pred = np.asarray(pred, np.float64).squeeze()
+    gt_b = np.asarray(gt).squeeze() > 0.5
+    thr = min(2.0 * pred.mean(), 1.0)
+    pb = (pred >= thr).astype(np.float64)
+    g = gt_b.astype(np.float64)
+
+    if gt_b.all():
+        return float(pb.mean())
+    if not gt_b.any():
+        return float(1.0 - pb.mean())
+
+    a_p = pb - pb.mean()
+    a_g = g - g.mean()
+    align = 2.0 * a_p * a_g / (a_p**2 + a_g**2 + 1e-20)
+    phi = (align + 1.0) ** 2 / 4.0
+    return float(phi.mean())

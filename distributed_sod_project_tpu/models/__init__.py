@@ -1,0 +1,3 @@
+from .registry import build_model, list_models, register_model
+
+__all__ = ["build_model", "list_models", "register_model"]

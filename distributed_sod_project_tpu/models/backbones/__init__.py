@@ -1,0 +1,4 @@
+from .vgg import VGG16
+from .resnet import ResNet, ResNet34, ResNet50
+
+__all__ = ["VGG16", "ResNet", "ResNet34", "ResNet50"]

@@ -1,0 +1,50 @@
+"""VGG16 feature-pyramid backbone (SURVEY.md §2 C6).
+
+Returns the 5-level pyramid SOD decoders consume: the last conv of each
+VGG stage, at strides 1/2/4/8/16 relative to the input (for 320×320
+input: 320, 160, 80, 40, 20).  Channels: 64/128/256/512/512.
+
+``use_bn=False`` reproduces the classic torchvision ``vgg16`` layout
+(what MINet-class models load ImageNet weights for);  ``use_bn=True``
+is the ``vgg16_bn`` layout and the better from-scratch default.  Both
+are supported by ``tools/port_torch_weights.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..layers import ConvBNAct, max_pool
+
+# Convs per stage and channel widths of VGG16.
+_STAGES: Sequence[int] = (2, 2, 3, 3, 3)
+_WIDTHS: Sequence[int] = (64, 128, 256, 512, 512)
+
+
+class VGG16(nn.Module):
+    use_bn: bool = True
+    axis_name: Optional[str] = None
+    bn_momentum: float = 0.9
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> List[jnp.ndarray]:
+        feats: List[jnp.ndarray] = []
+        for stage, (n_convs, width) in enumerate(zip(_STAGES, _WIDTHS)):
+            if stage > 0:
+                x = max_pool(x)
+            for _ in range(n_convs):
+                x = ConvBNAct(
+                    width,
+                    use_bn=self.use_bn,
+                    axis_name=self.axis_name,
+                    bn_momentum=self.bn_momentum,
+                    dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                )(x, train=train)
+            feats.append(x)
+        return feats

@@ -1,0 +1,79 @@
+"""Shared NHWC building blocks for the model zoo.
+
+TPU-first conventions used throughout the zoo:
+
+- NHWC layout (the XLA:TPU-native conv layout; channels land on the
+  128-wide lane dimension of the MXU/VPU).
+- ``dtype`` (compute) defaults to bfloat16 with float32 params — convs
+  and matmuls run on the MXU in bf16, BatchNorm statistics and the loss
+  are reduced in float32.
+- Cross-replica BatchNorm via linen's ``axis_name``: inside a
+  ``shard_map`` over the ``data`` mesh axis this psums batch statistics
+  across replicas, which is the XLA-native form of the SyncBN the
+  reference got from DDP (SURVEY.md §2.3, §7.3 hard part 3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+class ConvBNAct(nn.Module):
+    """Conv → (BatchNorm) → (activation), NHWC."""
+
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: int = 1
+    dilation: int = 1
+    use_bn: bool = True
+    act: Optional[Callable] = nn.relu
+    axis_name: Optional[str] = None  # cross-replica BN axis (e.g. "data")
+    bn_momentum: float = 0.9
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=(self.strides, self.strides),
+            kernel_dilation=(self.dilation, self.dilation),
+            padding="SAME",
+            use_bias=not self.use_bn,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(x)
+        if self.use_bn:
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=self.bn_momentum,
+                axis_name=self.axis_name if train else None,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+def max_pool(x, window: int = 2, stride: int = 2):
+    return nn.max_pool(x, (window, window), strides=(stride, stride), padding="SAME")
+
+
+def resize_to(x, hw: Tuple[int, int], method: str = "bilinear"):
+    """Static-shape spatial resize (the upsample path of every decoder)."""
+    import jax
+
+    out = jax.image.resize(x, (x.shape[0], hw[0], hw[1], x.shape[3]), method=method)
+    return out.astype(x.dtype)
+
+
+def upsample_like(x, ref, method: str = "bilinear"):
+    """Resize ``x`` to the spatial size of ``ref``."""
+    return resize_to(x, (ref.shape[1], ref.shape[2]), method=method)

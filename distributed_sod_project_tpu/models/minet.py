@@ -1,0 +1,124 @@
+"""MINet — Multi-scale Interactive Network for salient object detection.
+
+TPU-native re-design of the MINet family (CVPR 2020; reference parity
+target SURVEY.md §2 C5, call stack §3.3 — the reference mount was
+unreadable, so the module structure follows the paper's description):
+
+- backbone (VGG16 / ResNet50) → 5-level feature pyramid
+- AIM (aggregate interaction): each level is fused with its resampled
+  neighbours, so every decoder stage sees multi-scale context
+- SIM (self-interaction): each decoder stage runs a two-resolution
+  branch pair that exchanges information before merging
+- head: single-channel saliency logit at input resolution
+
+Framework conventions: NHWC, bf16 compute / f32 params, every model in
+the zoo returns a *list* of logit maps at input resolution with element
+0 the primary prediction (deep-supervision losses consume the list
+uniformly; MINet has a single output).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .backbones import ResNet50, VGG16
+from .layers import ConvBNAct, max_pool, resize_to, upsample_like
+
+
+class SIM(nn.Module):
+    """Self-interaction module: high-res / low-res branch exchange."""
+
+    width: int
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        kw = dict(axis_name=self.axis_name, dtype=self.dtype,
+                  param_dtype=self.param_dtype)
+        h = ConvBNAct(self.width, (3, 3), **kw)(x, train)
+        l = max_pool(ConvBNAct(self.width // 2, (3, 3), **kw)(x, train))
+        # Exchange: each branch receives the other, resampled.
+        h2 = ConvBNAct(self.width, (3, 3), **kw)(
+            h + upsample_like(ConvBNAct(self.width, (3, 3), **kw)(l, train), h),
+            train,
+        )
+        l2 = ConvBNAct(self.width // 2, (3, 3), **kw)(
+            l + max_pool(ConvBNAct(self.width // 2, (3, 3), **kw)(h, train)),
+            train,
+        )
+        merged = jnp.concatenate([h2, upsample_like(l2, h2)], axis=-1)
+        return ConvBNAct(self.width, (3, 3), **kw)(merged, train)
+
+
+class AIM(nn.Module):
+    """Aggregate interaction: fuse a level with its resampled neighbours."""
+
+    width: int
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, below, cur, above, train: bool = False):
+        kw = dict(axis_name=self.axis_name, dtype=self.dtype,
+                  param_dtype=self.param_dtype)
+        parts = [ConvBNAct(self.width, (3, 3), **kw)(cur, train)]
+        if below is not None:  # finer level → downsample to cur's size
+            b = ConvBNAct(self.width, (3, 3), **kw)(below, train)
+            parts.append(resize_to(b, cur.shape[1:3]))
+        if above is not None:  # coarser level → upsample to cur's size
+            a = ConvBNAct(self.width, (3, 3), **kw)(above, train)
+            parts.append(upsample_like(a, cur))
+        x = jnp.concatenate(parts, axis=-1)
+        return ConvBNAct(self.width, (3, 3), **kw)(x, train)
+
+
+class MINet(nn.Module):
+    backbone: str = "vgg16"
+    width: int = 64
+    axis_name: Optional[str] = None
+    bn_momentum: float = 0.9
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, image, depth=None, *, train: bool = False) -> List[jnp.ndarray]:
+        del depth  # RGB-only model; uniform zoo signature
+        x = image.astype(self.dtype)
+        bkw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                   dtype=self.dtype, param_dtype=self.param_dtype)
+        if self.backbone == "vgg16":
+            feats = VGG16(**bkw)(x, train=train)
+        elif self.backbone == "resnet50":
+            feats = ResNet50(**bkw)(x, train=train)
+        else:
+            raise ValueError(f"MINet: unknown backbone {self.backbone!r}")
+
+        kw = dict(axis_name=self.axis_name, dtype=self.dtype,
+                  param_dtype=self.param_dtype)
+
+        # AIM per level.
+        agg = []
+        for i, f in enumerate(feats):
+            below = feats[i - 1] if i > 0 else None
+            above = feats[i + 1] if i < len(feats) - 1 else None
+            agg.append(AIM(self.width, **kw)(below, f, above, train=train))
+
+        # Top-down decoder with SIM refinement.
+        d = agg[-1]
+        d = SIM(self.width, **kw)(d, train=train)
+        for i in range(len(agg) - 2, -1, -1):
+            d = upsample_like(d, agg[i]) + agg[i]
+            d = SIM(self.width, **kw)(d, train=train)
+
+        # Head → full-resolution single-channel logit.
+        h = ConvBNAct(32, (3, 3), **kw)(d, train=train)
+        logit = nn.Conv(1, (3, 3), padding="SAME", dtype=self.dtype,
+                        param_dtype=self.param_dtype)(h)
+        logit = resize_to(logit, image.shape[1:3]).astype(jnp.float32)
+        return [logit]

@@ -1,0 +1,59 @@
+"""Model zoo registry (SURVEY.md §2 C5).
+
+``build_model(cfg.model)`` maps a ModelConfig onto a constructed linen
+module.  Zoo-wide call convention::
+
+    logits_list = model.apply(variables, image, depth, train=...,
+                              mutable=["batch_stats"] if train else False)
+
+where ``logits_list[0]`` is the primary full-resolution saliency logit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    def deco(builder: Callable):
+        if name in _REGISTRY:
+            raise KeyError(f"model {name!r} already registered")
+        _REGISTRY[name] = builder
+        return builder
+
+    return deco
+
+
+def list_models():
+    return sorted(_REGISTRY)
+
+
+def build_model(model_cfg):
+    """Construct the linen module described by a ModelConfig."""
+    if model_cfg.name not in _REGISTRY:
+        raise KeyError(
+            f"unknown model {model_cfg.name!r}; known: {list_models()}"
+        )
+    dtype = jnp.dtype(model_cfg.compute_dtype)
+    param_dtype = jnp.dtype(model_cfg.param_dtype)
+    axis_name = "data" if model_cfg.sync_bn else None
+    return _REGISTRY[model_cfg.name](
+        model_cfg, dtype=dtype, param_dtype=param_dtype, axis_name=axis_name
+    )
+
+
+@register_model("minet")
+def _build_minet(cfg, *, dtype, param_dtype, axis_name):
+    from .minet import MINet
+
+    return MINet(
+        backbone=cfg.backbone,
+        axis_name=axis_name,
+        bn_momentum=cfg.bn_momentum,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )

@@ -1,18 +1,31 @@
-"""Test harness: force an 8-device virtual CPU platform BEFORE jax
-imports, so mesh/shard_map/psum logic is exercised without TPU hardware
-(SURVEY.md §4, "distributed without a cluster")."""
+"""Test harness: force an 8-virtual-device CPU platform.
+
+CRITICAL environment quirk: this container's ``sitecustomize.py``
+(PYTHONPATH=/root/.axon_site) imports jax at interpreter startup and the
+shell env carries ``JAX_PLATFORMS=axon`` (the remote-TPU tunnel).  By
+the time conftest runs, jax is ALREADY imported with platform=axon, so
+setting ``os.environ`` here is too late for the platform choice — we
+must use ``jax.config.update``.  ``XLA_FLAGS`` is still read lazily at
+first backend init, so setting it here works as long as no test touched
+a backend earlier (pytest imports conftest first).
+
+Running tests on the axon TPU tunnel would be disastrous anyway: eager
+op-by-op dispatch over a TCP relay on a 1-core host (SURVEY.md §4 calls
+for the 8-fake-CPU-device trick instead).
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+import jax  # noqa: E402  (a re-import if sitecustomize already pulled it in)
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "float32")
 
 import pytest  # noqa: E402

@@ -1,0 +1,152 @@
+"""Loss unit tests against torch-cpu oracles (SURVEY.md §4).
+
+torch 2.13-cpu is installed solely as a numerical oracle: each loss is
+re-implemented independently with torch ops inside the test and the jnp
+implementation must match to ~1e-5.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from distributed_sod_project_tpu.losses import (
+    bce_with_logits,
+    cel_loss,
+    deep_supervision_loss,
+    iou_loss,
+    ssim,
+    ssim_loss,
+)
+
+
+@pytest.fixture
+def batch():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(0, 2, size=(3, 24, 24, 1)).astype(np.float32)
+    targets = (rng.random((3, 24, 24, 1)) > 0.6).astype(np.float32)
+    return logits, targets
+
+
+def test_bce_matches_torch(batch):
+    logits, targets = batch
+    ours = float(bce_with_logits(jnp.asarray(logits), jnp.asarray(targets)))
+    ref = float(
+        F.binary_cross_entropy_with_logits(
+            torch.from_numpy(logits), torch.from_numpy(targets)
+        )
+    )
+    assert abs(ours - ref) < 1e-6
+
+
+def test_bce_extreme_logits_stable():
+    logits = jnp.asarray([[100.0, -100.0], [50.0, -50.0]]).reshape(1, 2, 2, 1)
+    targets = jnp.asarray([[1.0, 0.0], [0.0, 1.0]]).reshape(1, 2, 2, 1)
+    val = float(bce_with_logits(logits, targets))
+    assert np.isfinite(val)
+    # elements: (100,1)->0, (-100,0)->0, (50,0)->50, (-50,1)->50
+    assert abs(val - 25.0) < 1e-4
+
+
+def test_iou_matches_torch_oracle(batch):
+    logits, targets = batch
+    ours = float(iou_loss(jnp.asarray(logits), jnp.asarray(targets)))
+    p = torch.sigmoid(torch.from_numpy(logits)).reshape(3, -1)
+    t = torch.from_numpy(targets).reshape(3, -1)
+    inter = (p * t).sum(-1)
+    union = p.sum(-1) + t.sum(-1) - inter
+    ref = float((1 - (inter + 1.0) / (union + 1.0)).mean())
+    assert abs(ours - ref) < 1e-6
+
+
+def test_iou_perfect_prediction_near_zero():
+    t = np.zeros((1, 16, 16, 1), np.float32)
+    t[0, 4:12, 4:12] = 1.0
+    logits = (t * 2 - 1) * 20.0  # ±20 → sigmoid ≈ 0/1
+    assert float(iou_loss(jnp.asarray(logits), jnp.asarray(t))) < 1e-3
+
+
+def test_cel_oracle(batch):
+    logits, targets = batch
+    ours = float(cel_loss(jnp.asarray(logits), jnp.asarray(targets)))
+    p = torch.sigmoid(torch.from_numpy(logits)).reshape(3, -1)
+    t = torch.from_numpy(targets).reshape(3, -1)
+    inter = (p * t).sum(-1)
+    total = p.sum(-1) + t.sum(-1)
+    ref = float(((total - 2 * inter) / (total + 1e-6)).mean())
+    assert abs(ours - ref) < 1e-6
+
+
+def _torch_ssim(a: torch.Tensor, b: torch.Tensor, window_size=11, sigma=1.5):
+    """Independent torch SSIM oracle (separable Gaussian, zero padding)."""
+    coords = torch.arange(window_size, dtype=torch.float32) - window_size // 2
+    g = torch.exp(-(coords**2) / (2 * sigma**2))
+    g = (g / g.sum()).to(a.dtype)
+    c = a.shape[1]
+    kh = g.view(1, 1, -1, 1).repeat(c, 1, 1, 1)
+    kw = g.view(1, 1, 1, -1).repeat(c, 1, 1, 1)
+
+    def blur(x):
+        x = F.conv2d(x, kh, padding=(window_size // 2, 0), groups=c)
+        return F.conv2d(x, kw, padding=(0, window_size // 2), groups=c)
+
+    mu_a, mu_b = blur(a), blur(b)
+    var_a = blur(a * a) - mu_a * mu_a
+    var_b = blur(b * b) - mu_b * mu_b
+    cov = blur(a * b) - mu_a * mu_b
+    C1, C2 = 0.01**2, 0.03**2
+    num = (2 * mu_a * mu_b + C1) * (2 * cov + C2)
+    den = (mu_a**2 + mu_b**2 + C1) * (var_a + var_b + C2)
+    return (num / den).mean()
+
+
+def test_ssim_matches_torch_oracle(batch):
+    logits, targets = batch
+    a = 1.0 / (1.0 + np.exp(-logits))
+    ours = float(ssim(jnp.asarray(a), jnp.asarray(targets)))
+    ref = float(
+        _torch_ssim(
+            torch.from_numpy(a).permute(0, 3, 1, 2),
+            torch.from_numpy(targets).permute(0, 3, 1, 2),
+        )
+    )
+    assert abs(ours - ref) < 1e-5
+
+
+def test_ssim_identity_is_one():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.random((2, 32, 32, 1)).astype(np.float32))
+    assert abs(float(ssim(x, x)) - 1.0) < 1e-5
+
+
+def test_ssim_loss_orders_predictions():
+    """A close prediction must have lower SSIM loss than a bad one."""
+    t = np.zeros((1, 32, 32, 1), np.float32)
+    t[0, 8:24, 8:24] = 1.0
+    good = jnp.asarray((t * 2 - 1) * 10.0)
+    bad = jnp.asarray((-t * 2 + 1) * 10.0)
+    tj = jnp.asarray(t)
+    assert float(ssim_loss(good, tj)) < float(ssim_loss(bad, tj))
+
+
+def test_deep_supervision_sums_levels(batch):
+    logits, targets = batch
+    l1 = jnp.asarray(logits)
+    l2 = jnp.asarray(logits * 0.5)
+    tj = jnp.asarray(targets)
+    total, comps = deep_supervision_loss(
+        [l1, l2], tj, bce_w=1.0, iou_w=1.0, ssim_w=1.0, cel_w=0.0
+    )
+    manual = (
+        bce_with_logits(l1, tj) + bce_with_logits(l2, tj)
+        + iou_loss(l1, tj) + iou_loss(l2, tj)
+        + ssim_loss(l1, tj) + ssim_loss(l2, tj)
+    )
+    assert abs(float(total) - float(manual)) < 1e-5
+    assert set(comps) == {"bce", "iou", "ssim", "total"}
+    # single level with weight 2 on level_weights halves/doubles correctly
+    total_w, _ = deep_supervision_loss(
+        [l1], tj, bce_w=1.0, iou_w=0.0, ssim_w=0.0, level_weights=[2.0]
+    )
+    assert abs(float(total_w) - 2 * float(bce_with_logits(l1, tj))) < 1e-6

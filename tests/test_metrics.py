@@ -1,0 +1,101 @@
+"""Metric tests vs brute-force numpy oracles (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sod_project_tpu.metrics import (
+    SODMetrics,
+    e_measure,
+    init_fbeta_state,
+    max_fbeta,
+    s_measure,
+    update_fbeta_state,
+)
+
+
+def _brute_force_max_fbeta(preds, gts, beta2=0.3, eps=1e-8):
+    """Direct 256-threshold sweep over the dataset-accumulated counts."""
+    best = 0.0
+    for k in range(256):
+        thr = k / 255.0
+        tp = fp = n_pos = 0.0
+        for p, t in zip(preds, gts):
+            binp = p >= thr
+            tp += float((binp & (t > 0.5)).sum())
+            fp += float((binp & ~(t > 0.5)).sum())
+            n_pos += float((t > 0.5).sum())
+        prec = tp / (tp + fp + eps)
+        rec = tp / (n_pos + eps)
+        f = (1 + beta2) * prec * rec / (beta2 * prec + rec + eps)
+        best = max(best, f)
+    return best
+
+
+def test_streaming_max_fbeta_matches_brute_force():
+    rng = np.random.default_rng(0)
+    preds = [rng.random((20, 24)).astype(np.float32) for _ in range(3)]
+    gts = [(rng.random((20, 24)) > 0.5).astype(np.float32) for _ in range(3)]
+    # Quantise preds to the 255 grid so brute-force thresholds are exact.
+    preds = [np.round(p * 255) / 255 for p in preds]
+
+    state = init_fbeta_state()
+    for p, t in zip(preds, gts):
+        state = update_fbeta_state(state, jnp.asarray(p[None, ..., None]),
+                                   jnp.asarray(t[None, ..., None]))
+    maxf, mae = max_fbeta(state)
+    ref = _brute_force_max_fbeta(preds, gts)
+    assert abs(float(maxf) - ref) < 1e-5
+    ref_mae = np.mean([np.abs(p - t).mean() for p, t in zip(preds, gts)])
+    assert abs(float(mae) - ref_mae) < 1e-6
+
+
+def test_perfect_prediction_metrics():
+    gt = np.zeros((32, 32), np.float32)
+    gt[8:24, 8:24] = 1.0
+    state = update_fbeta_state(
+        init_fbeta_state(), jnp.asarray(gt[None, ..., None]),
+        jnp.asarray(gt[None, ..., None])
+    )
+    maxf, mae = max_fbeta(state)
+    assert float(maxf) > 0.999
+    assert float(mae) < 1e-6
+    assert s_measure(gt, gt) > 0.95
+    assert e_measure(gt, gt) > 0.95
+
+
+def test_inverted_prediction_scores_low():
+    gt = np.zeros((32, 32), np.float32)
+    gt[8:24, 8:24] = 1.0
+    inv = 1.0 - gt
+    assert s_measure(inv, gt) < 0.35
+    assert e_measure(inv, gt) < 0.35
+
+
+def test_s_measure_degenerate_gt():
+    empty = np.zeros((16, 16), np.float32)
+    full = np.ones((16, 16), np.float32)
+    assert s_measure(empty, empty) == 1.0  # black pred on empty gt
+    assert s_measure(full, empty) == 0.0
+    assert s_measure(full, full) == 1.0
+    assert s_measure(empty, full) == 0.0
+
+
+def test_aggregator_end_to_end():
+    rng = np.random.default_rng(3)
+    m = SODMetrics()
+    for _ in range(4):
+        gt = (rng.random((24, 24)) > 0.6).astype(np.float32)
+        noise = rng.normal(0, 0.15, gt.shape)
+        pred = np.clip(gt * 0.8 + 0.1 + noise, 0, 1).astype(np.float32)
+        m.add(pred, gt)
+    res = m.results()
+    assert res["num_images"] == 4
+    assert 0.5 < res["max_fbeta"] <= 1.0
+    assert 0.0 <= res["mae"] < 0.5
+    assert "s_measure" in res and "e_measure" in res
+    # good predictions beat random ones
+    m2 = SODMetrics()
+    for _ in range(4):
+        gt = (rng.random((24, 24)) > 0.6).astype(np.float32)
+        m2.add(rng.random((24, 24)).astype(np.float32), gt)
+    assert res["max_fbeta"] > m2.results()["max_fbeta"]

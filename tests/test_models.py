@@ -1,0 +1,105 @@
+"""Model zoo tests: forward shapes + finite loss/grad smoke (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sod_project_tpu.configs import get_config
+from distributed_sod_project_tpu.models import build_model
+from distributed_sod_project_tpu.models.backbones import ResNet34, ResNet50, VGG16
+
+
+@pytest.mark.parametrize("size", [(64, 64), (96, 64)])
+def test_vgg16_pyramid_shapes(size):
+    h, w = size
+    m = VGG16()
+    x = jnp.zeros((2, h, w, 3))
+    vars_ = m.init(jax.random.key(0), x)
+    feats = m.apply(vars_, x)
+    assert len(feats) == 5
+    widths = (64, 128, 256, 512, 512)
+    for i, (f, c) in enumerate(zip(feats, widths)):
+        s = 2**i
+        assert f.shape == (2, h // s, w // s, c), f"level {i}: {f.shape}"
+
+
+def test_resnet50_pyramid_shapes():
+    m = ResNet50()
+    x = jnp.zeros((1, 64, 64, 3))
+    feats = m.apply(m.init(jax.random.key(0), x), x)
+    shapes = [f.shape for f in feats]
+    assert shapes == [
+        (1, 32, 32, 64),
+        (1, 16, 16, 256),
+        (1, 8, 8, 512),
+        (1, 4, 4, 1024),
+        (1, 2, 2, 2048),
+    ]
+
+
+def test_resnet34_pyramid_shapes():
+    m = ResNet34()
+    x = jnp.zeros((1, 64, 64, 3))
+    feats = m.apply(m.init(jax.random.key(0), x), x)
+    assert [f.shape[-1] for f in feats] == [64, 64, 128, 256, 512]
+
+
+@pytest.mark.parametrize("config_name", ["minet_vgg16_ref"])
+def test_model_forward_from_config(config_name):
+    cfg = get_config(config_name)
+    model = build_model(cfg.model.__class__(
+        name=cfg.model.name, backbone=cfg.model.backbone, sync_bn=False,
+        compute_dtype="float32"))
+    x = jnp.zeros((1, 64, 64, 3))
+    vars_ = model.init(jax.random.key(0), x, train=False)
+    outs = model.apply(vars_, x, train=False)
+    assert isinstance(outs, list) and len(outs) >= 1
+    assert outs[0].shape == (1, 64, 64, 1)
+    assert outs[0].dtype == jnp.float32
+
+
+def test_minet_train_mode_updates_batch_stats_and_grads_finite():
+    cfg = get_config("minet_vgg16_ref")
+    model = build_model(cfg.model.__class__(
+        name="minet", backbone="vgg16", sync_bn=False, compute_dtype="float32"))
+    rng = jax.random.key(1)
+    x = jax.random.normal(rng, (2, 64, 64, 3))
+    y = (jax.random.uniform(rng, (2, 64, 64, 1)) > 0.5).astype(jnp.float32)
+    vars_ = model.init(rng, x, train=True)
+
+    def loss_fn(params):
+        outs, new_state = model.apply(
+            {"params": params, "batch_stats": vars_["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+        )
+        logit = outs[0]
+        loss = jnp.mean(
+            jnp.maximum(logit, 0) - logit * y + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        )
+        return loss, new_state
+
+    (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        vars_["params"]
+    )
+    assert np.isfinite(float(loss))
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(g)) for g in flat)
+    assert any(float(jnp.abs(g).max()) > 0 for g in flat)
+    # batch_stats actually changed
+    old = jax.tree_util.tree_leaves(vars_["batch_stats"])
+    new = jax.tree_util.tree_leaves(new_state["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))
+
+
+def test_minet_bf16_compute_keeps_f32_output():
+    cfg = get_config("minet_vgg16_ref")
+    model = build_model(cfg.model.__class__(
+        name="minet", backbone="vgg16", sync_bn=False, compute_dtype="bfloat16"))
+    x = jnp.zeros((1, 32, 32, 3))
+    vars_ = model.init(jax.random.key(0), x, train=False)
+    outs = model.apply(vars_, x, train=False)
+    assert outs[0].dtype == jnp.float32
+    # params stay f32
+    p = jax.tree_util.tree_leaves(vars_["params"])
+    assert all(a.dtype == jnp.float32 for a in p)
