@@ -34,6 +34,8 @@ class ModelConfig:
 
     name: str = "minet"  # minet | hdfnet | u2net | basnet | swin_sod
     backbone: str = "vgg16"  # vgg16 | resnet50 | swin_t | none (u2net is self-contained)
+    backbone_bn: bool = True  # False → classic torchvision VGG16 layout
+    #   (the tree ImageNet weight porting targets; see backbones/vgg.py)
     out_stride: int = 1  # saliency logits at input resolution
     sync_bn: bool = True  # cross-replica BatchNorm stats over the data axis
     bn_momentum: float = 0.9

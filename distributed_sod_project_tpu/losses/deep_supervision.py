@@ -9,7 +9,7 @@ costs one fused reduction pass, not N kernel launches.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -41,8 +41,6 @@ def deep_supervision_loss(
 
     def add(name, value, weight):
         nonlocal total
-        if weight == 0.0:
-            return
         comps[name] = comps.get(name, jnp.float32(0.0)) + value
         total = total + weight * value
 

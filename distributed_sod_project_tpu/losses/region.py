@@ -4,6 +4,7 @@ the MINet paper — reference unreadable, see SURVEY.md banner)."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -13,7 +14,7 @@ def _flatten_per_image(x):
 
 def iou_loss(logits, targets, *, eps: float = 1.0):
     """Soft Jaccard loss, per image then averaged: 1 − (∩+ε)/(∪+ε)."""
-    p = jnp.asarray(jnp.reciprocal(1.0 + jnp.exp(-logits.astype(jnp.float32))))
+    p = jax.nn.sigmoid(logits.astype(jnp.float32))
     t = targets.astype(jnp.float32)
     p, t = _flatten_per_image(p), _flatten_per_image(t)
     inter = (p * t).sum(-1)
@@ -30,7 +31,7 @@ def cel_loss(logits, targets, *, eps: float = 1e-6):
     averaged.  Differentiable and scale-invariant, pushing predictions
     toward whole-object consistency rather than per-pixel agreement.
     """
-    p = jnp.asarray(jnp.reciprocal(1.0 + jnp.exp(-logits.astype(jnp.float32))))
+    p = jax.nn.sigmoid(logits.astype(jnp.float32))
     t = targets.astype(jnp.float32)
     p, t = _flatten_per_image(p), _flatten_per_image(t)
     inter = (p * t).sum(-1)

@@ -13,6 +13,7 @@ down (torch-cpu oracle in tests/test_losses.py).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -62,6 +63,6 @@ def ssim(a, b, *, window_size: int = 11, sigma: float = 1.5):
 
 def ssim_loss(logits, targets, *, window_size: int = 11, sigma: float = 1.5):
     """1 − SSIM(sigmoid(logits), targets)."""
-    p = jnp.reciprocal(1.0 + jnp.exp(-logits.astype(jnp.float32)))
+    p = jax.nn.sigmoid(logits.astype(jnp.float32))
     return 1.0 - ssim(p, targets.astype(jnp.float32),
                       window_size=window_size, sigma=sigma)

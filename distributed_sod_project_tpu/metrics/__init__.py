@@ -3,6 +3,7 @@ from .streaming import (
     fbeta_curve,
     init_fbeta_state,
     max_fbeta,
+    mean_fbeta_curve,
     update_fbeta_state,
 )
 from .structure import e_measure, s_measure
@@ -13,6 +14,7 @@ __all__ = [
     "fbeta_curve",
     "init_fbeta_state",
     "max_fbeta",
+    "mean_fbeta_curve",
     "update_fbeta_state",
     "e_measure",
     "s_measure",

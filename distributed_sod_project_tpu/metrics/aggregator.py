@@ -15,9 +15,8 @@ import numpy as np
 from .streaming import (
     FBetaState,
     init_fbeta_state,
-    max_fbeta,
+    mean_fbeta_curve,
     update_fbeta_state,
-    fbeta_curve,
 )
 from .structure import e_measure, s_measure
 
@@ -43,12 +42,12 @@ class SODMetrics:
             self._em.append(e_measure(p, g))
 
     def results(self) -> Dict[str, float]:
-        maxf, mae = max_fbeta(self._state)
-        precision, recall, f = fbeta_curve(self._state)
+        f = mean_fbeta_curve(self._state)  # macro curve, one finalise pass
+        n = max(float(self._state.count), 1.0)
         out = {
-            "max_fbeta": float(maxf),
+            "max_fbeta": float(f.max()),
             "mean_fbeta": float(f.mean()),
-            "mae": float(mae),
+            "mae": float(self._state.mae_sum) / n,
             "num_images": int(self._state.count),
         }
         if self._compute_structure and self._sm:

@@ -1,20 +1,25 @@
 """Device-side streaming SOD metrics (SURVEY.md §2 C10, §5).
 
 The governing quality metric is DUTS-TE max-Fβ + MAE (BASELINE.json:2).
-TPU-first formulation: instead of looping 255 thresholds per image (the
-classic evaluator), each image contributes two 256-bin histograms —
-prediction values quantised to k=⌊p·255⌋ split by ground-truth class.
-Cumulative sums from the top then give TP/FP at every threshold at
-once: O(H·W + 256) per image, fully vectorised, accumulable across
-images/hosts with a single psum.  maxFβ from the streamed state is
-exact (bit-identical to the brute-force 256-threshold sweep — the
-oracle test checks this).
+Convention note: the standard SOD evaluator (PySODMetrics) is
+**macro-averaged** — a 256-threshold Fβ curve is computed per image,
+curves are averaged over the dataset, and max-Fβ is the max of the mean
+curve.  That is what ``max_fbeta`` returns.
+
+TPU-first formulation: instead of looping 255 thresholds per image, each
+image contributes a 256-bin prediction histogram split by ground-truth
+class (k=⌊p·255⌋); reverse cumulative sums give TP/FP at every threshold
+at once, so the per-image curve is O(H·W + 256) and fully vectorised.
+The streamed state is a small pytree — accumulable across batches and
+hosts with a single psum — holding the per-image curve sum (macro) plus
+dataset-pooled histograms (micro, kept for diagnostics).
 """
 
 from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 NUM_BINS = 256
@@ -24,14 +29,16 @@ BETA2 = 0.3  # β² for Fβ, the SOD-standard 0.3
 class FBetaState(NamedTuple):
     """Accumulated sufficient statistics; a pytree → psum/checkpoint-able."""
 
-    pos_hist: jnp.ndarray  # [256] prediction-bin counts where gt==1
-    neg_hist: jnp.ndarray  # [256] prediction-bin counts where gt==0
+    f_curve_sum: jnp.ndarray  # [256] Σ over images of per-image Fβ curves
+    pos_hist: jnp.ndarray  # [256] pooled prediction-bin counts where gt==1
+    neg_hist: jnp.ndarray  # [256] pooled prediction-bin counts where gt==0
     mae_sum: jnp.ndarray  # Σ per-image MAE
     count: jnp.ndarray  # number of images
 
 
 def init_fbeta_state() -> FBetaState:
     return FBetaState(
+        f_curve_sum=jnp.zeros((NUM_BINS,), jnp.float32),
         pos_hist=jnp.zeros((NUM_BINS,), jnp.float32),
         neg_hist=jnp.zeros((NUM_BINS,), jnp.float32),
         mae_sum=jnp.zeros((), jnp.float32),
@@ -39,43 +46,60 @@ def init_fbeta_state() -> FBetaState:
     )
 
 
-def update_fbeta_state(state: FBetaState, pred, gt) -> FBetaState:
-    """Accumulate a batch.  pred ∈ [0,1] float, gt binary, both [B,H,W,1]
-    (or [B,H,W]); static shapes, no host sync."""
-    p = pred.astype(jnp.float32).reshape(pred.shape[0], -1)
-    t = (gt.astype(jnp.float32) > 0.5).reshape(gt.shape[0], -1)
-    bins = jnp.clip((p * (NUM_BINS - 1)).astype(jnp.int32), 0, NUM_BINS - 1)
-    # Bincount via scatter-add, split by ground-truth class (histograms
-    # are additive across images, so the whole batch merges into one).
-    pos = jnp.zeros((NUM_BINS,), jnp.float32)
-    neg = jnp.zeros((NUM_BINS,), jnp.float32)
-    flat_bins = bins.reshape(-1)
-    flat_t = t.reshape(-1)
-    pos = pos.at[flat_bins].add(flat_t)
-    neg = neg.at[flat_bins].add(1.0 - flat_t)
-    mae = jnp.abs(p - t).mean(axis=-1).sum()
-    return FBetaState(
-        pos_hist=state.pos_hist + pos,
-        neg_hist=state.neg_hist + neg,
-        mae_sum=state.mae_sum + mae,
-        count=state.count + p.shape[0],
-    )
-
-
-def fbeta_curve(state: FBetaState, *, beta2: float = BETA2, eps: float = 1e-8):
-    """Precision/recall/Fβ at every threshold k/255 (prediction ≥ k/255
-    counts as positive).  Returns (precision[256], recall[256], f[256])."""
-    # TP at threshold k = # of positives with bin ≥ k  → reverse cumsum.
-    tp = jnp.cumsum(state.pos_hist[::-1])[::-1]
-    fp = jnp.cumsum(state.neg_hist[::-1])[::-1]
-    n_pos = state.pos_hist.sum()
+def _curves_from_hists(pos, neg, *, beta2: float, eps: float):
+    """(precision, recall, f) curves from class-split histograms; works
+    for one pooled histogram [256] or a batch of per-image ones [B,256].
+    Threshold convention: prediction ≥ k/255 counts as positive, so TP at
+    threshold k is a reverse cumulative sum over bins."""
+    tp = jnp.cumsum(pos[..., ::-1], axis=-1)[..., ::-1]
+    fp = jnp.cumsum(neg[..., ::-1], axis=-1)[..., ::-1]
+    n_pos = pos.sum(axis=-1, keepdims=pos.ndim > 1)
     precision = tp / (tp + fp + eps)
     recall = tp / (n_pos + eps)
     f = (1.0 + beta2) * precision * recall / (beta2 * precision + recall + eps)
     return precision, recall, f
 
 
-def max_fbeta(state: FBetaState, *, beta2: float = BETA2):
-    """(max-Fβ, mean MAE) from accumulated state."""
-    _, _, f = fbeta_curve(state, beta2=beta2)
+def update_fbeta_state(
+    state: FBetaState, pred, gt, *, beta2: float = BETA2, eps: float = 1e-8
+) -> FBetaState:
+    """Accumulate a batch.  pred ∈ [0,1] float, gt binary, both [B,H,W,1]
+    (or [B,H,W]); static shapes, no host sync."""
+    p = pred.astype(jnp.float32).reshape(pred.shape[0], -1)
+    t = (gt.astype(jnp.float32) > 0.5).reshape(gt.shape[0], -1).astype(jnp.float32)
+    bins = jnp.clip((p * (NUM_BINS - 1)).astype(jnp.int32), 0, NUM_BINS - 1)
+
+    def hists(b, tt):
+        pos = jnp.zeros((NUM_BINS,), jnp.float32).at[b].add(tt)
+        neg = jnp.zeros((NUM_BINS,), jnp.float32).at[b].add(1.0 - tt)
+        return pos, neg
+
+    pos_b, neg_b = jax.vmap(hists)(bins, t)  # [B,256] each
+    _, _, f_b = _curves_from_hists(pos_b, neg_b, beta2=beta2, eps=eps)
+    mae = jnp.abs(p - t).mean(axis=-1).sum()
+    return FBetaState(
+        f_curve_sum=state.f_curve_sum + f_b.sum(axis=0),
+        pos_hist=state.pos_hist + pos_b.sum(axis=0),
+        neg_hist=state.neg_hist + neg_b.sum(axis=0),
+        mae_sum=state.mae_sum + mae,
+        count=state.count + p.shape[0],
+    )
+
+
+def fbeta_curve(state: FBetaState, *, beta2: float = BETA2, eps: float = 1e-8):
+    """Dataset-POOLED (micro) precision/recall/Fβ curves — diagnostics
+    only; the headline number is the macro ``max_fbeta`` below."""
+    return _curves_from_hists(
+        state.pos_hist, state.neg_hist, beta2=beta2, eps=eps
+    )
+
+
+def mean_fbeta_curve(state: FBetaState) -> jnp.ndarray:
+    """Macro (per-image-averaged) Fβ curve — PySODMetrics convention."""
+    return state.f_curve_sum / jnp.maximum(state.count, 1.0)
+
+
+def max_fbeta(state: FBetaState):
+    """(macro max-Fβ, mean MAE) from accumulated state."""
+    f = mean_fbeta_curve(state)
     return f.max(), state.mae_sum / jnp.maximum(state.count, 1.0)

@@ -80,6 +80,7 @@ class AIM(nn.Module):
 
 class MINet(nn.Module):
     backbone: str = "vgg16"
+    backbone_bn: bool = True  # False → torchvision vgg16 layout for weight porting
     width: int = 64
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
@@ -93,7 +94,7 @@ class MINet(nn.Module):
         bkw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
                    dtype=self.dtype, param_dtype=self.param_dtype)
         if self.backbone == "vgg16":
-            feats = VGG16(**bkw)(x, train=train)
+            feats = VGG16(use_bn=self.backbone_bn, **bkw)(x, train=train)
         elif self.backbone == "resnet50":
             feats = ResNet50(**bkw)(x, train=train)
         else:

@@ -52,6 +52,7 @@ def _build_minet(cfg, *, dtype, param_dtype, axis_name):
 
     return MINet(
         backbone=cfg.backbone,
+        backbone_bn=cfg.backbone_bn,
         axis_name=axis_name,
         bn_momentum=cfg.bn_momentum,
         dtype=dtype,

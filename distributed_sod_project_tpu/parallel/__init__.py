@@ -1,0 +1,21 @@
+from .mesh import (
+    MeshAxes,
+    make_mesh,
+    batch_spec,
+    replicated_spec,
+    batch_sharding,
+    replicated_sharding,
+    host_shard,
+    global_batch_array,
+)
+
+__all__ = [
+    "MeshAxes",
+    "make_mesh",
+    "batch_spec",
+    "replicated_spec",
+    "batch_sharding",
+    "replicated_sharding",
+    "host_shard",
+    "global_batch_array",
+]

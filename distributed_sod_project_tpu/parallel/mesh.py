@@ -1,0 +1,105 @@
+"""Device mesh construction + sharding rules.
+
+This module is the TPU-native replacement for the reference's entire
+distributed runtime (SURVEY.md §2 C3/C4: ``init_process_group('nccl')``,
+``DistributedDataParallel``, ``DistributedSampler``).  There is no
+hand-written communication backend: the "backend" is a
+``jax.sharding.Mesh`` plus the PartitionSpecs below; XLA emits the
+collectives (psum over ICI within a host/pod slice, DCN across hosts)
+when the train step is compiled (SURVEY.md §5 "distributed communication
+backend").
+
+Axes (SURVEY.md §2.3):
+
+- ``data``  — the load-bearing axis: batch-sharded inputs, replicated
+  params, gradient psum.  Parity with the reference's DDP.
+- ``model`` — tensor-parallel axis for the Swin attention heads
+  (stretch config); size 1 in every DP config.
+- ``seq``   — sequence/context-parallel axis (ring attention); size 1
+  for the 320×320 CNN zoo.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxes: Tuple[str, str, str] = ("data", "model", "seq")
+
+
+def _resolve_axis_sizes(n_devices: int, data: int, model: int, seq: int):
+    sizes = {"data": data, "model": model, "seq": seq}
+    wild = [k for k, v in sizes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {sizes}")
+    fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+    if wild:
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes {sizes}"
+            )
+        sizes[wild[0]] = n_devices // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n_devices:
+        raise ValueError(
+            f"mesh {sizes} wants {total} devices, have {n_devices}"
+        )
+    return sizes["data"], sizes["model"], sizes["seq"]
+
+
+def make_mesh(
+    mesh_cfg=None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the (data, model, seq) mesh.
+
+    Axis order puts ``model``/``seq`` innermost so tensor/sequence
+    shards land on ICI-adjacent chips and the (large, per-step) DP
+    gradient psum rides the remaining links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    data = getattr(mesh_cfg, "data", -1) if mesh_cfg is not None else -1
+    model = getattr(mesh_cfg, "model", 1) if mesh_cfg is not None else 1
+    seq = getattr(mesh_cfg, "seq", 1) if mesh_cfg is not None else 1
+    d, m, s = _resolve_axis_sizes(len(devices), data, model, seq)
+    arr = np.asarray(devices).reshape(d, m, s)
+    return Mesh(arr, MeshAxes)
+
+
+def batch_spec() -> P:
+    """Batch dim sharded over ``data``; everything else replicated."""
+    return P("data")
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, replicated_spec())
+
+
+def host_shard() -> Tuple[int, int]:
+    """(shard_id, num_shards) for the host data pipeline — the analogue
+    of the reference's ``DistributedSampler(rank, world_size)``, except
+    sharding is per-*host* (each host feeds all its local devices)."""
+    return jax.process_index(), jax.process_count()
+
+
+def global_batch_array(batch, mesh: Mesh):
+    """Assemble per-host numpy batches into global batch-sharded
+    ``jax.Array``s (multi-host: each host contributes its slice via
+    ``make_array_from_process_local_data``; single-host this is just a
+    sharded device_put)."""
+    sharding = batch_sharding(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )

@@ -1,0 +1,13 @@
+from .state import TrainState, create_train_state
+from .schedules import build_schedule
+from .optim import build_optimizer
+from .step import make_train_step, make_eval_step
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "build_schedule",
+    "build_optimizer",
+    "make_train_step",
+    "make_eval_step",
+]

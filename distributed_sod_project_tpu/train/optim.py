@@ -1,0 +1,55 @@
+"""Optimizer factory (SURVEY.md §2 C9).
+
+SGD(momentum=0.9, nesterov, wd=5e-4) with poly decay is the reference
+regime; AdamW is provided for the Swin config.  Weight decay is applied
+as decoupled ``add_decayed_weights`` masked to exclude BatchNorm
+scale/bias and conv biases (the reference's torch SGD decays everything;
+masking norms is strictly better and standard for from-scratch runs).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import optax
+
+from .schedules import build_schedule
+
+
+def _decay_mask(params):
+    """True for leaves that should receive weight decay: rank>=2 kernels
+    (conv/dense); False for biases and norm scales (rank<=1)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda p: p.ndim >= 2, params)
+
+
+def build_optimizer(
+    optim_cfg, total_steps: int
+) -> Tuple[optax.GradientTransformation, optax.Schedule]:
+    schedule = build_schedule(optim_cfg, total_steps)
+    parts = []
+    if optim_cfg.grad_clip_norm and optim_cfg.grad_clip_norm > 0:
+        parts.append(optax.clip_by_global_norm(optim_cfg.grad_clip_norm))
+    if optim_cfg.optimizer == "sgd":
+        if optim_cfg.weight_decay:
+            parts.append(
+                optax.add_decayed_weights(optim_cfg.weight_decay, _decay_mask)
+            )
+        if optim_cfg.momentum:
+            parts.append(
+                optax.trace(
+                    decay=optim_cfg.momentum, nesterov=optim_cfg.nesterov
+                )
+            )
+        parts.append(optax.scale_by_learning_rate(schedule))
+    elif optim_cfg.optimizer == "adamw":
+        parts.append(optax.scale_by_adam())
+        if optim_cfg.weight_decay:
+            parts.append(
+                optax.add_decayed_weights(optim_cfg.weight_decay, _decay_mask)
+            )
+        parts.append(optax.scale_by_learning_rate(schedule))
+    else:
+        raise ValueError(f"unknown optimizer {optim_cfg.optimizer!r}")
+    return optax.chain(*parts), schedule

@@ -1,0 +1,36 @@
+"""LR schedules (SURVEY.md §2 C9).
+
+The reference idiom is poly decay ``lr·(1 − iter/max_iter)^0.9`` with
+optional warmup; cosine and constant are provided for the zoo configs.
+Schedules are pure ``step -> lr`` functions, so they trace into the
+compiled train step (the LR is computed on device, not fed from host).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def build_schedule(optim_cfg, total_steps: int) -> optax.Schedule:
+    if total_steps <= 0:
+        raise ValueError(f"total_steps must be positive, got {total_steps}")
+    warmup = int(optim_cfg.warmup_steps)
+    decay_steps = max(total_steps - warmup, 1)
+    kind = optim_cfg.schedule
+    if kind == "poly":
+        main = optax.polynomial_schedule(
+            init_value=optim_cfg.lr,
+            end_value=0.0,
+            power=optim_cfg.poly_power,
+            transition_steps=decay_steps,
+        )
+    elif kind == "cosine":
+        main = optax.cosine_decay_schedule(optim_cfg.lr, decay_steps)
+    elif kind == "constant":
+        main = optax.constant_schedule(optim_cfg.lr)
+    else:
+        raise ValueError(f"unknown schedule {kind!r}")
+    if warmup > 0:
+        ramp = optax.linear_schedule(0.0, optim_cfg.lr, warmup)
+        return optax.join_schedules([ramp, main], [warmup])
+    return main

@@ -1,0 +1,52 @@
+"""Train state — the single pytree the compiled step transforms.
+
+Replaces the reference's mutable trio (model.state_dict(), optimizer
+state, epoch counter; SURVEY.md §2 C11, §3.4) with one immutable pytree:
+``train_step(state, batch) -> state`` with the input buffers donated, so
+XLA updates parameters in place in HBM.
+
+Static callables (``apply_fn``, the optax transform) live in closures,
+NOT in the state, so the state is a pure array pytree — directly
+serializable by orbax and shardable by pjit without pytree surgery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray            # i32 scalar
+    params: Any                  # f32 param pytree
+    batch_stats: Any             # BatchNorm running stats (f32)
+    opt_state: Any               # optax state
+
+    def variables(self) -> Dict[str, Any]:
+        return {"params": self.params, "batch_stats": self.batch_stats}
+
+
+def create_train_state(rng, model, tx, sample_batch) -> TrainState:
+    """Initialise params/batch_stats from one (host-side) sample batch
+    and wrap them with the optimizer's initial state."""
+    image = jnp.asarray(sample_batch["image"])
+    depth = sample_batch.get("depth")
+    if depth is not None:
+        depth = jnp.asarray(depth)
+    variables = model.init(rng, image, depth, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+    )
+
+
+def param_count(state: TrainState) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(state.params))

@@ -1,0 +1,125 @@
+"""The compiled train/eval steps — the whole hot loop as one XLA program.
+
+The reference's inner loop (SURVEY.md §3.1: H2D copy → cuDNN forward →
+loss → backward with DDP's bucketed NCCL allreduce → SGD step) is here a
+single ``jit(shard_map(step))`` call: forward, loss, backward, the
+gradient/BN-stat ``pmean`` over the ``data`` mesh axis, and the
+optimizer update all fuse into one compiled program per step, with the
+state donated so parameters update in place in HBM.
+
+``shard_map`` (not bare jit-with-shardings) so the mesh axes are
+*named* inside the step: linen BatchNorm psums its batch statistics
+over ``data`` (cross-replica SyncBN, SURVEY.md §7.3 hard part 3) and
+the gradient ``pmean`` is explicit rather than inferred.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..losses import deep_supervision_loss
+from .state import TrainState
+
+
+def _loss_kwargs(loss_cfg) -> Dict[str, Any]:
+    return dict(
+        bce_w=loss_cfg.bce,
+        iou_w=loss_cfg.iou,
+        ssim_w=loss_cfg.ssim,
+        cel_w=loss_cfg.cel,
+        ssim_window=loss_cfg.ssim_window,
+    )
+
+
+def make_train_step(
+    model,
+    loss_cfg,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    schedule: Optional[optax.Schedule] = None,
+    donate: bool = True,
+) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Build ``(state, batch) -> (state, metrics)``.
+
+    Sharding contract: ``state`` replicated (P()), every ``batch`` leaf
+    batch-sharded (P('data')); metrics come back replicated scalars.
+    """
+    lkw = _loss_kwargs(loss_cfg)
+
+    def step_fn(state: TrainState, batch):
+        rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), state.step),
+            lax.axis_index("data"),
+        )
+
+        def loss_fn(params):
+            outs, mut = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                batch["image"],
+                batch.get("depth"),
+                train=True,
+                mutable=["batch_stats"],
+                rngs={"dropout": rng},
+            )
+            total, comps = deep_supervision_loss(outs, batch["mask"], **lkw)
+            return total, (comps, mut.get("batch_stats", state.batch_stats))
+
+        grads, (comps, new_stats) = jax.grad(loss_fn, has_aux=True)(state.params)
+        # DP allreduce — the reference's NCCL bucketed allreduce, as one
+        # in-program pmean XLA schedules against the backward pass.
+        grads = lax.pmean(grads, "data")
+        comps = lax.pmean(comps, "data")
+
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        metrics = dict(comps)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        if schedule is not None:
+            metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        step_fn,
+        mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(model, mesh: Mesh) -> Callable:
+    """Build ``(state, batch) -> probs``: forward-only, running BN stats,
+    sigmoid on the primary logit.  Output stays batch-sharded — the eval
+    loop gathers per-host slices for metric accumulation."""
+
+    def eval_fn(state: TrainState, batch):
+        outs = model.apply(
+            state.variables(),
+            batch["image"],
+            batch.get("depth"),
+            train=False,
+        )
+        return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
+
+    sharded = jax.shard_map(
+        eval_fn,
+        mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=P("data"),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

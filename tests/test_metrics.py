@@ -14,21 +14,22 @@ from distributed_sod_project_tpu.metrics import (
 
 
 def _brute_force_max_fbeta(preds, gts, beta2=0.3, eps=1e-8):
-    """Direct 256-threshold sweep over the dataset-accumulated counts."""
-    best = 0.0
-    for k in range(256):
-        thr = k / 255.0
-        tp = fp = n_pos = 0.0
-        for p, t in zip(preds, gts):
+    """Macro (PySODMetrics) convention: per-image 256-threshold Fβ
+    curves, averaged over images, then max of the mean curve."""
+    curves = []
+    for p, t in zip(preds, gts):
+        curve = []
+        for k in range(256):
+            thr = k / 255.0
             binp = p >= thr
-            tp += float((binp & (t > 0.5)).sum())
-            fp += float((binp & ~(t > 0.5)).sum())
-            n_pos += float((t > 0.5).sum())
-        prec = tp / (tp + fp + eps)
-        rec = tp / (n_pos + eps)
-        f = (1 + beta2) * prec * rec / (beta2 * prec + rec + eps)
-        best = max(best, f)
-    return best
+            tp = float((binp & (t > 0.5)).sum())
+            fp = float((binp & ~(t > 0.5)).sum())
+            n_pos = float((t > 0.5).sum())
+            prec = tp / (tp + fp + eps)
+            rec = tp / (n_pos + eps)
+            curve.append((1 + beta2) * prec * rec / (beta2 * prec + rec + eps))
+        curves.append(curve)
+    return float(np.mean(curves, axis=0).max())
 
 
 def test_streaming_max_fbeta_matches_brute_force():

@@ -1,0 +1,171 @@
+"""Train engine tests (SURVEY.md §4): mesh, schedules, shard_map train
+step on 8 virtual devices, and 1-device vs 8-device DP equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from distributed_sod_project_tpu.configs.base import (
+    LossConfig,
+    MeshConfig,
+    OptimConfig,
+)
+from distributed_sod_project_tpu.models.layers import ConvBNAct
+from distributed_sod_project_tpu.parallel import (
+    global_batch_array,
+    make_mesh,
+)
+from distributed_sod_project_tpu.train import (
+    build_optimizer,
+    build_schedule,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+
+
+class TinyNet(nn.Module):
+    """Minimal ConvBN model with the zoo call convention, for fast
+    engine tests (full zoo models are exercised in test_models.py)."""
+
+    axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, image, depth=None, *, train: bool = False):
+        del depth
+        x = ConvBNAct(8, axis_name=self.axis_name)(image, train)
+        x = ConvBNAct(8, axis_name=self.axis_name)(x, train)
+        logit = nn.Conv(1, (3, 3), padding="SAME")(x)
+        return [logit.astype(jnp.float32)]
+
+
+def _batch(n=8, hw=16, seed=0):
+    rng = np.random.default_rng(seed)
+    img = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
+    # Learnable target: salient = bright pixels (function of the input,
+    # so the overfit test measures optimization, not memorization).
+    mask = (img.mean(-1, keepdims=True) > 0).astype(np.float32)
+    return {"image": img, "mask": mask}
+
+
+def _setup(mesh, total_steps=10, lr=0.1):
+    model = TinyNet()
+    ocfg = OptimConfig(lr=lr, warmup_steps=0)
+    tx, sched = build_optimizer(ocfg, total_steps)
+    state = create_train_state(jax.random.key(0), model, tx, _batch(2))
+    lcfg = LossConfig(ssim_window=5)
+    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False)
+    return model, state, step
+
+
+# ---------------------------------------------------------------- mesh
+
+
+def test_mesh_default_all_data(eight_devices):
+    mesh = make_mesh(MeshConfig(), eight_devices)
+    assert mesh.devices.shape == (8, 1, 1)
+    assert mesh.axis_names == ("data", "model", "seq")
+
+
+def test_mesh_mixed_axes(eight_devices):
+    mesh = make_mesh(MeshConfig(data=-1, model=2), eight_devices)
+    assert mesh.devices.shape == (4, 2, 1)
+
+
+def test_mesh_bad_sizes(eight_devices):
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=3), eight_devices)
+
+
+# ----------------------------------------------------------- schedules
+
+
+def test_poly_schedule_endpoints():
+    ocfg = OptimConfig(lr=0.01, schedule="poly", poly_power=0.9)
+    s = build_schedule(ocfg, 100)
+    assert float(s(0)) == pytest.approx(0.01)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-8)
+    assert 0.0 < float(s(50)) < 0.01
+
+
+def test_warmup_ramps():
+    ocfg = OptimConfig(lr=0.01, warmup_steps=10)
+    s = build_schedule(ocfg, 100)
+    assert float(s(0)) == pytest.approx(0.0)
+    assert float(s(5)) == pytest.approx(0.005)
+    assert float(s(10)) == pytest.approx(0.01)
+
+
+# ---------------------------------------------------------- train step
+
+
+def test_train_step_runs_and_updates(eight_devices):
+    mesh = make_mesh(MeshConfig(), eight_devices)
+    _, state, step = _setup(mesh)
+    batch = global_batch_array(_batch(8), mesh)
+    new_state, metrics = step(state, batch)
+    assert int(new_state.step) == 1
+    for k in ("total", "bce", "iou", "ssim", "grad_norm", "lr"):
+        assert np.isfinite(float(metrics[k])), k
+    assert float(metrics["lr"]) == pytest.approx(0.1)
+    # params moved
+    diff = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.abs(a - b).max()), state.params, new_state.params
+    )
+    assert max(jax.tree_util.tree_leaves(diff)) > 0
+    # batch_stats updated and replicated-consistent
+    old = jax.tree_util.tree_leaves(state.batch_stats)
+    new = jax.tree_util.tree_leaves(new_state.batch_stats)
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))
+
+
+def test_dp_equivalence_1_vs_8_devices(eight_devices):
+    """Same global batch through a 1-device and an 8-device mesh must
+    produce identical updates (gradient pmean + SyncBN correctness)."""
+    mesh8 = make_mesh(MeshConfig(), eight_devices)
+    mesh1 = make_mesh(MeshConfig(data=1), eight_devices[:1])
+    _, state, step8 = _setup(mesh8)
+    _, _, step1 = _setup(mesh1)
+
+    b = _batch(8, seed=3)
+    s8, m8 = step8(state, global_batch_array(b, mesh8))
+    s1, m1 = step1(state, global_batch_array(b, mesh1))
+
+    assert float(m8["total"]) == pytest.approx(float(m1["total"]), rel=1e-5)
+    chex_tol = 1e-5
+    for a, b_ in zip(
+        jax.tree_util.tree_leaves(s8.params), jax.tree_util.tree_leaves(s1.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=chex_tol)
+    for a, b_ in zip(
+        jax.tree_util.tree_leaves(s8.batch_stats),
+        jax.tree_util.tree_leaves(s1.batch_stats),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=chex_tol)
+
+
+def test_overfit_smoke(eight_devices):
+    """20 steps on one fixed batch must cut the loss (SURVEY.md §4
+    integration prescription)."""
+    mesh = make_mesh(MeshConfig(), eight_devices)
+    _, state, step = _setup(mesh, total_steps=40, lr=0.05)
+    batch = global_batch_array(_batch(8, seed=7), mesh)
+    losses = []
+    for _ in range(20):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["total"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_eval_step_shapes(eight_devices):
+    mesh = make_mesh(MeshConfig(), eight_devices)
+    model, state, _ = _setup(mesh)
+    ev = make_eval_step(model, mesh)
+    batch = global_batch_array(_batch(8), mesh)
+    probs = ev(state, batch)
+    assert probs.shape == (8, 16, 16)
+    p = np.asarray(probs)
+    assert p.min() >= 0.0 and p.max() <= 1.0
