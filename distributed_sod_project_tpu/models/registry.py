@@ -58,3 +58,46 @@ def _build_minet(cfg, *, dtype, param_dtype, axis_name):
         dtype=dtype,
         param_dtype=param_dtype,
     )
+
+
+@register_model("u2net")
+def _build_u2net(cfg, *, dtype, param_dtype, axis_name):
+    from .u2net import U2Net
+
+    if cfg.backbone not in ("none", "small"):
+        raise ValueError(
+            f"u2net is self-contained: backbone must be 'none' (full) or "
+            f"'small' (U²-Net†), got {cfg.backbone!r}")
+    return U2Net(
+        small=cfg.backbone == "small",
+        axis_name=axis_name,
+        bn_momentum=cfg.bn_momentum,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )
+
+
+@register_model("basnet")
+def _build_basnet(cfg, *, dtype, param_dtype, axis_name):
+    from .basnet import BASNet
+
+    return BASNet(
+        axis_name=axis_name,
+        bn_momentum=cfg.bn_momentum,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )
+
+
+@register_model("hdfnet")
+def _build_hdfnet(cfg, *, dtype, param_dtype, axis_name):
+    from .hdfnet import HDFNet
+
+    return HDFNet(
+        backbone=cfg.backbone,
+        backbone_bn=cfg.backbone_bn,
+        axis_name=axis_name,
+        bn_momentum=cfg.bn_momentum,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )

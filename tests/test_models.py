@@ -103,3 +103,98 @@ def test_minet_bf16_compute_keeps_f32_output():
     # params stay f32
     p = jax.tree_util.tree_leaves(vars_["params"])
     assert all(a.dtype == jnp.float32 for a in p)
+
+
+def _finite_grad_check(model, x, y, depth=None, n_outputs=None):
+    rng = jax.random.key(0)
+    vars_ = model.init(rng, x, depth, train=True)
+
+    def loss_fn(params):
+        outs, new_state = model.apply(
+            {"params": params, "batch_stats": vars_["batch_stats"]},
+            x, depth, train=True, mutable=["batch_stats"],
+        )
+        loss = sum(
+            jnp.mean(jnp.maximum(l, 0) - l * y + jnp.log1p(jnp.exp(-jnp.abs(l))))
+            for l in outs
+        )
+        return loss, outs
+
+    (loss, outs), grads = jax.value_and_grad(loss_fn, has_aux=True)(vars_["params"])
+    if n_outputs is not None:
+        assert len(outs) == n_outputs
+    for l in outs:
+        assert l.shape == (x.shape[0], x.shape[1], x.shape[2], 1)
+        assert l.dtype == jnp.float32
+    assert np.isfinite(float(loss))
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.all(np.isfinite(g)) for g in flat)
+    assert any(float(jnp.abs(g).max()) > 0 for g in flat)
+
+
+def test_u2net_seven_outputs_and_finite_grads():
+    from distributed_sod_project_tpu.models.u2net import U2Net
+
+    model = U2Net(small=True)
+    x = jax.random.normal(jax.random.key(1), (1, 64, 64, 3))
+    y = (jax.random.uniform(jax.random.key(2), (1, 64, 64, 1)) > 0.5).astype(
+        jnp.float32)
+    _finite_grad_check(model, x, y, n_outputs=7)
+
+
+def test_basnet_eight_outputs_and_finite_grads():
+    from distributed_sod_project_tpu.models.basnet import BASNet
+
+    model = BASNet()
+    x = jax.random.normal(jax.random.key(1), (1, 64, 64, 3))
+    y = (jax.random.uniform(jax.random.key(2), (1, 64, 64, 1)) > 0.5).astype(
+        jnp.float32)
+    _finite_grad_check(model, x, y, n_outputs=8)
+
+
+def test_hdfnet_rgbd_outputs_and_finite_grads():
+    from distributed_sod_project_tpu.models.hdfnet import HDFNet
+
+    model = HDFNet(backbone="vgg16")
+    x = jax.random.normal(jax.random.key(1), (1, 64, 64, 3))
+    d = jax.random.normal(jax.random.key(3), (1, 64, 64, 1))
+    y = (jax.random.uniform(jax.random.key(2), (1, 64, 64, 1)) > 0.5).astype(
+        jnp.float32)
+    _finite_grad_check(model, x, y, depth=d, n_outputs=3)
+
+
+def test_hdfnet_requires_depth():
+    from distributed_sod_project_tpu.models.hdfnet import HDFNet
+
+    model = HDFNet()
+    x = jnp.zeros((1, 32, 32, 3))
+    with pytest.raises(ValueError, match="RGB-D"):
+        model.init(jax.random.key(0), x, None, train=False)
+
+
+def test_dynamic_local_filter_identity_kernel():
+    """A one-hot-center kernel must reproduce the input exactly."""
+    from distributed_sod_project_tpu.models.hdfnet import dynamic_local_filter
+
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 4))
+    k = jnp.zeros((2, 8, 8, 9)).at[..., 4].set(1.0)  # center tap of 3x3
+    out = dynamic_local_filter(x, k, ksize=3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+
+def test_dynamic_local_filter_mean_kernel_matches_avgpool():
+    """Uniform kernels = 3×3 box filter (zero-padded), cross-checked."""
+    from distributed_sod_project_tpu.models.hdfnet import dynamic_local_filter
+
+    x = jax.random.normal(jax.random.key(0), (1, 6, 6, 2))
+    k = jnp.full((1, 6, 6, 9), 1.0 / 9.0)
+    out = dynamic_local_filter(x, k, ksize=3)
+    ref = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, 3, 3, 1), (1, 1, 1, 1), "SAME") / 9.0
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_registry_builds_all_zoo_models():
+    from distributed_sod_project_tpu.models import list_models
+
+    assert {"minet", "u2net", "basnet", "hdfnet"} <= set(list_models())
