@@ -1,0 +1,3 @@
+from .manager import CheckpointManager, restore_latest
+
+__all__ = ["CheckpointManager", "restore_latest"]

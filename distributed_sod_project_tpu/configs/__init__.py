@@ -5,6 +5,7 @@ from .base import (
     MeshConfig,
     ModelConfig,
     OptimConfig,
+    apply_overrides,
     get_config,
     list_configs,
     register_config,
@@ -18,6 +19,7 @@ __all__ = [
     "MeshConfig",
     "ModelConfig",
     "OptimConfig",
+    "apply_overrides",
     "get_config",
     "list_configs",
     "register_config",

@@ -134,3 +134,72 @@ def get_config(name: str, **overrides) -> ExperimentConfig:
 
 def list_configs():
     return sorted(_REGISTRY)
+
+
+def _coerce(value: str, ftype):
+    """Parse a CLI string into a dataclass field's annotated type.
+
+    Typed by the annotation, not the current value, so fields defaulting
+    to ``None`` (``Optional[int] steps_per_epoch``) still coerce.
+    """
+    import typing
+
+    origin = typing.get_origin(ftype)
+    if origin is typing.Union:  # Optional[X] and friends
+        args = [a for a in typing.get_args(ftype) if a is not type(None)]
+        if value.lower() in ("none", "null"):
+            return None
+        return _coerce(value, args[0])
+    if origin is tuple:
+        parts = [p for p in value.replace("(", "").replace(")", "").split(",") if p]
+        args = typing.get_args(ftype)
+        elem = args[0] if args else str
+        return tuple(_coerce(p, elem) for p in parts)
+    if ftype is bool:
+        if value.lower() in ("1", "true", "yes"):
+            return True
+        if value.lower() in ("0", "false", "no"):
+            return False
+        raise ValueError(f"expected bool, got {value!r}")
+    if ftype is int:
+        return int(value)
+    if ftype is float:
+        return float(value)
+    if ftype is str:
+        return value
+    raise ValueError(f"cannot coerce {value!r} onto {ftype!r}")
+
+
+def apply_overrides(cfg: ExperimentConfig, overrides) -> ExperimentConfig:
+    """Apply ``section.field=value`` CLI overrides (SURVEY.md §2 C13).
+
+    Dotted paths address nested config dataclasses:
+    ``data.image_size=64,64 optim.lr=0.01 model.name=u2net``.
+    Top-level fields work without a dot (``global_batch_size=16``).
+    """
+    for ov in overrides or []:
+        if "=" not in ov:
+            raise ValueError(f"override {ov!r} is not key=value")
+        path, value = ov.split("=", 1)
+        keys = path.strip().split(".")
+        # Walk down, collecting the chain of dataclass instances.
+        objs = [cfg]
+        for k in keys[:-1]:
+            if not hasattr(objs[-1], k):
+                raise KeyError(f"no config field {'.'.join(keys)!r}")
+            objs.append(getattr(objs[-1], k))
+        leaf = keys[-1]
+        fields = {f.name: f for f in dataclasses.fields(type(objs[-1]))}
+        if leaf not in fields:
+            raise KeyError(f"no config field {'.'.join(keys)!r}")
+        ftype = fields[leaf].type
+        if isinstance(ftype, str):  # `from __future__ import annotations`
+            import typing
+
+            ftype = typing.get_type_hints(type(objs[-1]))[leaf]
+        new = _coerce(value.strip(), ftype)
+        # Rebuild the frozen chain bottom-up.
+        for obj, key in zip(reversed(objs), reversed(keys)):
+            new = dataclasses.replace(obj, **{key: new})
+        cfg = new
+    return cfg

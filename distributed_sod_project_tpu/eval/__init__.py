@@ -1,0 +1,3 @@
+from .inference import evaluate, run_inference
+
+__all__ = ["evaluate", "run_inference"]

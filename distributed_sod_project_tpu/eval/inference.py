@@ -1,0 +1,154 @@
+"""Inference + metric sweep — the reference's ``test.py`` path
+(SURVEY.md §2 C2, §3.2).
+
+Reference behavior reproduced: resize → forward → sigmoid →
+resize-back-to-original → save PNG → stream (pred, gt) into the metric
+aggregator.  TPU-shaped differences (SURVEY.md §7.3 hard part 5):
+
+- the compiled forward only ever sees the static ``cfg.data.image_size``
+  shape; per-image original-size handling (resize-back, PNG write,
+  metric update) is host-side numpy,
+- images run in fixed-size batches (last batch zero-padded and the pad
+  masked out) so there is exactly ONE compiled program, not one per
+  image size,
+- prediction batches come back as one device array per batch; the host
+  thread overlaps PNG/metric work with the next device batch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metrics import SODMetrics
+from ..utils.logging import get_logger
+
+
+def _original_mask(dataset, index: int, sample=None) -> np.ndarray:
+    """GT at original resolution when the dataset is file-backed;
+    falls back to the already-fetched (resized) sample mask otherwise."""
+    if hasattr(dataset, "mask_paths") and hasattr(dataset, "stems"):
+        from PIL import Image
+
+        with Image.open(dataset.mask_paths[dataset.stems[index]]) as im:
+            return (np.asarray(im.convert("L"), np.float32) / 255.0 > 0.5
+                    ).astype(np.float32)
+    if sample is None:
+        sample = dataset[index]
+    return np.asarray(sample["mask"]).squeeze()
+
+
+def _stem(dataset, index: int) -> str:
+    if hasattr(dataset, "stems"):
+        return dataset.stems[index]
+    return f"{index:06d}"
+
+
+def _resize_pred(pred: np.ndarray, hw) -> np.ndarray:
+    from PIL import Image
+
+    if pred.shape == tuple(hw):
+        return pred
+    im = Image.fromarray((np.clip(pred, 0, 1) * 255).astype(np.uint8))
+    im = im.resize((hw[1], hw[0]), Image.BILINEAR)
+    return np.asarray(im, np.float32) / 255.0
+
+
+def run_inference(
+    forward,
+    dataset,
+    batch_size: int = 8,
+    use_depth: bool = False,
+    save_dir: Optional[str] = None,
+    compute_metrics: bool = True,
+    compute_structure: bool = True,
+) -> Dict[str, float]:
+    """Sweep ``dataset`` through a compiled ``forward(batch)->probs``.
+
+    ``forward`` maps a dict with 'image' (and optionally 'depth') of the
+    static eval shape to per-pixel probabilities [B,H,W].  Returns the
+    SOD metric dict (empty when ``compute_metrics=False``).
+    """
+    log = get_logger()
+    if save_dir:
+        os.makedirs(save_dir, exist_ok=True)
+    agg = SODMetrics(compute_structure=compute_structure)
+
+    n = len(dataset)
+    for lo in range(0, n, batch_size):
+        idxs = list(range(lo, min(lo + batch_size, n)))
+        pad = batch_size - len(idxs)
+        samples = [dataset[i] for i in idxs]
+        batch = {"image": np.stack([s["image"] for s in samples])}
+        if use_depth:
+            batch["depth"] = np.stack([s["depth"] for s in samples])
+        if pad:
+            batch = {k: np.concatenate(
+                [v, np.zeros((pad,) + v.shape[1:], v.dtype)]) for k, v in
+                batch.items()}
+        probs = np.asarray(forward(batch))[: len(idxs)]
+
+        for j, i in enumerate(idxs):
+            gt = _original_mask(dataset, i, samples[j])
+            pred = _resize_pred(probs[j], gt.shape[:2])
+            if compute_metrics:
+                agg.add(pred, gt)
+            if save_dir:
+                from PIL import Image
+
+                Image.fromarray(
+                    (np.clip(pred, 0, 1) * 255).astype(np.uint8)
+                ).save(os.path.join(save_dir, f"{_stem(dataset, i)}.png"))
+    out = agg.results() if compute_metrics else {}
+    if out:
+        log.info("eval: %s", {k: round(v, 4) if isinstance(v, float) else v
+                              for k, v in out.items()})
+    return out
+
+
+def evaluate(
+    cfg,
+    state,
+    model=None,
+    mesh=None,
+    datasets: Optional[Dict[str, object]] = None,
+    save_root: Optional[str] = None,
+    batch_size: Optional[int] = None,
+    compute_structure: bool = True,
+) -> Dict[str, Dict[str, float]]:
+    """Test-entrypoint engine: run every test set through the model.
+
+    ``datasets`` maps name → dataset; defaults to the config's dataset.
+    Single-device jit (eval is per-host embarrassingly parallel; the
+    sharded path exists via ``make_eval_step`` for pod-scale eval).
+    """
+    from ..data import resolve_dataset
+    from ..models import build_model
+
+    model = model or build_model(cfg.model)
+    if datasets is None:
+        # hflip is a train-loader op, not a dataset property — resolve as-is.
+        datasets = {cfg.data.dataset: resolve_dataset(cfg.data)}
+    bs = batch_size or min(cfg.global_batch_size, 8)
+
+    @jax.jit
+    def forward(batch):
+        outs = model.apply(
+            state.variables(), batch["image"], batch.get("depth"),
+            train=False)
+        return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
+
+    results = {}
+    for name, ds in datasets.items():
+        results[name] = run_inference(
+            forward, ds,
+            batch_size=bs,
+            use_depth=cfg.data.use_depth,
+            save_dir=os.path.join(save_root, name) if save_root else None,
+            compute_structure=compute_structure,
+        )
+    return results

@@ -90,8 +90,6 @@ class BASNet(nn.Module):
         x = image.astype(self.dtype)
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
                   dtype=self.dtype, param_dtype=self.param_dtype)
-        bkw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
-                   dtype=self.dtype, param_dtype=self.param_dtype)
 
         # --- predict-module encoder ---------------------------------
         # Stem at full resolution (3×3/1 — BASNet keeps stage 1 unpooled).
@@ -101,12 +99,12 @@ class BASNet(nn.Module):
         for n, width, first_stride in stage_blocks:
             for i in range(n):
                 x = BasicBlock(width, strides=first_stride if i == 0 else 1,
-                               **bkw)(x, train)
+                               **kw)(x, train)
             feats.append(x)  # strides 1, 2, 4, 8
         for _ in range(2):  # extra stages → strides 16, 32
             x = max_pool(x)
             for _ in range(3):
-                x = BasicBlock(512, **bkw)(x, train)
+                x = BasicBlock(512, **kw)(x, train)
             feats.append(x)
 
         # Bridge: dilated 512 convs at the coarsest resolution.

@@ -43,10 +43,12 @@ def _resolve_axis_sizes(n_devices: int, data: int, model: int, seq: int):
             )
         sizes[wild[0]] = n_devices // fixed
     total = int(np.prod(list(sizes.values())))
-    if total != n_devices:
+    if total > n_devices:
         raise ValueError(
             f"mesh {sizes} wants {total} devices, have {n_devices}"
         )
+    # total < n_devices is allowed: a fully pinned config (e.g. the
+    # single-device reference config) runs on the first `total` devices.
     return sizes["data"], sizes["model"], sizes["seq"]
 
 
@@ -65,7 +67,7 @@ def make_mesh(
     model = getattr(mesh_cfg, "model", 1) if mesh_cfg is not None else 1
     seq = getattr(mesh_cfg, "seq", 1) if mesh_cfg is not None else 1
     d, m, s = _resolve_axis_sizes(len(devices), data, model, seq)
-    arr = np.asarray(devices).reshape(d, m, s)
+    arr = np.asarray(devices[: d * m * s]).reshape(d, m, s)
     return Mesh(arr, MeshAxes)
 
 

@@ -1,0 +1,139 @@
+"""The training engine: config → trained checkpoints (SURVEY.md §2 C1, §3.1).
+
+``fit(cfg)`` is the whole reference ``train.py::main`` (SURVEY.md §3.1)
+minus process spawning: on TPU pods every host runs the same ``fit``
+under ``jax.distributed`` and the mesh spans all chips; there is no
+torchrun/fork step.  Per step the host only feeds its local shard of the
+batch and reads back scalar metrics — everything else (forward, loss,
+backward, cross-replica psum, optimizer) is one compiled XLA program
+(`make_train_step`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..ckpt import CheckpointManager
+from ..configs.base import ExperimentConfig
+from ..data import HostDataLoader, prefetch_to_device, resolve_dataset
+from ..models import build_model
+from ..parallel.mesh import batch_sharding, make_mesh, replicated_sharding
+from ..utils.logging import get_logger, is_primary_process
+from ..utils.timing import StepTimer
+from .optim import build_optimizer
+from .state import create_train_state, param_count
+from .step import make_eval_step, make_train_step
+
+
+def fit(
+    cfg: ExperimentConfig,
+    workdir: Optional[str] = None,
+    resume: bool = False,
+    max_steps: Optional[int] = None,
+    hooks: Optional[Dict[str, Callable]] = None,
+) -> Dict[str, float]:
+    """Run the full training loop; returns final scalar metrics.
+
+    ``max_steps`` truncates (smoke tests / benchmarks); ``hooks`` may
+    contain ``on_metrics(step, dict)`` for test instrumentation.
+    """
+    log = get_logger()
+    hooks = hooks or {}
+    workdir = workdir or cfg.checkpoint_dir
+
+    mesh = make_mesh(cfg.mesh)
+    n_dev = mesh.devices.size
+    if cfg.global_batch_size % n_dev:
+        raise ValueError(
+            f"global_batch_size={cfg.global_batch_size} not divisible by "
+            f"mesh size {n_dev}")
+
+    dataset = resolve_dataset(cfg.data)
+    loader = HostDataLoader(
+        dataset,
+        global_batch_size=cfg.global_batch_size,
+        shard_id=jax.process_index(),
+        num_shards=jax.process_count(),
+        shuffle=True,
+        seed=cfg.seed,
+        hflip=cfg.data.hflip,
+        num_workers=cfg.data.num_workers,
+    )
+    steps_per_epoch = cfg.steps_per_epoch or loader.steps_per_epoch
+    total_steps = steps_per_epoch * cfg.num_epochs
+    if max_steps is not None:
+        total_steps = min(total_steps, max_steps)
+
+    model = build_model(cfg.model)
+    tx, schedule = build_optimizer(cfg.optim, total_steps)
+
+    sample = next(iter(loader))
+    state = create_train_state(jax.random.key(cfg.seed), model, tx, sample)
+    log.info("model=%s params=%.2fM devices=%d global_batch=%d "
+             "steps/epoch=%d total_steps=%d",
+             cfg.model.name, param_count(state) / 1e6, n_dev,
+             cfg.global_batch_size, steps_per_epoch, total_steps)
+
+    mgr = CheckpointManager(workdir, keep=cfg.keep_checkpoints)
+    if is_primary_process():
+        mgr.save_config(cfg)
+    start_step = 0
+    if resume:
+        ck_step = mgr.latest_step()
+        if ck_step is not None:
+            state = mgr.restore(state, ck_step)
+            start_step = int(state.step)
+            log.info("resumed from checkpoint step %d", start_step)
+
+    state = jax.device_put(state, replicated_sharding(mesh))
+    train_step = make_train_step(model, cfg.loss, tx, mesh, schedule=schedule)
+    in_sharding = batch_sharding(mesh)
+
+    timer = StepTimer()
+    last_metrics: Dict[str, float] = {}
+    step = start_step
+    last_saved = -1
+    try:
+        for epoch in range(start_step // max(steps_per_epoch, 1), cfg.num_epochs):
+            loader.set_epoch(epoch)
+            it = prefetch_to_device(
+                iter(loader), size=cfg.data.prefetch_batches,
+                sharding=in_sharding)
+            for batch in it:
+                if step >= total_steps:
+                    break
+                state, metrics = train_step(state, batch)
+                step += 1
+                timer.tick()
+                if step % cfg.log_every_steps == 0 or step == total_steps:
+                    host = {k: float(v) for k, v in metrics.items()}
+                    host["imgs_per_sec"] = timer.images_per_sec(
+                        cfg.global_batch_size)
+                    host["epoch"] = epoch
+                    last_metrics = host
+                    if is_primary_process():
+                        log.info(
+                            "step %d/%d  loss=%.4f  lr=%.2e  %.1f imgs/s",
+                            step, total_steps, host.get("total", float("nan")),
+                            host.get("lr", float("nan")),
+                            host["imgs_per_sec"])
+                    if "on_metrics" in hooks:
+                        hooks["on_metrics"](step, host)
+                if cfg.checkpoint_every_steps and (
+                        step % cfg.checkpoint_every_steps == 0):
+                    # state passed as-is: orbax's async save does the D2H
+                    # copy behind the next train steps (no device_get stall).
+                    mgr.save(step, state)
+                    last_saved = step
+            if step >= total_steps:
+                break
+        if step != last_saved:
+            mgr.save(step, state, force=True)
+    finally:
+        mgr.close()
+    last_metrics["final_step"] = step
+    return last_metrics

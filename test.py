@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Test/eval entrypoint (SURVEY.md §2 C2, §3.2; [B:5] `test.py --device`).
+
+    python test.py --config minet_r50_dp --ckpt-dir runs/minet --device tpu \
+        --save-dir preds/ --data-root /data/DUTS-TE
+
+Loads the newest checkpoint, sweeps every test set (resize → forward →
+sigmoid → resize-back → PNG), and prints the metric dict (max-Fβ, MAE,
+S/E-measure) as JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", required=True)
+    p.add_argument("--ckpt-dir", required=True,
+                   help="directory of checkpoints written by train.py")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: newest)")
+    p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
+    p.add_argument("--data-root", default=None,
+                   help="test-set root; repeatable as name=path",
+                   action="append")
+    p.add_argument("--save-dir", default=None, help="write saliency PNGs here")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--no-structure", action="store_true",
+                   help="skip S/E-measure (faster)")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="PATH=VALUE",
+                   help="dotted config override (repeatable)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.device:
+        os.environ["JAX_PLATFORMS"] = args.device
+
+    import jax
+
+    if args.device:
+        jax.config.update("jax_platforms", args.device)
+
+    from distributed_sod_project_tpu.ckpt import CheckpointManager
+    from distributed_sod_project_tpu.configs import apply_overrides, get_config
+    from distributed_sod_project_tpu.data import resolve_dataset
+    from distributed_sod_project_tpu.eval import evaluate
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.train import (
+        build_optimizer, create_train_state)
+
+    cfg = get_config(args.config)
+    cfg = apply_overrides(cfg, args.overrides)
+
+    # Named test sets: ["duts_te=/data/DUTS-TE", ...]; default config set.
+    datasets = None
+    if args.data_root:
+        datasets = {}
+        for spec in args.data_root:
+            name, _, path = spec.rpartition("=")
+            name = name or os.path.basename(path.rstrip("/")) or "test"
+            datasets[name] = resolve_dataset(
+                dataclasses.replace(cfg.data, root=path))
+
+    model = build_model(cfg.model)
+    tx, _ = build_optimizer(cfg.optim, 1)
+    ds0 = next(iter(datasets.values())) if datasets else resolve_dataset(cfg.data)
+    sample = ds0[0]
+    import numpy as np
+
+    batch = {k: np.asarray(v)[None] for k, v in sample.items()
+             if k in ("image", "depth")}
+    template = create_train_state(jax.random.key(0), model, tx, batch)
+
+    mgr = CheckpointManager(args.ckpt_dir, async_save=False)
+    state = mgr.restore(template, step=args.step)
+    mgr.close()
+
+    results = evaluate(cfg, state, model=model, datasets=datasets,
+                       save_root=args.save_dir, batch_size=args.batch_size,
+                       compute_structure=not args.no_structure)
+    print(json.dumps(results, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

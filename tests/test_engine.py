@@ -1,0 +1,128 @@
+"""End-to-end engine tests: fit() → checkpoint → resume → evaluate
+(SURVEY.md §4 integration tier)."""
+
+import glob
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_sod_project_tpu.configs import get_config
+from distributed_sod_project_tpu.configs.base import (
+    DataConfig, MeshConfig, ModelConfig, OptimConfig)
+from distributed_sod_project_tpu.train.loop import fit
+
+
+def _smoke_cfg(tmp_path, **kw):
+    cfg = get_config("minet_vgg16_ref")
+    return cfg.replace(
+        data=DataConfig(dataset="synthetic", image_size=(32, 32),
+                        synthetic_size=32, num_workers=0),
+        model=ModelConfig(name="minet", backbone="vgg16", sync_bn=True,
+                          compute_dtype="float32"),
+        optim=OptimConfig(lr=0.01),
+        mesh=MeshConfig(data=-1),
+        global_batch_size=8,
+        num_epochs=2,
+        log_every_steps=1,
+        checkpoint_every_steps=2,
+        checkpoint_dir=str(tmp_path / "ck"),
+        **kw,
+    )
+
+
+def test_fit_trains_checkpoints_and_resumes(tmp_path, eight_devices):
+    cfg = _smoke_cfg(tmp_path)
+    seen = []
+    out = fit(cfg, max_steps=4,
+              hooks={"on_metrics": lambda s, m: seen.append((s, m))})
+    assert out["final_step"] == 4
+    assert np.isfinite(out["total"])
+    assert seen and all(np.isfinite(m["total"]) for _, m in seen)
+    # checkpoints exist on disk
+    assert os.path.exists(os.path.join(cfg.checkpoint_dir, "config.json"))
+    steps = [int(os.path.basename(d)) for d in
+             glob.glob(os.path.join(cfg.checkpoint_dir, "[0-9]*"))]
+    assert 4 in steps
+
+    # resume continues from step 4
+    out2 = fit(cfg, resume=True, max_steps=6)
+    assert out2["final_step"] == 6
+
+
+def test_fit_rejects_indivisible_batch(tmp_path, eight_devices):
+    cfg = _smoke_cfg(tmp_path).replace(global_batch_size=6)
+    with pytest.raises(ValueError, match="not divisible"):
+        fit(cfg, max_steps=1)
+
+
+def test_evaluate_metrics_on_synthetic(tmp_path, eight_devices):
+    from distributed_sod_project_tpu.data import resolve_dataset
+    from distributed_sod_project_tpu.eval import evaluate
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.train import (
+        build_optimizer, create_train_state)
+
+    cfg = _smoke_cfg(tmp_path)
+    model = build_model(cfg.model.__class__(
+        name="minet", backbone="vgg16", sync_bn=False,
+        compute_dtype="float32"))
+    tx, _ = build_optimizer(cfg.optim, 1)
+    ds = resolve_dataset(cfg.data)
+    batch = {"image": np.asarray(ds[0]["image"])[None]}
+    state = create_train_state(jax.random.key(0), model, tx, batch)
+
+    save_root = str(tmp_path / "preds")
+    res = evaluate(cfg, state, model=model, save_root=save_root, batch_size=4)
+    m = res["synthetic"]
+    assert 0.0 <= m["mae"] <= 1.0
+    assert 0.0 <= m["max_fbeta"] <= 1.0
+    assert m["num_images"] == len(ds)
+    pngs = glob.glob(os.path.join(save_root, "synthetic", "*.png"))
+    assert len(pngs) == len(ds)
+
+
+def test_train_cli_smoke(tmp_path, eight_devices, monkeypatch):
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import importlib
+
+    small = ["--set", "data.image_size=32,32", "--set", "data.synthetic_size=16",
+             "--set", "model.compute_dtype=float32"]
+    train_mod = importlib.import_module("train")
+    rc = train_mod.main([
+        "--config", "minet_vgg16_ref",
+        "--workdir", str(tmp_path / "cli_ck"),
+        "--batch-size", "8",
+        "--max-steps", "2",
+    ] + small)
+    assert rc == 0
+    assert os.path.exists(str(tmp_path / "cli_ck" / "config.json"))
+
+    test_mod = importlib.import_module("test")
+    rc = test_mod.main([
+        "--config", "minet_vgg16_ref",
+        "--ckpt-dir", str(tmp_path / "cli_ck"),
+        "--batch-size", "4",
+        "--no-structure",
+    ] + small)
+    assert rc == 0
+
+
+def test_apply_overrides_types_and_errors():
+    from distributed_sod_project_tpu.configs import apply_overrides
+
+    cfg = get_config("minet_r50_dp")
+    cfg = apply_overrides(cfg, [
+        "optim.lr=0.5", "data.image_size=64,64", "global_batch_size=4",
+        "model.sync_bn=false", "data.root=/tmp/x", "loss.cel=0",
+    ])
+    assert cfg.optim.lr == 0.5 and cfg.data.image_size == (64, 64)
+    assert cfg.global_batch_size == 4 and cfg.model.sync_bn is False
+    assert cfg.data.root == "/tmp/x" and cfg.loss.cel == 0.0
+    with pytest.raises(KeyError):
+        apply_overrides(cfg, ["nope.lr=1"])
+    with pytest.raises(ValueError):
+        apply_overrides(cfg, ["optim.lr"])

@@ -75,8 +75,17 @@ def test_mesh_mixed_axes(eight_devices):
 
 
 def test_mesh_bad_sizes(eight_devices):
-    with pytest.raises(ValueError):
-        make_mesh(MeshConfig(data=3), eight_devices)
+    with pytest.raises(ValueError):  # wants more devices than exist
+        make_mesh(MeshConfig(data=16), eight_devices)
+    with pytest.raises(ValueError):  # two wildcard axes
+        make_mesh(MeshConfig(data=-1, model=-1), eight_devices)
+
+
+def test_mesh_pinned_subset(eight_devices):
+    # A fully pinned config smaller than the host (e.g. the single-device
+    # reference config on an 8-chip pod) runs on the first N devices.
+    mesh = make_mesh(MeshConfig(data=3), eight_devices)
+    assert mesh.devices.size == 3
 
 
 # ----------------------------------------------------------- schedules

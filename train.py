@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Train entrypoint (SURVEY.md §2 C1, §3.1; [B:5] `train.py --device`).
+
+    python train.py --config minet_r50_dp --device tpu
+    python train.py --config u2net_ds --data-root /data/DUTS-TR --resume
+
+Multi-host pods: launch the same command on every host (with
+``--distributed`` to run ``jax.distributed.initialize``); the mesh spans
+all chips — the TPU replacement for torchrun + init_process_group
+(SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", required=True, help="registered config name")
+    p.add_argument("--device", default=None, choices=["tpu", "cpu", None],
+                   help="force a JAX platform (default: auto)")
+    p.add_argument("--workdir", default=None, help="checkpoint/log dir")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest checkpoint in workdir")
+    p.add_argument("--data-root", default=None,
+                   help="dataset root (overrides config; falls back to "
+                        "synthetic data when absent)")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--max-steps", type=int, default=None,
+                   help="truncate training (smoke runs)")
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host: run jax.distributed.initialize()")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="PATH=VALUE",
+                   help="dotted config override, e.g. --set optim.lr=0.01 "
+                        "--set data.image_size=256,256 (repeatable)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    # Platform choice must precede the first jax backend touch.
+    if args.device:
+        os.environ["JAX_PLATFORMS"] = args.device
+
+    import jax
+
+    if args.device:
+        jax.config.update("jax_platforms", args.device)
+    if args.distributed:
+        jax.distributed.initialize()
+
+    from distributed_sod_project_tpu.configs import apply_overrides, get_config
+    from distributed_sod_project_tpu.train.loop import fit
+
+    cfg = get_config(args.config)
+    cfg = apply_overrides(cfg, args.overrides)
+    if args.data_root is not None:
+        cfg = cfg.replace(data=dataclasses.replace(cfg.data, root=args.data_root))
+    if args.batch_size is not None:
+        cfg = cfg.replace(global_batch_size=args.batch_size)
+    if args.epochs is not None:
+        cfg = cfg.replace(num_epochs=args.epochs)
+    if args.lr is not None:
+        cfg = cfg.replace(optim=dataclasses.replace(cfg.optim, lr=args.lr))
+    if args.seed is not None:
+        cfg = cfg.replace(seed=args.seed)
+
+    metrics = fit(cfg, workdir=args.workdir, resume=args.resume,
+                  max_steps=args.max_steps)
+    print({k: round(v, 4) if isinstance(v, float) else v
+           for k, v in metrics.items()})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
