@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Throughput benchmark — prints ONE JSON line for the driver.
+
+Measures the governing metric (BASELINE.json:2): images/sec/chip for the
+flagship data-parallel train step (MINet-ResNet50, 320×320, bf16), the
+TPU analogue of the reference's 8×V100 DDP throughput posture.
+
+``vs_baseline`` is self-relative: the reference's V100 number was
+unobtainable (BASELINE.md), so the first recorded run seeds
+``bench_baseline.json`` and later runs report the ratio against it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="minet_r50_dp")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--batch-per-chip", type=int, default=8)
+    p.add_argument("--image-size", type=int, default=320)
+    p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
+    args = p.parse_args(argv)
+
+    from distributed_sod_project_tpu.utils.platform import select_platform
+
+    select_platform(args.device)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_sod_project_tpu.configs import apply_overrides, get_config
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.parallel.mesh import (
+        batch_sharding, make_mesh, replicated_sharding)
+    from distributed_sod_project_tpu.train import (
+        build_optimizer, create_train_state, make_train_step)
+
+    n_chips = jax.device_count()
+    batch = args.batch_per_chip * n_chips
+    hw = args.image_size
+
+    cfg = get_config(args.config)
+    cfg = apply_overrides(cfg, [f"global_batch_size={batch}"])
+
+    mesh = make_mesh(cfg.mesh)
+    model = build_model(cfg.model)
+    tx, sched = build_optimizer(cfg.optim, 1000)
+
+    rng = np.random.RandomState(0)
+    host_batch = {
+        "image": rng.randn(batch, hw, hw, 3).astype(np.float32),
+        "mask": (rng.rand(batch, hw, hw, 1) > 0.5).astype(np.float32),
+    }
+    if cfg.data.use_depth:
+        host_batch["depth"] = rng.randn(batch, hw, hw, 1).astype(np.float32)
+
+    state = create_train_state(jax.random.key(0), model, tx, host_batch)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    dev_batch = jax.device_put(host_batch, batch_sharding(mesh))
+    step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched)
+
+    for _ in range(args.warmup):  # compile + stabilise
+        state, metrics = step(state, dev_batch)
+    jax.block_until_ready(metrics["total"])
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, dev_batch)
+    jax.block_until_ready(metrics["total"])
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * args.steps / dt
+    per_chip = imgs_per_sec / n_chips
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_baseline.json")
+    key = f"{args.config}-{hw}-{jax.devices()[0].platform}"
+    base = {}
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)
+    if key not in base:
+        base[key] = per_chip
+        with open(base_path, "w") as f:
+            json.dump(base, f, indent=2)
+    vs = per_chip / base[key] if base[key] else 1.0
+
+    print(json.dumps({
+        "metric": f"train_throughput[{args.config}@{hw}px,"
+                  f"{jax.devices()[0].platform}x{n_chips}]",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

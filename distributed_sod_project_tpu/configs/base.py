@@ -185,7 +185,8 @@ def apply_overrides(cfg: ExperimentConfig, overrides) -> ExperimentConfig:
         # Walk down, collecting the chain of dataclass instances.
         objs = [cfg]
         for k in keys[:-1]:
-            if not hasattr(objs[-1], k):
+            if not hasattr(objs[-1], k) or not dataclasses.is_dataclass(
+                    getattr(objs[-1], k)):
                 raise KeyError(f"no config field {'.'.join(keys)!r}")
             objs.append(getattr(objs[-1], k))
         leaf = keys[-1]

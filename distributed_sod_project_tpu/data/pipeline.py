@@ -117,11 +117,16 @@ class HostDataLoader:
                 pool.shutdown(wait=False)
 
 
-def prefetch_to_device(iterator, size: int = 2, sharding=None):
+def prefetch_to_device(iterator, size: int = 2, sharding=None, mesh=None):
     """Wrap a host batch iterator with a background thread that stages
     batches onto device ahead of consumption (H2D overlap, the TPU
     analogue of the reference's pinned-memory ``non_blocking`` H2D copies
     in SURVEY.md §3.1).
+
+    Pass ``mesh`` for a batch-sharded global array built from each
+    host's local slice (``make_array_from_process_local_data`` — the
+    multi-host-correct path); ``sharding`` is the single-host
+    device_put path.
 
     Producer-thread exceptions propagate to the consumer; closing the
     generator early unblocks and stops the producer.
@@ -137,7 +142,11 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
             for batch in iterator:
                 if stop.is_set():
                     return
-                if sharding is not None:
+                if mesh is not None:
+                    from ..parallel.mesh import global_batch_array
+
+                    batch = global_batch_array(batch, mesh)
+                elif sharding is not None:
                     batch = jax.device_put(batch, sharding)
                 else:
                     batch = jax.device_put(batch)

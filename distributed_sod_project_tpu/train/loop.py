@@ -21,7 +21,7 @@ from ..ckpt import CheckpointManager
 from ..configs.base import ExperimentConfig
 from ..data import HostDataLoader, prefetch_to_device, resolve_dataset
 from ..models import build_model
-from ..parallel.mesh import batch_sharding, make_mesh, replicated_sharding
+from ..parallel.mesh import make_mesh, replicated_sharding
 from ..utils.logging import get_logger, is_primary_process
 from ..utils.timing import StepTimer
 from .optim import build_optimizer
@@ -64,6 +64,10 @@ def fit(
         num_workers=cfg.data.num_workers,
     )
     steps_per_epoch = cfg.steps_per_epoch or loader.steps_per_epoch
+    if steps_per_epoch <= 0:
+        raise ValueError(
+            f"dataset of {len(dataset)} samples yields zero steps at "
+            f"global_batch_size={cfg.global_batch_size}")
     total_steps = steps_per_epoch * cfg.num_epochs
     if max_steps is not None:
         total_steps = min(total_steps, max_steps)
@@ -91,7 +95,6 @@ def fit(
 
     state = jax.device_put(state, replicated_sharding(mesh))
     train_step = make_train_step(model, cfg.loss, tx, mesh, schedule=schedule)
-    in_sharding = batch_sharding(mesh)
 
     timer = StepTimer()
     last_metrics: Dict[str, float] = {}
@@ -100,9 +103,10 @@ def fit(
     try:
         for epoch in range(start_step // max(steps_per_epoch, 1), cfg.num_epochs):
             loader.set_epoch(epoch)
+            # mesh= (not sharding=): each host contributes its local
+            # slice of the global batch — correct on multi-host pods.
             it = prefetch_to_device(
-                iter(loader), size=cfg.data.prefetch_batches,
-                sharding=in_sharding)
+                iter(loader), size=cfg.data.prefetch_batches, mesh=mesh)
             for batch in it:
                 if step >= total_steps:
                     break

@@ -1,0 +1,22 @@
+"""JAX platform selection shared by every entrypoint ([B:5] --device)."""
+
+from __future__ import annotations
+
+
+def select_platform(device: str | None) -> None:
+    """Apply a ``--device {tpu,cpu}`` choice.  Call before the first
+    backend touch.
+
+    Uses ``jax.config.update`` only — never the ``JAX_PLATFORMS`` env
+    var: with a PJRT plugin registered at interpreter startup (e.g. a
+    remote-TPU tunnel), the env path forces an eager plugin dial that
+    can hang the process, while the config path initializes only the
+    requested backend.  ``tpu`` (and None) trust default discovery so
+    the same flag works with libtpu, tunnel plugins, and bare CPU.
+    """
+    if device == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    elif device not in (None, "tpu"):
+        raise ValueError(f"unknown --device {device!r}")

@@ -41,13 +41,12 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
-    if args.device:
-        os.environ["JAX_PLATFORMS"] = args.device
+
+    from distributed_sod_project_tpu.utils.platform import select_platform
+
+    select_platform(args.device)
 
     import jax
-
-    if args.device:
-        jax.config.update("jax_platforms", args.device)
 
     from distributed_sod_project_tpu.ckpt import CheckpointManager
     from distributed_sod_project_tpu.configs import apply_overrides, get_config

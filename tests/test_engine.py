@@ -1,6 +1,7 @@
 """End-to-end engine tests: fit() → checkpoint → resume → evaluate
 (SURVEY.md §4 integration tier)."""
 
+import dataclasses
 import glob
 import os
 
@@ -54,6 +55,13 @@ def test_fit_trains_checkpoints_and_resumes(tmp_path, eight_devices):
 def test_fit_rejects_indivisible_batch(tmp_path, eight_devices):
     cfg = _smoke_cfg(tmp_path).replace(global_batch_size=6)
     with pytest.raises(ValueError, match="not divisible"):
+        fit(cfg, max_steps=1)
+
+
+def test_fit_rejects_dataset_smaller_than_batch(tmp_path, eight_devices):
+    cfg = _smoke_cfg(tmp_path)
+    cfg = cfg.replace(data=dataclasses.replace(cfg.data, synthetic_size=4))
+    with pytest.raises(ValueError, match="zero steps"):
         fit(cfg, max_steps=1)
 
 

@@ -46,14 +46,13 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
-    # Platform choice must precede the first jax backend touch.
-    if args.device:
-        os.environ["JAX_PLATFORMS"] = args.device
+
+    from distributed_sod_project_tpu.utils.platform import select_platform
+
+    select_platform(args.device)
 
     import jax
 
-    if args.device:
-        jax.config.update("jax_platforms", args.device)
     if args.distributed:
         jax.distributed.initialize()
 
