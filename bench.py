@@ -68,8 +68,8 @@ def main(argv=None):
     step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched)
 
     for _ in range(args.warmup):  # compile + stabilise
-        state, metrics = step(state, dev_batch)
-    jax.block_until_ready(metrics["total"])
+        state, _ = step(state, dev_batch)
+    jax.block_until_ready(state.params)
 
     t0 = time.perf_counter()
     for _ in range(args.steps):

@@ -42,6 +42,7 @@ class ModelConfig:
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
     param_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the backbone stages
+    pretrained: Optional[str] = None  # .npz from tools/port_torch_weights.py
 
 
 @dataclasses.dataclass(frozen=True)

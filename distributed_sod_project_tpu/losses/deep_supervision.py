@@ -28,11 +28,15 @@ def deep_supervision_loss(
     cel_w: float = 0.0,
     ssim_window: int = 11,
     level_weights: Sequence[float] | None = None,
+    fused: bool = False,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Σ_levels w_l · (bce_w·BCE + iou_w·IoU + ssim_w·SSIM + cel_w·CEL).
 
     Returns (total, components) where components holds the per-term sums
-    across levels for logging.
+    across levels for logging.  ``fused=True`` routes the BCE/IoU/CEL
+    terms through the Pallas single-pass reduction kernel
+    (``pallas/fused_loss.py``; numerically identical, logged as one
+    combined ``bce_iou_cel`` component).
     """
     if level_weights is None:
         level_weights = [1.0] * len(logits_list)
@@ -45,13 +49,20 @@ def deep_supervision_loss(
         total = total + weight * value
 
     for logit, lw in zip(logits_list, level_weights):
-        if bce_w:
-            add("bce", lw * bce_with_logits(logit, target), bce_w)
-        if iou_w:
-            add("iou", lw * iou_loss(logit, target), iou_w)
+        if fused and (bce_w or iou_w or cel_w):
+            from ..pallas import fused_bce_iou_cel
+
+            add("bce_iou_cel",
+                lw * fused_bce_iou_cel(logit, target, bce_w, iou_w, cel_w),
+                1.0)
+        else:
+            if bce_w:
+                add("bce", lw * bce_with_logits(logit, target), bce_w)
+            if iou_w:
+                add("iou", lw * iou_loss(logit, target), iou_w)
+            if cel_w:
+                add("cel", lw * cel_loss(logit, target), cel_w)
         if ssim_w:
             add("ssim", lw * ssim_loss(logit, target, window_size=ssim_window), ssim_w)
-        if cel_w:
-            add("cel", lw * cel_loss(logit, target), cel_w)
     comps["total"] = total
     return total, comps

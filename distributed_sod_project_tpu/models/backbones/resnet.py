@@ -96,7 +96,10 @@ class ResNet(nn.Module):
         feats: List[jnp.ndarray] = []
         x = ConvBNAct(64, (7, 7), strides=2, **kw)(x, train)
         feats.append(x)  # stride 2
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        # padding (1,1), not SAME: matches torch MaxPool2d(3,2,1) so
+        # ported ImageNet weights see the alignment they trained with.
+        x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                        padding=((1, 1), (1, 1)))
         widths = (64, 128, 256, 512)
         for stage, (n_blocks, width) in enumerate(zip(self.stage_sizes, widths)):
             for i in range(n_blocks):

@@ -1,0 +1,209 @@
+"""Swin-T backbone — hierarchical window-attention feature pyramid.
+
+Stretch config [B:11] (SURVEY.md §2 C6).  Swin-Tiny layout: patch-embed
+4×4 → C=96, depths (2,2,6,2), heads (3,6,12,24), 2× patch-merging
+between stages → pyramid at strides 4/8/16/32.
+
+TPU-first design decisions:
+- Window partition/reverse are pure reshapes/transposes of a statically
+  padded NHWC tensor — no gather ops; the shifted variant is two
+  ``jnp.roll``s (XLA lowers to concat-of-slices, cheap on TPU).
+- Attention is one batched einsum over all windows at once:
+  [B·nW, heads, win², head_dim] — a large MXU contraction instead of
+  many small ones.
+- Shifted-window masking uses the standard region-id trick computed
+  from static window geometry at trace time.
+- Tensor parallelism: the train step is shard_map-manual, so head
+  sharding is expressed with explicit in_specs on a ``model`` axis by
+  the TP step builder, not with boxed param metadata (which conflicts
+  with manual mesh axes).  Heads-per-device stays an integer for every
+  power-of-two ``model`` size up to the head count.
+- Resolutions that need global (non-windowed) attention at pod scale
+  route through ``parallel.ring_attention`` (the ``seq`` axis); at SOD
+  resolutions windows fit on-chip and the ring is size 1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+Dtype = Any
+
+
+def window_partition(x: jnp.ndarray, w: int) -> jnp.ndarray:
+    """[B,H,W,C] → [B·nW, w·w, C]; H,W must be multiples of w."""
+    b, h, wd, c = x.shape
+    x = x.reshape(b, h // w, w, wd // w, w, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(-1, w * w, c)
+
+
+def window_reverse(x: jnp.ndarray, w: int, h: int, wd: int) -> jnp.ndarray:
+    """Inverse of :func:`window_partition`."""
+    b = x.shape[0] // ((h // w) * (wd // w))
+    x = x.reshape(b, h // w, wd // w, w, w, -1)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h, wd, -1)
+
+
+def _relative_position_index(w: int) -> np.ndarray:
+    """Static [w²,w²] index into the (2w-1)² relative-bias table."""
+    coords = np.stack(np.meshgrid(np.arange(w), np.arange(w),
+                                  indexing="ij")).reshape(2, -1)
+    rel = coords[:, :, None] - coords[:, None, :]  # 2, w², w²
+    rel = rel.transpose(1, 2, 0) + (w - 1)
+    return (rel[..., 0] * (2 * w - 1) + rel[..., 1]).astype(np.int32)
+
+
+def _shift_attn_mask(h: int, wd: int, w: int, shift: int) -> np.ndarray:
+    """Static region-id mask for shifted windows: [nW, w², w²] bool
+    (True = may attend)."""
+    img = np.zeros((h, wd), np.int32)
+    cnt = 0
+    for hs in (slice(0, -w), slice(-w, -shift), slice(-shift, None)):
+        for ws in (slice(0, -w), slice(-w, -shift), slice(-shift, None)):
+            img[hs, ws] = cnt
+            cnt += 1
+    ids = window_partition(img[None, ..., None].astype(np.float32), w)
+    ids = np.asarray(ids).squeeze(-1).astype(np.int32)  # [nW, w²]
+    return ids[:, :, None] == ids[:, None, :]
+
+
+class WindowAttention(nn.Module):
+    dim: int
+    heads: int
+    window: int
+    axis_name: Optional[str] = None  # unused (no BN); uniform ctor surface
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        """x: [nB, w², C]; mask: [nW, w², w²] bool or None."""
+        nb, n, c = x.shape
+        hd = self.dim // self.heads
+        dense_kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        qkv = nn.Dense(self.dim * 3, use_bias=True,
+                       kernel_init=nn.initializers.xavier_uniform(),
+                       **dense_kw)(x)
+        qkv = qkv.reshape(nb, n, 3, self.heads, hd).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]  # [nB, H, n, hd]
+
+        bias_table = self.param(
+            "rel_pos_bias", nn.initializers.truncated_normal(0.02),
+            ((2 * self.window - 1) ** 2, self.heads), self.param_dtype)
+        idx = _relative_position_index(self.window)
+        bias = bias_table[idx.reshape(-1)].reshape(n, n, self.heads)
+        bias = bias.transpose(2, 0, 1)[None]  # [1, H, n, n]
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32)
+        s = s / np.sqrt(hd) + bias.astype(jnp.float32)
+        if mask is not None:
+            nw = mask.shape[0]
+            s = s.reshape(nb // nw, nw, self.heads, n, n)
+            s = jnp.where(mask[None, :, None], s, -1e9)
+            s = s.reshape(nb, self.heads, n, n)
+        p = jax.nn.softmax(s, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        out = out.transpose(0, 2, 1, 3).reshape(nb, n, self.dim)
+        return nn.Dense(self.dim,
+                        kernel_init=nn.initializers.xavier_uniform(),
+                        **dense_kw)(out)
+
+
+class SwinBlock(nn.Module):
+    dim: int
+    heads: int
+    window: int
+    shift: int = 0
+    mlp_ratio: float = 4.0
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        """x: [B, H, W, C] with H,W already multiples of ``window``."""
+        b, h, wd, c = x.shape
+        ln_kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        y = nn.LayerNorm(**ln_kw)(x)
+        if self.shift:
+            y = jnp.roll(y, (-self.shift, -self.shift), axis=(1, 2))
+            mask = jnp.asarray(_shift_attn_mask(h, wd, self.window, self.shift))
+        else:
+            mask = None
+        y = window_partition(y, self.window)
+        y = WindowAttention(self.dim, self.heads, self.window,
+                            dtype=self.dtype, param_dtype=self.param_dtype)(
+            y, mask)
+        y = window_reverse(y, self.window, h, wd)
+        if self.shift:
+            y = jnp.roll(y, (self.shift, self.shift), axis=(1, 2))
+        x = x + y
+
+        z = nn.LayerNorm(**ln_kw)(x)
+        z = nn.Dense(int(self.dim * self.mlp_ratio), dtype=self.dtype,
+                     param_dtype=self.param_dtype)(z)
+        z = nn.gelu(z)
+        z = nn.Dense(self.dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype)(z)
+        return x + z
+
+
+class SwinT(nn.Module):
+    """Swin-Tiny; returns a 4-level pyramid (strides 4/8/16/32)."""
+
+    embed_dim: int = 96
+    depths: Sequence[int] = (2, 2, 6, 2)
+    heads: Sequence[int] = (3, 6, 12, 24)
+    window: int = 7
+    axis_name: Optional[str] = None  # no BN; kept for zoo ctor parity
+    bn_momentum: float = 0.9        # idem
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> List[jnp.ndarray]:
+        del train  # no dropout/BN in this deployment
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.embed_dim, (4, 4), strides=(4, 4), padding="VALID",
+                    dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+
+        feats: List[jnp.ndarray] = []
+        dim = self.embed_dim
+        for stage, (depth, heads) in enumerate(zip(self.depths, self.heads)):
+            if stage:
+                # Patch merging: 2×2 neighbourhood concat → linear to 2C.
+                b, h, wd, c = x.shape
+                x = x[:, : h - h % 2, : wd - wd % 2]
+                x = jnp.concatenate(
+                    [x[:, 0::2, 0::2], x[:, 1::2, 0::2],
+                     x[:, 0::2, 1::2], x[:, 1::2, 1::2]], axis=-1)
+                x = nn.LayerNorm(dtype=self.dtype,
+                                 param_dtype=self.param_dtype)(x)
+                dim *= 2
+                x = nn.Dense(dim, use_bias=False, dtype=self.dtype,
+                             param_dtype=self.param_dtype)(x)
+
+            # Pad to window multiples (static — shapes known at trace).
+            b, h, wd, c = x.shape
+            w = min(self.window, h, wd)
+            ph = (-h) % w
+            pw = (-wd) % w
+            if ph or pw:
+                x = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)))
+            for i in range(depth):
+                shift = w // 2 if (i % 2 and min(x.shape[1:3]) > w) else 0
+                x = SwinBlock(dim, heads, w, shift=shift, dtype=self.dtype,
+                              param_dtype=self.param_dtype)(x)
+            x = x[:, :h, :wd]
+            feats.append(
+                nn.LayerNorm(dtype=self.dtype,
+                             param_dtype=self.param_dtype)(x))
+        return feats

@@ -39,12 +39,21 @@ class ConvBNAct(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        # Explicit symmetric padding (= torch's padding=k//2·dilation).
+        # XLA's "SAME" pads (0,1) at stride 2 — one pixel off from the
+        # torch alignment ImageNet weights were trained with, which
+        # would silently degrade every ported backbone.  Identical to
+        # SAME at stride 1 with odd kernels.
+        if self.kernel[0] % 2 and self.kernel[1] % 2:
+            pad = [(self.dilation * (k // 2),) * 2 for k in self.kernel]
+        else:
+            pad = "SAME"
         x = nn.Conv(
             self.features,
             self.kernel,
             strides=(self.strides, self.strides),
             kernel_dilation=(self.dilation, self.dilation),
-            padding="SAME",
+            padding=pad,
             use_bias=not self.use_bn,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

@@ -1,0 +1,113 @@
+"""Merge ported ImageNet backbone weights into zoo param trees.
+
+The port tool (tools/port_torch_weights.py) emits backbone-level
+{params, batch_stats} trees.  Models embed the backbone at different
+scopes (``VGG16_0`` in MINet, ``vgg_rgb``/``vgg_depth`` in HDFNet, …),
+so the loader finds every subtree that *structurally matches* the
+ported tree — same child names and leaf shapes — and swaps it in
+(HDFNet: both streams get the same ImageNet init, the standard RGB-D
+practice of initialising the depth stream from the RGB weights).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def save_npz(path: str, params: Dict, stats: Dict) -> None:
+    """Flatten {params, batch_stats} into an npz with /-joined keys
+    (the interchange format tools/port_torch_weights.py writes)."""
+    flat: Dict[str, np.ndarray] = {}
+
+    def walk(prefix, tree, out):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                walk(f"{prefix}{k}/", v, out)
+            else:
+                out[f"{prefix}{k}"] = np.asarray(v)
+
+    walk("params/", params, flat)
+    walk("batch_stats/", stats, flat)
+    np.savez(path, **flat)
+
+
+def load_npz(path: str) -> Tuple[Dict, Dict]:
+    """Inverse of :func:`save_npz`."""
+    flat = np.load(path)
+    params: Dict = {}
+    stats: Dict = {}
+    for key in flat.files:
+        parts = key.split("/")
+        root = params if parts[0] == "params" else stats
+        node = root
+        for p in parts[1:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = flat[key]
+    return params, stats
+
+
+def _is_prefix_match(subtree: Dict, ported: Dict) -> bool:
+    """ported's keys are a subset-by-name with equal leaf shapes."""
+    for k, v in ported.items():
+        if k not in subtree:
+            return False
+        if isinstance(v, dict):
+            if not isinstance(subtree[k], dict) or not _is_prefix_match(
+                    subtree[k], v):
+                return False
+        else:
+            tgt = subtree[k]
+            if isinstance(tgt, dict) or tuple(np.shape(tgt)) != tuple(v.shape):
+                return False
+    return True
+
+
+def _merge(subtree: Dict, ported: Dict) -> Dict:
+    out = dict(subtree)
+    for k, v in ported.items():
+        if isinstance(v, dict):
+            out[k] = _merge(subtree[k], v)
+        else:
+            out[k] = jnp.asarray(v, jnp.asarray(subtree[k]).dtype)
+    return out
+
+
+def _find_and_merge(tree: Dict, ported: Dict, path="") -> Tuple[Dict, List[str]]:
+    if _is_prefix_match(tree, ported):
+        return _merge(tree, ported), [path or "/"]
+    hits: List[str] = []
+    out = dict(tree)
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            merged, sub_hits = _find_and_merge(v, ported, f"{path}/{k}")
+            if sub_hits:
+                out[k] = merged
+                hits.extend(sub_hits)
+    return out, hits
+
+
+def load_pretrained(variables: Dict[str, Any], npz_path: str) -> Dict[str, Any]:
+    """Return ``variables`` with every matching backbone subtree replaced
+    by the ported weights from ``npz_path``.  Raises if nothing matches
+    (a silently ignored checkpoint is the worst failure mode)."""
+    p_params, p_stats = load_npz(npz_path)
+    new_params, hits = _find_and_merge(variables["params"], p_params)
+    if not hits:
+        raise ValueError(
+            f"{npz_path}: no subtree of the model's params matches the "
+            "ported backbone (wrong --arch or wrong model?)")
+    out = dict(variables)
+    out["params"] = new_params
+    if p_stats and "batch_stats" in variables:
+        new_stats, s_hits = _find_and_merge(variables["batch_stats"], p_stats)
+        if s_hits:
+            out["batch_stats"] = new_stats
+    from ..utils.logging import get_logger
+
+    get_logger().info("loaded pretrained backbone %s into %s",
+                      npz_path, ", ".join(hits))
+    return out

@@ -89,6 +89,18 @@ def _build_basnet(cfg, *, dtype, param_dtype, axis_name):
     )
 
 
+@register_model("swin_sod")
+def _build_swin_sod(cfg, *, dtype, param_dtype, axis_name):
+    from .swin_sod import SwinSOD
+
+    return SwinSOD(
+        axis_name=axis_name,
+        bn_momentum=cfg.bn_momentum,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )
+
+
 @register_model("hdfnet")
 def _build_hdfnet(cfg, *, dtype, param_dtype, axis_name):
     from .hdfnet import HDFNet

@@ -1,0 +1,3 @@
+from .fused_loss import fused_bce_iou_cel, pixel_region_sums
+
+__all__ = ["fused_bce_iou_cel", "pixel_region_sums"]

@@ -76,7 +76,8 @@ def fit(
     tx, schedule = build_optimizer(cfg.optim, total_steps)
 
     sample = next(iter(loader))
-    state = create_train_state(jax.random.key(cfg.seed), model, tx, sample)
+    state = create_train_state(jax.random.key(cfg.seed), model, tx, sample,
+                               pretrained=cfg.model.pretrained)
     log.info("model=%s params=%.2fM devices=%d global_batch=%d "
              "steps/epoch=%d total_steps=%d",
              cfg.model.name, param_count(state) / 1e6, n_dev,

@@ -30,14 +30,20 @@ class TrainState:
         return {"params": self.params, "batch_stats": self.batch_stats}
 
 
-def create_train_state(rng, model, tx, sample_batch) -> TrainState:
+def create_train_state(rng, model, tx, sample_batch,
+                       pretrained: str = None) -> TrainState:
     """Initialise params/batch_stats from one (host-side) sample batch
-    and wrap them with the optimizer's initial state."""
+    and wrap them with the optimizer's initial state.  ``pretrained``
+    merges a ported ImageNet backbone (.npz) over the fresh init."""
     image = jnp.asarray(sample_batch["image"])
     depth = sample_batch.get("depth")
     if depth is not None:
         depth = jnp.asarray(depth)
     variables = model.init(rng, image, depth, train=False)
+    if pretrained:
+        from ..models.pretrained import load_pretrained
+
+        variables = load_pretrained(variables, pretrained)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     return TrainState(

@@ -35,6 +35,7 @@ def _loss_kwargs(loss_cfg) -> Dict[str, Any]:
         ssim_w=loss_cfg.ssim,
         cel_w=loss_cfg.cel,
         ssim_window=loss_cfg.ssim_window,
+        fused=loss_cfg.fused_kernel,
     )
 
 

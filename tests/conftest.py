@@ -27,6 +27,12 @@ import jax  # noqa: E402  (a re-import if sitecustomize already pulled it in)
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "float32")
+# Persistent compilation cache: this sandbox has ONE core, and the
+# model-zoo compiles dominate suite time — cache them across runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_pytest_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_enable_xla_caches",
+                  "xla_gpu_per_fusion_autotune_cache_dir")
 
 import pytest  # noqa: E402
 

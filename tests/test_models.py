@@ -198,3 +198,46 @@ def test_registry_builds_all_zoo_models():
     from distributed_sod_project_tpu.models import list_models
 
     assert {"minet", "u2net", "basnet", "hdfnet"} <= set(list_models())
+
+
+def test_swin_backbone_pyramid_shapes():
+    from distributed_sod_project_tpu.models.backbones.swin import SwinT
+
+    m = SwinT()
+    x = jnp.zeros((1, 64, 64, 3))
+    feats = m.apply(m.init(jax.random.key(0), x), x)
+    assert [f.shape for f in feats] == [
+        (1, 16, 16, 96), (1, 8, 8, 192), (1, 4, 4, 384), (1, 2, 2, 768)]
+
+
+def test_swin_window_partition_roundtrip():
+    from distributed_sod_project_tpu.models.backbones.swin import (
+        window_partition, window_reverse)
+
+    x = jax.random.normal(jax.random.key(0), (2, 8, 12, 5))
+    w = 4
+    parts = window_partition(x, w)
+    assert parts.shape == (2 * 2 * 3, 16, 5)
+    back = window_reverse(parts, w, 8, 12)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_swin_sod_outputs_and_finite_grads():
+    from distributed_sod_project_tpu.models.swin_sod import SwinSOD
+
+    model = SwinSOD(width=32)
+    x = jax.random.normal(jax.random.key(1), (1, 64, 64, 3))
+    y = (jax.random.uniform(jax.random.key(2), (1, 64, 64, 1)) > 0.5).astype(
+        jnp.float32)
+    _finite_grad_check(model, x, y, n_outputs=3)
+
+
+def test_swin_nondivisible_input_padding():
+    # 56 = 8*7: stride-4 map is 14 (divisible by 7), stride-8 is 7,
+    # stride-16 is 3 (needs pad→window clamp), stride-32 is 1.
+    from distributed_sod_project_tpu.models.backbones.swin import SwinT
+
+    m = SwinT()
+    x = jnp.zeros((1, 56, 56, 3))
+    feats = m.apply(m.init(jax.random.key(0), x), x)
+    assert [f.shape[1] for f in feats] == [14, 7, 3, 1]

@@ -1,0 +1,76 @@
+"""Pallas fused loss vs reference losses (SURVEY.md §2.2; CPU interpret
+mode — the same kernel compiles for TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sod_project_tpu.losses import deep_supervision_loss
+from distributed_sod_project_tpu.losses.elementwise import bce_with_logits
+from distributed_sod_project_tpu.losses.region import cel_loss, iou_loss
+from distributed_sod_project_tpu.pallas import (
+    fused_bce_iou_cel, pixel_region_sums)
+
+
+def _data(b=2, h=16, w=16, seed=0):
+    kx, kt = jax.random.split(jax.random.key(seed))
+    x = jax.random.normal(kx, (b, h, w, 1)) * 3.0
+    t = (jax.random.uniform(kt, (b, h, w, 1)) > 0.5).astype(jnp.float32)
+    return x, t
+
+
+def test_pixel_region_sums_match_numpy():
+    x, t = _data()
+    bce, inter, psum, tsum = pixel_region_sums(x, t)
+    xn = np.asarray(x, np.float64).reshape(2, -1)
+    tn = np.asarray(t, np.float64).reshape(2, -1)
+    p = 1 / (1 + np.exp(-xn))
+    ref_bce = (np.maximum(xn, 0) - xn * tn + np.log1p(np.exp(-np.abs(xn)))).sum(-1)
+    np.testing.assert_allclose(np.asarray(bce), ref_bce, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(inter), (p * tn).sum(-1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(psum), p.sum(-1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(tsum), tn.sum(-1), rtol=1e-5)
+
+
+@pytest.mark.parametrize("weights", [
+    (1.0, 1.0, 0.0), (1.0, 0.0, 1.0), (0.7, 1.3, 0.5)])
+def test_fused_loss_matches_reference(weights):
+    bce_w, iou_w, cel_w = weights
+    x, t = _data(seed=1)
+    fused = fused_bce_iou_cel(x, t, bce_w, iou_w, cel_w)
+    ref = (bce_w * bce_with_logits(x, t) + iou_w * iou_loss(x, t)
+           + cel_w * cel_loss(x, t))
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-5)
+
+
+@pytest.mark.parametrize("weights", [
+    (1.0, 1.0, 0.0), (1.0, 1.0, 1.0), (0.0, 1.0, 0.0)])
+def test_fused_loss_grads_match_reference(weights):
+    bce_w, iou_w, cel_w = weights
+    x, t = _data(seed=2)
+
+    g_fused = jax.grad(
+        lambda a: fused_bce_iou_cel(a, t, bce_w, iou_w, cel_w))(x)
+    g_ref = jax.grad(
+        lambda a: bce_w * bce_with_logits(a, t) + iou_w * iou_loss(a, t)
+        + cel_w * cel_loss(a, t))(x)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref),
+                               atol=1e-6, rtol=1e-4)
+
+
+def test_deep_supervision_fused_path_matches():
+    x1, t = _data(seed=3)
+    x2, _ = _data(seed=4)
+    logits = [x1, x2]
+    kw = dict(bce_w=1.0, iou_w=1.0, ssim_w=1.0, cel_w=0.5)
+    ref_total, _ = deep_supervision_loss(logits, t, **kw)
+    fused_total, comps = deep_supervision_loss(logits, t, fused=True, **kw)
+    np.testing.assert_allclose(float(fused_total), float(ref_total), rtol=1e-5)
+    assert "bce_iou_cel" in comps and "ssim" in comps
+
+
+def test_fused_rejects_unaligned_pixel_count():
+    x = jnp.zeros((2, 5, 5, 1))
+    with pytest.raises(ValueError, match="multiple of 128"):
+        pixel_region_sums(x, x)

@@ -1,0 +1,254 @@
+"""Weight-porting equivalence tests (SURVEY.md §7.3 hard part 1).
+
+torchvision is not installed here, so the tests build torch modules with
+the SAME structure and state_dict ordering as torchvision's vgg16 /
+vgg16_bn / resnet50 / resnet34, randomize their weights, port with
+tools/port_torch_weights.py, and assert the flax backbones reproduce the
+torch forward activations.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+sys.path.insert(0, "/root/repo")
+from tools.port_torch_weights import (  # noqa: E402
+    load_npz, port_resnet, port_vgg16, save_npz)
+
+from distributed_sod_project_tpu.models.backbones import (  # noqa: E402
+    ResNet34, ResNet50, VGG16)
+
+
+def _torch_vgg16(bn: bool) -> tnn.Module:
+    """torchvision.models.vgg16(_bn).features — same module order."""
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512]
+    layers, c_in = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(tnn.MaxPool2d(2, 2))
+        else:
+            layers.append(tnn.Conv2d(c_in, v, 3, padding=1, bias=not bn))
+            if bn:
+                layers.append(tnn.BatchNorm2d(v))
+            layers.append(tnn.ReLU(inplace=False))
+            c_in = v
+    return tnn.Sequential(*layers)
+
+
+class _TorchBottleneck(tnn.Module):
+    expansion = 4
+
+    def __init__(self, c_in, width, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(c_in, width, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.conv2 = tnn.Conv2d(width, width, 3, stride=stride, padding=1,
+                                bias=False)
+        self.bn2 = tnn.BatchNorm2d(width)
+        self.conv3 = tnn.Conv2d(width, width * 4, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(width * 4)
+        self.relu = tnn.ReLU(inplace=False)
+        self.downsample = None
+        if stride != 1 or c_in != width * 4:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(c_in, width * 4, 1, stride=stride, bias=False),
+                tnn.BatchNorm2d(width * 4))
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return self.relu(y + idt)
+
+
+class _TorchBasicBlock(tnn.Module):
+    expansion = 1
+
+    def __init__(self, c_in, width, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(c_in, width, 3, stride=stride, padding=1,
+                                bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.conv2 = tnn.Conv2d(width, width, 3, padding=1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(width)
+        self.relu = tnn.ReLU(inplace=False)
+        self.downsample = None
+        if stride != 1 or c_in != width:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(c_in, width, 1, stride=stride, bias=False),
+                tnn.BatchNorm2d(width))
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return self.relu(y + idt)
+
+
+class _TorchResNet(tnn.Module):
+    """torchvision.models.resnet{34,50} trunk (no fc/avgpool)."""
+
+    def __init__(self, block, stage_sizes):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.relu = tnn.ReLU(inplace=False)
+        self.maxpool = tnn.MaxPool2d(3, stride=2, padding=1)
+        c_in = 64
+        for i, (n, w) in enumerate(zip(stage_sizes, (64, 128, 256, 512))):
+            blocks = []
+            for b in range(n):
+                stride = 2 if (b == 0 and i > 0) else 1
+                blocks.append(block(c_in, w, stride))
+                c_in = w * block.expansion
+            setattr(self, f"layer{i+1}", tnn.Sequential(*blocks))
+
+    def forward_pyramid(self, x):
+        feats = []
+        x = self.relu(self.bn1(self.conv1(x)))
+        feats.append(x)
+        x = self.maxpool(x)
+        for i in range(4):
+            x = getattr(self, f"layer{i+1}")(x)
+            feats.append(x)
+        return feats
+
+
+def _randomize_bn_stats(model):
+    g = torch.Generator().manual_seed(0)
+    for m in model.modules():
+        if isinstance(m, tnn.BatchNorm2d):
+            m.running_mean.copy_(torch.randn(m.running_mean.shape, generator=g) * 0.1)
+            m.running_var.copy_(torch.rand(m.running_var.shape, generator=g) + 0.5)
+
+
+def _vgg_torch_pyramid(model, x, bn):
+    """Outputs after each stage's last ReLU (pre-pool), 5 levels."""
+    feats, stage_convs = [], [2, 2, 3, 3, 3]
+    it = iter(model)
+    for n in stage_convs:
+        for _ in range(n):
+            x = next(it)(x)          # conv
+            if bn:
+                x = next(it)(x)      # bn
+            x = next(it)(x)          # relu
+        feats.append(x)
+        nxt = next(it, None)         # pool (absent after stage 5)
+        if nxt is not None:
+            x = nxt(x)
+    return feats
+
+
+@pytest.mark.parametrize("bn", [False, True])
+def test_vgg16_port_matches_torch(bn):
+    tm = _torch_vgg16(bn).eval()
+    with torch.no_grad():
+        _randomize_bn_stats(tm)
+        x = torch.randn(1, 3, 32, 32, generator=torch.Generator().manual_seed(1))
+        ref = [t.permute(0, 2, 3, 1).numpy() for t in
+               _vgg_torch_pyramid(tm, x, bn)]
+
+    params, stats = port_vgg16(tm.state_dict(), use_bn=bn)
+    fm = VGG16(use_bn=bn)
+    variables = {"params": params}
+    if bn:
+        variables["batch_stats"] = stats
+    outs = fm.apply(jax.tree_util.tree_map(jnp.asarray, variables),
+                    jnp.asarray(x.permute(0, 2, 3, 1).numpy()), train=False)
+    for lvl, (o, r) in enumerate(zip(outs, ref)):
+        np.testing.assert_allclose(np.asarray(o), r, atol=1e-4, rtol=1e-4,
+                                   err_msg=f"vgg level {lvl}")
+
+
+@pytest.mark.parametrize("arch,block,flax_cls", [
+    ("resnet50", _TorchBottleneck, ResNet50),
+    ("resnet34", _TorchBasicBlock, ResNet34),
+])
+def test_resnet_port_matches_torch(arch, block, flax_cls):
+    tm = _TorchResNet(block, (3, 4, 6, 3)).eval()
+    with torch.no_grad():
+        _randomize_bn_stats(tm)
+        x = torch.randn(1, 3, 64, 64, generator=torch.Generator().manual_seed(2))
+        ref = [t.permute(0, 2, 3, 1).numpy() for t in tm.forward_pyramid(x)]
+
+    params, stats = port_resnet(tm.state_dict(), arch)
+    fm = flax_cls()
+    outs = fm.apply(
+        jax.tree_util.tree_map(
+            jnp.asarray, {"params": params, "batch_stats": stats}),
+        jnp.asarray(x.permute(0, 2, 3, 1).numpy()), train=False)
+    for lvl, (o, r) in enumerate(zip(outs, ref)):
+        np.testing.assert_allclose(np.asarray(o), r, atol=5e-4, rtol=5e-4,
+                                   err_msg=f"{arch} level {lvl}")
+
+
+def test_npz_roundtrip(tmp_path):
+    tm = _torch_vgg16(True).eval()
+    params, stats = port_vgg16(tm.state_dict(), use_bn=True)
+    path = str(tmp_path / "w.npz")
+    save_npz(path, params, stats)
+    p2, s2 = load_npz(path)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree_util.tree_leaves(stats),
+                    jax.tree_util.tree_leaves(s2)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_load_pretrained_into_minet_and_hdfnet(tmp_path):
+    from distributed_sod_project_tpu.models.minet import MINet
+    from distributed_sod_project_tpu.models.hdfnet import HDFNet
+    from distributed_sod_project_tpu.models.pretrained import load_pretrained
+
+    tm = _torch_vgg16(True).eval()
+    with torch.no_grad():
+        _randomize_bn_stats(tm)
+    params, stats = port_vgg16(tm.state_dict(), use_bn=True)
+    path = str(tmp_path / "vgg16_bn.npz")
+    save_npz(path, params, stats)
+
+    x = jnp.zeros((1, 32, 32, 3))
+    m = MINet(backbone="vgg16")
+    v = m.init(jax.random.key(0), x, train=False)
+    v2 = load_pretrained(v, path)
+    # the backbone conv kernel now equals the ported torch weight
+    got = np.asarray(v2["params"]["VGG16_0"]["ConvBNAct_0"]["Conv_0"]["kernel"])
+    want = tm.state_dict()["0.weight"].permute(2, 3, 1, 0).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # non-backbone params untouched
+    for k in v["params"]:
+        if k != "VGG16_0":
+            for a, b in zip(jax.tree_util.tree_leaves(v["params"][k]),
+                            jax.tree_util.tree_leaves(v2["params"][k])):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # HDFNet: BOTH streams receive the backbone init
+    hm = HDFNet(backbone="vgg16")
+    hv = hm.init(jax.random.key(0), x, jnp.zeros((1, 32, 32, 1)), train=False)
+    hv2 = load_pretrained(hv, path)
+    for scope in ("vgg_rgb", "vgg_depth"):
+        got = np.asarray(hv2["params"][scope]["ConvBNAct_0"]["Conv_0"]["kernel"])
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_load_pretrained_mismatch_raises(tmp_path):
+    from distributed_sod_project_tpu.models.u2net import U2Net
+    from distributed_sod_project_tpu.models.pretrained import load_pretrained
+
+    tm = _torch_vgg16(True).eval()
+    params, stats = port_vgg16(tm.state_dict(), use_bn=True)
+    path = str(tmp_path / "w.npz")
+    save_npz(path, params, stats)
+    m = U2Net(small=True)
+    v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    with pytest.raises(ValueError, match="no subtree"):
+        load_pretrained(v, path)

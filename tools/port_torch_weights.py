@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Port torchvision ImageNet backbone weights → flax param trees.
+
+SURVEY.md §7.3 hard part 1: this zero-egress environment cannot download
+ImageNet checkpoints, so paper-level DUTS numbers need this script run
+once wherever network (or a cached ``~/.cache/torch``) exists:
+
+    python tools/port_torch_weights.py --arch vgg16 --out vgg16.npz
+    python tools/port_torch_weights.py --arch resnet50 --state-dict r50.pth \
+        --out resnet50.npz
+    python train.py --config minet_r50_dp --set model.pretrained=resnet50.npz
+
+The mapping is structural, not name-matched: both torchvision and our
+backbones enumerate convs/BNs in execution order, so the port walks the
+two sequences in lockstep.  Layout transforms:
+
+- conv kernels: torch OIHW → flax HWIO (transpose 2,3,1,0)
+- linear: torch [out,in] → flax [in,out] (unused by the pyramids, kept
+  for completeness)
+- BN: weight/bias/running_mean/running_var → scale/bias/mean/var
+
+Verified by tests/test_weight_port.py: random torch weights pushed
+through torchvision's forward and ours agree to float tolerance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def _t2n(t) -> np.ndarray:
+    return np.asarray(t.detach().cpu().numpy(), np.float32)
+
+
+def _conv_kernel(t) -> np.ndarray:
+    return _t2n(t).transpose(2, 3, 1, 0)  # OIHW → HWIO
+
+
+def _ordered_convs_and_bns(state_dict) -> List[Tuple[str, Dict[str, np.ndarray]]]:
+    """Group a torchvision state_dict into execution-ordered conv/bn units.
+
+    Works for vgg16/vgg16_bn/resnet* because their state_dicts enumerate
+    modules in definition order == execution order.
+    """
+    units: List[Tuple[str, Dict[str, np.ndarray]]] = []
+    by_prefix: Dict[str, Dict[str, np.ndarray]] = {}
+    order: List[str] = []
+    for key, val in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        prefix, leaf = key.rsplit(".", 1)
+        if prefix not in by_prefix:
+            by_prefix[prefix] = {}
+            order.append(prefix)
+        by_prefix[prefix][leaf] = val
+    for prefix in order:
+        leaves = by_prefix[prefix]
+        if "running_mean" in leaves:
+            units.append(("bn", {
+                "scale": _t2n(leaves["weight"]),
+                "bias": _t2n(leaves["bias"]),
+                "mean": _t2n(leaves["running_mean"]),
+                "var": _t2n(leaves["running_var"]),
+            }))
+        elif "weight" in leaves and leaves["weight"].dim() == 4:
+            unit = {"kernel": _conv_kernel(leaves["weight"])}
+            if "bias" in leaves:
+                unit["bias"] = _t2n(leaves["bias"])
+            units.append(("conv", unit))
+        # linear heads (classifier) are dropped: pyramids don't use them.
+    return units
+
+
+def port_vgg16(state_dict, use_bn: bool):
+    """→ (params, batch_stats) trees matching backbones/vgg.py VGG16."""
+    units = _ordered_convs_and_bns(state_dict)
+    convs = [u for k, u in units if k == "conv"]
+    bns = [u for k, u in units if k == "bn"]
+    n_convs = 13
+    assert len(convs) == n_convs, f"vgg16 expects 13 convs, got {len(convs)}"
+    if use_bn:
+        assert len(bns) == n_convs, "vgg16_bn expects a BN per conv"
+    params: Dict = {}
+    stats: Dict = {}
+    for i in range(n_convs):
+        scope = f"ConvBNAct_{i}"
+        conv = {"kernel": convs[i]["kernel"]}
+        if not use_bn:
+            conv["bias"] = convs[i]["bias"]
+            params[scope] = {"Conv_0": conv}
+        else:
+            params[scope] = {
+                "Conv_0": conv,
+                "BatchNorm_0": {"scale": bns[i]["scale"],
+                                "bias": bns[i]["bias"]},
+            }
+            stats[scope] = {"BatchNorm_0": {"mean": bns[i]["mean"],
+                                            "var": bns[i]["var"]}}
+    return params, stats
+
+
+def _resnet_block_unit_counts(arch: str) -> Tuple[List[int], int]:
+    if arch in ("resnet34",):
+        return [3, 4, 6, 3], 2  # convs per BasicBlock
+    if arch in ("resnet50",):
+        return [3, 4, 6, 3], 3  # convs per Bottleneck
+    raise ValueError(f"unsupported arch {arch!r}")
+
+
+def port_resnet(state_dict, arch: str):
+    """→ (params, batch_stats) matching backbones/resnet.py ResNet.
+
+    Our blocks are ConvBNAct chains with the projection shortcut LAST
+    within each block's parameter list (it is created inside the
+    ``if residual...`` after the main path), whereas torchvision puts
+    ``downsample`` after the block's convs too — same relative order, so
+    the lockstep walk holds.
+    """
+    import torch  # local import: tool usable only where torch exists
+
+    stage_sizes, convs_per_block = _resnet_block_unit_counts(arch)
+    units = _ordered_convs_and_bns(state_dict)
+    # Pair every conv with its following bn (resnet always interleaves).
+    pairs = []
+    i = 0
+    while i < len(units):
+        kind, u = units[i]
+        if kind == "conv":
+            assert i + 1 < len(units) and units[i + 1][0] == "bn", \
+                "resnet conv without bn"
+            pairs.append((u, units[i + 1][1]))
+            i += 2
+        else:
+            i += 1
+
+    params: Dict = {}
+    stats: Dict = {}
+
+    def put(scope: str, conv, bn):
+        params[scope] = {
+            "Conv_0": {"kernel": conv["kernel"]},
+            "BatchNorm_0": {"scale": bn["scale"], "bias": bn["bias"]},
+        }
+        stats[scope] = {"BatchNorm_0": {"mean": bn["mean"], "var": bn["var"]}}
+
+    pi = 0
+    put("ConvBNAct_0", *pairs[pi]); pi += 1  # stem
+    block_cls = "BasicBlock" if convs_per_block == 2 else "Bottleneck"
+    bi = 0
+    for stage, n_blocks in enumerate(stage_sizes):
+        for b in range(n_blocks):
+            scope = f"{block_cls}_{bi}"; bi += 1
+            blk_params: Dict = {}
+            blk_stats: Dict = {}
+
+            def bput(sub, conv, bn):
+                blk_params[sub] = {
+                    "Conv_0": {"kernel": conv["kernel"]},
+                    "BatchNorm_0": {"scale": bn["scale"], "bias": bn["bias"]},
+                }
+                blk_stats[sub] = {"BatchNorm_0": {"mean": bn["mean"],
+                                                  "var": bn["var"]}}
+
+            for c in range(convs_per_block):
+                bput(f"ConvBNAct_{c}", *pairs[pi]); pi += 1
+            # torchvision: downsample conv+bn follow the block's convs
+            # exactly when the block projects (first block of a stage
+            # with stride/width change) — mirrored by our trailing
+            # projection ConvBNAct.
+            has_proj = (b == 0 and (stage > 0 or convs_per_block == 3))
+            if has_proj:
+                bput(f"ConvBNAct_{convs_per_block}", *pairs[pi]); pi += 1
+            params[scope] = blk_params
+            stats[scope] = blk_stats
+    assert pi == len(pairs), f"consumed {pi} of {len(pairs)} conv/bn pairs"
+    return params, stats
+
+
+# npz IO lives in the package (the training path loads these files);
+# re-exported here for script users.
+from distributed_sod_project_tpu.models.pretrained import (  # noqa: E402
+    load_npz, save_npz)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--arch", required=True,
+                   choices=["vgg16", "vgg16_bn", "resnet34", "resnet50"])
+    p.add_argument("--out", required=True, help="output .npz path")
+    p.add_argument("--state-dict", default=None,
+                   help="local .pth state_dict (default: download via "
+                        "torchvision, needs network)")
+    args = p.parse_args(argv)
+
+    import torch
+
+    if args.state_dict:
+        sd = torch.load(args.state_dict, map_location="cpu")
+        if hasattr(sd, "state_dict"):
+            sd = sd.state_dict()
+    else:
+        import torchvision.models as tvm
+
+        model = getattr(tvm, args.arch)(weights="IMAGENET1K_V1")
+        sd = model.state_dict()
+
+    if args.arch.startswith("vgg16"):
+        params, stats = port_vgg16(sd, use_bn=args.arch.endswith("_bn"))
+    else:
+        params, stats = port_resnet(sd, args.arch)
+    save_npz(args.out, params, stats)
+    n = sum(v.size for v in np.load(args.out).values())
+    print(f"wrote {args.out}: {n/1e6:.1f}M params")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
