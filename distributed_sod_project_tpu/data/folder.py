@@ -122,6 +122,35 @@ class FolderSOD:
             out["depth"] = self._load(self.depth_paths[stem], gray=True)
         return out
 
+    def load_batch(self, indices, hflip=None) -> Optional[Dict[str, np.ndarray]]:
+        """Native C++ batch decode (data/native.py); None when the
+        library is unbuilt or original sizes are kept (eval path)."""
+        from . import native
+
+        if self.keep_original_size or not native.available():
+            return None
+        stems = [self.stems[int(i)] for i in indices]
+        kw = dict(size_hw=self.image_size, hflip=hflip)
+        try:
+            out = {
+                "image": native.decode_batch(
+                    [self.img_paths[s] for s in stems], gray=False,
+                    mean=self.mean, std=self.std, **kw),
+                "mask": (native.decode_batch(
+                    [self.mask_paths[s] for s in stems], gray=True, **kw)
+                    > 0.5).astype(np.float32),
+                "index": np.asarray(indices, np.int32),
+            }
+            if self.depth_paths is not None:
+                out["depth"] = native.decode_batch(
+                    [self.depth_paths[s] for s in stems], gray=True, **kw)
+        except RuntimeError:
+            # Format the native decoder doesn't cover (BMP, CMYK JPEG…):
+            # this batch — and, via the caller's latch, the rest of the
+            # run — goes down the PIL path, which handles them all.
+            return None
+        return out
+
 
 def resolve_dataset(cfg) -> object:
     """Build a dataset from a DataConfig; falls back to synthetic when the

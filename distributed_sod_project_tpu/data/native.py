@@ -1,0 +1,93 @@
+"""ctypes binding for the native host data plane (native/dsod_host.cpp).
+
+Optional fast path: when ``native/build/libdsod_host.so`` has been built
+(``make -C native``), batched decode+resize+normalize(+hflip) runs in
+C++ threads without the GIL; otherwise callers fall back to the PIL
+path transparently (SURVEY.md §2.2 native-component row).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _lib_path() -> str:
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo_root, "native", "build", "libdsod_host.so")
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The shared library, or None when unbuilt/unloadable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        path = os.environ.get("DSOD_NATIVE_LIB", _lib_path())
+        if not os.path.exists(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.dsod_decode_batch.restype = ctypes.c_int
+        lib.dsod_decode_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+        ]
+        lib.dsod_version.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+def decode_batch(
+    paths: Sequence[str],
+    size_hw,
+    *,
+    gray: bool = False,
+    hflip: Optional[Sequence[bool]] = None,
+    mean=(0.0, 0.0, 0.0),
+    std=(1.0, 1.0, 1.0),
+    threads: int = 0,
+) -> np.ndarray:
+    """Decode ``paths`` → [N,H,W,C] float32, resized/normalised/flipped.
+
+    Raises RuntimeError naming the first file that failed to decode.
+    """
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError("native library not built (make -C native)")
+    n = len(paths)
+    h, w = int(size_hw[0]), int(size_hw[1])
+    c = 1 if gray else 3
+    out = np.empty((n, h, w, c), np.float32)
+    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    mean_a = (ctypes.c_float * c)(*([float(m) for m in mean[:c]] if not gray
+                                    else [float(mean[0])]))
+    std_a = (ctypes.c_float * c)(*([float(s) for s in std[:c]] if not gray
+                                   else [float(std[0])]))
+    flip_buf = None
+    if hflip is not None:
+        flip_buf = bytes(bytearray(1 if f else 0 for f in hflip))
+    rc = lib.dsod_decode_batch(
+        c_paths, n, h, w, int(gray), flip_buf, mean_a, std_a,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), int(threads))
+    if rc:
+        raise RuntimeError(f"native decode failed for {paths[rc - 1]!r}")
+    return out

@@ -79,14 +79,17 @@ class HostDataLoader:
             order = np.concatenate([order, order[:pad]])
         return order
 
+    @staticmethod
+    def _hflip_draw(aug_seed: int, idx: int) -> bool:
+        rng = np.random.default_rng(np.random.SeedSequence([aug_seed, int(idx)]))
+        return bool(rng.random() < 0.5)
+
     def _fetch(self, idx: int, aug_seed: int) -> Dict[str, np.ndarray]:
         sample = dict(self.dataset[int(idx)])
-        if self.hflip:
-            rng = np.random.default_rng(np.random.SeedSequence([aug_seed, int(idx)]))
-            if rng.random() < 0.5:
-                for k in ("image", "mask", "depth"):
-                    if k in sample:
-                        sample[k] = np.ascontiguousarray(sample[k][:, ::-1])
+        if self.hflip and self._hflip_draw(aug_seed, idx):
+            for k in ("image", "mask", "depth"):
+                if k in sample:
+                    sample[k] = np.ascontiguousarray(sample[k][:, ::-1])
         return sample
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
@@ -100,10 +103,23 @@ class HostDataLoader:
             if self.num_workers > 0
             else None
         )
+        native_batch = getattr(self.dataset, "load_batch", None)
         try:
             for step in range(steps):
                 lo = step * self.global_batch_size + self.shard_id * self.local_batch_size
                 idxs = order[lo : lo + self.local_batch_size]
+                if native_batch is not None:
+                    # C++ data plane: whole-batch decode without the GIL,
+                    # same per-index hflip draws as the PIL path.
+                    flags = [self.hflip and self._hflip_draw(aug_seed, i)
+                             for i in idxs]
+                    batch = native_batch(idxs, hflip=flags)
+                    if batch is not None:
+                        yield batch
+                        continue
+                    # Latch off: None is sticky (lib unbuilt / format
+                    # unsupported) — don't redo the probe every step.
+                    native_batch = None
                 if pool is not None:
                     samples = list(pool.map(lambda i: self._fetch(i, aug_seed), idxs))
                 else:

@@ -1,0 +1,260 @@
+// dsod_host — native host-side data plane for the TPU SOD framework.
+//
+// Replaces the reference's DataLoader worker-process decode path
+// (SURVEY.md §2 C7, §2.2 "DALI-style / libjpeg decode in DataLoader
+// workers") with an in-process C++ pipeline: libjpeg/libpng decode →
+// half-pixel bilinear resize → (optional hflip) → ImageNet
+// normalisation, parallelised over a batch with std::thread.  TPU hosts
+// feed many chips from one process; decode must not hold the GIL, so
+// the whole batch path is C++ and Python only sees the filled
+// float32 NHWC buffer (ctypes, zero copies beyond the decode itself).
+//
+// C ABI (see data/native.py):
+//   dsod_decode_batch(paths, n, H, W, gray, hflip_mask, mean, std, out)
+//     → 0 on success, else 1-based index of the first failed item.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <csetjmp>
+#include <string>
+#include <thread>
+#include <vector>
+#include <atomic>
+
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+struct Image {
+  int w = 0, h = 0, c = 0;     // c: 1 or 3
+  std::vector<uint8_t> data;   // HWC, row-major
+};
+
+// ---------------------------------------------------------------- JPEG
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+bool decode_jpeg(FILE* f, bool gray, Image* out) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = gray ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  out->w = cinfo.output_width;
+  out->h = cinfo.output_height;
+  out->c = cinfo.output_components;
+  out->data.resize(size_t(out->w) * out->h * out->c);
+  const size_t stride = size_t(out->w) * out->c;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data.data() + stride * cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// ----------------------------------------------------------------- PNG
+bool decode_png(FILE* f, bool gray, Image* out) {
+  png_structp png = png_create_read_struct(PNG_LIBPNG_VER_STRING,
+                                           nullptr, nullptr, nullptr);
+  if (!png) return false;
+  png_infop info = png_create_info_struct(png);
+  if (!info) {
+    png_destroy_read_struct(&png, nullptr, nullptr);
+    return false;
+  }
+  if (setjmp(png_jmpbuf(png))) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    return false;
+  }
+  png_init_io(png, f);
+  png_read_info(png, info);
+  // Normalise to 8-bit gray or RGB.
+  png_set_strip_16(png);
+  png_set_strip_alpha(png);
+  png_set_packing(png);
+  png_set_expand(png);
+  if (gray) {
+    if (png_get_color_type(png, info) & PNG_COLOR_MASK_COLOR)
+      png_set_rgb_to_gray_fixed(png, 1, -1, -1);
+  } else {
+    if (!(png_get_color_type(png, info) & PNG_COLOR_MASK_COLOR))
+      png_set_gray_to_rgb(png);
+  }
+  png_read_update_info(png, info);
+  out->w = png_get_image_width(png, info);
+  out->h = png_get_image_height(png, info);
+  out->c = png_get_channels(png, info);
+  out->data.resize(size_t(out->w) * out->h * out->c);
+  std::vector<png_bytep> rows(out->h);
+  const size_t stride = size_t(out->w) * out->c;
+  for (int y = 0; y < out->h; ++y)
+    rows[y] = out->data.data() + stride * y;
+  png_read_image(png, rows.data());
+  png_read_end(png, nullptr);
+  png_destroy_read_struct(&png, &info, nullptr);
+  return true;
+}
+
+bool decode_file(const char* path, bool gray, Image* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  uint8_t magic[2] = {0, 0};
+  if (fread(magic, 1, 2, f) != 2) {
+    fclose(f);
+    return false;
+  }
+  rewind(f);
+  bool ok = false;
+  if (magic[0] == 0xFF && magic[1] == 0xD8)
+    ok = decode_jpeg(f, gray, out);
+  else if (magic[0] == 0x89 && magic[1] == 0x50)
+    ok = decode_png(f, gray, out);
+  fclose(f);
+  return ok && out->c == (gray ? 1 : 3);
+}
+
+// ------------------------------------------------- resize + normalise
+// PIL-convention separable bilinear resampling: a triangle filter whose
+// support scales with the downscale ratio (antialiased), identical in
+// spirit to Pillow's ImagingResample with BILINEAR — so the native path
+// and the PIL fallback produce matching training data.  For upscale the
+// support clamps to 1 and this is classic half-pixel bilinear.
+struct ResampleAxis {
+  std::vector<int> lo;        // first source index per output index
+  std::vector<int> n;         // taps per output index
+  std::vector<float> w;       // taps, flattened, max_taps stride
+  int max_taps = 0;
+};
+
+ResampleAxis build_axis(int in_size, int out_size) {
+  ResampleAxis ax;
+  const double scale = double(in_size) / out_size;
+  const double fscale = scale < 1.0 ? 1.0 : scale;
+  const double support = 1.0 * fscale;  // triangle filter support
+  ax.max_taps = int(support) * 2 + 2;
+  ax.lo.resize(out_size);
+  ax.n.resize(out_size);
+  ax.w.assign(size_t(out_size) * ax.max_taps, 0.0f);
+  for (int o = 0; o < out_size; ++o) {
+    const double center = (o + 0.5) * scale;
+    // Pillow's window rounding (precompute_coeffs): ±support with +0.5.
+    int lo = int(center - support + 0.5);
+    if (lo < 0) lo = 0;
+    int hi = int(center + support + 0.5);
+    if (hi > in_size) hi = in_size;
+    double sum = 0.0;
+    std::vector<double> taps(hi - lo);
+    for (int x = lo; x < hi; ++x) {
+      double t = (x + 0.5 - center) / fscale;
+      double v = t < 0 ? 1.0 + t : 1.0 - t;  // triangle
+      if (v < 0) v = 0;
+      taps[x - lo] = v;
+      sum += v;
+    }
+    ax.lo[o] = lo;
+    ax.n[o] = hi - lo;
+    for (int k = 0; k < hi - lo; ++k)
+      ax.w[size_t(o) * ax.max_taps + k] =
+          float(sum > 0 ? taps[k] / sum : 0.0);
+  }
+  return ax;
+}
+
+void resize_normalize(const Image& im, int H, int W, bool hflip,
+                      const float* mean, const float* stdv, float* out) {
+  const int C = im.c;
+  const ResampleAxis axx = build_axis(im.w, W);
+  const ResampleAxis axy = build_axis(im.h, H);
+  // Horizontal pass: [im.h, W, C] floats.
+  std::vector<float> tmp(size_t(im.h) * W * C);
+  for (int y = 0; y < im.h; ++y) {
+    const uint8_t* src = im.data.data() + size_t(y) * im.w * C;
+    float* dst = tmp.data() + size_t(y) * W * C;
+    for (int o = 0; o < W; ++o) {
+      const float* w = &axx.w[size_t(o) * axx.max_taps];
+      for (int ch = 0; ch < C; ++ch) {
+        float acc = 0.0f;
+        for (int k = 0; k < axx.n[o]; ++k)
+          acc += w[k] * src[(axx.lo[o] + k) * C + ch];
+        dst[o * C + ch] = acc;
+      }
+    }
+  }
+  // Vertical pass + normalise + optional hflip on the write.
+  for (int o = 0; o < H; ++o) {
+    const float* w = &axy.w[size_t(o) * axy.max_taps];
+    for (int x = 0; x < W; ++x) {
+      int out_x = hflip ? (W - 1 - x) : x;
+      float* dst = out + (size_t(o) * W + out_x) * C;
+      for (int ch = 0; ch < C; ++ch) {
+        float acc = 0.0f;
+        for (int k = 0; k < axy.n[o]; ++k)
+          acc += w[k] * tmp[(size_t(axy.lo[o] + k) * W + x) * C + ch];
+        dst[ch] = (acc * (1.0f / 255.0f) - mean[ch]) / stdv[ch];
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// paths: n C-strings.  out: [n, H, W, C] f32 (C = gray ? 1 : 3).
+// hflip_mask: n bytes (0/1) or nullptr.  mean/stdv: C floats.
+// threads <= 0 → hardware_concurrency.  Returns 0 on success, else the
+// 1-based index of the first item that failed to decode.
+int dsod_decode_batch(const char** paths, int n, int H, int W, int gray,
+                      const uint8_t* hflip_mask, const float* mean,
+                      const float* stdv, float* out, int threads) {
+  const int C = gray ? 1 : 3;
+  const size_t item = size_t(H) * W * C;
+  std::atomic<int> next(0), failed(0);
+  int nt = threads > 0 ? threads
+                       : int(std::thread::hardware_concurrency());
+  if (nt < 1) nt = 1;
+  if (nt > n) nt = n;
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      Image im;
+      if (!decode_file(paths[i], gray != 0, &im)) {
+        int expect = 0;
+        failed.compare_exchange_strong(expect, i + 1);
+        continue;
+      }
+      bool hf = hflip_mask && hflip_mask[i];
+      resize_normalize(im, H, W, hf, mean, stdv, out + item * i);
+    }
+  };
+  if (nt == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(nt);
+    for (int t = 0; t < nt; ++t) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return failed.load();
+}
+
+int dsod_version() { return 1; }
+
+}  // extern "C"

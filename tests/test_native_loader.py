@@ -1,0 +1,148 @@
+"""Native C++ data-plane tests (native/dsod_host.cpp via data/native.py).
+
+Skipped wholesale when the library is unbuilt (`make -C native`); CI in
+this repo always builds it.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributed_sod_project_tpu.data import native
+
+if not native.available():
+    # one build attempt — the Makefile is fast (single TU)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run(["make", "-C", os.path.join(repo, "native")], check=False)
+    native._tried = False  # re-probe
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib unbuilt")
+
+
+@pytest.fixture(scope="module")
+def img_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("imgs")
+    rng = np.random.default_rng(0)
+    rgb = rng.integers(0, 256, (40, 56, 3), np.uint8)
+    gray = rng.integers(0, 256, (40, 56), np.uint8)
+    paths = {}
+    Image.fromarray(rgb).save(d / "a.png")
+    Image.fromarray(rgb).save(d / "a.jpg", quality=95)
+    Image.fromarray(gray).save(d / "g.png")
+    paths["png"] = str(d / "a.png")
+    paths["jpg"] = str(d / "a.jpg")
+    paths["gray"] = str(d / "g.png")
+    paths["rgb_arr"] = rgb
+    paths["gray_arr"] = gray
+    return paths
+
+
+def test_png_decode_identity_exact(img_files):
+    out = native.decode_batch([img_files["png"]], (40, 56))
+    ref = img_files["rgb_arr"].astype(np.float32) / 255.0
+    np.testing.assert_allclose(out[0], ref, atol=1e-6)
+
+
+def test_jpeg_decode_close_to_pil(img_files):
+    out = native.decode_batch([img_files["jpg"]], (40, 56))
+    with Image.open(img_files["jpg"]) as im:
+        ref = np.asarray(im.convert("RGB"), np.float32) / 255.0
+    # different IDCT implementations: allow a few grey levels
+    assert np.abs(out[0] - ref).max() < 6 / 255.0
+
+
+def test_gray_decode_and_normalize(img_files):
+    out = native.decode_batch([img_files["gray"]], (40, 56), gray=True,
+                              mean=(0.4,), std=(0.2,))
+    ref = (img_files["gray_arr"][..., None].astype(np.float32) / 255.0
+           - 0.4) / 0.2
+    np.testing.assert_allclose(out[0], ref, atol=1e-5)
+
+
+def test_resize_matches_pil(img_files):
+    out = native.decode_batch([img_files["png"]], (17, 23))
+    ref = np.asarray(
+        Image.fromarray(img_files["rgb_arr"]).resize((23, 17),
+                                                     Image.BILINEAR),
+        np.float32) / 255.0
+    # same triangle-filter convention; PIL uses 8-bit fixed-point taps
+    np.testing.assert_allclose(out[0], ref, atol=2e-2)
+
+
+def test_upscale_matches_pil(img_files):
+    out = native.decode_batch([img_files["png"]], (80, 112))
+    ref = np.asarray(
+        Image.fromarray(img_files["rgb_arr"]).resize((112, 80),
+                                                     Image.BILINEAR),
+        np.float32) / 255.0
+    np.testing.assert_allclose(out[0], ref, atol=2e-2)
+
+
+def test_hflip_flag(img_files):
+    out = native.decode_batch([img_files["png"]] * 2, (40, 56),
+                              hflip=[False, True])
+    np.testing.assert_allclose(out[1], out[0][:, ::-1], atol=1e-6)
+
+
+def test_decode_failure_names_file(img_files, tmp_path):
+    bad = str(tmp_path / "missing.png")
+    with pytest.raises(RuntimeError, match="missing.png"):
+        native.decode_batch([img_files["png"], bad], (8, 8))
+
+
+def test_folder_dataset_native_batch_matches_pil(tmp_path):
+    from distributed_sod_project_tpu.data.folder import FolderSOD
+
+    rng = np.random.default_rng(1)
+    (tmp_path / "Image").mkdir()
+    (tmp_path / "Mask").mkdir()
+    for i in range(4):
+        Image.fromarray(rng.integers(0, 256, (30, 30, 3), np.uint8)).save(
+            tmp_path / "Image" / f"s{i}.png")
+        Image.fromarray(
+            (rng.random((30, 30)) > 0.5).astype(np.uint8) * 255).save(
+            tmp_path / "Mask" / f"s{i}.png")
+    ds = FolderSOD(str(tmp_path), image_size=(16, 16))
+    batch = ds.load_batch([0, 2], hflip=[False, False])
+    assert batch is not None
+    assert batch["image"].shape == (2, 16, 16, 3)
+    assert set(np.unique(batch["mask"])) <= {0.0, 1.0}
+    # PIL path for comparison (PIL's bilinear antialiases on downscale,
+    # so compare only the binarised mask semantics + shapes, and the
+    # image values loosely).
+    pil0 = ds[0]
+    assert pil0["image"].shape == (16, 16, 3)
+    # Both paths use PIL-convention antialiased bilinear; compare in raw
+    # pixel space (normalisation divides by std≈0.22, amplifying the
+    # PIL fixed-point rounding ~4.5×).
+    std = np.asarray((0.229, 0.224, 0.225), np.float32)
+    raw_native = batch["image"][0] * std
+    raw_pil = pil0["image"] * std
+    assert np.abs(raw_native - raw_pil).max() < 0.03
+
+
+def test_host_loader_uses_native_and_stays_deterministic(tmp_path):
+    from distributed_sod_project_tpu.data.folder import FolderSOD
+    from distributed_sod_project_tpu.data.pipeline import HostDataLoader
+
+    rng = np.random.default_rng(2)
+    (tmp_path / "Image").mkdir()
+    (tmp_path / "Mask").mkdir()
+    for i in range(8):
+        Image.fromarray(rng.integers(0, 256, (20, 20, 3), np.uint8)).save(
+            tmp_path / "Image" / f"s{i}.png")
+        Image.fromarray(
+            (rng.random((20, 20)) > 0.5).astype(np.uint8) * 255).save(
+            tmp_path / "Mask" / f"s{i}.png")
+    ds = FolderSOD(str(tmp_path), image_size=(16, 16))
+    loader = HostDataLoader(ds, global_batch_size=4, hflip=True, seed=3)
+    loader.set_epoch(1)
+    run1 = [b["image"].copy() for b in loader]
+    loader.set_epoch(1)
+    run2 = [b["image"].copy() for b in loader]
+    assert len(run1) == 2
+    for a, b in zip(run1, run2):
+        np.testing.assert_array_equal(a, b)
