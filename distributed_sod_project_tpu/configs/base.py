@@ -18,6 +18,7 @@ class DataConfig:
 
     dataset: str = "synthetic"  # synthetic | duts | nju2k | nlpr
     root: Optional[str] = None  # directory with <name>-Image/ and <name>-Mask/
+    val_root: Optional[str] = None  # held-out set for in-training eval
     image_size: Tuple[int, int] = (320, 320)  # H, W — static for XLA
     use_depth: bool = False  # RGB-D datasets carry a depth channel
     hflip: bool = True
@@ -104,6 +105,9 @@ class ExperimentConfig:
     checkpoint_every_steps: int = 500
     checkpoint_dir: str = "checkpoints"
     keep_checkpoints: int = 3
+    eval_every_steps: int = 0  # 0 = no in-training eval
+    best_metric: Optional[str] = None  # e.g. "max_fbeta": keep best ckpts
+    tensorboard: bool = True  # event files under <workdir>/tb
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

@@ -15,6 +15,7 @@ import time
 from typing import Callable, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ckpt import CheckpointManager
@@ -35,12 +36,20 @@ def fit(
     resume: bool = False,
     max_steps: Optional[int] = None,
     hooks: Optional[Dict[str, Callable]] = None,
+    profile_dir: Optional[str] = None,
 ) -> Dict[str, float]:
     """Run the full training loop; returns final scalar metrics.
 
     ``max_steps`` truncates (smoke tests / benchmarks); ``hooks`` may
-    contain ``on_metrics(step, dict)`` for test instrumentation.
+    contain ``on_metrics(step, dict)`` for test instrumentation;
+    ``profile_dir`` captures a jax.profiler trace of a short post-warmup
+    step window (view in TensorBoard/Perfetto).
     """
+    import os
+
+    from ..utils.observability import (MetricWriter, PreemptionGuard,
+                                       profile_window)
+
     log = get_logger()
     hooks = hooks or {}
     workdir = workdir or cfg.checkpoint_dir
@@ -83,7 +92,13 @@ def fit(
              cfg.model.name, param_count(state) / 1e6, n_dev,
              cfg.global_batch_size, steps_per_epoch, total_steps)
 
-    mgr = CheckpointManager(workdir, keep=cfg.keep_checkpoints)
+    if cfg.best_metric and not cfg.eval_every_steps:
+        raise ValueError(
+            "best_metric retention needs eval_every_steps > 0 — without "
+            "eval metrics orbax never deletes checkpoints and keep_"
+            "checkpoints is silently ignored")
+    mgr = CheckpointManager(workdir, keep=cfg.keep_checkpoints,
+                            best_metric=cfg.best_metric)
     if is_primary_process():
         mgr.save_config(cfg)
     start_step = 0
@@ -97,11 +112,21 @@ def fit(
     state = jax.device_put(state, replicated_sharding(mesh))
     train_step = make_train_step(model, cfg.loss, tx, mesh, schedule=schedule)
 
+    writer = MetricWriter(os.path.join(workdir, "tb")
+                          if cfg.tensorboard else None)
+    eval_fn = _make_inline_eval(cfg, model) if cfg.eval_every_steps else None
+
     timer = StepTimer()
     last_metrics: Dict[str, float] = {}
+    eval_metrics: Dict[str, float] = {}
     step = start_step
     last_saved = -1
+    last_eval_step = -1
+    profile_at = -1
+    if profile_dir:
+        profile_at = max(start_step, min(start_step + 10, total_steps - 1))
     try:
+      with PreemptionGuard() as guard:
         for epoch in range(start_step // max(steps_per_epoch, 1), cfg.num_epochs):
             loader.set_epoch(epoch)
             # mesh= (not sharding=): each host contributes its local
@@ -109,9 +134,14 @@ def fit(
             it = prefetch_to_device(
                 iter(loader), size=cfg.data.prefetch_batches, mesh=mesh)
             for batch in it:
-                if step >= total_steps:
+                if step >= total_steps or guard.sync():
                     break
-                state, metrics = train_step(state, batch)
+                if step == profile_at:
+                    with profile_window(profile_dir):
+                        state, metrics = train_step(state, batch)
+                        jax.block_until_ready(metrics["total"])
+                else:
+                    state, metrics = train_step(state, batch)
                 step += 1
                 timer.tick()
                 if step % cfg.log_every_steps == 0 or step == total_steps:
@@ -120,6 +150,7 @@ def fit(
                         cfg.global_batch_size)
                     host["epoch"] = epoch
                     last_metrics = host
+                    writer.scalars(step, host)
                     if is_primary_process():
                         log.info(
                             "step %d/%d  loss=%.4f  lr=%.2e  %.1f imgs/s",
@@ -128,17 +159,71 @@ def fit(
                             host["imgs_per_sec"])
                     if "on_metrics" in hooks:
                         hooks["on_metrics"](step, host)
+                if eval_fn is not None and step % cfg.eval_every_steps == 0:
+                    eval_metrics = eval_fn(state)
+                    last_eval_step = step
+                    writer.scalars(step, {f"eval/{k}": v
+                                          for k, v in eval_metrics.items()})
+                    if is_primary_process():
+                        log.info("eval @ %d: %s", step,
+                                 {k: round(v, 4) for k, v in
+                                  eval_metrics.items()})
                 if cfg.checkpoint_every_steps and (
                         step % cfg.checkpoint_every_steps == 0):
+                    if (cfg.best_metric and eval_fn is not None
+                            and last_eval_step != step):
+                        # best-k ranking must reflect THIS state, not a
+                        # stale measurement from an earlier step.
+                        eval_metrics = eval_fn(state)
+                        last_eval_step = step
                     # state passed as-is: orbax's async save does the D2H
                     # copy behind the next train steps (no device_get stall).
-                    mgr.save(step, state)
+                    mgr.save(step, state, metrics=eval_metrics or None)
                     last_saved = step
-            if step >= total_steps:
+            if step >= total_steps or guard.should_stop:
+                # (already synced inside the batch loop before breaking)
                 break
         if step != last_saved:
-            mgr.save(step, state, force=True)
+            mgr.save(step, state, metrics=eval_metrics or None, force=True)
     finally:
         mgr.close()
+        writer.close()
     last_metrics["final_step"] = step
+    last_metrics.update({f"eval_{k}": v for k, v in eval_metrics.items()})
     return last_metrics
+
+
+def _make_inline_eval(cfg: ExperimentConfig, model) -> Callable:
+    """Build a lightweight in-training eval: max-Fβ/MAE over the
+    held-out set (``data.val_root`` when set, else the train dataset —
+    meaningful for overfit smoke tests, a real val set in production).
+    Feeds CheckpointManager's best-metric retention (cfg.best_metric)."""
+    import dataclasses
+
+    from ..eval import run_inference
+
+    data_cfg = cfg.data
+    if cfg.data.val_root:
+        data_cfg = dataclasses.replace(cfg.data, root=cfg.data.val_root)
+    dataset = resolve_dataset(data_cfg)
+
+    # jit once with the variables as an argument: re-invoking eval does
+    # NOT retrace (same shapes), unlike a fresh closure per call.
+    @jax.jit
+    def forward(variables, batch):
+        outs = model.apply(variables, batch["image"], batch.get("depth"),
+                           train=False)
+        return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
+
+    def eval_fn(state) -> Dict[str, float]:
+        variables = state.variables()
+        # Every host sweeps the full val set: metrics must be identical
+        # across processes for consistent best-k checkpoint ranking.
+        return {k: v for k, v in run_inference(
+            lambda b: forward(variables, b), dataset,
+            batch_size=max(1, cfg.global_batch_size),
+            use_depth=cfg.data.use_depth,
+            compute_structure=False,
+        ).items() if isinstance(v, float)}
+
+    return eval_fn

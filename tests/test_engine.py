@@ -134,3 +134,37 @@ def test_apply_overrides_types_and_errors():
         apply_overrides(cfg, ["nope.lr=1"])
     with pytest.raises(ValueError):
         apply_overrides(cfg, ["optim.lr"])
+
+
+def test_fit_with_inline_eval_and_tensorboard(tmp_path, eight_devices):
+    cfg = _smoke_cfg(tmp_path).replace(
+        eval_every_steps=2, best_metric="max_fbeta")
+    out = fit(cfg, max_steps=2)
+    assert "eval_max_fbeta" in out and 0.0 <= out["eval_max_fbeta"] <= 1.0
+    assert "eval_mae" in out
+    # tensorboard event files written
+    tb = list((tmp_path / "ck" / "tb").glob("events.*"))
+    assert tb, "no tensorboard event files"
+
+
+def test_preemption_guard_checkpoints_and_stops(tmp_path, eight_devices):
+    import signal
+
+    from distributed_sod_project_tpu.utils.observability import (
+        PreemptionGuard)
+
+    cfg = _smoke_cfg(tmp_path)
+    calls = {}
+
+    def trip(step, m):
+        calls[step] = m
+        if step == 2:
+            # deliver SIGTERM to ourselves mid-training
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    out = fit(cfg, max_steps=50, hooks={"on_metrics": trip})
+    # stopped well before 50 and saved a final checkpoint
+    assert out["final_step"] <= 4
+    steps = [int(os.path.basename(d)) for d in
+             glob.glob(os.path.join(cfg.checkpoint_dir, "[0-9]*"))]
+    assert out["final_step"] in steps

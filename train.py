@@ -41,6 +41,12 @@ def parse_args(argv=None):
                    metavar="PATH=VALUE",
                    help="dotted config override, e.g. --set optim.lr=0.01 "
                         "--set data.image_size=256,256 (repeatable)")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of a post-warmup "
+                        "step window into this directory")
+    p.add_argument("--eval-every", type=int, default=None,
+                   help="run held-out eval every N steps (overrides "
+                        "config eval_every_steps)")
     return p.parse_args(argv)
 
 
@@ -71,9 +77,11 @@ def main(argv=None):
         cfg = cfg.replace(optim=dataclasses.replace(cfg.optim, lr=args.lr))
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
+    if args.eval_every is not None:
+        cfg = cfg.replace(eval_every_steps=args.eval_every)
 
     metrics = fit(cfg, workdir=args.workdir, resume=args.resume,
-                  max_steps=args.max_steps)
+                  max_steps=args.max_steps, profile_dir=args.profile_dir)
     print({k: round(v, 4) if isinstance(v, float) else v
            for k, v in metrics.items()})
     return 0
