@@ -107,6 +107,7 @@ class ExperimentConfig:
     keep_checkpoints: int = 3
     eval_every_steps: int = 0  # 0 = no in-training eval
     best_metric: Optional[str] = None  # e.g. "max_fbeta": keep best ckpts
+    best_mode: str = "max"  # "min" for lower-is-better metrics (mae)
     tensorboard: bool = True  # event files under <workdir>/tb
 
     def replace(self, **kw) -> "ExperimentConfig":

@@ -7,9 +7,12 @@ from .streaming import (
     update_fbeta_state,
 )
 from .structure import e_measure, s_measure
+from .weighted import adaptive_fbeta, weighted_fmeasure
 from .aggregator import SODMetrics
 
 __all__ = [
+    "adaptive_fbeta",
+    "weighted_fmeasure",
     "FBetaState",
     "fbeta_curve",
     "init_fbeta_state",

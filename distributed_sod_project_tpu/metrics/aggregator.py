@@ -19,6 +19,7 @@ from .streaming import (
     update_fbeta_state,
 )
 from .structure import e_measure, s_measure
+from .weighted import adaptive_fbeta, weighted_fmeasure
 
 
 class SODMetrics:
@@ -27,6 +28,8 @@ class SODMetrics:
         self._compute_structure = compute_structure
         self._sm: list = []
         self._em: list = []
+        self._adp: list = []
+        self._wfm: list = []
 
     def add(self, pred: np.ndarray, gt: np.ndarray) -> None:
         """pred in [0,1], gt binary; any of [H,W], [H,W,1]."""
@@ -40,6 +43,8 @@ class SODMetrics:
         if self._compute_structure:
             self._sm.append(s_measure(p, g))
             self._em.append(e_measure(p, g))
+            self._adp.append(adaptive_fbeta(p, g))
+            self._wfm.append(weighted_fmeasure(p, g))
 
     def results(self) -> Dict[str, float]:
         f = mean_fbeta_curve(self._state)  # macro curve, one finalise pass
@@ -53,4 +58,6 @@ class SODMetrics:
         if self._compute_structure and self._sm:
             out["s_measure"] = float(np.mean(self._sm))
             out["e_measure"] = float(np.mean(self._em))
+            out["adp_fbeta"] = float(np.mean(self._adp))
+            out["weighted_fmeasure"] = float(np.mean(self._wfm))
         return out

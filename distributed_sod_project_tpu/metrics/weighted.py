@@ -1,0 +1,76 @@
+"""Weighted F-measure and adaptive Fβ (PySODMetrics parity, SURVEY.md §2 C10).
+
+- ``adaptive_fbeta``: Fβ at the per-image adaptive threshold
+  ``min(2·mean(pred), 1)`` — the classic "adp" column of SOD tables.
+- ``weighted_fmeasure``: Margolin et al., CVPR 2014 ("How to Evaluate
+  Foreground Maps?").  Errors are (1) smoothed by a Gaussian on their
+  distance to the foreground — nearby mistakes count less — and (2)
+  false positives are discounted by distance from the object.  Host-side
+  numpy (per-image, eval path only) since it needs a distance transform.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+BETA2 = 0.3
+
+
+def adaptive_fbeta(pred: np.ndarray, gt: np.ndarray,
+                   beta2: float = BETA2, eps: float = 1e-8) -> float:
+    p = np.asarray(pred, np.float64).squeeze()
+    g = np.asarray(gt).squeeze() > 0.5
+    thr = min(2.0 * p.mean(), 1.0)
+    binary = p >= thr
+    tp = float(np.logical_and(binary, g).sum())
+    precision = tp / max(float(binary.sum()), eps)
+    recall = tp / max(float(g.sum()), eps)
+    return float((1 + beta2) * precision * recall
+                 / max(beta2 * precision + recall, eps))
+
+
+def _gaussian_kernel(size: int = 7, sigma: float = 5.0) -> np.ndarray:
+    ax = np.arange(size) - size // 2
+    g = np.exp(-(ax ** 2) / (2 * sigma ** 2))
+    k = np.outer(g, g)
+    return k / k.sum()
+
+
+def _convolve2d_same(x: np.ndarray, k: np.ndarray) -> np.ndarray:
+    from scipy.signal import convolve2d  # scipy ships with the image
+
+    return convolve2d(x, k, mode="same", boundary="symm")
+
+
+def weighted_fmeasure(pred: np.ndarray, gt: np.ndarray,
+                      beta2: float = 1.0, eps: float = 1e-8) -> float:
+    """Margolin's wFβ (β²=1 as in the paper and PySODMetrics)."""
+    from scipy.ndimage import distance_transform_edt
+
+    p = np.asarray(pred, np.float64).squeeze()
+    g = (np.asarray(gt).squeeze() > 0.5)
+    if not g.any():
+        return 0.0
+
+    e = np.abs(p - g.astype(np.float64))
+    # Distance transform of the background w.r.t. the foreground, with
+    # the index of the nearest foreground pixel.
+    dst, idx = distance_transform_edt(~g, return_indices=True)
+    # Errors outside the object borrow the error of the nearest object
+    # pixel (dependency between neighbouring pixels).
+    et = e.copy()
+    et[~g] = e[idx[0][~g], idx[1][~g]]
+    # Gaussian-smoothed error inside the object neighbourhood.
+    ea = _convolve2d_same(et, _gaussian_kernel(7, 5.0))
+    min_ea = np.where(g & (ea < e), ea, e)
+    # Pixel importance: background errors decay with distance from the
+    # object.
+    b = np.where(g, 1.0, 2.0 - np.exp(np.log(0.5) / 5.0 * dst))
+    ew = min_ea * b
+
+    tpw = float(g.sum()) - float(ew[g].sum())
+    fpw = float(ew[~g].sum())
+    recall = 1.0 - float(ew[g].mean()) if g.any() else 0.0
+    precision = tpw / max(tpw + fpw, eps)
+    return float((1 + beta2) * precision * recall
+                 / max(beta2 * precision + recall, eps))

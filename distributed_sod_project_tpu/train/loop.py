@@ -97,8 +97,11 @@ def fit(
             "best_metric retention needs eval_every_steps > 0 — without "
             "eval metrics orbax never deletes checkpoints and keep_"
             "checkpoints is silently ignored")
+    if cfg.best_mode not in ("max", "min"):
+        raise ValueError(f"best_mode must be max|min, got {cfg.best_mode!r}")
     mgr = CheckpointManager(workdir, keep=cfg.keep_checkpoints,
-                            best_metric=cfg.best_metric)
+                            best_metric=cfg.best_metric,
+                            best_mode=cfg.best_mode)
     if is_primary_process():
         mgr.save_config(cfg)
     start_step = 0
@@ -122,6 +125,10 @@ def fit(
     step = start_step
     last_saved = -1
     last_eval_step = -1
+    stop = False
+    # Cross-host stop agreement only at deterministic steps (all hosts
+    # must enter the collective together); local-only checks otherwise.
+    sync_every = max(1, cfg.log_every_steps)
     profile_at = -1
     if profile_dir:
         profile_at = max(start_step, min(start_step + 10, total_steps - 1))
@@ -134,16 +141,22 @@ def fit(
             it = prefetch_to_device(
                 iter(loader), size=cfg.data.prefetch_batches, mesh=mesh)
             for batch in it:
-                if step >= total_steps or guard.sync():
+                if step >= total_steps or stop:
                     break
                 if step == profile_at:
                     with profile_window(profile_dir):
                         state, metrics = train_step(state, batch)
                         jax.block_until_ready(metrics["total"])
                 else:
-                    state, metrics = train_step(state, batch)
+                        state, metrics = train_step(state, batch)
                 step += 1
                 timer.tick()
+                if jax.process_count() == 1:
+                    stop = guard.should_stop
+                elif step % sync_every == 0:
+                    # Blocking allgather — throttled so the host keeps
+                    # its async run-ahead between agreement points.
+                    stop = guard.sync()
                 if step % cfg.log_every_steps == 0 or step == total_steps:
                     host = {k: float(v) for k, v in metrics.items()}
                     host["imgs_per_sec"] = timer.images_per_sec(
@@ -180,10 +193,14 @@ def fit(
                     # copy behind the next train steps (no device_get stall).
                     mgr.save(step, state, metrics=eval_metrics or None)
                     last_saved = step
-            if step >= total_steps or guard.should_stop:
-                # (already synced inside the batch loop before breaking)
+            if step >= total_steps or stop:
                 break
         if step != last_saved:
+            if (cfg.best_metric and eval_fn is not None
+                    and last_eval_step != step):
+                # Rank the final checkpoint with fresh measurements too.
+                eval_metrics = eval_fn(state)
+                last_eval_step = step
             mgr.save(step, state, metrics=eval_metrics or None, force=True)
     finally:
         mgr.close()

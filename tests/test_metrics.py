@@ -1,6 +1,7 @@
 """Metric tests vs brute-force numpy oracles (SURVEY.md §4)."""
 
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from distributed_sod_project_tpu.metrics import (
@@ -100,3 +101,64 @@ def test_aggregator_end_to_end():
         gt = (rng.random((24, 24)) > 0.6).astype(np.float32)
         m2.add(rng.random((24, 24)).astype(np.float32), gt)
     assert res["max_fbeta"] > m2.results()["max_fbeta"]
+
+
+def test_adaptive_fbeta_perfect_and_inverted():
+    from distributed_sod_project_tpu.metrics import adaptive_fbeta
+
+    rng = np.random.default_rng(0)
+    g = rng.random((32, 32)) > 0.5
+    assert adaptive_fbeta(g.astype(np.float64), g) == pytest.approx(1.0)
+    assert adaptive_fbeta((~g).astype(np.float64), g) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_adaptive_fbeta_matches_bruteforce():
+    from distributed_sod_project_tpu.metrics import adaptive_fbeta
+
+    rng = np.random.default_rng(1)
+    p = rng.random((16, 16))
+    g = rng.random((16, 16)) > 0.6
+    thr = min(2 * p.mean(), 1.0)
+    binary = p >= thr
+    tp = (binary & g).sum()
+    prec = tp / max(binary.sum(), 1e-8)
+    rec = tp / max(g.sum(), 1e-8)
+    want = (1.3 * prec * rec) / max(0.3 * prec + rec, 1e-8)
+    assert adaptive_fbeta(p, g) == pytest.approx(want, rel=1e-6)
+
+
+def test_weighted_fmeasure_sanity():
+    from distributed_sod_project_tpu.metrics import weighted_fmeasure
+
+    rng = np.random.default_rng(2)
+    g = np.zeros((32, 32), bool)
+    g[8:24, 8:24] = True
+    # perfect prediction → 1.0
+    assert weighted_fmeasure(g.astype(np.float64), g) == pytest.approx(1.0)
+    # all-zero prediction → ~0
+    assert weighted_fmeasure(np.zeros((32, 32)), g) < 0.05
+    # B = 2 − exp(ln(0.5)/5·d): background errors WEIGH MORE with
+    # distance (boundary FPs are forgivable, isolated far FPs are not).
+    near = g.astype(np.float64).copy()
+    near[7, 8:24] = 1.0  # touching the object
+    far = g.astype(np.float64).copy()
+    far[0, 8:24] = 1.0  # far row
+    assert weighted_fmeasure(near, g) > weighted_fmeasure(far, g)
+    # noisy prediction scores strictly between
+    noisy = np.clip(g + 0.3 * rng.standard_normal((32, 32)), 0, 1)
+    assert 0.3 < weighted_fmeasure(noisy, g) < 1.0
+
+
+def test_aggregator_includes_new_metrics():
+    from distributed_sod_project_tpu.metrics import SODMetrics
+
+    rng = np.random.default_rng(3)
+    agg = SODMetrics()
+    for _ in range(3):
+        g = rng.random((16, 16)) > 0.5
+        p = np.clip(g + 0.2 * rng.standard_normal((16, 16)), 0, 1)
+        agg.add(p, g)
+    res = agg.results()
+    for key in ("adp_fbeta", "weighted_fmeasure", "s_measure", "e_measure",
+                "max_fbeta", "mae"):
+        assert key in res and 0.0 <= res[key] <= 1.0, (key, res)
