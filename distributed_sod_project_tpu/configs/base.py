@@ -17,6 +17,7 @@ class DataConfig:
     """Input pipeline configuration (SURVEY.md §2 C7)."""
 
     dataset: str = "synthetic"  # synthetic | duts | nju2k | nlpr
+    backend: str = "host"  # host (C++/PIL loader) | tfdata
     root: Optional[str] = None  # directory with <name>-Image/ and <name>-Mask/
     val_root: Optional[str] = None  # held-out set for in-training eval
     image_size: Tuple[int, int] = (320, 320)  # H, W — static for XLA
@@ -72,6 +73,7 @@ class OptimConfig:
     poly_power: float = 0.9
     warmup_steps: int = 0
     grad_clip_norm: float = 0.0  # 0 disables
+    accum_steps: int = 1  # >1: optax.MultiSteps gradient accumulation
 
 
 @dataclasses.dataclass(frozen=True)

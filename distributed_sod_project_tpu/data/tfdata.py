@@ -1,0 +1,173 @@
+"""tf.data input backend (SURVEY.md §2.2, [B:5] "feeds TPU hosts via
+tf.data").
+
+An alternative to the default ``HostDataLoader``(+C++ decoder) with the
+same contract — per-host shard of every global batch, epoch-seeded
+deterministic shuffling, numpy dict batches — built from tf.data's
+parallel map/prefetch machinery.  Select with
+``--set data.backend=tfdata``.
+
+Sharding follows the DistributedSampler semantics the reference used
+(SURVEY.md §2 C4): one global permutation per epoch (same seed on every
+host), each host taking its contiguous slice of every global batch — so
+shards are disjoint and covering, and batch composition is identical to
+the host-loader backend.
+
+TensorFlow is imported lazily and pinned to CPU: it is a host-side data
+library here; the accelerators belong to JAX.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+def _tf():
+    import tensorflow as tf
+
+    try:  # never let tf grab the accelerators
+        tf.config.set_visible_devices([], "GPU")
+        tf.config.set_visible_devices([], "TPU")
+    except Exception:  # noqa: BLE001 — best-effort on exotic builds
+        pass
+    return tf
+
+
+class TFDataLoader:
+    """HostDataLoader-compatible loader over a file-backed FolderSOD."""
+
+    def __init__(
+        self,
+        dataset,
+        global_batch_size: int,
+        shard_id: int = 0,
+        num_shards: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        hflip: bool = False,
+        num_workers: int = 4,
+    ):
+        if global_batch_size % num_shards != 0:
+            raise ValueError(
+                f"global_batch_size={global_batch_size} not divisible by "
+                f"num_shards={num_shards}")
+        if not hasattr(dataset, "stems"):
+            raise ValueError(
+                "tfdata backend needs a file-backed dataset (FolderSOD); "
+                "use the default host backend for synthetic data")
+        self.dataset = dataset
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // num_shards
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.hflip = hflip
+        self.num_workers = num_workers
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    @property
+    def steps_per_epoch(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.global_batch_size
+        return -(-n // self.global_batch_size)
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
+            order = rng.permutation(n)
+        else:
+            order = np.arange(n)
+        if not self.drop_last and n % self.global_batch_size:
+            pad = self.global_batch_size - n % self.global_batch_size
+            order = np.concatenate([order, order[:pad]])
+        return order
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        tf = _tf()
+        ds_obj = self.dataset
+        h, w = ds_obj.image_size
+        mean = tf.constant(ds_obj.mean, tf.float32)
+        std = tf.constant(ds_obj.std, tf.float32)
+        use_depth = ds_obj.depth_paths is not None
+        epoch = self._epoch
+        aug_seed = hash((self.seed, epoch)) & 0x7FFFFFFF
+
+        # This host's slice of every global batch, in global epoch order.
+        order = self._epoch_order(epoch)
+        steps = self.steps_per_epoch
+        my = np.concatenate([
+            order[s * self.global_batch_size
+                  + self.shard_id * self.local_batch_size:
+                  s * self.global_batch_size
+                  + (self.shard_id + 1) * self.local_batch_size]
+            for s in range(steps)]) if steps else np.zeros((0,), np.int64)
+
+        stems = [ds_obj.stems[i] for i in my]
+        img_paths = [ds_obj.img_paths[s] for s in stems]
+        mask_paths = [ds_obj.mask_paths[s] for s in stems]
+        tensors = {
+            "index": my.astype(np.int32),
+            "img_path": img_paths,
+            "mask_path": mask_paths,
+        }
+        if use_depth:
+            tensors["depth_path"] = [ds_obj.depth_paths[s] for s in stems]
+
+        def decode(rec):
+            img = tf.io.decode_image(tf.io.read_file(rec["img_path"]),
+                                     channels=3, expand_animations=False)
+            img = tf.image.resize(tf.cast(img, tf.float32), (h, w),
+                                  antialias=True) / 255.0
+            img = (img - mean) / std
+            mask = tf.io.decode_image(tf.io.read_file(rec["mask_path"]),
+                                      channels=1, expand_animations=False)
+            mask = tf.image.resize(tf.cast(mask, tf.float32), (h, w),
+                                   antialias=True) / 255.0
+            mask = tf.cast(mask > 0.5, tf.float32)
+            out = {"image": img, "mask": mask, "index": rec["index"]}
+            if use_depth:
+                d = tf.io.decode_image(tf.io.read_file(rec["depth_path"]),
+                                       channels=1, expand_animations=False)
+                out["depth"] = tf.image.resize(
+                    tf.cast(d, tf.float32), (h, w), antialias=True) / 255.0
+            if self.hflip:
+                flip = tf.random.stateless_uniform(
+                    [], seed=[aug_seed, rec["index"]]) < 0.5
+                for k in ("image", "mask", "depth"):
+                    if k in out:
+                        out[k] = tf.cond(
+                            flip, lambda t=out[k]: tf.reverse(t, axis=[1]),
+                            lambda t=out[k]: t)
+            return out
+
+        ds = (tf.data.Dataset.from_tensor_slices(tensors)
+              .map(decode, num_parallel_calls=max(1, self.num_workers))
+              .batch(self.local_batch_size, drop_remainder=True)
+              .prefetch(2))
+        for batch in ds.as_numpy_iterator():
+            batch.pop("img_path", None)
+            batch.pop("mask_path", None)
+            batch.pop("depth_path", None)
+            yield batch
+
+
+def make_loader(dataset, data_cfg, **kw):
+    """Backend dispatch: 'host' (default) or 'tfdata'."""
+    backend = getattr(data_cfg, "backend", "host")
+    if backend == "tfdata":
+        return TFDataLoader(dataset, **kw)
+    if backend == "host":
+        from .pipeline import HostDataLoader
+
+        return HostDataLoader(dataset, **kw)
+    raise ValueError(f"unknown data backend {backend!r}")

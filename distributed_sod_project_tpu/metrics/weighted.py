@@ -39,7 +39,9 @@ def _gaussian_kernel(size: int = 7, sigma: float = 5.0) -> np.ndarray:
 def _convolve2d_same(x: np.ndarray, k: np.ndarray) -> np.ndarray:
     from scipy.signal import convolve2d  # scipy ships with the image
 
-    return convolve2d(x, k, mode="same", boundary="symm")
+    # Zero padding: matches PySODMetrics / the original imfilter, so
+    # border-touching objects score identically to published numbers.
+    return convolve2d(x, k, mode="same", boundary="fill", fillvalue=0.0)
 
 
 def weighted_fmeasure(pred: np.ndarray, gt: np.ndarray,
@@ -70,7 +72,7 @@ def weighted_fmeasure(pred: np.ndarray, gt: np.ndarray,
 
     tpw = float(g.sum()) - float(ew[g].sum())
     fpw = float(ew[~g].sum())
-    recall = 1.0 - float(ew[g].mean()) if g.any() else 0.0
+    recall = 1.0 - float(ew[g].mean())
     precision = tpw / max(tpw + fpw, eps)
     return float((1 + beta2) * precision * recall
                  / max(beta2 * precision + recall, eps))

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..ckpt import CheckpointManager
 from ..configs.base import ExperimentConfig
-from ..data import HostDataLoader, prefetch_to_device, resolve_dataset
+from ..data import prefetch_to_device, resolve_dataset
 from ..models import build_model
 from ..parallel.mesh import make_mesh, replicated_sharding
 from ..utils.logging import get_logger, is_primary_process
@@ -61,9 +61,11 @@ def fit(
             f"global_batch_size={cfg.global_batch_size} not divisible by "
             f"mesh size {n_dev}")
 
+    from ..data.tfdata import make_loader
+
     dataset = resolve_dataset(cfg.data)
-    loader = HostDataLoader(
-        dataset,
+    loader = make_loader(
+        dataset, cfg.data,
         global_batch_size=cfg.global_batch_size,
         shard_id=jax.process_index(),
         num_shards=jax.process_count(),
@@ -113,7 +115,8 @@ def fit(
             log.info("resumed from checkpoint step %d", start_step)
 
     state = jax.device_put(state, replicated_sharding(mesh))
-    train_step = make_train_step(model, cfg.loss, tx, mesh, schedule=schedule)
+    train_step = make_train_step(model, cfg.loss, tx, mesh,
+                                 schedule=schedule, remat=cfg.model.remat)
 
     writer = MetricWriter(os.path.join(workdir, "tb")
                           if cfg.tensorboard else None)
@@ -148,7 +151,7 @@ def fit(
                         state, metrics = train_step(state, batch)
                         jax.block_until_ready(metrics["total"])
                 else:
-                        state, metrics = train_step(state, batch)
+                    state, metrics = train_step(state, batch)
                 step += 1
                 timer.tick()
                 if jax.process_count() == 1:

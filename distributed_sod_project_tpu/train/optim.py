@@ -28,6 +28,15 @@ def build_optimizer(
     optim_cfg, total_steps: int
 ) -> Tuple[optax.GradientTransformation, optax.Schedule]:
     schedule = build_schedule(optim_cfg, total_steps)
+    accum = getattr(optim_cfg, "accum_steps", 1) or 1
+    # Under MultiSteps the inner count advances once per APPLIED update
+    # (once per `accum` micro-steps — verified against optax source), so
+    # the transform's schedule is re-indexed to keep decay on the
+    # micro-step clock `total_steps` was sized in; the returned
+    # `schedule` stays micro-step-indexed, so step.py's logged lr equals
+    # the applied lr at every emit.
+    tx_schedule = schedule if accum == 1 else (
+        lambda count: schedule(count * accum))
     parts = []
     if optim_cfg.grad_clip_norm and optim_cfg.grad_clip_norm > 0:
         parts.append(optax.clip_by_global_norm(optim_cfg.grad_clip_norm))
@@ -42,14 +51,22 @@ def build_optimizer(
                     decay=optim_cfg.momentum, nesterov=optim_cfg.nesterov
                 )
             )
-        parts.append(optax.scale_by_learning_rate(schedule))
+        parts.append(optax.scale_by_learning_rate(tx_schedule))
     elif optim_cfg.optimizer == "adamw":
         parts.append(optax.scale_by_adam())
         if optim_cfg.weight_decay:
             parts.append(
                 optax.add_decayed_weights(optim_cfg.weight_decay, _decay_mask)
             )
-        parts.append(optax.scale_by_learning_rate(schedule))
+        parts.append(optax.scale_by_learning_rate(tx_schedule))
     else:
         raise ValueError(f"unknown optimizer {optim_cfg.optimizer!r}")
-    return optax.chain(*parts), schedule
+    tx = optax.chain(*parts)
+    if accum > 1:
+        # Micro-batch accumulation: the update applies every `accum`
+        # micro-steps; between them gradients average in MultiSteps
+        # state.  The per-chip batch can then shrink by `accum` at
+        # equal effective batch — the memory lever when remat alone is
+        # not enough.
+        tx = optax.MultiSteps(tx, every_k_schedule=accum)
+    return tx, schedule

@@ -46,11 +46,18 @@ def make_train_step(
     mesh: Mesh,
     schedule: Optional[optax.Schedule] = None,
     donate: bool = True,
+    remat: bool = False,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build ``(state, batch) -> (state, metrics)``.
 
     Sharding contract: ``state`` replicated (P()), every ``batch`` leaf
     batch-sharded (P('data')); metrics come back replicated scalars.
+
+    ``remat=True`` rematerialises the forward during backward
+    (``jax.checkpoint``): activations are recomputed instead of stored,
+    trading ~⅓ more FLOPs for the activation memory — the standard lever
+    when a bigger per-chip batch is HBM-bound (SURVEY.md "HBM
+    bandwidth" row).
     """
     lkw = _loss_kwargs(loss_cfg)
 
@@ -60,15 +67,22 @@ def make_train_step(
             lax.axis_index("data"),
         )
 
-        def loss_fn(params):
-            outs, mut = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                batch["image"],
-                batch.get("depth"),
+        def forward(params, batch_stats, image, depth):
+            return model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                image,
+                depth,
                 train=True,
                 mutable=["batch_stats"],
                 rngs={"dropout": rng},
             )
+
+        if remat:
+            forward = jax.checkpoint(forward)
+
+        def loss_fn(params):
+            outs, mut = forward(params, state.batch_stats,
+                                batch["image"], batch.get("depth"))
             total, comps = deep_supervision_loss(outs, batch["mask"], **lkw)
             return total, (comps, mut.get("batch_stats", state.batch_stats))
 
