@@ -28,6 +28,12 @@ class DataConfig:
     num_workers: int = 4  # host-side prefetch threads
     prefetch_batches: int = 2
     synthetic_size: int = 256  # virtual dataset length when dataset=synthetic
+    # Multi-scale training (MINet-style): the cycle of square train
+    # sizes, e.g. (256, 320, 384).  Empty = single-scale at image_size.
+    # Each size is one statically-shaped compiled step (XLA-friendly);
+    # the resize rides the device, not the input pipeline.  Use
+    # multiples of 32 (backbone strides + fused-loss lane alignment).
+    multiscale: Tuple[int, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +80,7 @@ class OptimConfig:
     warmup_steps: int = 0
     grad_clip_norm: float = 0.0  # 0 disables
     accum_steps: int = 1  # >1: optax.MultiSteps gradient accumulation
+    ema_decay: float = 0.0  # >0: track an EMA of params; eval uses it
 
 
 @dataclasses.dataclass(frozen=True)

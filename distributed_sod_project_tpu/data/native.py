@@ -48,6 +48,14 @@ def load_library() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_float), ctypes.c_int,
         ]
         lib.dsod_version.restype = ctypes.c_int
+        if hasattr(lib, "dsod_write_png_batch"):  # v2+ of the lib
+            lib.dsod_write_png_batch.restype = ctypes.c_int
+            lib.dsod_write_png_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.c_int, ctypes.c_int,
+            ]
         _lib = lib
         return _lib
 
@@ -91,3 +99,39 @@ def decode_batch(
     if rc:
         raise RuntimeError(f"native decode failed for {paths[rc - 1]!r}")
     return out
+
+
+def png_writer_available() -> bool:
+    lib = load_library()
+    return lib is not None and hasattr(lib, "dsod_write_png_batch")
+
+
+def write_png_batch(items, threads: int = 0) -> None:
+    """Write grayscale PNGs in C++ threads (no GIL).
+
+    ``items``: sequence of (path, uint8 [H,W] array); arrays may have
+    different shapes (per-image original resolutions on the eval path).
+    Raises RuntimeError naming the first failed write.
+    """
+    lib = load_library()
+    if lib is None or not hasattr(lib, "dsod_write_png_batch"):
+        raise RuntimeError("native PNG writer unavailable "
+                           "(make -C native, lib v2+)")
+    n = len(items)
+    if n == 0:
+        return
+    arrays = []
+    for _, a in items:
+        a = np.ascontiguousarray(a)
+        if a.dtype != np.uint8 or a.ndim != 2:
+            raise ValueError(f"want uint8 [H,W], got {a.dtype} {a.shape}")
+        arrays.append(a)
+    c_paths = (ctypes.c_char_p * n)(*[p.encode() for p, _ in items])
+    c_data = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    c_w = (ctypes.c_int * n)(*[a.shape[1] for a in arrays])
+    c_h = (ctypes.c_int * n)(*[a.shape[0] for a in arrays])
+    rc = lib.dsod_write_png_batch(c_paths, c_data, c_w, c_h, n,
+                                  int(threads))
+    if rc:
+        raise RuntimeError(f"native PNG write failed for "
+                           f"{items[rc - 1][0]!r}")

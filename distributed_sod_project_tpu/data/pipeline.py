@@ -56,9 +56,17 @@ class HostDataLoader:
         self.hflip = hflip
         self.num_workers = num_workers
         self._epoch = 0
+        self._skip = 0
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+
+    def skip_steps(self, n: int) -> None:
+        """Start the NEXT iteration ``n`` batches into the epoch (exact
+        mid-epoch resume: order is a pure function of (seed, epoch), so
+        skipping is index arithmetic, no data is touched).  One-shot —
+        consumed by the next ``__iter__``."""
+        self._skip = int(n)
 
     @property
     def steps_per_epoch(self) -> int:
@@ -96,6 +104,7 @@ class HostDataLoader:
         epoch = self._epoch
         order = self._epoch_order(epoch)
         steps = self.steps_per_epoch
+        start, self._skip = self._skip, 0
         aug_seed = hash((self.seed, epoch)) & 0x7FFFFFFF
 
         pool = (
@@ -105,7 +114,7 @@ class HostDataLoader:
         )
         native_batch = getattr(self.dataset, "load_batch", None)
         try:
-            for step in range(steps):
+            for step in range(start, steps):
                 lo = step * self.global_batch_size + self.shard_id * self.local_batch_size
                 idxs = order[lo : lo + self.local_batch_size]
                 if native_batch is not None:

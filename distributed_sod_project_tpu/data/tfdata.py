@@ -69,9 +69,14 @@ class TFDataLoader:
         self.hflip = hflip
         self.num_workers = num_workers
         self._epoch = 0
+        self._skip = 0
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+
+    def skip_steps(self, n: int) -> None:
+        """One-shot mid-epoch resume offset (see HostDataLoader)."""
+        self._skip = int(n)
 
     @property
     def steps_per_epoch(self) -> int:
@@ -105,12 +110,14 @@ class TFDataLoader:
         # This host's slice of every global batch, in global epoch order.
         order = self._epoch_order(epoch)
         steps = self.steps_per_epoch
+        start, self._skip = self._skip, 0
         my = np.concatenate([
             order[s * self.global_batch_size
                   + self.shard_id * self.local_batch_size:
                   s * self.global_batch_size
                   + (self.shard_id + 1) * self.local_batch_size]
-            for s in range(steps)]) if steps else np.zeros((0,), np.int64)
+            for s in range(start, steps)]) if steps > start else np.zeros(
+                (0,), np.int64)
 
         stems = [ds_obj.stems[i] for i in my]
         img_paths = [ds_obj.img_paths[s] for s in stems]

@@ -58,6 +58,21 @@ def _resize_pred(pred: np.ndarray, hw) -> np.ndarray:
     return np.asarray(im, np.float32) / 255.0
 
 
+def _save_pngs(items) -> None:
+    """One eval batch of saliency maps → PNGs: C++ threaded writer when
+    the native lib is built (GIL-free, SURVEY.md §3.2's dump hot loop),
+    else PIL."""
+    from ..data import native
+
+    if native.png_writer_available():
+        native.write_png_batch(items)
+        return
+    from PIL import Image
+
+    for path, arr in items:
+        Image.fromarray(arr).save(path)
+
+
 def run_inference(
     forward,
     dataset,
@@ -92,17 +107,18 @@ def run_inference(
                 batch.items()}
         probs = np.asarray(forward(batch))[: len(idxs)]
 
+        pending = []
         for j, i in enumerate(idxs):
             gt = _original_mask(dataset, i, samples[j])
             pred = _resize_pred(probs[j], gt.shape[:2])
             if compute_metrics:
                 agg.add(pred, gt)
             if save_dir:
-                from PIL import Image
-
-                Image.fromarray(
-                    (np.clip(pred, 0, 1) * 255).astype(np.uint8)
-                ).save(os.path.join(save_dir, f"{_stem(dataset, i)}.png"))
+                pending.append((
+                    os.path.join(save_dir, f"{_stem(dataset, i)}.png"),
+                    (np.clip(pred, 0, 1) * 255).astype(np.uint8)))
+        if pending:
+            _save_pngs(pending)
     out = agg.results() if compute_metrics else {}
     if out:
         log.info("eval: %s", {k: round(v, 4) if isinstance(v, float) else v
@@ -123,8 +139,9 @@ def evaluate(
     """Test-entrypoint engine: run every test set through the model.
 
     ``datasets`` maps name → dataset; defaults to the config's dataset.
-    Single-device jit (eval is per-host embarrassingly parallel; the
-    sharded path exists via ``make_eval_step`` for pod-scale eval).
+    Pass ``mesh`` to shard the forward over its ``data`` axis (all local
+    chips work on every batch — the pod/donut eval path); without it the
+    jit runs on the default device.
     """
     from ..data import resolve_dataset
     from ..models import build_model
@@ -134,13 +151,27 @@ def evaluate(
         # hflip is a train-loader op, not a dataset property — resolve as-is.
         datasets = {cfg.data.dataset: resolve_dataset(cfg.data)}
     bs = batch_size or min(cfg.global_batch_size, 8)
+    if mesh is not None:
+        from ..parallel.mesh import (batch_sharding, replicated_sharding)
+
+        n_data = mesh.shape.get("data", 1)
+        bs = max(1, bs // n_data) * n_data  # divisible by the data axis
+        state = jax.device_put(state, replicated_sharding(mesh))
+
+    variables = (state.eval_variables() if hasattr(state, "eval_variables")
+                 else state.variables())
 
     @jax.jit
-    def forward(batch):
+    def _apply(variables, batch):
         outs = model.apply(
-            state.variables(), batch["image"], batch.get("depth"),
+            variables, batch["image"], batch.get("depth"),
             train=False)
         return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
+
+    def forward(batch):
+        if mesh is not None:
+            batch = jax.device_put(batch, batch_sharding(mesh))
+        return _apply(variables, batch)
 
     results = {}
     for name, ds in datasets.items():

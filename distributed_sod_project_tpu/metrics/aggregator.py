@@ -46,6 +46,20 @@ class SODMetrics:
             self._adp.append(adaptive_fbeta(p, g))
             self._wfm.append(weighted_fmeasure(p, g))
 
+    def curves(self) -> Dict[str, np.ndarray]:
+        """256-threshold curves for plotting (PySODEvalToolkit-style):
+        pooled (micro) precision/recall/Fβ plus the macro Fβ curve the
+        headline max-Fβ comes from."""
+        from .streaming import fbeta_curve
+
+        prec, rec, f = fbeta_curve(self._state)
+        return {
+            "precision": np.asarray(prec),
+            "recall": np.asarray(rec),
+            "fbeta_pooled": np.asarray(f),
+            "fbeta_macro": np.asarray(mean_fbeta_curve(self._state)),
+        }
+
     def results(self) -> Dict[str, float]:
         f = mean_fbeta_curve(self._state)  # macro curve, one finalise pass
         n = max(float(self._state.count), 1.0)

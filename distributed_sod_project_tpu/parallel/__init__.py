@@ -8,6 +8,13 @@ from .mesh import (
     host_shard,
     global_batch_array,
 )
+from .tp import (
+    SWIN_TP_RULES,
+    make_tp_train_step,
+    param_partition_specs,
+    shard_state,
+    state_partition_specs,
+)
 
 __all__ = [
     "MeshAxes",
@@ -18,4 +25,9 @@ __all__ = [
     "replicated_sharding",
     "host_shard",
     "global_batch_array",
+    "SWIN_TP_RULES",
+    "make_tp_train_step",
+    "param_partition_specs",
+    "shard_state",
+    "state_partition_specs",
 ]

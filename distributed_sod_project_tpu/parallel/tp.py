@@ -1,0 +1,204 @@
+"""Tensor parallelism over the ``model`` mesh axis — GSPMD style.
+
+The DP train step (train/step.py) is shard_map-manual because it needs
+named-axis BatchNorm psums.  The transformer path (Swin-SOD: LayerNorm
+only, no cross-replica BN) takes the other TPU-idiomatic route instead:
+**annotate parameter shardings, jit, and let XLA's SPMD partitioner
+insert the collectives** (the scaling-book recipe; SURVEY.md §2.3 "TP"
+row).  Megatron-style layout:
+
+- qkv / MLP-up ``Dense`` kernels are column-parallel — output features
+  sharded over ``model`` — so each chip computes its slice of the heads
+  with zero communication;
+- attention-out / MLP-down kernels are row-parallel — input features
+  sharded — so XLA emits exactly one reduce(-scatter)/all-reduce pair
+  per block, the Megatron minimum;
+- the relative-position bias table shards over its heads column;
+- everything else (LayerNorms, patch-merge projections, conv decoder)
+  stays replicated over ``model`` and batch-sharded compute rides the
+  ``data`` axis exactly as in the DP step (gradient allreduce over
+  ``data`` is inserted by the partitioner, replacing step.py's explicit
+  ``pmean``).
+
+Sharding a leaf is skipped (replicated) when its dimension does not
+divide the axis size, so the same rules work for any ``model`` degree
+that divides the widths — degrees that do not divide simply fall back
+per-leaf.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, spec) — first match wins; paths are '/'-joined key paths.
+# Swin modules: SwinBlock's direct Dense_0/Dense_1 are the MLP up/down;
+# WindowAttention's Dense_0/Dense_1 are qkv / output projection.
+SWIN_TP_RULES: Tuple[Tuple[str, P], ...] = (
+    (r"WindowAttention_\d+/Dense_0/kernel$", P(None, "model")),
+    (r"WindowAttention_\d+/Dense_0/bias$", P("model")),
+    (r"WindowAttention_\d+/Dense_1/kernel$", P("model", None)),
+    (r"WindowAttention_\d+/rel_pos_bias$", P(None, "model")),
+    (r"SwinBlock_\d+/Dense_0/kernel$", P(None, "model")),
+    (r"SwinBlock_\d+/Dense_0/bias$", P("model")),
+    (r"SwinBlock_\d+/Dense_1/kernel$", P("model", None)),
+)
+
+
+def _leaf_path(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _divisible(shape, spec: P, mesh: Mesh) -> bool:
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for dim, names in zip(shape, spec):
+        if names is None:
+            continue
+        names = names if isinstance(names, tuple) else (names,)
+        total = int(np.prod([axis_sizes[n] for n in names]))
+        if dim % total:
+            return False
+    return True
+
+
+def param_partition_specs(params, mesh: Mesh,
+                          rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES):
+    """Spec pytree for ``params``: first rule whose regex matches the
+    '/'-joined path wins; non-matching (or non-divisible) leaves
+    replicate.  Specs longer than the leaf's rank are an error caught
+    here rather than inside jit."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def assign(path, leaf):
+        name = _leaf_path(path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                if len(spec) > leaf.ndim:
+                    raise ValueError(
+                        f"rule {pat.pattern!r} spec {spec} exceeds rank "
+                        f"of {name} {leaf.shape}")
+                if _divisible(leaf.shape, spec, mesh):
+                    return spec
+                return P()
+        return P()
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def _specs_like(tree, params_treedef, param_specs):
+    """Spec tree for an arbitrary container (e.g. an optax state):
+    any subtree whose treedef equals the params' gets ``param_specs``
+    (momentum/EMA buffers shard with their parameters); all other
+    leaves replicate."""
+
+    def rec(t):
+        try:
+            if jax.tree_util.tree_structure(t) == params_treedef:
+                return param_specs
+        except Exception:
+            pass
+        if isinstance(t, tuple) and hasattr(t, "_fields"):  # NamedTuple
+            return type(t)(*(rec(x) for x in t))
+        if isinstance(t, (tuple, list)):
+            return type(t)(rec(x) for x in t)
+        if isinstance(t, dict):
+            return {k: rec(v) for k, v in t.items()}
+        return P()
+
+    return rec(tree)
+
+
+def state_partition_specs(state, mesh: Mesh,
+                          rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES):
+    """A TrainState-shaped pytree of PartitionSpecs: params per the TP
+    rules, optimizer buffers matching their parameters, the rest
+    replicated."""
+    param_specs = param_partition_specs(state.params, mesh, rules)
+    pdef = jax.tree_util.tree_structure(state.params)
+    return type(state)(
+        step=P(),
+        params=param_specs,
+        batch_stats=jax.tree_util.tree_map(lambda _: P(), state.batch_stats),
+        opt_state=_specs_like(state.opt_state, pdef, param_specs),
+        ema_params=param_specs if state.ema_params is not None else None,
+    )
+
+
+def to_shardings(spec_tree, mesh: Mesh):
+    """PartitionSpec pytree → NamedSharding pytree (specs are tuple
+    subclasses, so tree_map needs the is_leaf guard)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_state(state, mesh: Mesh,
+                rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES):
+    """Place a host/replicated TrainState onto the mesh with the TP
+    layout; returns (sharded_state, state_shardings)."""
+    shardings = to_shardings(state_partition_specs(state, mesh, rules), mesh)
+    return jax.device_put(state, shardings), shardings
+
+
+def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
+                       schedule=None, donate: bool = True,
+                       ema_decay: float = 0.0, ema_every: int = 1):
+    """Build the GSPMD train step: ``(state, batch) -> (state, metrics)``.
+
+    Unlike the shard_map DP step there is no explicit ``pmean`` and no
+    named-axis BN: compute is written with *global* semantics and the
+    SPMD partitioner inserts the gradient allreduce over ``data`` and
+    the Megatron pair over ``model`` from the sharding annotations
+    alone.  Requires ``model_cfg.sync_bn=False`` models (the
+    transformer zoo); BN stats here are computed over the global batch
+    by construction, which is strictly stronger than SyncBN.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from ..losses import deep_supervision_loss
+    from ..train.step import _loss_kwargs, apply_update
+    from .mesh import batch_sharding
+
+    lkw = _loss_kwargs(loss_cfg)
+
+    def step_fn(state, batch):
+        rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+
+        def loss_fn(params):
+            outs, mut = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                batch["image"], batch.get("depth"), train=True,
+                mutable=["batch_stats"], rngs={"dropout": rng})
+            total, comps = deep_supervision_loss(outs, batch["mask"], **lkw)
+            return total, (comps, mut.get("batch_stats", state.batch_stats))
+
+        grads, (comps, new_stats) = jax.grad(loss_fn, has_aux=True)(
+            state.params)
+        new_state = apply_update(state, grads, new_stats, tx,
+                                 ema_decay=ema_decay, ema_every=ema_every)
+        metrics = dict(comps)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        if schedule is not None:
+            metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
+        return new_state, metrics
+
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, batch_sharding(mesh)),
+        out_shardings=(state_shardings, replicated),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -88,7 +88,8 @@ def fit(
 
     sample = next(iter(loader))
     state = create_train_state(jax.random.key(cfg.seed), model, tx, sample,
-                               pretrained=cfg.model.pretrained)
+                               pretrained=cfg.model.pretrained,
+                               ema=cfg.optim.ema_decay > 0)
     log.info("model=%s params=%.2fM devices=%d global_batch=%d "
              "steps/epoch=%d total_steps=%d",
              cfg.model.name, param_count(state) / 1e6, n_dev,
@@ -115,12 +116,27 @@ def fit(
             log.info("resumed from checkpoint step %d", start_step)
 
     state = jax.device_put(state, replicated_sharding(mesh))
-    train_step = make_train_step(model, cfg.loss, tx, mesh,
-                                 schedule=schedule, remat=cfg.model.remat)
+    # Multi-scale training: one compiled step per size in the cycle
+    # (each is a distinct static-shape XLA program; the resize happens
+    # on-device inside the step).  Single-scale is the 1-entry cycle at
+    # the loader's native (possibly non-square) image_size.
+    ms_cycle = (tuple((s, s) for s in cfg.data.multiscale)
+                or (tuple(cfg.data.image_size),))
+    step_for_size = {
+        hw: make_train_step(model, cfg.loss, tx, mesh,
+                            schedule=schedule, remat=cfg.model.remat,
+                            ema_decay=cfg.optim.ema_decay,
+                            ema_every=cfg.optim.accum_steps,
+                            scale_hw=None if hw ==
+                            tuple(cfg.data.image_size) else hw)
+        for hw in dict.fromkeys(ms_cycle)
+    }
+    train_step_at = lambda i: step_for_size[ms_cycle[i % len(ms_cycle)]]  # noqa: E731
 
     writer = MetricWriter(os.path.join(workdir, "tb")
                           if cfg.tensorboard else None)
-    eval_fn = _make_inline_eval(cfg, model) if cfg.eval_every_steps else None
+    eval_fn = (_make_inline_eval(cfg, model, mesh)
+               if cfg.eval_every_steps else None)
 
     timer = StepTimer()
     last_metrics: Dict[str, float] = {}
@@ -135,9 +151,15 @@ def fit(
     profile_at = -1
     if profile_dir:
         profile_at = max(start_step, min(start_step + 10, total_steps - 1))
+    start_epoch = start_step // max(steps_per_epoch, 1)
+    if start_step % max(steps_per_epoch, 1) and hasattr(loader, "skip_steps"):
+        # Exact mid-epoch resume: the epoch order is a pure function of
+        # (seed, epoch), so re-entry is an index skip — no replayed or
+        # skipped samples vs the uninterrupted run.
+        loader.skip_steps(start_step % steps_per_epoch)
     try:
       with PreemptionGuard() as guard:
-        for epoch in range(start_step // max(steps_per_epoch, 1), cfg.num_epochs):
+        for epoch in range(start_epoch, cfg.num_epochs):
             loader.set_epoch(epoch)
             # mesh= (not sharding=): each host contributes its local
             # slice of the global batch — correct on multi-host pods.
@@ -146,6 +168,7 @@ def fit(
             for batch in it:
                 if step >= total_steps or stop:
                     break
+                train_step = train_step_at(step)
                 if step == profile_at:
                     with profile_window(profile_dir):
                         state, metrics = train_step(state, batch)
@@ -213,14 +236,17 @@ def fit(
     return last_metrics
 
 
-def _make_inline_eval(cfg: ExperimentConfig, model) -> Callable:
+def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
     """Build a lightweight in-training eval: max-Fβ/MAE over the
     held-out set (``data.val_root`` when set, else the train dataset —
     meaningful for overfit smoke tests, a real val set in production).
-    Feeds CheckpointManager's best-metric retention (cfg.best_metric)."""
+    Batches shard over the mesh's ``data`` axis, so eval reuses every
+    chip the train step uses.  Feeds CheckpointManager's best-metric
+    retention (cfg.best_metric)."""
     import dataclasses
 
     from ..eval import run_inference
+    from ..parallel.mesh import batch_sharding
 
     data_cfg = cfg.data
     if cfg.data.val_root:
@@ -236,11 +262,13 @@ def _make_inline_eval(cfg: ExperimentConfig, model) -> Callable:
         return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
 
     def eval_fn(state) -> Dict[str, float]:
-        variables = state.variables()
+        variables = state.eval_variables()
         # Every host sweeps the full val set: metrics must be identical
         # across processes for consistent best-k checkpoint ranking.
         return {k: v for k, v in run_inference(
-            lambda b: forward(variables, b), dataset,
+            lambda b: forward(variables,
+                              jax.device_put(b, batch_sharding(mesh))),
+            dataset,
             batch_size=max(1, cfg.global_batch_size),
             use_depth=cfg.data.use_depth,
             compute_structure=False,

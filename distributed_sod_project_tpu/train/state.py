@@ -25,16 +25,26 @@ class TrainState:
     params: Any                  # f32 param pytree
     batch_stats: Any             # BatchNorm running stats (f32)
     opt_state: Any               # optax state
+    ema_params: Any = None       # EMA of params (None = EMA disabled)
 
     def variables(self) -> Dict[str, Any]:
         return {"params": self.params, "batch_stats": self.batch_stats}
 
+    def eval_variables(self) -> Dict[str, Any]:
+        """Variables for evaluation: the EMA weights when tracked (the
+        averaged model generalises better; reference-era repos get the
+        same effect from picking the best epoch), else the raw params."""
+        params = self.ema_params if self.ema_params is not None else self.params
+        return {"params": params, "batch_stats": self.batch_stats}
+
 
 def create_train_state(rng, model, tx, sample_batch,
-                       pretrained: str = None) -> TrainState:
+                       pretrained: str = None,
+                       ema: bool = False) -> TrainState:
     """Initialise params/batch_stats from one (host-side) sample batch
     and wrap them with the optimizer's initial state.  ``pretrained``
-    merges a ported ImageNet backbone (.npz) over the fresh init."""
+    merges a ported ImageNet backbone (.npz) over the fresh init.
+    ``ema=True`` seeds the EMA tree as a copy of the initial params."""
     image = jnp.asarray(sample_batch["image"])
     depth = sample_batch.get("depth")
     if depth is not None:
@@ -51,6 +61,7 @@ def create_train_state(rng, model, tx, sample_batch,
         params=params,
         batch_stats=batch_stats,
         opt_state=tx.init(params),
+        ema_params=jax.tree_util.tree_map(jnp.copy, params) if ema else None,
     )
 
 

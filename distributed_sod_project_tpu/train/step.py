@@ -39,6 +39,38 @@ def _loss_kwargs(loss_cfg) -> Dict[str, Any]:
     )
 
 
+def apply_update(state: TrainState, grads, new_stats, tx, *,
+                 ema_decay: float = 0.0, ema_every: int = 1):
+    """Shared optimizer/EMA tail of every train step (DP and TP).
+
+    ``ema_every`` is the gradient-accumulation factor: under
+    ``optax.MultiSteps`` params change only every k-th micro-step, so
+    the EMA blends only there too — keeping the effective per-update
+    decay at ``ema_decay`` instead of ``ema_decay**k``.
+    """
+    updates, new_opt = tx.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    new_ema = state.ema_params
+    if ema_decay and new_ema is not None:
+        d = jnp.float32(ema_decay)
+        blended = jax.tree_util.tree_map(
+            lambda e, p: e * d + p.astype(e.dtype) * (1.0 - d),
+            new_ema, new_params)
+        if ema_every > 1:
+            applied = (state.step + 1) % ema_every == 0
+            new_ema = jax.tree_util.tree_map(
+                lambda b, e: jnp.where(applied, b, e), blended, new_ema)
+        else:
+            new_ema = blended
+    return TrainState(
+        step=state.step + 1,
+        params=new_params,
+        batch_stats=new_stats,
+        opt_state=new_opt,
+        ema_params=new_ema,
+    )
+
+
 def make_train_step(
     model,
     loss_cfg,
@@ -47,6 +79,9 @@ def make_train_step(
     schedule: Optional[optax.Schedule] = None,
     donate: bool = True,
     remat: bool = False,
+    ema_decay: float = 0.0,
+    ema_every: int = 1,
+    scale_hw: Optional[Tuple[int, int]] = None,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build ``(state, batch) -> (state, metrics)``.
 
@@ -58,10 +93,28 @@ def make_train_step(
     trading ~⅓ more FLOPs for the activation memory — the standard lever
     when a bigger per-chip batch is HBM-bound (SURVEY.md "HBM
     bandwidth" row).
+
+    ``scale_hw`` is the multi-scale training hook: the step resizes
+    image/mask/depth to that (H, W) on-device before the forward, so
+    the loader keeps emitting one static shape and every train size is
+    its own compiled program (no dynamic shapes anywhere).
     """
     lkw = _loss_kwargs(loss_cfg)
 
+    def _rescale(batch):
+        hw = batch["image"].shape[1:3]
+        if scale_hw is None or tuple(scale_hw) == tuple(hw):
+            return batch
+        out = dict(batch)
+        for k in ("image", "mask", "depth"):
+            if k in out:
+                b, _, _, c = out[k].shape
+                out[k] = jax.image.resize(
+                    out[k], (b,) + tuple(scale_hw) + (c,), "bilinear")
+        return out
+
     def step_fn(state: TrainState, batch):
+        batch = _rescale(batch)
         rng = jax.random.fold_in(
             jax.random.fold_in(jax.random.PRNGKey(0), state.step),
             lax.axis_index("data"),
@@ -92,14 +145,8 @@ def make_train_step(
         grads = lax.pmean(grads, "data")
         comps = lax.pmean(comps, "data")
 
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(
-            step=state.step + 1,
-            params=new_params,
-            batch_stats=new_stats,
-            opt_state=new_opt,
-        )
+        new_state = apply_update(state, grads, new_stats, tx,
+                                 ema_decay=ema_decay, ema_every=ema_every)
         metrics = dict(comps)
         metrics["grad_norm"] = optax.global_norm(grads)
         if schedule is not None:
@@ -123,7 +170,7 @@ def make_eval_step(model, mesh: Mesh) -> Callable:
 
     def eval_fn(state: TrainState, batch):
         outs = model.apply(
-            state.variables(),
+            state.eval_variables(),
             batch["image"],
             batch.get("depth"),
             train=False,

@@ -113,6 +113,46 @@ bool decode_png(FILE* f, bool gray, Image* out) {
   return true;
 }
 
+// ------------------------------------------------------- PNG encode
+// 8-bit grayscale writer for saliency maps (the test.py dump path —
+// thousands of small PNGs per eval; SURVEY.md §3.2 hot loop).
+bool encode_png_gray(const char* path, const uint8_t* data, int w, int h) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return false;
+  png_structp png = png_create_write_struct(PNG_LIBPNG_VER_STRING,
+                                            nullptr, nullptr, nullptr);
+  if (!png) {
+    fclose(f);
+    return false;
+  }
+  png_infop info = png_create_info_struct(png);
+  if (!info) {
+    png_destroy_write_struct(&png, nullptr);
+    fclose(f);
+    return false;
+  }
+  if (setjmp(png_jmpbuf(png))) {
+    png_destroy_write_struct(&png, &info);
+    fclose(f);
+    return false;
+  }
+  png_init_io(png, f);
+  png_set_IHDR(png, info, w, h, 8, PNG_COLOR_TYPE_GRAY,
+               PNG_INTERLACE_NONE, PNG_COMPRESSION_TYPE_DEFAULT,
+               PNG_FILTER_TYPE_DEFAULT);
+  // Saliency maps are smooth: level 1 + SUB filter ≈ same size as
+  // default at a fraction of the CPU time.
+  png_set_compression_level(png, 1);
+  png_set_filter(png, 0, PNG_FILTER_SUB);
+  png_write_info(png, info);
+  for (int y = 0; y < h; ++y)
+    png_write_row(png, const_cast<png_bytep>(data + size_t(y) * w));
+  png_write_end(png, nullptr);
+  png_destroy_write_struct(&png, &info);
+  fclose(f);
+  return true;
+}
+
 bool decode_file(const char* path, bool gray, Image* out) {
   FILE* f = fopen(path, "rb");
   if (!f) return false;
@@ -255,6 +295,34 @@ int dsod_decode_batch(const char** paths, int n, int H, int W, int gray,
   return failed.load();
 }
 
-int dsod_version() { return 1; }
+// paths/data/ws/hs: n grayscale images, data[i] is hs[i]*ws[i] bytes.
+// Returns 0 on success, else the 1-based index of the first failure.
+int dsod_write_png_batch(const char** paths, const uint8_t* const* data,
+                         const int* ws, const int* hs, int n, int threads) {
+  std::atomic<int> next(0), failed(0);
+  int nt = threads > 0 ? threads
+                       : int(std::thread::hardware_concurrency());
+  if (nt < 1) nt = 1;
+  if (nt > n) nt = n;
+  auto worker = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) {
+      if (!encode_png_gray(paths[i], data[i], ws[i], hs[i])) {
+        int expect = 0;
+        failed.compare_exchange_strong(expect, i + 1);
+      }
+    }
+  };
+  if (nt == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(nt);
+    for (int t = 0; t < nt; ++t) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return failed.load();
+}
+
+int dsod_version() { return 2; }
 
 }  // extern "C"

@@ -77,13 +77,20 @@ def main(argv=None):
 
     batch = {k: np.asarray(v)[None] for k, v in sample.items()
              if k in ("image", "depth")}
-    template = create_train_state(jax.random.key(0), model, tx, batch)
+    # Template must match the training-time state tree: an EMA run's
+    # checkpoint has ema_params, and orbax restores by template shape.
+    template = create_train_state(jax.random.key(0), model, tx, batch,
+                                  ema=cfg.optim.ema_decay > 0)
 
     mgr = CheckpointManager(args.ckpt_dir, async_save=False)
     state = mgr.restore(template, step=args.step)
     mgr.close()
 
-    results = evaluate(cfg, state, model=model, datasets=datasets,
+    from distributed_sod_project_tpu.parallel.mesh import make_mesh
+
+    # All local chips share every eval batch (data-sharded forward).
+    mesh = make_mesh(cfg.mesh) if jax.device_count() > 1 else None
+    results = evaluate(cfg, state, model=model, mesh=mesh, datasets=datasets,
                        save_root=args.save_dir, batch_size=args.batch_size,
                        compute_structure=not args.no_structure)
     print(json.dumps(results, indent=2))

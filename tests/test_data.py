@@ -82,3 +82,26 @@ def test_loader_rejects_indivisible_batch():
     ds = SyntheticSOD(size=16, image_size=(16, 16))
     with pytest.raises(ValueError):
         HostDataLoader(ds, global_batch_size=6, num_shards=4)
+
+
+def test_loader_skip_steps_resumes_mid_epoch():
+    """skip_steps(n) yields exactly the tail of the epoch — identical
+    batches to the uninterrupted run — and is one-shot."""
+    ds = SyntheticSOD(size=32, image_size=(16, 16), seed=1)
+    mk = lambda: HostDataLoader(ds, global_batch_size=4, shuffle=True,  # noqa: E731
+                                seed=7)
+    full = mk()
+    full.set_epoch(2)
+    all_batches = [b["image"] for b in full]
+
+    resumed = mk()
+    resumed.set_epoch(2)
+    resumed.skip_steps(3)
+    tail = [b["image"] for b in resumed]
+    assert len(tail) == len(all_batches) - 3
+    for a, b in zip(all_batches[3:], tail):
+        np.testing.assert_array_equal(a, b)
+
+    # One-shot: the next epoch starts from the beginning again.
+    resumed.set_epoch(3)
+    assert len(list(resumed)) == len(all_batches)

@@ -1,0 +1,79 @@
+"""Offline evaluator (tools/eval_preds.py) — PySODEvalToolkit parity."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import eval_preds  # noqa: E402
+
+
+def _write(dirpath, stem, arr):
+    os.makedirs(dirpath, exist_ok=True)
+    Image.fromarray((np.clip(arr, 0, 1) * 255).astype(np.uint8)).save(
+        os.path.join(dirpath, stem + ".png"))
+
+
+@pytest.fixture
+def pred_gt_dirs(tmp_path):
+    rng = np.random.default_rng(0)
+    pd, gd = str(tmp_path / "pred"), str(tmp_path / "gt")
+    for i in range(4):
+        gt = (rng.random((24, 32)) > 0.6).astype(np.float32)
+        noise = rng.random((24, 32)) * 0.3
+        pred = np.clip(gt * 0.8 + noise, 0, 1)
+        _write(gd, f"im{i}", gt)
+        _write(pd, f"im{i}", pred)
+    # One extra GT with no prediction → counted missing, not fatal.
+    _write(gd, "orphan", np.zeros((8, 8), np.float32))
+    return pd, gd
+
+
+def test_evaluate_pair_scores_and_curves(pred_gt_dirs):
+    pd, gd = pred_gt_dirs
+    res, curve, missing = eval_preds.evaluate_pair(pd, gd, curves=True)
+    assert res["num_images"] == 4
+    assert missing == 1
+    assert 0.0 <= res["mae"] <= 1.0
+    assert 0.5 < res["max_fbeta"] <= 1.0  # predictions correlate with gt
+    assert set(curve) == {"precision", "recall", "fbeta_pooled",
+                          "fbeta_macro"}
+    assert len(curve["precision"]) == 256
+    assert max(curve["fbeta_macro"]) == pytest.approx(res["max_fbeta"],
+                                                      abs=1e-6)
+
+
+def test_pred_resized_to_gt_resolution(tmp_path):
+    """Saved-map convention: predictions at model resolution are scored
+    against GT at its original (different) resolution."""
+    pd, gd = str(tmp_path / "p"), str(tmp_path / "g")
+    gt = np.zeros((40, 60), np.float32)
+    gt[10:30, 15:45] = 1.0
+    _write(gd, "a", gt)
+    small = np.zeros((20, 30), np.float32)
+    small[5:15, 8:23] = 1.0  # same box at half resolution
+    _write(pd, "a", small)
+    res, _, _ = eval_preds.evaluate_pair(pd, gd)
+    assert res["max_fbeta"] > 0.9
+    assert res["mae"] < 0.1
+
+
+def test_cli_table_and_outputs(pred_gt_dirs, tmp_path, capsys):
+    pd, gd = pred_gt_dirs
+    csv = str(tmp_path / "out.csv")
+    curves = str(tmp_path / "curves.json")
+    rc = eval_preds.main([f"mini={pd}:{gd}", "--csv", csv,
+                          "--curves", curves])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mini" in out and "max_fbeta" in out
+    with open(csv) as f:
+        lines = f.read().strip().splitlines()
+    assert lines[0].startswith("dataset,") and lines[1].startswith("mini,")
+    with open(curves) as f:
+        assert "mini" in json.load(f)

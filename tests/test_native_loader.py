@@ -146,3 +146,45 @@ def test_host_loader_uses_native_and_stays_deterministic(tmp_path):
     assert len(run1) == 2
     for a, b in zip(run1, run2):
         np.testing.assert_array_equal(a, b)
+
+
+def test_write_png_batch_roundtrip(tmp_path):
+    if not native.png_writer_available():
+        pytest.skip("lib < v2")
+    rng = np.random.default_rng(1)
+    items = []
+    arrays = []
+    for i, shape in enumerate([(24, 31), (16, 16), (50, 7)]):
+        a = rng.integers(0, 256, shape, np.uint8)
+        arrays.append(a)
+        items.append((str(tmp_path / f"p{i}.png"), a))
+    native.write_png_batch(items)
+    for (path, _), a in zip(items, arrays):
+        np.testing.assert_array_equal(np.asarray(Image.open(path)), a)
+
+
+def test_write_png_batch_reports_failure(tmp_path):
+    if not native.png_writer_available():
+        pytest.skip("lib < v2")
+    a = np.zeros((4, 4), np.uint8)
+    bad = str(tmp_path / "no_such_dir" / "x.png")
+    with pytest.raises(RuntimeError, match="no_such_dir"):
+        native.write_png_batch([(bad, a)])
+
+
+def test_save_dir_uses_writer_end_to_end(tmp_path):
+    """run_inference --save-dir path produces readable PNGs."""
+    from distributed_sod_project_tpu.data import SyntheticSOD
+    from distributed_sod_project_tpu.eval.inference import run_inference
+
+    ds = SyntheticSOD(size=3, image_size=(16, 16), seed=0)
+    out = run_inference(
+        lambda b: np.asarray(b["image"]).mean(-1) * 0 + 0.5,
+        ds, batch_size=2, save_dir=str(tmp_path / "preds"),
+        compute_structure=False)
+    files = sorted(os.listdir(tmp_path / "preds"))
+    assert len(files) == 3
+    arr = np.asarray(Image.open(tmp_path / "preds" / files[0]))
+    assert arr.shape == (16, 16)
+    assert abs(int(arr.mean()) - 127) <= 2
+    assert out["num_images"] == 3

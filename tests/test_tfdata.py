@@ -100,3 +100,21 @@ def test_tfdata_rejects_synthetic(folder_ds):
 
     with pytest.raises(ValueError, match="file-backed"):
         TFDataLoader(SyntheticSOD(), global_batch_size=4)
+
+
+def test_tfdata_skip_steps_resumes_mid_epoch(folder_ds):
+    from distributed_sod_project_tpu.data.tfdata import TFDataLoader
+
+    mk = lambda: TFDataLoader(folder_ds, global_batch_size=2,  # noqa: E731
+                              shuffle=True, seed=5, hflip=False)
+    full = mk()
+    full.set_epoch(1)
+    all_batches = [b["index"] for b in full]
+
+    resumed = mk()
+    resumed.set_epoch(1)
+    resumed.skip_steps(2)
+    tail = [b["index"] for b in resumed]
+    assert len(tail) == len(all_batches) - 2
+    for a, b in zip(all_batches[2:], tail):
+        np.testing.assert_array_equal(a, b)

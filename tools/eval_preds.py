@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Offline saliency-map evaluator — PySODEvalToolkit parity.
+
+The reference author's ecosystem evaluates *saved* prediction maps
+against ground-truth folders, decoupled from any framework
+(SURVEY.md §2 C10: the PySODMetrics/PySODEvalToolkit pair).  This tool
+is that capability for the TPU framework: point it at one or more
+(pred_dir, gt_dir) pairs and get the full SOD metric table — MAE,
+max/mean/adaptive Fβ, weighted Fβ, S-measure, E-measure — plus an
+optional per-dataset precision/recall curve dump for plotting.
+
+Usage:
+    python tools/eval_preds.py duts_te=preds/duts_te:/data/DUTS-TE/Mask \
+        [more name=pred_dir:gt_dir ...] [--curves curves.json] [--csv out.csv]
+
+Predictions and GT are matched by file stem; predictions are resized to
+GT resolution (the saved-map convention) before scoring.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pin_cpu() -> None:
+    """Offline scoring never needs an accelerator; with a remote-TPU
+    PJRT plugin registered (sitecustomize), letting jax auto-pick would
+    dial the tunnel — and hang when it is down.  Config path, not env:
+    the plugin re-exports JAX_PLATFORMS at interpreter start."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already up: leave it
+        pass
+
+
+IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp")
+
+
+def _index_dir(d):
+    out = {}
+    for f in sorted(os.listdir(d)):
+        stem, ext = os.path.splitext(f)
+        if ext.lower() in IMG_EXTS:
+            out[stem] = os.path.join(d, f)
+    return out
+
+
+def _load_gray(path, size=None):
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("L")
+        if size is not None and im.size != size:
+            im = im.resize(size, Image.BILINEAR)
+        return np.asarray(im, np.float32) / 255.0
+
+
+def evaluate_pair(pred_dir: str, gt_dir: str, curves: bool = False):
+    """Score every stem-matched (pred, gt) pair; returns (metrics,
+    curve_dict|None, n_missing)."""
+    from distributed_sod_project_tpu.metrics import SODMetrics
+
+    preds = _index_dir(pred_dir)
+    gts = _index_dir(gt_dir)
+    matched = sorted(set(preds) & set(gts))
+    missing = len(gts) - len(matched)
+    if not matched:
+        raise SystemExit(
+            f"no stem matches between {pred_dir} ({len(preds)} maps) and "
+            f"{gt_dir} ({len(gts)} masks)")
+
+    agg = SODMetrics(compute_structure=True)
+    for stem in matched:
+        gt = (_load_gray(gts[stem]) > 0.5).astype(np.float32)
+        pred = _load_gray(preds[stem], size=(gt.shape[1], gt.shape[0]))
+        agg.add(pred, gt)
+    results = agg.results()
+
+    curve = None
+    if curves:
+        curve = {k: v.tolist() for k, v in agg.curves().items()}
+    return results, curve, missing
+
+
+def main(argv=None):
+    _pin_cpu()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("pairs", nargs="+",
+                   help="name=pred_dir:gt_dir (repeatable)")
+    p.add_argument("--curves", default=None,
+                   help="write per-dataset PR/Fβ curves to this JSON")
+    p.add_argument("--csv", default=None, help="write the table as CSV")
+    args = p.parse_args(argv)
+
+    all_results = {}
+    all_curves = {}
+    for spec in args.pairs:
+        if "=" not in spec or ":" not in spec.split("=", 1)[1]:
+            raise SystemExit(f"bad pair {spec!r}; want name=pred_dir:gt_dir")
+        name, rest = spec.split("=", 1)
+        pred_dir, gt_dir = rest.rsplit(":", 1)
+        res, curve, missing = evaluate_pair(pred_dir, gt_dir,
+                                            curves=bool(args.curves))
+        if missing:
+            print(f"[warn] {name}: {missing} GT masks had no prediction",
+                  file=sys.stderr)
+        all_results[name] = res
+        if curve:
+            all_curves[name] = curve
+
+    cols = ["mae", "max_fbeta", "mean_fbeta", "adp_fbeta",
+            "weighted_fmeasure", "s_measure", "e_measure", "num_images"]
+    present = [c for c in cols if any(c in r for r in all_results.values())]
+    widths = {c: max(len(c), 7) for c in present}
+    header = "dataset".ljust(12) + "  ".join(c.rjust(widths[c])
+                                             for c in present)
+    print(header)
+    print("-" * len(header))
+    for name, res in all_results.items():
+        row = name.ljust(12)
+        for c in present:
+            v = res.get(c)
+            row += ("" if v is None else
+                    (f"{v:.4f}" if isinstance(v, float) else str(v))
+                    ).rjust(widths[c]) + "  "
+        print(row.rstrip())
+
+    if args.csv:
+        with open(args.csv, "w") as f:
+            f.write("dataset," + ",".join(present) + "\n")
+            for name, res in all_results.items():
+                f.write(name + "," + ",".join(
+                    str(res.get(c, "")) for c in present) + "\n")
+    if args.curves:
+        with open(args.curves, "w") as f:
+            json.dump(all_curves, f)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
