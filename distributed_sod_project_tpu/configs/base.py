@@ -81,6 +81,10 @@ class OptimConfig:
     grad_clip_norm: float = 0.0  # 0 disables
     accum_steps: int = 1  # >1: optax.MultiSteps gradient accumulation
     ema_decay: float = 0.0  # >0: track an EMA of params; eval uses it
+    # >0: skip updates whose gradients are non-finite (bad batch / bf16
+    # overflow) instead of poisoning the params; errors out after this
+    # many CONSECUTIVE skips (a persistent divergence, not a glitch).
+    skip_nonfinite: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -149,7 +149,10 @@ class SwinBlock(nn.Module):
         z = nn.LayerNorm(**ln_kw)(x)
         z = nn.Dense(int(self.dim * self.mlp_ratio), dtype=self.dtype,
                      param_dtype=self.param_dtype)(z)
-        z = nn.gelu(z)
+        # Exact (erf) GELU: the official Swin checkpoints were trained
+        # with torch nn.GELU, and the tanh approximation would add a
+        # systematic error to ported weights (tools/port_torch_weights).
+        z = nn.gelu(z, approximate=False)
         z = nn.Dense(self.dim, dtype=self.dtype,
                      param_dtype=self.param_dtype)(z)
         return x + z

@@ -69,4 +69,11 @@ def build_optimizer(
         # equal effective batch — the memory lever when remat alone is
         # not enough.
         tx = optax.MultiSteps(tx, every_k_schedule=accum)
+    skip = getattr(optim_cfg, "skip_nonfinite", 0) or 0
+    if skip > 0:
+        # Outermost so a non-finite micro-gradient never reaches the
+        # MultiSteps accumulator: the whole micro-step becomes a no-op
+        # (the DDP-era alternative was a poisoned replica bringing down
+        # the run); `skip` consecutive failures still raise.
+        tx = optax.apply_if_finite(tx, max_consecutive_errors=skip)
     return tx, schedule

@@ -341,3 +341,26 @@ def test_ema_every_gates_blend_under_accumulation(eight_devices):
     for e, a, b in zip(ema2, p0, p2):
         np.testing.assert_allclose(e, 0.5 * a + 0.5 * b, rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_skip_nonfinite_guards_updates():
+    """A NaN gradient leaves params untouched; finite ones apply."""
+    import dataclasses
+
+    import optax
+
+    ocfg = OptimConfig(optimizer="sgd", lr=0.1, momentum=0.0,
+                       weight_decay=0.0, nesterov=False,
+                       schedule="constant", skip_nonfinite=3)
+    tx, _ = build_optimizer(ocfg, 10)
+    p0 = jnp.asarray([1.0, 2.0])
+    s = tx.init(p0)
+
+    upd, s = tx.update(jnp.asarray([jnp.nan, 1.0]), s, p0)
+    p1 = optax.apply_updates(p0, upd)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p0))  # skipped
+
+    upd, s = tx.update(jnp.asarray([1.0, 1.0]), s, p1)
+    p2 = optax.apply_updates(p1, upd)
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(p0) - 0.1,
+                               atol=1e-6)

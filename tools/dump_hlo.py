@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Dump the compiled train step's HLO for a config — regression diffing.
+
+SURVEY.md §5 (tracing/profiling): the TPU-native analogue of "did my
+change alter the compiled program?" is an HLO diff.  This tool lowers
+the full sharded train step for a registered config on a virtual
+n-device CPU mesh and writes:
+
+    <out>/<config>.stablehlo.txt   — pre-optimization StableHLO (stable
+                                     across machines; the diffing target)
+    <out>/<config>.cost.json       — XLA's per-program cost analysis
+                                     (flops, bytes accessed) when
+                                     available
+
+Usage:
+    python tools/dump_hlo.py --config minet_r50_dp --out hlo/
+    diff hlo_before/minet_r50_dp.stablehlo.txt hlo_after/...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def dump(config_name: str, out_dir: str, n_devices: int = 8,
+         batch_per_device: int = 1, image_size: int = 64) -> dict:
+    """Lower the config's train step; returns {'stablehlo': path, ...}."""
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        f"--xla_force_host_platform_device_count={n_devices}")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already initialized
+        pass
+    import numpy as np
+
+    from distributed_sod_project_tpu.configs import (apply_overrides,
+                                                     get_config)
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.parallel.mesh import (
+        batch_sharding, make_mesh, replicated_sharding)
+    from distributed_sod_project_tpu.train import (
+        build_optimizer, create_train_state, make_train_step)
+
+    cfg = get_config(config_name)
+    cfg = apply_overrides(cfg, [
+        f"global_batch_size={batch_per_device * n_devices}",
+        f"data.image_size={image_size},{image_size}",
+        "mesh.data=-1", "mesh.model=1", "mesh.seq=1",
+    ])
+    mesh = make_mesh(cfg.mesh, jax.devices()[:n_devices])
+    model = build_model(cfg.model)
+    tx, sched = build_optimizer(cfg.optim, 100)
+
+    rng = np.random.RandomState(0)
+    b, hw = cfg.global_batch_size, image_size
+    batch = {
+        "image": rng.randn(b, hw, hw, 3).astype(np.float32),
+        "mask": (rng.rand(b, hw, hw, 1) > 0.5).astype(np.float32),
+    }
+    if cfg.data.use_depth:
+        batch["depth"] = rng.randn(b, hw, hw, 1).astype(np.float32)
+    state = create_train_state(jax.random.key(0), model, tx, batch)
+    state = jax.device_put(state, replicated_sharding(mesh))
+    dbatch = jax.device_put(batch, batch_sharding(mesh))
+
+    step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
+                           donate=False)
+    lowered = step.lower(state, dbatch)
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {}
+    shlo = os.path.join(out_dir, f"{config_name}.stablehlo.txt")
+    with open(shlo, "w") as f:
+        f.write(lowered.as_text())
+    paths["stablehlo"] = shlo
+
+    try:
+        compiled = lowered.compile()
+        cost = compiled.cost_analysis() or {}
+        cost = {k: float(v) for k, v in cost.items()
+                if isinstance(v, (int, float))}
+        cpath = os.path.join(out_dir, f"{config_name}.cost.json")
+        with open(cpath, "w") as f:
+            json.dump(cost, f, indent=2, sort_keys=True)
+        paths["cost"] = cpath
+    except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
+        print(f"[warn] cost analysis unavailable: {e}", file=sys.stderr)
+    return paths
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", required=True)
+    p.add_argument("--out", default="hlo")
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--batch-per-device", type=int, default=1)
+    p.add_argument("--image-size", type=int, default=64)
+    args = p.parse_args(argv)
+    paths = dump(args.config, args.out, args.devices,
+                 args.batch_per_device, args.image_size)
+    for k, v in paths.items():
+        print(f"{k}: {v}  ({os.path.getsize(v)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

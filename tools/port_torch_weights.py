@@ -180,6 +180,90 @@ def port_resnet(state_dict, arch: str):
     return params, stats
 
 
+def _linear_kernel(t) -> np.ndarray:
+    return _t2n(t).T  # torch [out,in] → flax [in,out]
+
+
+def _ln(state_dict, prefix: str) -> Dict[str, np.ndarray]:
+    return {"scale": _t2n(state_dict[prefix + ".weight"]),
+            "bias": _t2n(state_dict[prefix + ".bias"])}
+
+
+def port_swin_t(state_dict,
+                depths=(2, 2, 6, 2)) -> Tuple[Dict, Dict]:
+    """Official Swin-Transformer checkpoint → our backbones/swin.py tree.
+
+    Key schema is the microsoft/Swin-Transformer repo's (also used by
+    its segmentation/detection forks): ``patch_embed.proj``,
+    ``layers.{s}.blocks.{b}.{norm1,attn.qkv,attn.proj,norm2,mlp.fc1,
+    mlp.fc2}``, ``layers.{s}.downsample.{norm,reduction}``.  Layout
+    notes (verified numerically in tests/test_weight_port.py):
+
+    - qkv packing: torch reshapes [.,3C]→(3,heads,hd) exactly like our
+      WindowAttention, so the kernel ports as one transpose;
+    - the relative-position bias table is [(2w-1)², heads] under the
+      identical index formula — copied as-is;
+    - official attaches ``downsample`` at the END of stage s; our merge
+      (LayerNorm + Dense) opens stage s+1 — same weights, same dataflow;
+    - classification ckpts carry one final ``norm`` (→ our last
+      stage-out LayerNorm); dense-prediction ckpts carry ``norm{0..3}``
+      (→ every stage-out LayerNorm); absent ones keep fresh init.
+    """
+    params: Dict = {
+        "Conv_0": {
+            "kernel": _conv_kernel(state_dict["patch_embed.proj.weight"]),
+            "bias": _t2n(state_dict["patch_embed.proj.bias"]),
+        },
+        "LayerNorm_0": _ln(state_dict, "patch_embed.norm"),
+    }
+    block_idx = 0
+    for s, depth in enumerate(depths):
+        if s:  # merge that opens stage s == official downsample of s-1
+            params[f"LayerNorm_{2 * s}"] = _ln(
+                state_dict, f"layers.{s - 1}.downsample.norm")
+            params[f"Dense_{s - 1}"] = {"kernel": _linear_kernel(
+                state_dict[f"layers.{s - 1}.downsample.reduction.weight"])}
+        for b in range(depth):
+            pre = f"layers.{s}.blocks.{b}"
+            params[f"SwinBlock_{block_idx}"] = {
+                "LayerNorm_0": _ln(state_dict, pre + ".norm1"),
+                "WindowAttention_0": {
+                    "Dense_0": {
+                        "kernel": _linear_kernel(
+                            state_dict[pre + ".attn.qkv.weight"]),
+                        "bias": _t2n(state_dict[pre + ".attn.qkv.bias"]),
+                    },
+                    "rel_pos_bias": _t2n(
+                        state_dict[pre + ".attn.relative_position_bias_table"]),
+                    "Dense_1": {
+                        "kernel": _linear_kernel(
+                            state_dict[pre + ".attn.proj.weight"]),
+                        "bias": _t2n(state_dict[pre + ".attn.proj.bias"]),
+                    },
+                },
+                "LayerNorm_1": _ln(state_dict, pre + ".norm2"),
+                "Dense_0": {
+                    "kernel": _linear_kernel(
+                        state_dict[pre + ".mlp.fc1.weight"]),
+                    "bias": _t2n(state_dict[pre + ".mlp.fc1.bias"]),
+                },
+                "Dense_1": {
+                    "kernel": _linear_kernel(
+                        state_dict[pre + ".mlp.fc2.weight"]),
+                    "bias": _t2n(state_dict[pre + ".mlp.fc2.bias"]),
+                },
+            }
+            block_idx += 1
+        # Stage-out LayerNorm: dense-prediction ckpts name them norm{s};
+        # classification ckpts only have the final `norm`.
+        out_ln = f"LayerNorm_{2 * s + 1}"
+        if f"norm{s}.weight" in state_dict:
+            params[out_ln] = _ln(state_dict, f"norm{s}")
+        elif s == len(depths) - 1 and "norm.weight" in state_dict:
+            params[out_ln] = _ln(state_dict, "norm")
+    return params, {}
+
+
 # npz IO lives in the package (the training path loads these files);
 # re-exported here for script users.
 from distributed_sod_project_tpu.models.pretrained import (  # noqa: E402
@@ -189,7 +273,8 @@ from distributed_sod_project_tpu.models.pretrained import (  # noqa: E402
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--arch", required=True,
-                   choices=["vgg16", "vgg16_bn", "resnet34", "resnet50"])
+                   choices=["vgg16", "vgg16_bn", "resnet34", "resnet50",
+                            "swin_t"])
     p.add_argument("--out", required=True, help="output .npz path")
     p.add_argument("--state-dict", default=None,
                    help="local .pth state_dict (default: download via "
@@ -202,14 +287,23 @@ def main(argv=None):
         sd = torch.load(args.state_dict, map_location="cpu")
         if hasattr(sd, "state_dict"):
             sd = sd.state_dict()
+    elif args.arch == "swin_t":
+        raise SystemExit(
+            "swin_t ports the official microsoft/Swin-Transformer "
+            "checkpoint schema — pass it via --state-dict "
+            "(torchvision's swin_t uses a different naming)")
     else:
         import torchvision.models as tvm
 
         model = getattr(tvm, args.arch)(weights="IMAGENET1K_V1")
         sd = model.state_dict()
 
+    if "model" in sd and isinstance(sd["model"], dict):
+        sd = sd["model"]  # official Swin repo wraps the state_dict
     if args.arch.startswith("vgg16"):
         params, stats = port_vgg16(sd, use_bn=args.arch.endswith("_bn"))
+    elif args.arch == "swin_t":
+        params, stats = port_swin_t(sd)
     else:
         params, stats = port_resnet(sd, args.arch)
     save_npz(args.out, params, stats)
