@@ -85,6 +85,12 @@ class OptimConfig:
     # overflow) instead of poisoning the params; errors out after this
     # many CONSECUTIVE skips (a persistent divergence, not a glitch).
     skip_nonfinite: int = 0
+    # ZeRO-1-style cross-replica weight-update sharding (PAPERS.md:
+    # arXiv 2004.13336): optimizer/EMA buffers shard over the data axis,
+    # grads reduce-scatter into a 1/N-sized update, params all-gather.
+    # Routes training through the GSPMD step (needs model.sync_bn=False;
+    # BN stats are global-batch there by construction).
+    zero1: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -120,19 +120,44 @@ def _specs_like(tree, params_treedef, param_specs):
     return rec(tree)
 
 
+def _zero1_specs(params, param_specs, mesh: Mesh):
+    """Cross-replica weight-update sharding (PAPERS.md: arXiv
+    2004.13336, ZeRO-1 style): optimizer/EMA buffers shard over ``data``
+    so each replica stores and updates 1/N of them — the SPMD
+    partitioner turns the gradient allreduce into reduce-scatter +
+    sharded update + param all-gather.  A leaf takes ``data`` on its
+    first divisible dim; leaves already sharded by TP rules keep them."""
+    n_data = mesh.shape.get("data", 1)
+
+    def assign(leaf, spec: P):
+        if spec != P():
+            return spec  # TP-sharded: leave the Megatron layout alone
+        for dim, size in enumerate(leaf.shape):
+            if size % n_data == 0 and size >= n_data:
+                return P(*([None] * dim + ["data"]))
+        return P()
+
+    return jax.tree_util.tree_map(
+        assign, params, param_specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
 def state_partition_specs(state, mesh: Mesh,
-                          rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES):
+                          rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES,
+                          zero1: bool = False):
     """A TrainState-shaped pytree of PartitionSpecs: params per the TP
-    rules, optimizer buffers matching their parameters, the rest
-    replicated."""
+    rules, optimizer buffers matching their parameters (or sharded over
+    ``data`` with ``zero1``), the rest replicated."""
     param_specs = param_partition_specs(state.params, mesh, rules)
     pdef = jax.tree_util.tree_structure(state.params)
+    buf_specs = (_zero1_specs(state.params, param_specs, mesh)
+                 if zero1 else param_specs)
     return type(state)(
         step=P(),
         params=param_specs,
         batch_stats=jax.tree_util.tree_map(lambda _: P(), state.batch_stats),
-        opt_state=_specs_like(state.opt_state, pdef, param_specs),
-        ema_params=param_specs if state.ema_params is not None else None,
+        opt_state=_specs_like(state.opt_state, pdef, buf_specs),
+        ema_params=buf_specs if state.ema_params is not None else None,
     )
 
 
@@ -145,10 +170,13 @@ def to_shardings(spec_tree, mesh: Mesh):
 
 
 def shard_state(state, mesh: Mesh,
-                rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES):
+                rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES,
+                zero1: bool = False):
     """Place a host/replicated TrainState onto the mesh with the TP
-    layout; returns (sharded_state, state_shardings)."""
-    shardings = to_shardings(state_partition_specs(state, mesh, rules), mesh)
+    (and optionally ZeRO-1) layout; returns (sharded_state,
+    state_shardings)."""
+    shardings = to_shardings(
+        state_partition_specs(state, mesh, rules, zero1=zero1), mesh)
     return jax.device_put(state, shardings), shardings
 
 

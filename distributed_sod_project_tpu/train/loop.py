@@ -115,22 +115,46 @@ def fit(
             start_step = int(state.step)
             log.info("resumed from checkpoint step %d", start_step)
 
-    state = jax.device_put(state, replicated_sharding(mesh))
-    # Multi-scale training: one compiled step per size in the cycle
-    # (each is a distinct static-shape XLA program; the resize happens
-    # on-device inside the step).  Single-scale is the 1-entry cycle at
-    # the loader's native (possibly non-square) image_size.
-    ms_cycle = (tuple((s, s) for s in cfg.data.multiscale)
-                or (tuple(cfg.data.image_size),))
-    step_for_size = {
-        hw: make_train_step(model, cfg.loss, tx, mesh,
-                            schedule=schedule, remat=cfg.model.remat,
-                            ema_decay=cfg.optim.ema_decay,
-                            ema_every=cfg.optim.accum_steps,
-                            scale_hw=None if hw ==
-                            tuple(cfg.data.image_size) else hw)
-        for hw in dict.fromkeys(ms_cycle)
-    }
+    # Step builder: shard_map DP step for the CNN zoo (named-axis
+    # SyncBN), or the GSPMD step when the mesh has a tensor-parallel
+    # axis and/or ZeRO-1 weight-update sharding is on.
+    use_gspmd = mesh.shape.get("model", 1) > 1 or cfg.optim.zero1
+    if use_gspmd:
+        from ..parallel.tp import make_tp_train_step, shard_state
+
+        if cfg.model.sync_bn:
+            raise ValueError(
+                "mesh.model>1 / optim.zero1 route through the GSPMD step, "
+                "which has no named mesh axis: set model.sync_bn=false "
+                "(BN stats are global-batch there, strictly stronger)")
+        if cfg.data.multiscale:
+            raise ValueError("data.multiscale is only supported on the "
+                             "shard_map data-parallel path")
+        state, state_shardings = shard_state(state, mesh,
+                                             zero1=cfg.optim.zero1)
+        gspmd_step = make_tp_train_step(
+            model, cfg.loss, tx, mesh, state_shardings, schedule=schedule,
+            ema_decay=cfg.optim.ema_decay, ema_every=cfg.optim.accum_steps)
+        ms_cycle = (tuple(cfg.data.image_size),)
+        step_for_size = {ms_cycle[0]: gspmd_step}
+    else:
+        state = jax.device_put(state, replicated_sharding(mesh))
+        # Multi-scale training: one compiled step per size in the cycle
+        # (each is a distinct static-shape XLA program; the resize
+        # happens on-device inside the step).  Single-scale is the
+        # 1-entry cycle at the loader's native (possibly non-square)
+        # image_size.
+        ms_cycle = (tuple((s, s) for s in cfg.data.multiscale)
+                    or (tuple(cfg.data.image_size),))
+        step_for_size = {
+            hw: make_train_step(model, cfg.loss, tx, mesh,
+                                schedule=schedule, remat=cfg.model.remat,
+                                ema_decay=cfg.optim.ema_decay,
+                                ema_every=cfg.optim.accum_steps,
+                                scale_hw=None if hw ==
+                                tuple(cfg.data.image_size) else hw)
+            for hw in dict.fromkeys(ms_cycle)
+        }
     train_step_at = lambda i: step_for_size[ms_cycle[i % len(ms_cycle)]]  # noqa: E731
 
     writer = MetricWriter(os.path.join(workdir, "tb")

@@ -20,7 +20,9 @@ import sys
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--config", required=True, help="registered config name")
+    p.add_argument("--config", default=None, help="registered config name")
+    p.add_argument("--list-configs", action="store_true",
+                   help="print registered configs and exit")
     p.add_argument("--device", default=None, choices=["tpu", "cpu", None],
                    help="force a JAX platform (default: auto)")
     p.add_argument("--workdir", default=None, help="checkpoint/log dir")
@@ -52,6 +54,18 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+
+    if args.list_configs:
+        from distributed_sod_project_tpu.configs import get_config, list_configs
+
+        for name in list_configs():
+            cfg = get_config(name)
+            print(f"{name:18s} model={cfg.model.name}/{cfg.model.backbone}"
+                  f"  batch={cfg.global_batch_size}"
+                  f"  data={cfg.data.dataset}")
+        return 0
+    if not args.config:
+        raise SystemExit("--config is required (see --list-configs)")
 
     from distributed_sod_project_tpu.utils.platform import select_platform
 
