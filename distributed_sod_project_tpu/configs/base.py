@@ -17,7 +17,7 @@ class DataConfig:
     """Input pipeline configuration (SURVEY.md §2 C7)."""
 
     dataset: str = "synthetic"  # synthetic | duts | nju2k | nlpr
-    backend: str = "host"  # host (C++/PIL loader) | tfdata
+    backend: str = "host"  # host (C++/PIL loader) | tfdata | grain
     root: Optional[str] = None  # directory with <name>-Image/ and <name>-Mask/
     val_root: Optional[str] = None  # held-out set for in-training eval
     image_size: Tuple[int, int] = (320, 320)  # H, W — static for XLA

@@ -1,0 +1,147 @@
+"""Grain input backend — the third loader, same contract as the rest.
+
+Google Grain is the TPU-era input library (deterministic, multiprocess,
+checkpointable iterators).  This backend keeps OUR sharding semantics —
+one global permutation per epoch, each host taking its contiguous slice
+of every global batch (identical batch composition to the host/tfdata
+backends, verified in tests) — and uses Grain for the execution layer:
+worker processes, prefetch, and batch assembly.  Select with
+``--set data.backend=grain``.
+
+The epoch's record sequence for this host is precomputed as an index
+view (pure function of (seed, epoch), like the other backends), so
+``skip_steps`` mid-epoch resume is an index offset here too.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator
+
+import numpy as np
+
+
+class _ShardView:
+    """Random-access view: position in this host's epoch sequence →
+    transformed sample (deterministic per-record hflip, matching
+    HostDataLoader._hflip_draw exactly)."""
+
+    def __init__(self, dataset, keys: np.ndarray, hflip: bool,
+                 aug_seed: int):
+        self._dataset = dataset
+        self._keys = keys
+        self._hflip = hflip
+        self._aug_seed = aug_seed
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def __getitem__(self, i) -> Dict[str, np.ndarray]:
+        idx = int(self._keys[int(i)])
+        sample = dict(self._dataset[idx])
+        if self._hflip and self._flip(idx):
+            for k in ("image", "mask", "depth"):
+                if k in sample:
+                    sample[k] = np.ascontiguousarray(sample[k][:, ::-1])
+        return sample
+
+    def _flip(self, idx: int) -> bool:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self._aug_seed, int(idx)]))
+        return bool(rng.random() < 0.5)
+
+
+class GrainLoader:
+    """HostDataLoader-compatible loader executed by Grain."""
+
+    def __init__(
+        self,
+        dataset,
+        global_batch_size: int,
+        shard_id: int = 0,
+        num_shards: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        hflip: bool = False,
+        num_workers: int = 0,
+    ):
+        if global_batch_size % num_shards != 0:
+            raise ValueError(
+                f"global_batch_size={global_batch_size} not divisible by "
+                f"num_shards={num_shards}")
+        self.dataset = dataset
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // num_shards
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.hflip = hflip
+        self.num_workers = num_workers
+        self._epoch = 0
+        self._skip = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def skip_steps(self, n: int) -> None:
+        """One-shot mid-epoch resume offset (see HostDataLoader)."""
+        self._skip = int(n)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.global_batch_size
+        return -(-n // self.global_batch_size)
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, epoch]))
+            order = rng.permutation(n)
+        else:
+            order = np.arange(n)
+        if not self.drop_last and n % self.global_batch_size:
+            pad = self.global_batch_size - n % self.global_batch_size
+            order = np.concatenate([order, order[:pad]])
+        return order
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        import grain.python as grain
+
+        epoch = self._epoch
+        start, self._skip = self._skip, 0
+        order = self._epoch_order(epoch)
+        steps = self.steps_per_epoch
+        aug_seed = hash((self.seed, epoch)) & 0x7FFFFFFF
+
+        # This host's contiguous slice of every remaining global batch.
+        keys = (np.concatenate([
+            order[s * self.global_batch_size
+                  + self.shard_id * self.local_batch_size:
+                  s * self.global_batch_size
+                  + (self.shard_id + 1) * self.local_batch_size]
+            for s in range(start, steps)]) if steps > start
+            else np.zeros((0,), np.int64))
+        if not len(keys):
+            return iter(())
+
+        view = _ShardView(self.dataset, keys, self.hflip, aug_seed)
+        sampler = grain.IndexSampler(
+            num_records=len(view),
+            shard_options=grain.NoSharding(),  # host sharding is in `keys`
+            shuffle=False,  # order is already the epoch permutation
+            num_epochs=1,
+            seed=self.seed,
+        )
+        loader = grain.DataLoader(
+            data_source=view,
+            sampler=sampler,
+            operations=[grain.Batch(self.local_batch_size,
+                                    drop_remainder=True)],
+            worker_count=self.num_workers,
+        )
+        return iter(loader)

@@ -169,10 +169,14 @@ class TFDataLoader:
 
 
 def make_loader(dataset, data_cfg, **kw):
-    """Backend dispatch: 'host' (default) or 'tfdata'."""
+    """Backend dispatch: 'host' (default), 'tfdata', or 'grain'."""
     backend = getattr(data_cfg, "backend", "host")
     if backend == "tfdata":
         return TFDataLoader(dataset, **kw)
+    if backend == "grain":
+        from .grain_pipeline import GrainLoader
+
+        return GrainLoader(dataset, **kw)
     if backend == "host":
         from .pipeline import HostDataLoader
 
