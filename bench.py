@@ -26,6 +26,12 @@ def main(argv=None):
     p.add_argument("--batch-per-chip", type=int, default=8)
     p.add_argument("--image-size", type=int, default=320)
     p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="PATH=VALUE",
+                   help="dotted config override, e.g. --set "
+                        "loss.fused_kernel=true --set optim.zero1=true")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the timed window")
     args = p.parse_args(argv)
 
     from distributed_sod_project_tpu.utils.platform import select_platform
@@ -48,7 +54,8 @@ def main(argv=None):
     hw = args.image_size
 
     cfg = get_config(args.config)
-    cfg = apply_overrides(cfg, [f"global_batch_size={batch}"])
+    cfg = apply_overrides(cfg, [f"global_batch_size={batch}"]
+                          + list(args.overrides))
 
     mesh = make_mesh(cfg.mesh)
     model = build_model(cfg.model)
@@ -71,11 +78,15 @@ def main(argv=None):
         state, _ = step(state, dev_batch)
     jax.block_until_ready(state.params)
 
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, metrics = step(state, dev_batch)
     jax.block_until_ready(metrics["total"])
     dt = time.perf_counter() - t0
+    if args.profile_dir:
+        jax.profiler.stop_trace()
 
     imgs_per_sec = batch * args.steps / dt
     per_chip = imgs_per_sec / n_chips

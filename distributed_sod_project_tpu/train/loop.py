@@ -108,11 +108,13 @@ def fit(
     if is_primary_process():
         mgr.save_config(cfg)
     start_step = 0
+    resumed_from = -1
     if resume:
         ck_step = mgr.latest_step()
         if ck_step is not None:
             state = mgr.restore(state, ck_step)
             start_step = int(state.step)
+            resumed_from = start_step
             log.info("resumed from checkpoint step %d", start_step)
 
     # Step builder: shard_map DP step for the CNN zoo (named-axis
@@ -166,7 +168,9 @@ def fit(
     last_metrics: Dict[str, float] = {}
     eval_metrics: Dict[str, float] = {}
     step = start_step
-    last_saved = -1
+    # A restore means this step's checkpoint already exists on disk — a
+    # zero-progress run must not force-save over it (orbax raises).
+    last_saved = resumed_from
     last_eval_step = -1
     stop = False
     # Cross-host stop agreement only at deterministic steps (all hosts

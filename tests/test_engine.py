@@ -168,3 +168,30 @@ def test_preemption_guard_checkpoints_and_stops(tmp_path, eight_devices):
     steps = [int(os.path.basename(d)) for d in
              glob.glob(os.path.join(cfg.checkpoint_dir, "[0-9]*"))]
     assert out["final_step"] in steps
+
+
+def test_resume_with_no_remaining_steps_is_a_noop(eight_devices, tmp_path):
+    """Resuming at max_steps must not force-save over the existing
+    checkpoint (orbax StepAlreadyExistsError regression)."""
+    import dataclasses
+
+    from distributed_sod_project_tpu.configs import get_config
+    from distributed_sod_project_tpu.train.loop import fit
+
+    cfg = get_config("minet_vgg16_ref")
+    cfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, image_size=(32, 32),
+                                 synthetic_size=16),
+        model=dataclasses.replace(cfg.model, sync_bn=False,
+                                  compute_dtype="float32"),
+        mesh=dataclasses.replace(cfg.mesh, data=8),
+        global_batch_size=8,
+        num_epochs=2,
+        log_every_steps=1,
+        checkpoint_every_steps=2,
+        tensorboard=False,
+    )
+    m1 = fit(cfg, workdir=str(tmp_path), max_steps=2)
+    assert m1["final_step"] == 2
+    m2 = fit(cfg, workdir=str(tmp_path), resume=True, max_steps=2)
+    assert m2["final_step"] == 2  # zero new steps, no crash

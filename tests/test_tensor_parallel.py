@@ -196,11 +196,16 @@ def test_fit_routes_through_gspmd_for_zero1(eight_devices, tmp_path):
         optim=dataclasses.replace(cfg.optim, zero1=True, ema_decay=0.9),
         mesh=dataclasses.replace(cfg.mesh, data=8),
         global_batch_size=8,
-        num_epochs=1,
+        num_epochs=2,
         log_every_steps=1,
-        checkpoint_every_steps=0,
+        checkpoint_every_steps=2,
         tensorboard=False,
     )
     metrics = fit(cfg, workdir=str(tmp_path), max_steps=2)
     assert metrics["final_step"] == 2
+    assert np.isfinite(metrics["total"])
+
+    # Sharded (ZeRO-1) state checkpoints and resumes exactly.
+    metrics = fit(cfg, workdir=str(tmp_path), resume=True, max_steps=4)
+    assert metrics["final_step"] == 4
     assert np.isfinite(metrics["total"])
