@@ -2,13 +2,11 @@
 
 The BASNet-style hybrid loss uses 1 − SSIM with an 11×11 Gaussian
 window (σ=1.5) computed on sigmoid probabilities.  TPU-first design:
-the windowed means/variances are depthwise convolutions (one fused
-``lax.conv_general_dilated`` with ``feature_group_count=C`` per moment),
-which XLA maps straight onto the MXU; everything reduces in float32.
-
-A hand-fused Pallas variant lives in ``ops/`` for the training hot
-path; this module is the reference implementation the oracle tests pin
-down (torch-cpu oracle in tests/test_losses.py).
+all five windowed moments (E[a], E[b], E[a²], E[b²], E[ab]) are stacked
+into channels and blurred by ONE pair of separable depthwise
+convolutions (``feature_group_count``), so the input maps are read from
+HBM once instead of five times; everything reduces in float32.  Oracle:
+torch-cpu in tests/test_losses.py.
 """
 
 from __future__ import annotations
@@ -51,11 +49,16 @@ def ssim(a, b, *, window_size: int = 11, sigma: float = 1.5):
     a = a.astype(jnp.float32)
     b = b.astype(jnp.float32)
     win = gaussian_window(window_size, sigma)
-    mu_a, mu_b = _blur(a, win), _blur(b, win)
+    c = a.shape[-1]
+    # One blur over the 5 stacked moment maps instead of 5 blurs.
+    stack = jnp.concatenate([a, b, a * a, b * b, a * b], axis=-1)
+    blurred = _blur(stack, win)
+    mu_a, mu_b, e_aa, e_bb, e_ab = (
+        blurred[..., i * c:(i + 1) * c] for i in range(5))
     mu_aa, mu_bb, mu_ab = mu_a * mu_a, mu_b * mu_b, mu_a * mu_b
-    var_a = _blur(a * a, win) - mu_aa
-    var_b = _blur(b * b, win) - mu_bb
-    cov = _blur(a * b, win) - mu_ab
+    var_a = e_aa - mu_aa
+    var_b = e_bb - mu_bb
+    cov = e_ab - mu_ab
     num = (2.0 * mu_ab + _C1) * (2.0 * cov + _C2)
     den = (mu_aa + mu_bb + _C1) * (var_a + var_b + _C2)
     return (num / den).mean()
