@@ -8,12 +8,14 @@ component inventory and ``BASELINE.json``):
 - Model zoo: MINet (VGG16/ResNet50), HDFNet (RGB-D two-stream), U²-Net,
   BASNet, Swin-T SOD  (``models/``)
 - Losses: BCE + soft-IoU + SSIM + CEL with multi-level deep supervision
-  (``losses/``, fused Pallas kernels in ``ops/``)
+  (``losses/``, fused Pallas reductions in ``pallas/``)
 - Data: DUTS / NJU2K / NLPR loaders with per-host sharding and a
-  synthetic fallback (``data/``), C++ prefetch runtime (``native/``)
+  synthetic fallback — three batch-identical backends (C++/PIL host,
+  tf.data, Grain) (``data/``), C++ decode/encode runtime (``native/``)
 - Parallelism: SPMD data-parallel training over a ``jax.sharding.Mesh``
   via ``shard_map`` (cross-replica BatchNorm + gradient psum riding
-  ICI), ring-attention sequence parallelism for the transformer path
+  ICI), GSPMD tensor parallelism + ZeRO-1 weight-update sharding, and
+  ring-attention sequence parallelism for the transformer path
   (``parallel/``)
 - Train/eval engines, poly-LR schedules, orbax checkpointing, SOD
   metrics (MAE, max-Fβ, S-measure, E-measure)  (``train/``, ``eval/``,

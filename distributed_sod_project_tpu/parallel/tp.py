@@ -182,7 +182,8 @@ def shard_state(state, mesh: Mesh,
 
 def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
                        schedule=None, donate: bool = True,
-                       ema_decay: float = 0.0, ema_every: int = 1):
+                       ema_decay: float = 0.0, ema_every: int = 1,
+                       scale_hw: Optional[Tuple[int, int]] = None):
     """Build the GSPMD train step: ``(state, batch) -> (state, metrics)``.
 
     Unlike the shard_map DP step there is no explicit ``pmean`` and no
@@ -202,7 +203,20 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
 
     lkw = _loss_kwargs(loss_cfg)
 
+    def _rescale(batch):
+        hw = batch["image"].shape[1:3]
+        if scale_hw is None or tuple(scale_hw) == tuple(hw):
+            return batch
+        out = dict(batch)
+        for k in ("image", "mask", "depth"):
+            if k in out:
+                b, _, _, c = out[k].shape
+                out[k] = jax.image.resize(
+                    out[k], (b,) + tuple(scale_hw) + (c,), "bilinear")
+        return out
+
     def step_fn(state, batch):
+        batch = _rescale(batch)
         rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
 
         def loss_fn(params):

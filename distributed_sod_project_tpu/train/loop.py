@@ -129,16 +129,18 @@ def fit(
                 "mesh.model>1 / optim.zero1 route through the GSPMD step, "
                 "which has no named mesh axis: set model.sync_bn=false "
                 "(BN stats are global-batch there, strictly stronger)")
-        if cfg.data.multiscale:
-            raise ValueError("data.multiscale is only supported on the "
-                             "shard_map data-parallel path")
         state, state_shardings = shard_state(state, mesh,
                                              zero1=cfg.optim.zero1)
-        gspmd_step = make_tp_train_step(
-            model, cfg.loss, tx, mesh, state_shardings, schedule=schedule,
-            ema_decay=cfg.optim.ema_decay, ema_every=cfg.optim.accum_steps)
-        ms_cycle = (tuple(cfg.data.image_size),)
-        step_for_size = {ms_cycle[0]: gspmd_step}
+        ms_cycle = (tuple((s, s) for s in cfg.data.multiscale)
+                    or (tuple(cfg.data.image_size),))
+        step_for_size = {
+            hw: make_tp_train_step(
+                model, cfg.loss, tx, mesh, state_shardings,
+                schedule=schedule, ema_decay=cfg.optim.ema_decay,
+                ema_every=cfg.optim.accum_steps,
+                scale_hw=None if hw == tuple(cfg.data.image_size) else hw)
+            for hw in dict.fromkeys(ms_cycle)
+        }
     else:
         state = jax.device_put(state, replicated_sharding(mesh))
         # Multi-scale training: one compiled step per size in the cycle

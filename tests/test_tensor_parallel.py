@@ -190,7 +190,7 @@ def test_fit_routes_through_gspmd_for_zero1(eight_devices, tmp_path):
     cfg = get_config("minet_vgg16_ref")
     cfg = cfg.replace(
         data=dataclasses.replace(cfg.data, image_size=(32, 32),
-                                 synthetic_size=16),
+                                 synthetic_size=16, multiscale=(24, 32)),
         model=dataclasses.replace(cfg.model, sync_bn=False,
                                   compute_dtype="float32"),
         optim=dataclasses.replace(cfg.optim, zero1=True, ema_decay=0.9),
