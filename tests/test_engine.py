@@ -195,3 +195,33 @@ def test_resume_with_no_remaining_steps_is_a_noop(eight_devices, tmp_path):
     assert m1["final_step"] == 2
     m2 = fit(cfg, workdir=str(tmp_path), resume=True, max_steps=2)
     assert m2["final_step"] == 2  # zero new steps, no crash
+
+
+@pytest.mark.parametrize("config_name", ["hdfnet_rgbd", "u2net_ds",
+                                         "basnet_ds", "swin_sod"])
+def test_fit_one_step_every_zoo_config(config_name, eight_devices,
+                                       tmp_path):
+    """Every BASELINE config trains one real step through fit() —
+    config plumbing, loss wiring, and the step builder all compose
+    (model math itself is covered in test_models)."""
+    import dataclasses
+
+    from distributed_sod_project_tpu.train.loop import fit
+
+    cfg = get_config(config_name)
+    cfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, dataset="synthetic",
+                                 image_size=(64, 64), synthetic_size=8,
+                                 root=None),
+        model=dataclasses.replace(cfg.model, compute_dtype="float32"),
+        mesh=dataclasses.replace(cfg.mesh, data=8, model=1, seq=1),
+        global_batch_size=8,
+        num_epochs=1,
+        log_every_steps=1,
+        checkpoint_every_steps=0,
+        eval_every_steps=0,
+        tensorboard=False,
+    )
+    metrics = fit(cfg, workdir=str(tmp_path), max_steps=1)
+    assert metrics["final_step"] == 1
+    assert np.isfinite(metrics["total"])
