@@ -154,8 +154,12 @@ class FolderSOD:
 
 def resolve_dataset(cfg) -> object:
     """Build a dataset from a DataConfig; falls back to synthetic when the
-    configured real-dataset root is absent (no network in this env)."""
-    if cfg.dataset == "synthetic" or cfg.root is None or not os.path.isdir(cfg.root):
+    configured real-dataset root is absent (no network in this env).
+
+    An existing ``root`` always wins — a user passing ``--data-root``
+    to a config whose default dataset is synthetic means the files,
+    not the fallback."""
+    if cfg.root is None or not os.path.isdir(cfg.root):
         if cfg.dataset != "synthetic":
             from ..utils.logging import get_logger
 

@@ -105,3 +105,31 @@ def test_loader_skip_steps_resumes_mid_epoch():
     # One-shot: the next epoch starts from the beginning again.
     resumed.set_epoch(3)
     assert len(list(resumed)) == len(all_batches)
+
+
+def test_resolve_dataset_prefers_existing_root(tmp_path):
+    """--data-root on a synthetic-default config loads the files."""
+    import dataclasses
+
+    from PIL import Image
+
+    from distributed_sod_project_tpu.configs import get_config
+    from distributed_sod_project_tpu.data import FolderSOD, resolve_dataset
+
+    (tmp_path / "Image").mkdir()
+    (tmp_path / "Mask").mkdir()
+    for i in range(2):
+        Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(
+            tmp_path / "Image" / f"a{i}.jpg")
+        Image.fromarray(np.zeros((8, 8), np.uint8)).save(
+            tmp_path / "Mask" / f"a{i}.png")
+
+    cfg = get_config("minet_vgg16_ref")  # dataset="synthetic" by default
+    dcfg = dataclasses.replace(cfg.data, root=str(tmp_path),
+                               image_size=(8, 8))
+    ds = resolve_dataset(dcfg)
+    assert isinstance(ds, FolderSOD)
+    assert len(ds) == 2
+    # Missing root still falls back to synthetic.
+    dcfg = dataclasses.replace(cfg.data, root=str(tmp_path / "nope"))
+    assert not isinstance(resolve_dataset(dcfg), FolderSOD)
