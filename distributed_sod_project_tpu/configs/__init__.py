@@ -6,6 +6,7 @@ from .base import (
     ModelConfig,
     OptimConfig,
     apply_overrides,
+    config_from_dict,
     get_config,
     list_configs,
     register_config,
@@ -20,6 +21,7 @@ __all__ = [
     "ModelConfig",
     "OptimConfig",
     "apply_overrides",
+    "config_from_dict",
     "get_config",
     "list_configs",
     "register_config",

@@ -195,6 +195,31 @@ def _coerce(value: str, ftype):
     raise ValueError(f"cannot coerce {value!r} onto {ftype!r}")
 
 
+def config_from_dict(d: Dict) -> ExperimentConfig:
+    """Rebuild an ExperimentConfig from its JSON dict (the checkpoint
+    config sidecar, ckpt/manager.py) — checkpoints are self-describing,
+    so ``test.py`` can run without naming the config again."""
+    import typing
+
+    def build(cls, dd):
+        hints = typing.get_type_hints(cls)
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name not in dd:
+                continue
+            v = dd[f.name]
+            ft = hints[f.name]
+            if dataclasses.is_dataclass(ft) and isinstance(v, dict):
+                kwargs[f.name] = build(ft, v)
+            elif typing.get_origin(ft) is tuple and isinstance(v, list):
+                kwargs[f.name] = tuple(v)
+            else:
+                kwargs[f.name] = v
+        return cls(**kwargs)
+
+    return build(ExperimentConfig, d)
+
+
 def apply_overrides(cfg: ExperimentConfig, overrides) -> ExperimentConfig:
     """Apply ``section.field=value`` CLI overrides (SURVEY.md §2 C13).
 

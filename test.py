@@ -20,7 +20,9 @@ import sys
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--config", required=True)
+    p.add_argument("--config", default=None,
+                   help="registered config name (default: read the "
+                        "checkpoint's own config.json sidecar)")
     p.add_argument("--ckpt-dir", required=True,
                    help="directory of checkpoints written by train.py")
     p.add_argument("--step", type=int, default=None,
@@ -56,7 +58,18 @@ def main(argv=None):
     from distributed_sod_project_tpu.train import (
         build_optimizer, create_train_state)
 
-    cfg = get_config(args.config)
+    if args.config:
+        cfg = get_config(args.config)
+    else:
+        from distributed_sod_project_tpu.configs import config_from_dict
+
+        sidecar = os.path.join(args.ckpt_dir, "config.json")
+        if not os.path.exists(sidecar):
+            raise SystemExit(
+                f"--config not given and {sidecar} missing — pass "
+                "--config explicitly")
+        with open(sidecar) as f:
+            cfg = config_from_dict(json.load(f))
     cfg = apply_overrides(cfg, args.overrides)
 
     # Named test sets: ["duts_te=/data/DUTS-TE", ...]; default config set.

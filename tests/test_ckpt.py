@@ -83,3 +83,27 @@ def test_restore_missing_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         mgr.restore(state)
     mgr.close()
+
+
+def test_config_sidecar_roundtrip(tmp_path):
+    """config.json sidecar rebuilds the exact ExperimentConfig."""
+    import dataclasses
+    import json
+    import os
+
+    from distributed_sod_project_tpu.ckpt import CheckpointManager
+    from distributed_sod_project_tpu.configs import (config_from_dict,
+                                                     get_config)
+
+    cfg = get_config("hdfnet_rgbd").replace(
+        data=None or dataclasses.replace(
+            get_config("hdfnet_rgbd").data, image_size=(64, 96),
+            multiscale=(48, 64)),
+        global_batch_size=4)
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save_config(cfg)
+    mgr.close()
+
+    with open(os.path.join(tmp_path, "config.json")) as f:
+        rebuilt = config_from_dict(json.load(f))
+    assert rebuilt == cfg
