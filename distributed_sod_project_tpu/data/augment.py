@@ -1,0 +1,71 @@
+"""Shared train-time augmentations (SURVEY.md §2 C7).
+
+The reference-era SOD training recipe augments with horizontal flips
+plus small random rotations (MINet's joint transforms rotate up to
+±10°).  Both are implemented here as pure functions of
+``(aug_seed, sample index)`` so every backend draws identically and
+mid-epoch resume replays the exact stream (data/pipeline.py contract).
+
+Rotation runs host-side on the decoded float arrays: bilinear for
+image/depth, nearest for the binary mask, constant fill — matching the
+torchvision ``rotate(expand=False)`` convention.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def hflip_draw(aug_seed: int, idx: int) -> bool:
+    rng = np.random.default_rng(np.random.SeedSequence([aug_seed, int(idx)]))
+    return bool(rng.random() < 0.5)
+
+
+def rotate_draw(aug_seed: int, idx: int, degrees: float) -> float:
+    """Deterministic angle in [-degrees, +degrees] for this sample.
+    A distinct stream from hflip (offset key) so the two draws stay
+    independent."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence([aug_seed ^ 0x5EED, int(idx)]))
+    return float((rng.random() * 2.0 - 1.0) * degrees)
+
+
+def apply_hflip(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    out = dict(sample)
+    for k in ("image", "mask", "depth"):
+        if k in out:
+            out[k] = np.ascontiguousarray(out[k][:, ::-1])
+    return out
+
+
+def apply_rotate(sample: Dict[str, np.ndarray],
+                 angle_deg: float) -> Dict[str, np.ndarray]:
+    """Rotate image/depth bilinearly and the mask nearest by
+    ``angle_deg`` about the center, same spatial shape (expand=False)."""
+    if abs(angle_deg) < 1e-6:
+        return sample
+    from scipy import ndimage
+
+    out = dict(sample)
+    for k, order in (("image", 1), ("depth", 1), ("mask", 0)):
+        if k in out:
+            arr = out[k]
+            rot = ndimage.rotate(arr, angle_deg, axes=(1, 0),
+                                 reshape=False, order=order,
+                                 mode="constant", cval=0.0)
+            out[k] = np.ascontiguousarray(rot.astype(arr.dtype))
+    return out
+
+
+def augment_sample(sample: Dict[str, np.ndarray], idx: int, aug_seed: int,
+                   *, hflip: bool, rotate_degrees: float
+                   ) -> Dict[str, np.ndarray]:
+    """The full deterministic train-time augmentation for one sample."""
+    if hflip and hflip_draw(aug_seed, idx):
+        sample = apply_hflip(sample)
+    if rotate_degrees:
+        sample = apply_rotate(sample, rotate_draw(aug_seed, idx,
+                                                  rotate_degrees))
+    return sample

@@ -22,32 +22,27 @@ import numpy as np
 
 class _ShardView:
     """Random-access view: position in this host's epoch sequence →
-    transformed sample (deterministic per-record hflip, matching
-    HostDataLoader._hflip_draw exactly)."""
+    augmented sample (deterministic per-record draws shared with the
+    host backend via data/augment.py)."""
 
     def __init__(self, dataset, keys: np.ndarray, hflip: bool,
-                 aug_seed: int):
+                 aug_seed: int, rotate_degrees: float = 0.0):
         self._dataset = dataset
         self._keys = keys
         self._hflip = hflip
         self._aug_seed = aug_seed
+        self._rotate = rotate_degrees
 
     def __len__(self) -> int:
         return len(self._keys)
 
     def __getitem__(self, i) -> Dict[str, np.ndarray]:
-        idx = int(self._keys[int(i)])
-        sample = dict(self._dataset[idx])
-        if self._hflip and self._flip(idx):
-            for k in ("image", "mask", "depth"):
-                if k in sample:
-                    sample[k] = np.ascontiguousarray(sample[k][:, ::-1])
-        return sample
+        from .augment import augment_sample
 
-    def _flip(self, idx: int) -> bool:
-        rng = np.random.default_rng(
-            np.random.SeedSequence([self._aug_seed, int(idx)]))
-        return bool(rng.random() < 0.5)
+        idx = int(self._keys[int(i)])
+        return augment_sample(dict(self._dataset[idx]), idx,
+                              self._aug_seed, hflip=self._hflip,
+                              rotate_degrees=self._rotate)
 
 
 class GrainLoader:
@@ -63,12 +58,14 @@ class GrainLoader:
         seed: int = 0,
         drop_last: bool = True,
         hflip: bool = False,
+        rotate_degrees: float = 0.0,
         num_workers: int = 0,
     ):
         if global_batch_size % num_shards != 0:
             raise ValueError(
                 f"global_batch_size={global_batch_size} not divisible by "
                 f"num_shards={num_shards}")
+        self.rotate_degrees = float(rotate_degrees)
         self.dataset = dataset
         self.global_batch_size = global_batch_size
         self.local_batch_size = global_batch_size // num_shards
@@ -129,7 +126,8 @@ class GrainLoader:
         if not len(keys):
             return iter(())
 
-        view = _ShardView(self.dataset, keys, self.hflip, aug_seed)
+        view = _ShardView(self.dataset, keys, self.hflip, aug_seed,
+                          rotate_degrees=self.rotate_degrees)
         sampler = grain.IndexSampler(
             num_records=len(view),
             shard_options=grain.NoSharding(),  # host sharding is in `keys`

@@ -38,6 +38,7 @@ class HostDataLoader:
         seed: int = 0,
         drop_last: bool = True,
         hflip: bool = False,
+        rotate_degrees: float = 0.0,
         num_workers: int = 0,
     ):
         if global_batch_size % num_shards != 0:
@@ -54,6 +55,7 @@ class HostDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.hflip = hflip
+        self.rotate_degrees = float(rotate_degrees)
         self.num_workers = num_workers
         self._epoch = 0
         self._skip = 0
@@ -89,16 +91,32 @@ class HostDataLoader:
 
     @staticmethod
     def _hflip_draw(aug_seed: int, idx: int) -> bool:
-        rng = np.random.default_rng(np.random.SeedSequence([aug_seed, int(idx)]))
-        return bool(rng.random() < 0.5)
+        from .augment import hflip_draw
+
+        return hflip_draw(aug_seed, idx)
 
     def _fetch(self, idx: int, aug_seed: int) -> Dict[str, np.ndarray]:
+        from .augment import augment_sample
+
         sample = dict(self.dataset[int(idx)])
-        if self.hflip and self._hflip_draw(aug_seed, idx):
-            for k in ("image", "mask", "depth"):
-                if k in sample:
-                    sample[k] = np.ascontiguousarray(sample[k][:, ::-1])
-        return sample
+        return augment_sample(sample, int(idx), aug_seed,
+                              hflip=self.hflip,
+                              rotate_degrees=self.rotate_degrees)
+
+    def _rotate_batch(self, batch, idxs, aug_seed: int):
+        """Rotation for the native-decode path (which handled decode +
+        hflip in C++): same per-index draws as the PIL path."""
+        from .augment import apply_rotate, rotate_draw
+
+        per_image = [
+            apply_rotate({k: batch[k][j] for k in ("image", "mask", "depth")
+                          if k in batch},
+                         rotate_draw(aug_seed, int(i), self.rotate_degrees))
+            for j, i in enumerate(idxs)]
+        out = dict(batch)
+        for k in per_image[0]:
+            out[k] = np.stack([s[k] for s in per_image])
+        return out
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         epoch = self._epoch
@@ -124,6 +142,8 @@ class HostDataLoader:
                              for i in idxs]
                     batch = native_batch(idxs, hflip=flags)
                     if batch is not None:
+                        if self.rotate_degrees:
+                            batch = self._rotate_batch(batch, idxs, aug_seed)
                         yield batch
                         continue
                     # Latch off: None is sticky (lib unbuilt / format

@@ -48,8 +48,14 @@ class TFDataLoader:
         seed: int = 0,
         drop_last: bool = True,
         hflip: bool = False,
+        rotate_degrees: float = 0.0,
         num_workers: int = 4,
     ):
+        if rotate_degrees:
+            raise ValueError(
+                "rotation augmentation is host-side (scipy) — use the "
+                "'host' or 'grain' backend with data.rotate_degrees, or "
+                "set it to 0 for tfdata")
         if global_batch_size % num_shards != 0:
             raise ValueError(
                 f"global_batch_size={global_batch_size} not divisible by "

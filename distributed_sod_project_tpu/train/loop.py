@@ -72,6 +72,7 @@ def fit(
         shuffle=True,
         seed=cfg.seed,
         hflip=cfg.data.hflip,
+        rotate_degrees=cfg.data.rotate_degrees,
         num_workers=cfg.data.num_workers,
     )
     steps_per_epoch = cfg.steps_per_epoch or loader.steps_per_epoch

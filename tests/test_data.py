@@ -133,3 +133,45 @@ def test_resolve_dataset_prefers_existing_root(tmp_path):
     # Missing root still falls back to synthetic.
     dcfg = dataclasses.replace(cfg.data, root=str(tmp_path / "nope"))
     assert not isinstance(resolve_dataset(dcfg), FolderSOD)
+
+
+def test_rotation_augmentation_deterministic_and_geometric():
+    """Rotation draws are per-index deterministic, rotate image and
+    mask jointly, keep shapes, and keep the mask binary."""
+    from distributed_sod_project_tpu.data.augment import (
+        apply_rotate, augment_sample, rotate_draw)
+
+    a1 = rotate_draw(7, 3, 10.0)
+    a2 = rotate_draw(7, 3, 10.0)
+    assert a1 == a2 and -10.0 <= a1 <= 10.0
+    assert rotate_draw(7, 4, 10.0) != a1
+
+    # A horizontal bar rotated 90° becomes a vertical bar.
+    img = np.zeros((21, 21, 3), np.float32)
+    img[10, 3:18] = 1.0
+    mask = (img[..., :1] > 0).astype(np.float32)
+    rot = apply_rotate({"image": img, "mask": mask}, 90.0)
+    assert rot["image"].shape == img.shape
+    np.testing.assert_allclose(rot["mask"][3:18, 10, 0], 1.0, atol=1e-6)
+    assert set(np.unique(rot["mask"])) <= {0.0, 1.0}  # nearest: binary
+
+    # augment_sample with rotate=0 and hflip off is the identity.
+    same = augment_sample({"image": img, "mask": mask}, 5, 1,
+                          hflip=False, rotate_degrees=0.0)
+    np.testing.assert_array_equal(same["image"], img)
+
+
+def test_loader_rotation_matches_grain_backend():
+    """host and grain backends draw identical rotations."""
+    from distributed_sod_project_tpu.data.grain_pipeline import GrainLoader
+
+    ds = SyntheticSOD(size=8, image_size=(16, 16), seed=1)
+    kw = dict(global_batch_size=4, shuffle=True, seed=5, hflip=True,
+              rotate_degrees=10.0)
+    host = HostDataLoader(ds, **kw)
+    gr = GrainLoader(ds, **kw)
+    host.set_epoch(0)
+    gr.set_epoch(0)
+    for a, b in zip(host, gr):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["mask"], b["mask"])

@@ -188,3 +188,33 @@ def test_save_dir_uses_writer_end_to_end(tmp_path):
     assert arr.shape == (16, 16)
     assert abs(int(arr.mean()) - 127) <= 2
     assert out["num_images"] == 3
+
+
+def test_native_path_applies_rotation(tmp_path):
+    """HostDataLoader rotates native-decoded batches with the same
+    per-index draws (deterministic across iterations)."""
+    from distributed_sod_project_tpu.data import FolderSOD, HostDataLoader
+
+    rng = np.random.default_rng(0)
+    (tmp_path / "Image").mkdir()
+    (tmp_path / "Mask").mkdir()
+    for i in range(4):
+        Image.fromarray(rng.integers(0, 256, (24, 24, 3), np.uint8)).save(
+            tmp_path / "Image" / f"s{i}.jpg")
+        m = np.zeros((24, 24), np.uint8)
+        m[8:16, 4:20] = 255
+        Image.fromarray(m).save(tmp_path / "Mask" / f"s{i}.png")
+    ds = FolderSOD(str(tmp_path), image_size=(24, 24))
+    assert ds.load_batch([0, 1]) is not None  # native path live
+
+    mk = lambda deg: HostDataLoader(ds, global_batch_size=4,  # noqa: E731
+                                    shuffle=False, seed=0, hflip=False,
+                                    rotate_degrees=deg)
+    plain = next(iter(mk(0.0)))
+    rot_a = next(iter(mk(25.0)))
+    rot_b = next(iter(mk(25.0)))
+    for k in ("image", "mask"):
+        np.testing.assert_array_equal(rot_a[k], rot_b[k])  # deterministic
+        assert rot_a[k].shape == plain[k].shape
+    assert not np.allclose(rot_a["image"], plain["image"])  # applied
+    assert set(np.unique(rot_a["mask"])) <= {0.0, 1.0}
