@@ -37,7 +37,10 @@ def minet_r50_dp() -> ExperimentConfig:
     """Config 2: MINet-ResNet50 full data-parallel training (flagship)."""
     return ExperimentConfig(
         name="minet_r50_dp",
-        data=DataConfig(dataset="duts", image_size=(320, 320)),
+        # rotate_degrees=10: the MINet-era joint-transform recipe
+        # (hflip + small random rotation) on the host data plane.
+        data=DataConfig(dataset="duts", image_size=(320, 320),
+                        rotate_degrees=10.0),
         model=ModelConfig(name="minet", backbone="resnet50", sync_bn=True),
         loss=LossConfig(cel=1.0),
         optim=OptimConfig(lr=0.005, schedule="poly"),

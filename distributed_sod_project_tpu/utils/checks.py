@@ -1,0 +1,60 @@
+"""Input sanitation — host-side failure detection (SURVEY.md §5).
+
+The functional training step cannot race, but it CAN be fed garbage:
+wrong dataset layout, masks that aren't binary, NaNs from a corrupt
+decode, images that skipped normalization.  ``validate_batch`` runs
+once on the first batch of a training run (cheap, host-side) and fails
+loudly with the actual problem instead of letting a silent bad input
+become an unexplained divergence thousands of steps later.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def validate_batch(batch: Dict, image_size, use_depth: bool = False) -> None:
+    """Raise ValueError describing the first problem found."""
+    def arr(k):
+        v = batch.get(k)
+        if v is None:
+            raise ValueError(f"batch is missing {k!r}")
+        return np.asarray(v)
+
+    img = arr("image")
+    mask = arr("mask")
+    h, w = int(image_size[0]), int(image_size[1])
+    if img.ndim != 4 or img.shape[1:] != (h, w, 3):
+        raise ValueError(
+            f"image shape {img.shape} != [B,{h},{w},3] — dataset layout "
+            "or image_size mismatch")
+    if mask.shape != img.shape[:3] + (1,):
+        raise ValueError(f"mask shape {mask.shape} does not pair with "
+                         f"image {img.shape}")
+    if not np.all(np.isfinite(img)):
+        raise ValueError("non-finite pixels in image batch (corrupt "
+                         "decode or broken normalization)")
+    mmin, mmax = float(mask.min()), float(mask.max())
+    if mmin < 0.0 or mmax > 1.0:
+        raise ValueError(f"mask range [{mmin}, {mmax}] outside [0,1] — "
+                         "masks must be binarized probabilities")
+    uniq = np.unique(mask)
+    if np.any((uniq > 0.0) & (uniq < 1.0)):
+        # Bilinear-resized masks must have been re-binarized upstream.
+        raise ValueError("mask is not binary (found values strictly "
+                         "between 0 and 1) — check the mask transform")
+    if float(mask.mean()) in (0.0, 1.0):
+        import warnings
+
+        warnings.warn("every mask pixel in the first batch is "
+                      f"{int(mask.mean())} — wrong mask directory?",
+                      stacklevel=2)
+    if use_depth:
+        depth = arr("depth")
+        if depth.shape != img.shape[:3] + (1,):
+            raise ValueError(f"depth shape {depth.shape} does not pair "
+                             f"with image {img.shape}")
+        if not np.all(np.isfinite(depth)):
+            raise ValueError("non-finite values in depth batch")
