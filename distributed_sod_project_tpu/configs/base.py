@@ -29,6 +29,7 @@ class DataConfig:
     normalize_std: Tuple[float, float, float] = (0.229, 0.224, 0.225)
     num_workers: int = 4  # host-side prefetch threads
     prefetch_batches: int = 2
+    transfer_dtype: str = "float32"  # bfloat16 halves H2D image bytes
     synthetic_size: int = 256  # virtual dataset length when dataset=synthetic
     # Multi-scale training (MINet-style): the cycle of square train
     # sizes, e.g. (256, 320, 384).  Empty = single-scale at image_size.

@@ -198,7 +198,8 @@ def fit(
             # mesh= (not sharding=): each host contributes its local
             # slice of the global batch — correct on multi-host pods.
             it = prefetch_to_device(
-                iter(loader), size=cfg.data.prefetch_batches, mesh=mesh)
+                iter(loader), size=cfg.data.prefetch_batches, mesh=mesh,
+                transfer_dtype=cfg.data.transfer_dtype)
             for batch in it:
                 if step >= total_steps or stop:
                     break

@@ -175,3 +175,24 @@ def test_loader_rotation_matches_grain_backend():
     for a, b in zip(host, gr):
         np.testing.assert_array_equal(a["image"], b["image"])
         np.testing.assert_array_equal(a["mask"], b["mask"])
+
+
+def test_prefetch_transfer_dtype_bf16():
+    """bfloat16 transfer casts image (not mask) and still trains."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_sod_project_tpu.data.pipeline import prefetch_to_device
+
+    ds = SyntheticSOD(size=8, image_size=(8, 8), seed=0)
+    ld = HostDataLoader(ds, global_batch_size=4, shuffle=False, seed=0)
+    batches = list(prefetch_to_device(iter(ld), size=1,
+                                      transfer_dtype="bfloat16"))
+    assert len(batches) == 2
+    assert batches[0]["image"].dtype == jnp.bfloat16
+    assert batches[0]["mask"].dtype == jnp.float32
+    # Values survive the cast to bf16 precision.
+    ref = next(iter(ld))
+    np.testing.assert_allclose(
+        np.asarray(batches[0]["image"], np.float32),
+        ref["image"].astype(np.float32), atol=0.02, rtol=0.02)
