@@ -29,7 +29,8 @@ def main(argv=None):
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="PATH=VALUE",
                    help="dotted config override, e.g. --set "
-                        "loss.fused_kernel=true --set optim.zero1=true")
+                        "loss.fused_kernel=true --set model.remat=true "
+                        "(bench always times the shard_map DP step)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the timed window")
     args = p.parse_args(argv)

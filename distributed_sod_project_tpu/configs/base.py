@@ -85,8 +85,10 @@ class OptimConfig:
     accum_steps: int = 1  # >1: optax.MultiSteps gradient accumulation
     ema_decay: float = 0.0  # >0: track an EMA of params; eval uses it
     # >0: skip updates whose gradients are non-finite (bad batch / bf16
-    # overflow) instead of poisoning the params; errors out after this
-    # many CONSECUTIVE skips (a persistent divergence, not a glitch).
+    # overflow) instead of poisoning the params; the train loop raises
+    # once this many CONSECUTIVE skips accumulate (a persistent
+    # divergence, not a glitch), checked at the logging cadence.  A bad
+    # update is NEVER applied.
     skip_nonfinite: int = 0
     # ZeRO-1-style cross-replica weight-update sharding (PAPERS.md:
     # arXiv 2004.13336): optimizer/EMA buffers shard over the data axis,

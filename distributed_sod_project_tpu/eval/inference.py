@@ -151,15 +151,17 @@ def evaluate(
         # hflip is a train-loader op, not a dataset property — resolve as-is.
         datasets = {cfg.data.dataset: resolve_dataset(cfg.data)}
     bs = batch_size or min(cfg.global_batch_size, 8)
+    # Only the eval variables (params + BN stats) go to the devices —
+    # NOT the optimizer/EMA buffers a restored TrainState carries
+    # (3-4x the param bytes, replicated onto every chip for nothing).
+    variables = (state.eval_variables() if hasattr(state, "eval_variables")
+                 else state.variables())
     if mesh is not None:
         from ..parallel.mesh import (batch_sharding, replicated_sharding)
 
         n_data = mesh.shape.get("data", 1)
         bs = max(1, bs // n_data) * n_data  # divisible by the data axis
-        state = jax.device_put(state, replicated_sharding(mesh))
-
-    variables = (state.eval_variables() if hasattr(state, "eval_variables")
-                 else state.variables())
+        variables = jax.device_put(variables, replicated_sharding(mesh))
 
     @jax.jit
     def _apply(variables, batch):

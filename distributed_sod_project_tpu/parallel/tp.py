@@ -182,7 +182,7 @@ def shard_state(state, mesh: Mesh,
 
 def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
                        schedule=None, donate: bool = True,
-                       ema_decay: float = 0.0, ema_every: int = 1,
+                       ema_decay: float = 0.0,
                        scale_hw: Optional[Tuple[int, int]] = None):
     """Build the GSPMD train step: ``(state, batch) -> (state, metrics)``.
 
@@ -198,25 +198,14 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
     import optax
 
     from ..losses import deep_supervision_loss
-    from ..train.step import _loss_kwargs, apply_update
+    from ..train.step import (_loss_kwargs, apply_update, notfinite_count,
+                              rescale_batch)
     from .mesh import batch_sharding
 
     lkw = _loss_kwargs(loss_cfg)
 
-    def _rescale(batch):
-        hw = batch["image"].shape[1:3]
-        if scale_hw is None or tuple(scale_hw) == tuple(hw):
-            return batch
-        out = dict(batch)
-        for k in ("image", "mask", "depth"):
-            if k in out:
-                b, _, _, c = out[k].shape
-                out[k] = jax.image.resize(
-                    out[k], (b,) + tuple(scale_hw) + (c,), "bilinear")
-        return out
-
     def step_fn(state, batch):
-        batch = _rescale(batch)
+        batch = rescale_batch(batch, scale_hw)
         rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
 
         def loss_fn(params):
@@ -230,9 +219,12 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
         grads, (comps, new_stats) = jax.grad(loss_fn, has_aux=True)(
             state.params)
         new_state = apply_update(state, grads, new_stats, tx,
-                                 ema_decay=ema_decay, ema_every=ema_every)
+                                 ema_decay=ema_decay)
         metrics = dict(comps)
         metrics["grad_norm"] = optax.global_norm(grads)
+        nfc = notfinite_count(new_state.opt_state)
+        if nfc is not None:
+            metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)
         if schedule is not None:
             metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
         return new_state, metrics

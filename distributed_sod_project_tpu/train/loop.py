@@ -135,34 +135,31 @@ def fit(
                 "(BN stats are global-batch there, strictly stronger)")
         state, state_shardings = shard_state(state, mesh,
                                              zero1=cfg.optim.zero1)
-        ms_cycle = (tuple((s, s) for s in cfg.data.multiscale)
-                    or (tuple(cfg.data.image_size),))
-        step_for_size = {
-            hw: make_tp_train_step(
+
+        def step_factory(scale_hw):
+            return make_tp_train_step(
                 model, cfg.loss, tx, mesh, state_shardings,
                 schedule=schedule, ema_decay=cfg.optim.ema_decay,
-                ema_every=cfg.optim.accum_steps,
-                scale_hw=None if hw == tuple(cfg.data.image_size) else hw)
-            for hw in dict.fromkeys(ms_cycle)
-        }
+                scale_hw=scale_hw)
     else:
         state = jax.device_put(state, replicated_sharding(mesh))
-        # Multi-scale training: one compiled step per size in the cycle
-        # (each is a distinct static-shape XLA program; the resize
-        # happens on-device inside the step).  Single-scale is the
-        # 1-entry cycle at the loader's native (possibly non-square)
-        # image_size.
-        ms_cycle = (tuple((s, s) for s in cfg.data.multiscale)
-                    or (tuple(cfg.data.image_size),))
-        step_for_size = {
-            hw: make_train_step(model, cfg.loss, tx, mesh,
-                                schedule=schedule, remat=cfg.model.remat,
-                                ema_decay=cfg.optim.ema_decay,
-                                ema_every=cfg.optim.accum_steps,
-                                scale_hw=None if hw ==
-                                tuple(cfg.data.image_size) else hw)
-            for hw in dict.fromkeys(ms_cycle)
-        }
+
+        def step_factory(scale_hw):
+            return make_train_step(
+                model, cfg.loss, tx, mesh, schedule=schedule,
+                remat=cfg.model.remat, ema_decay=cfg.optim.ema_decay,
+                scale_hw=scale_hw)
+
+    # Multi-scale training: one compiled step per size in the cycle
+    # (each is a distinct static-shape XLA program; the resize happens
+    # on-device inside the step).  Single-scale is the 1-entry cycle at
+    # the loader's native (possibly non-square) image_size.
+    ms_cycle = (tuple((s, s) for s in cfg.data.multiscale)
+                or (tuple(cfg.data.image_size),))
+    step_for_size = {
+        hw: step_factory(None if hw == tuple(cfg.data.image_size) else hw)
+        for hw in dict.fromkeys(ms_cycle)
+    }
     train_step_at = lambda i: step_for_size[ms_cycle[i % len(ms_cycle)]]  # noqa: E731
 
     writer = MetricWriter(os.path.join(workdir, "tb")
@@ -185,15 +182,28 @@ def fit(
     profile_at = -1
     if profile_dir:
         profile_at = max(start_step, min(start_step + 10, total_steps - 1))
-    start_epoch = start_step // max(steps_per_epoch, 1)
-    if start_step % max(steps_per_epoch, 1) and hasattr(loader, "skip_steps"):
+    # Resume position in LOADER coordinates: the loader always yields
+    # loader.steps_per_epoch batches per epoch regardless of any
+    # cfg.steps_per_epoch accounting override, so epoch/offset math must
+    # use the loader's own period or the resumed stream diverges.
+    loader_spe = max(loader.steps_per_epoch, 1)
+    start_epoch = start_step // loader_spe
+    if start_step % loader_spe and hasattr(loader, "skip_steps"):
         # Exact mid-epoch resume: the epoch order is a pure function of
         # (seed, epoch), so re-entry is an index skip — no replayed or
         # skipped samples vs the uninterrupted run.
-        loader.skip_steps(start_step % steps_per_epoch)
+        loader.skip_steps(start_step % loader_spe)
+    # Epoch iteration is open-ended and bounded by total_steps (which
+    # encodes cfg.num_epochs × steps_per_epoch): when cfg.steps_per_epoch
+    # overrides the accounting, the loader may need more or fewer passes
+    # than cfg.num_epochs.
+    import itertools
+
     try:
       with PreemptionGuard() as guard:
-        for epoch in range(start_epoch, cfg.num_epochs):
+        for epoch in itertools.count(start_epoch):
+            if step >= total_steps or stop:
+                break
             loader.set_epoch(epoch)
             # mesh= (not sharding=): each host contributes its local
             # slice of the global batch — correct on multi-host pods.
@@ -220,6 +230,16 @@ def fit(
                     stop = guard.sync()
                 if step % cfg.log_every_steps == 0 or step == total_steps:
                     host = {k: float(v) for k, v in metrics.items()}
+                    if (cfg.optim.skip_nonfinite and
+                            host.get("notfinite_count", 0.0)
+                            >= cfg.optim.skip_nonfinite):
+                        raise RuntimeError(
+                            f"{int(host['notfinite_count'])} consecutive "
+                            "non-finite gradient updates (≥ optim."
+                            f"skip_nonfinite={cfg.optim.skip_nonfinite}) — "
+                            "training has diverged; no bad update was "
+                            "applied, restart from the last checkpoint "
+                            "with a lower lr / higher loss scale")
                     host["imgs_per_sec"] = timer.images_per_sec(
                         cfg.global_batch_size)
                     host["epoch"] = epoch

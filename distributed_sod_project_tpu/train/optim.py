@@ -74,6 +74,11 @@ def build_optimizer(
         # Outermost so a non-finite micro-gradient never reaches the
         # MultiSteps accumulator: the whole micro-step becomes a no-op
         # (the DDP-era alternative was a poisoned replica bringing down
-        # the run); `skip` consecutive failures still raise.
-        tx = optax.apply_if_finite(tx, max_consecutive_errors=skip)
+        # the run).  max_consecutive_errors is effectively infinite
+        # because optax's semantics past the threshold are to ACCEPT the
+        # bad update — the opposite of what anyone wants; instead the
+        # train loop watches the in-state notfinite counter (surfaced as
+        # the `notfinite_count` metric) and raises once it exceeds the
+        # configured limit.
+        tx = optax.apply_if_finite(tx, max_consecutive_errors=10**9)
     return tx, schedule

@@ -40,28 +40,29 @@ def _loss_kwargs(loss_cfg) -> Dict[str, Any]:
 
 
 def apply_update(state: TrainState, grads, new_stats, tx, *,
-                 ema_decay: float = 0.0, ema_every: int = 1):
+                 ema_decay: float = 0.0):
     """Shared optimizer/EMA tail of every train step (DP and TP).
 
-    ``ema_every`` is the gradient-accumulation factor: under
-    ``optax.MultiSteps`` params change only every k-th micro-step, so
-    the EMA blends only there too — keeping the effective per-update
-    decay at ``ema_decay`` instead of ``ema_decay**k``.
+    The EMA blends only on micro-steps where the parameters actually
+    changed — derived by comparing trees, not by counting steps, so it
+    stays correct under ``optax.MultiSteps`` accumulation AND
+    ``apply_if_finite`` skips (a step counter desyncs the moment one
+    micro-step is rejected).  Effective per-update decay is therefore
+    exactly ``ema_decay``.
     """
     updates, new_opt = tx.update(grads, state.opt_state, state.params)
     new_params = optax.apply_updates(state.params, updates)
     new_ema = state.ema_params
     if ema_decay and new_ema is not None:
         d = jnp.float32(ema_decay)
-        blended = jax.tree_util.tree_map(
-            lambda e, p: e * d + p.astype(e.dtype) * (1.0 - d),
+        applied = jnp.any(jnp.stack([
+            jnp.any(a != b) for a, b in zip(
+                jax.tree_util.tree_leaves(state.params),
+                jax.tree_util.tree_leaves(new_params))]))
+        new_ema = jax.tree_util.tree_map(
+            lambda e, p: jnp.where(
+                applied, e * d + p.astype(e.dtype) * (1.0 - d), e),
             new_ema, new_params)
-        if ema_every > 1:
-            applied = (state.step + 1) % ema_every == 0
-            new_ema = jax.tree_util.tree_map(
-                lambda b, e: jnp.where(applied, b, e), blended, new_ema)
-        else:
-            new_ema = blended
     return TrainState(
         step=state.step + 1,
         params=new_params,
@@ -69,6 +70,31 @@ def apply_update(state: TrainState, grads, new_stats, tx, *,
         opt_state=new_opt,
         ema_params=new_ema,
     )
+
+
+def notfinite_count(opt_state) -> Optional[jnp.ndarray]:
+    """The ``apply_if_finite`` consecutive-failure counter, when the
+    optimizer is wrapped with ``optim.skip_nonfinite`` (it is the
+    OUTERMOST transform, so the counter sits at the state root);
+    None otherwise."""
+    if hasattr(opt_state, "notfinite_count"):
+        return opt_state.notfinite_count
+    return None
+
+
+def rescale_batch(batch, scale_hw):
+    """On-device multi-scale resize (image/mask/depth → ``scale_hw``);
+    shared by the shard_map and GSPMD steps."""
+    hw = batch["image"].shape[1:3]
+    if scale_hw is None or tuple(scale_hw) == tuple(hw):
+        return batch
+    out = dict(batch)
+    for k in ("image", "mask", "depth"):
+        if k in out:
+            b, _, _, c = out[k].shape
+            out[k] = jax.image.resize(
+                out[k], (b,) + tuple(scale_hw) + (c,), "bilinear")
+    return out
 
 
 def make_train_step(
@@ -80,7 +106,6 @@ def make_train_step(
     donate: bool = True,
     remat: bool = False,
     ema_decay: float = 0.0,
-    ema_every: int = 1,
     scale_hw: Optional[Tuple[int, int]] = None,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build ``(state, batch) -> (state, metrics)``.
@@ -101,20 +126,8 @@ def make_train_step(
     """
     lkw = _loss_kwargs(loss_cfg)
 
-    def _rescale(batch):
-        hw = batch["image"].shape[1:3]
-        if scale_hw is None or tuple(scale_hw) == tuple(hw):
-            return batch
-        out = dict(batch)
-        for k in ("image", "mask", "depth"):
-            if k in out:
-                b, _, _, c = out[k].shape
-                out[k] = jax.image.resize(
-                    out[k], (b,) + tuple(scale_hw) + (c,), "bilinear")
-        return out
-
     def step_fn(state: TrainState, batch):
-        batch = _rescale(batch)
+        batch = rescale_batch(batch, scale_hw)
         rng = jax.random.fold_in(
             jax.random.fold_in(jax.random.PRNGKey(0), state.step),
             lax.axis_index("data"),
@@ -146,9 +159,12 @@ def make_train_step(
         comps = lax.pmean(comps, "data")
 
         new_state = apply_update(state, grads, new_stats, tx,
-                                 ema_decay=ema_decay, ema_every=ema_every)
+                                 ema_decay=ema_decay)
         metrics = dict(comps)
         metrics["grad_norm"] = optax.global_norm(grads)
+        nfc = notfinite_count(new_state.opt_state)
+        if nfc is not None:
+            metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)
         if schedule is not None:
             metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
         return new_state, metrics

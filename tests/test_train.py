@@ -312,24 +312,27 @@ def test_multiscale_step_resizes_on_device(eight_devices):
 
 
 def test_ema_every_gates_blend_under_accumulation(eight_devices):
-    """With ema_every=k the EMA blends only on applied updates, so the
-    effective decay stays ema_decay (not ema_decay**k)."""
+    """Under accum_steps=k the EMA blends only on micro-steps where the
+    params actually change (tree-diff gate), so the effective decay
+    stays ema_decay — not ema_decay**k — and stays correct even when
+    apply_if_finite rejects micro-steps."""
     from distributed_sod_project_tpu.parallel.mesh import (
         batch_sharding, replicated_sharding)
 
     mesh = make_mesh(MeshConfig(data=8), eight_devices)
     model = TinyNet()
-    tx, sched = build_optimizer(OptimConfig(lr=0.5, warmup_steps=0), 10)
+    tx, sched = build_optimizer(
+        OptimConfig(lr=0.5, warmup_steps=0, accum_steps=2), 10)
     state = jax.device_get(
         create_train_state(jax.random.key(0), model, tx, _batch(2),
                            ema=True))
     lcfg = LossConfig(ssim_window=5)
     step = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
-                           ema_decay=0.5, ema_every=2)
+                           ema_decay=0.5)
     batch = jax.device_put(_batch(8), batch_sharding(mesh))
 
     s = jax.device_put(state, replicated_sharding(mesh))
-    s, _ = step(s, batch)  # micro-step 1: (0+1)%2 != 0 → EMA frozen
+    s, _ = step(s, batch)  # micro-step 1: accumulate only → EMA frozen
     ema1 = jax.tree_util.tree_leaves(jax.device_get(s.ema_params))
     p0 = jax.tree_util.tree_leaves(state.params)
     for e, a in zip(ema1, p0):
@@ -364,3 +367,41 @@ def test_skip_nonfinite_guards_updates():
     p2 = optax.apply_updates(p1, upd)
     np.testing.assert_allclose(np.asarray(p2), np.asarray(p0) - 0.1,
                                atol=1e-6)
+
+
+def test_skip_nonfinite_step_reports_counter_and_freezes(eight_devices):
+    """A NaN batch: params/EMA frozen, notfinite_count=1 in metrics; a
+    following good batch applies and resets the counter."""
+    from distributed_sod_project_tpu.parallel.mesh import (
+        batch_sharding, replicated_sharding)
+
+    mesh = make_mesh(MeshConfig(data=8), eight_devices)
+    model = TinyNet()
+    tx, sched = build_optimizer(
+        OptimConfig(lr=0.1, warmup_steps=0, skip_nonfinite=3), 10)
+    state = jax.device_get(
+        create_train_state(jax.random.key(0), model, tx, _batch(2),
+                           ema=True))
+    lcfg = LossConfig(ssim_window=5)
+    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
+                           ema_decay=0.5)
+
+    bad = _batch(8)
+    bad["image"][0, 0, 0, 0] = np.inf
+    s = jax.device_put(state, replicated_sharding(mesh))
+    s, m = step(s, jax.device_put(bad, batch_sharding(mesh)))
+    assert float(m["notfinite_count"]) == 1.0
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(jax.device_get(s.params))):
+        np.testing.assert_array_equal(a, b)  # bad update NOT applied
+    for a, b in zip(jax.tree_util.tree_leaves(state.ema_params),
+                    jax.tree_util.tree_leaves(jax.device_get(s.ema_params))):
+        np.testing.assert_array_equal(a, b)  # EMA gate held too
+
+    s, m = step(s, jax.device_put(_batch(8), batch_sharding(mesh)))
+    assert float(m["notfinite_count"]) == 0.0  # reset by a finite step
+    changed = any(
+        not np.array_equal(a, b) for a, b in zip(
+            jax.tree_util.tree_leaves(state.params),
+            jax.tree_util.tree_leaves(jax.device_get(s.params))))
+    assert changed
