@@ -15,6 +15,7 @@ import numpy as np
 from .streaming import (
     FBetaState,
     init_fbeta_state,
+    mean_emeasure_curve,
     mean_fbeta_curve,
     update_fbeta_state,
 )
@@ -58,14 +59,18 @@ class SODMetrics:
             "recall": np.asarray(rec),
             "fbeta_pooled": np.asarray(f),
             "fbeta_macro": np.asarray(mean_fbeta_curve(self._state)),
+            "emeasure_macro": np.asarray(mean_emeasure_curve(self._state)),
         }
 
     def results(self) -> Dict[str, float]:
         f = mean_fbeta_curve(self._state)  # macro curve, one finalise pass
+        em = mean_emeasure_curve(self._state)
         n = max(float(self._state.count), 1.0)
         out = {
             "max_fbeta": float(f.max()),
             "mean_fbeta": float(f.mean()),
+            "max_emeasure": float(em.max()),
+            "mean_emeasure": float(em.mean()),
             "mae": float(self._state.mae_sum) / n,
             "num_images": int(self._state.count),
         }

@@ -30,6 +30,7 @@ class FBetaState(NamedTuple):
     """Accumulated sufficient statistics; a pytree → psum/checkpoint-able."""
 
     f_curve_sum: jnp.ndarray  # [256] Σ over images of per-image Fβ curves
+    e_curve_sum: jnp.ndarray  # [256] Σ over images of per-image Em curves
     pos_hist: jnp.ndarray  # [256] pooled prediction-bin counts where gt==1
     neg_hist: jnp.ndarray  # [256] pooled prediction-bin counts where gt==0
     mae_sum: jnp.ndarray  # Σ per-image MAE
@@ -39,6 +40,7 @@ class FBetaState(NamedTuple):
 def init_fbeta_state() -> FBetaState:
     return FBetaState(
         f_curve_sum=jnp.zeros((NUM_BINS,), jnp.float32),
+        e_curve_sum=jnp.zeros((NUM_BINS,), jnp.float32),
         pos_hist=jnp.zeros((NUM_BINS,), jnp.float32),
         neg_hist=jnp.zeros((NUM_BINS,), jnp.float32),
         mae_sum=jnp.zeros((), jnp.float32),
@@ -60,6 +62,39 @@ def _curves_from_hists(pos, neg, *, beta2: float, eps: float):
     return precision, recall, f
 
 
+def _em_curves_from_hists(pos, neg, *, eps: float = 1e-12):
+    """Per-image E-measure curves from class-split histograms [B,256].
+
+    The enhanced-alignment map φ of a BINARISED prediction takes only
+    four values per threshold — one per (pred, gt) ∈ {0,1}² cell —
+    because the bias maps a_p = pb−mean(pb), a_g = g−mean(g) are
+    two-valued.  Weighting those four φ values by TP/FP/FN/TN counts
+    (reverse cumsums of the same histograms the Fβ curve uses) gives
+    the exact 256-threshold Em curve in O(256) instead of O(256·H·W).
+    Degenerate GT follows the PySODMetrics convention: all-fg → Em =
+    fg-fraction of the prediction; all-bg → 1 − fg-fraction.
+    """
+    tp = jnp.cumsum(pos[..., ::-1], axis=-1)[..., ::-1]
+    fp = jnp.cumsum(neg[..., ::-1], axis=-1)[..., ::-1]
+    n_pos = pos.sum(axis=-1, keepdims=True)
+    n_neg = neg.sum(axis=-1, keepdims=True)
+    n = n_pos + n_neg
+    fn = n_pos - tp
+    tn = n_neg - fp
+    p = (tp + fp) / n  # foreground fraction of the binarised pred
+    q = n_pos / n      # foreground fraction of the gt (per image)
+
+    def phi(ap, ag):
+        align = 2.0 * ap * ag / (ap * ap + ag * ag + eps)
+        return (align + 1.0) ** 2 / 4.0
+
+    em = (tp * phi(1.0 - p, 1.0 - q) + fp * phi(1.0 - p, -q)
+          + fn * phi(-p, 1.0 - q) + tn * phi(-p, -q)) / n
+    em = jnp.where(q >= 1.0, p, em)        # all-foreground GT
+    em = jnp.where(q <= 0.0, 1.0 - p, em)  # empty GT
+    return em
+
+
 def update_fbeta_state(
     state: FBetaState, pred, gt, *, beta2: float = BETA2, eps: float = 1e-8
 ) -> FBetaState:
@@ -76,9 +111,11 @@ def update_fbeta_state(
 
     pos_b, neg_b = jax.vmap(hists)(bins, t)  # [B,256] each
     _, _, f_b = _curves_from_hists(pos_b, neg_b, beta2=beta2, eps=eps)
+    em_b = _em_curves_from_hists(pos_b, neg_b)
     mae = jnp.abs(p - t).mean(axis=-1).sum()
     return FBetaState(
         f_curve_sum=state.f_curve_sum + f_b.sum(axis=0),
+        e_curve_sum=state.e_curve_sum + em_b.sum(axis=0),
         pos_hist=state.pos_hist + pos_b.sum(axis=0),
         neg_hist=state.neg_hist + neg_b.sum(axis=0),
         mae_sum=state.mae_sum + mae,
@@ -97,6 +134,11 @@ def fbeta_curve(state: FBetaState, *, beta2: float = BETA2, eps: float = 1e-8):
 def mean_fbeta_curve(state: FBetaState) -> jnp.ndarray:
     """Macro (per-image-averaged) Fβ curve — PySODMetrics convention."""
     return state.f_curve_sum / jnp.maximum(state.count, 1.0)
+
+
+def mean_emeasure_curve(state: FBetaState) -> jnp.ndarray:
+    """Macro (per-image-averaged) 256-threshold E-measure curve."""
+    return state.e_curve_sum / jnp.maximum(state.count, 1.0)
 
 
 def max_fbeta(state: FBetaState):

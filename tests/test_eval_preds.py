@@ -42,7 +42,7 @@ def test_evaluate_pair_scores_and_curves(pred_gt_dirs):
     assert 0.0 <= res["mae"] <= 1.0
     assert 0.5 < res["max_fbeta"] <= 1.0  # predictions correlate with gt
     assert set(curve) == {"precision", "recall", "fbeta_pooled",
-                          "fbeta_macro"}
+                          "fbeta_macro", "emeasure_macro"}
     assert len(curve["precision"]) == 256
     assert max(curve["fbeta_macro"]) == pytest.approx(res["max_fbeta"],
                                                       abs=1e-6)

@@ -162,3 +162,58 @@ def test_aggregator_includes_new_metrics():
     for key in ("adp_fbeta", "weighted_fmeasure", "s_measure", "e_measure",
                 "max_fbeta", "mae"):
         assert key in res and 0.0 <= res[key] <= 1.0, (key, res)
+
+
+def test_emeasure_curve_matches_bruteforce():
+    """The O(256) histogram closed form equals per-threshold binarize +
+    phi-map evaluation (the definitional brute force)."""
+    import jax.numpy as jnp
+
+    from distributed_sod_project_tpu.metrics.streaming import (
+        NUM_BINS, init_fbeta_state, mean_emeasure_curve,
+        update_fbeta_state)
+
+    rng = np.random.default_rng(3)
+    preds = rng.random((3, 20, 24)).astype(np.float32)
+    gts = (rng.random((3, 20, 24)) > 0.6).astype(np.float32)
+    # Degenerate GT cases ride along:
+    gts[1] = 1.0
+    gts[2] = 0.0
+
+    st = init_fbeta_state()
+    st = update_fbeta_state(st, jnp.asarray(preds), jnp.asarray(gts))
+    got = np.asarray(mean_emeasure_curve(st))
+
+    def phi_em(pb, g):
+        if g.all():
+            return pb.mean()
+        if not g.any():
+            return 1.0 - pb.mean()
+        ap = pb - pb.mean()
+        ag = g - g.mean()
+        align = 2 * ap * ag / (ap**2 + ag**2 + 1e-12)
+        return (((align + 1) ** 2) / 4).mean()
+
+    bins = np.clip((preds * (NUM_BINS - 1)).astype(np.int64), 0,
+                   NUM_BINS - 1)
+    want = np.zeros(NUM_BINS)
+    for k in range(NUM_BINS):
+        want[k] = np.mean([phi_em((bins[i] >= k).astype(np.float64),
+                                  gts[i].astype(np.float64))
+                           for i in range(3)])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_aggregator_reports_emeasure_variants():
+    from distributed_sod_project_tpu.metrics import SODMetrics
+
+    rng = np.random.default_rng(0)
+    agg = SODMetrics(compute_structure=True)
+    for _ in range(3):
+        gt = (rng.random((16, 16)) > 0.5).astype(np.float32)
+        agg.add(np.clip(gt + rng.normal(0, 0.2, gt.shape), 0, 1), gt)
+    res = agg.results()
+    for k in ("max_emeasure", "mean_emeasure", "e_measure"):
+        assert 0.0 <= res[k] <= 1.0
+    assert res["max_emeasure"] >= res["mean_emeasure"]
+    assert "emeasure_macro" in agg.curves()

@@ -118,7 +118,8 @@ def main(argv=None):
             all_curves[name] = curve
 
     cols = ["mae", "max_fbeta", "mean_fbeta", "adp_fbeta",
-            "weighted_fmeasure", "s_measure", "e_measure", "num_images"]
+            "weighted_fmeasure", "s_measure", "e_measure", "max_emeasure",
+            "mean_emeasure", "num_images"]
     present = [c for c in cols if any(c in r for r in all_results.values())]
     widths = {c: max(len(c), 7) for c in present}
     header = "dataset".ljust(12) + "  ".join(c.rjust(widths[c])
