@@ -21,3 +21,29 @@ def test_dump_hlo_writes_stablehlo(tmp_path):
 
         cost = json.load(open(paths["cost"]))
         assert cost.get("flops", 1) > 0
+
+
+def test_plot_curves_writes_figures(tmp_path):
+    import json
+
+    import numpy as np
+
+    import plot_curves
+
+    t = np.linspace(0, 1, 256)
+    curves = {}
+    for i, name in enumerate(["m1", "m2"]):
+        curves[name] = {
+            "precision": (0.9 - 0.1 * i - 0.3 * t).clip(0, 1).tolist(),
+            "recall": t.tolist(),
+            "fbeta_macro": (0.8 - 0.1 * i - 0.4 * (t - 0.4) ** 2).tolist(),
+            "emeasure_macro": (0.85 - 0.1 * i - 0.3 * (t - 0.5) ** 2
+                               ).tolist(),
+        }
+    cj = tmp_path / "curves.json"
+    cj.write_text(json.dumps(curves))
+    rc = plot_curves.main([str(cj), "--out", str(tmp_path / "figs")])
+    assert rc == 0
+    for f in ("pr_curve.png", "fbeta_curve.png", "emeasure_curve.png"):
+        p = tmp_path / "figs" / f
+        assert p.exists() and p.stat().st_size > 5_000

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Plot PR / Fβ / E-measure curves — PySODEvalToolkit's figure output.
+
+Input: the per-dataset curve JSON written by ``tools/eval_preds.py
+--curves`` (keys: precision, recall, fbeta_macro, emeasure_macro per
+dataset).  Output: three PNGs — the standard SOD comparison figures:
+
+    pr_curve.png        precision vs recall (one line per dataset/method)
+    fbeta_curve.png     macro Fβ vs binarisation threshold
+    emeasure_curve.png  macro Em vs binarisation threshold
+
+Usage:
+    python tools/eval_preds.py m1=preds1:/gt m2=preds2:/gt --curves c.json
+    python tools/plot_curves.py c.json --out figures/
+
+Design notes: Okabe–Ito colorblind-safe hues in fixed assignment order
+(the de-facto published CVD-safe palette; this zero-egress image has no
+Node runtime for an automated palette check), 2px lines, one axis per
+figure, recessive grid; more than 6 series folds the extras into a
+single muted "other" group to keep identity readable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+# Okabe & Ito (2008) — fixed assignment order, never cycled.
+PALETTE = ["#0072B2", "#E69F00", "#009E73", "#CC79A7", "#56B4E9", "#D55E00"]
+OTHER = "#888888"
+MAX_SERIES = len(PALETTE)
+
+
+def _style(ax, xlabel, ylabel, title):
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.set_title(title, fontsize=11)
+    ax.grid(True, color="#DDDDDD", linewidth=0.6, alpha=0.7)
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    ax.set_xlim(0.0, 1.0)
+    ax.set_ylim(0.0, 1.02)
+
+
+def plot_curves(curves: dict, out_dir: str, dpi: int = 150):
+    """curves: {name: {precision, recall, fbeta_macro, emeasure_macro}}.
+    Returns the list of files written."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    os.makedirs(out_dir, exist_ok=True)
+    names = list(curves)
+    colors = {}
+    for i, name in enumerate(names):
+        colors[name] = PALETTE[i] if i < MAX_SERIES else OTHER
+
+    thresholds = None
+    figures = [
+        ("pr_curve.png", "recall", "precision",
+         "Precision–Recall", lambda c: (c["recall"], c["precision"])),
+        ("fbeta_curve.png", "threshold", "Fβ",
+         "Fβ vs threshold",
+         lambda c: (thresholds, c["fbeta_macro"])),
+        ("emeasure_curve.png", "threshold", "E-measure",
+         "E-measure vs threshold",
+         lambda c: (thresholds, c["emeasure_macro"])),
+    ]
+    written = []
+    for fname, xl, yl, title, getter in figures:
+        fig, ax = plt.subplots(figsize=(5.0, 4.0))
+        plotted = False
+        for name in names:
+            c = curves[name]
+            needed = ("precision", "recall") if "pr_" in fname else (
+                "fbeta_macro" if "fbeta" in fname else "emeasure_macro",)
+            if any(k not in c for k in needed):
+                continue
+            n_pts = len(c.get("fbeta_macro", c.get("precision", [])))
+            thresholds = np.arange(n_pts) / max(n_pts - 1, 1)
+            x, y = getter(c)
+            ax.plot(np.asarray(x, float), np.asarray(y, float),
+                    color=colors[name], linewidth=2.0, label=name)
+            plotted = True
+        if not plotted:
+            plt.close(fig)
+            continue
+        # Single series: the title carries the name, no legend box.
+        _style(ax, xl, yl,
+               f"{title} — {names[0]}" if len(names) == 1 else title)
+        if len(names) > 1:
+            ax.legend(frameon=False, fontsize=9, loc="lower left"
+                      if "pr_" in fname else "best")
+        path = os.path.join(out_dir, fname)
+        fig.tight_layout()
+        fig.savefig(path, dpi=dpi)
+        plt.close(fig)
+        written.append(path)
+    return written
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("curves_json", help="output of eval_preds.py --curves")
+    p.add_argument("--out", default="figures")
+    p.add_argument("--dpi", type=int, default=150)
+    args = p.parse_args(argv)
+    with open(args.curves_json) as f:
+        curves = json.load(f)
+    for path in plot_curves(curves, args.out, args.dpi):
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
