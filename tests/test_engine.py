@@ -225,3 +225,52 @@ def test_fit_one_step_every_zoo_config(config_name, eight_devices,
     metrics = fit(cfg, workdir=str(tmp_path), max_steps=1)
     assert metrics["final_step"] == 1
     assert np.isfinite(metrics["total"])
+
+
+def test_fit_aborts_on_persistent_divergence(eight_devices, tmp_path,
+                                             monkeypatch):
+    """skip_nonfinite: bad updates are never applied, and fit raises
+    once the consecutive-failure counter reaches the limit."""
+    import dataclasses
+
+    from distributed_sod_project_tpu.data import SyntheticSOD
+    from distributed_sod_project_tpu.train import loop as loop_mod
+
+    class Poisoned(SyntheticSOD):
+        """First 16 fetches clean (validation sample + step-1 batch),
+        poison everything after — a mid-run data corruption."""
+
+        _fetches = 0
+
+        def __getitem__(self, index):
+            s = dict(super().__getitem__(index))
+            Poisoned._fetches += 1
+            if Poisoned._fetches > 16:
+                img = np.array(s["image"])
+                img[0, 0, 0] = np.inf
+                s["image"] = img
+            return s
+
+    monkeypatch.setattr(
+        loop_mod, "resolve_dataset",
+        lambda dcfg: Poisoned(size=32, image_size=(16, 16),
+                              use_depth=False))
+
+    from distributed_sod_project_tpu.configs import get_config
+
+    cfg = get_config("minet_vgg16_ref")
+    cfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, image_size=(16, 16),
+                                 hflip=False),
+        model=dataclasses.replace(cfg.model, sync_bn=True,
+                                  compute_dtype="float32"),
+        optim=dataclasses.replace(cfg.optim, skip_nonfinite=2),
+        mesh=dataclasses.replace(cfg.mesh, data=8),
+        global_batch_size=8,
+        num_epochs=1,
+        log_every_steps=1,
+        checkpoint_every_steps=0,
+        tensorboard=False,
+    )
+    with pytest.raises(RuntimeError, match="non-finite gradient"):
+        fit(cfg, workdir=str(tmp_path), max_steps=4)
