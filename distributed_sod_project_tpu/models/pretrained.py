@@ -50,8 +50,41 @@ def load_npz(path: str) -> Tuple[Dict, Dict]:
     return params, stats
 
 
+def _bias_table_windows(shape) -> int:
+    """(2w-1)² rows → w, or 0 when the shape is not a bias table."""
+    if len(shape) != 2:
+        return 0
+    side = int(round(shape[0] ** 0.5))
+    if side * side != shape[0] or side % 2 != 1:
+        return 0
+    return (side + 1) // 2
+
+
+def _adaptable_bias(key: str, target_shape, ported_shape) -> bool:
+    """Swin relative-position bias tables adapt across window sizes by
+    bicubic resize (the standard fine-tune-at-new-resolution practice):
+    [(2w-1)², H] ↔ [(2w'-1)², H]."""
+    return (key == "rel_pos_bias"
+            and len(target_shape) == 2 and len(ported_shape) == 2
+            and target_shape[1] == ported_shape[1]
+            and _bias_table_windows(target_shape) > 0
+            and _bias_table_windows(ported_shape) > 0)
+
+
+def _resize_bias_table(v: np.ndarray, target_shape) -> np.ndarray:
+    from scipy import ndimage
+
+    side_src = int(round(v.shape[0] ** 0.5))
+    side_tgt = int(round(target_shape[0] ** 0.5))
+    grid = np.asarray(v, np.float32).reshape(side_src, side_src, -1)
+    zoom = (side_tgt / side_src, side_tgt / side_src, 1.0)
+    out = ndimage.zoom(grid, zoom, order=3)
+    return out.reshape(side_tgt * side_tgt, -1)
+
+
 def _is_prefix_match(subtree: Dict, ported: Dict) -> bool:
-    """ported's keys are a subset-by-name with equal leaf shapes."""
+    """ported's keys are a subset-by-name with equal (or bias-table
+    adaptable) leaf shapes."""
     for k, v in ported.items():
         if k not in subtree:
             return False
@@ -61,7 +94,10 @@ def _is_prefix_match(subtree: Dict, ported: Dict) -> bool:
                 return False
         else:
             tgt = subtree[k]
-            if isinstance(tgt, dict) or tuple(np.shape(tgt)) != tuple(v.shape):
+            if isinstance(tgt, dict):
+                return False
+            if tuple(np.shape(tgt)) != tuple(v.shape) and not \
+                    _adaptable_bias(k, np.shape(tgt), v.shape):
                 return False
     return True
 
@@ -72,7 +108,10 @@ def _merge(subtree: Dict, ported: Dict) -> Dict:
         if isinstance(v, dict):
             out[k] = _merge(subtree[k], v)
         else:
-            out[k] = jnp.asarray(v, jnp.asarray(subtree[k]).dtype)
+            tgt = jnp.asarray(subtree[k])
+            if tuple(tgt.shape) != tuple(v.shape):
+                v = _resize_bias_table(np.asarray(v), tgt.shape)
+            out[k] = jnp.asarray(v, tgt.dtype)
     return out
 
 

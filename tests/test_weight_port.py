@@ -408,3 +408,50 @@ def test_swin_port_loads_into_swin_sod():
     np.testing.assert_allclose(got, want)
     outs = model.apply(merged, x, train=False)
     assert np.isfinite(np.asarray(outs[0])).all()
+
+
+def test_swin_port_adapts_bias_tables_to_small_inputs():
+    """At 64px the deep stages shrink their windows (<7), so the target
+    bias tables are smaller than the checkpoint's — the loader resizes
+    them bicubically (standard Swin resolution transfer) instead of
+    failing the structural match."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import dataclasses
+    import tempfile
+
+    import port_torch_weights as ptw
+
+    from distributed_sod_project_tpu.configs import get_config
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.models.pretrained import (
+        load_pretrained, save_npz)
+
+    rng = np.random.default_rng(2)
+    sd = _swin_state_dict(rng)
+    params, stats = ptw.port_swin_t(sd)
+
+    cfg = get_config("swin_sod")
+    model = build_model(dataclasses.replace(cfg.model,
+                                            compute_dtype="float32"))
+    x = jnp.asarray(rng.normal(0, 1, (1, 64, 64, 3)), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+
+    with tempfile.TemporaryDirectory() as d:
+        npz = os.path.join(d, "swin_t.npz")
+        save_npz(npz, params, stats)
+        merged = load_pretrained(variables, npz)  # must not raise
+
+    # Full-window tables copied exactly; shrunken ones resized.
+    got = np.asarray(
+        merged["params"]["SwinT_0"]["SwinBlock_0"]["WindowAttention_0"]
+        ["rel_pos_bias"])
+    want = np.asarray(
+        sd["layers.0.blocks.0.attn.relative_position_bias_table"].numpy())
+    np.testing.assert_allclose(got, want)
+    deep = np.asarray(
+        merged["params"]["SwinT_0"]["SwinBlock_10"]["WindowAttention_0"]
+        ["rel_pos_bias"])
+    assert deep.shape[0] < want.shape[0]  # genuinely resized
+    outs = model.apply(merged, x, train=False)
+    assert np.isfinite(np.asarray(outs[0])).all()
