@@ -23,8 +23,8 @@ class DataConfig:
     image_size: Tuple[int, int] = (320, 320)  # H, W — static for XLA
     use_depth: bool = False  # RGB-D datasets carry a depth channel
     hflip: bool = True
-    rotate_degrees: float = 0.0  # ±deg random rotation (MINet-style aug);
-    #   host/grain backends only (applied host-side with scipy)
+    rotate_degrees: float = 0.0  # ±deg random rotation (MINet-style
+    #   aug); identical per-index draws on every backend
     normalize_mean: Tuple[float, float, float] = (0.485, 0.456, 0.406)
     normalize_std: Tuple[float, float, float] = (0.229, 0.224, 0.225)
     num_workers: int = 4  # host-side prefetch threads

@@ -51,11 +51,7 @@ class TFDataLoader:
         rotate_degrees: float = 0.0,
         num_workers: int = 4,
     ):
-        if rotate_degrees:
-            raise ValueError(
-                "rotation augmentation is host-side (scipy) — use the "
-                "'host' or 'grain' backend with data.rotate_degrees, or "
-                "set it to 0 for tfdata")
+        self.rotate_degrees = float(rotate_degrees)
         if global_batch_size % num_shards != 0:
             raise ValueError(
                 f"global_batch_size={global_batch_size} not divisible by "
@@ -161,6 +157,34 @@ class TFDataLoader:
                         out[k] = tf.cond(
                             flip, lambda t=out[k]: tf.reverse(t, axis=[1]),
                             lambda t=out[k]: t)
+            if self.rotate_degrees:
+                # Host-side scipy rotation via py_function, the SAME
+                # per-index draws as the host/grain backends
+                # (data/augment.py) — backend choice never changes the
+                # training data.  py_function serialises on the GIL but
+                # scipy releases it for the heavy spline work.
+                deg = self.rotate_degrees
+
+                def rot(idx, img, mask, *maybe_depth):
+                    from .augment import apply_rotate, rotate_draw
+
+                    angle = rotate_draw(aug_seed, int(idx.numpy()), deg)
+                    s = {"image": img.numpy(), "mask": mask.numpy()}
+                    if maybe_depth:
+                        s["depth"] = maybe_depth[0].numpy()
+                    s = apply_rotate(s, angle)
+                    outs = [s["image"], s["mask"]]
+                    if maybe_depth:
+                        outs.append(s["depth"])
+                    return outs
+
+                keys = ["image", "mask"] + (["depth"] if use_depth else [])
+                rotated = tf.py_function(
+                    rot, inp=[out["index"]] + [out[k] for k in keys],
+                    Tout=[tf.float32] * len(keys))
+                for k, r in zip(keys, rotated):
+                    r.set_shape(out[k].shape)  # py_function drops shapes
+                    out[k] = r
             return out
 
         ds = (tf.data.Dataset.from_tensor_slices(tensors)

@@ -118,3 +118,30 @@ def test_tfdata_skip_steps_resumes_mid_epoch(folder_ds):
     assert len(tail) == len(all_batches) - 2
     for a, b in zip(all_batches[2:], tail):
         np.testing.assert_array_equal(a, b)
+
+
+def test_tfdata_rotation_matches_shared_augment(folder_ds):
+    """tfdata rotation == augment.apply_rotate on the unrotated stream
+    with the shared per-index draws (backend parity)."""
+    from distributed_sod_project_tpu.data.augment import (
+        apply_rotate, rotate_draw)
+    from distributed_sod_project_tpu.data.tfdata import TFDataLoader
+
+    mk = lambda deg: TFDataLoader(folder_ds, global_batch_size=2,  # noqa: E731
+                                  shuffle=True, seed=4, hflip=False,
+                                  rotate_degrees=deg)
+    plain = mk(0.0)
+    plain.set_epoch(0)
+    rot = mk(15.0)
+    rot.set_epoch(0)
+    aug_seed = hash((4, 0)) & 0x7FFFFFFF
+    for pb, rb in zip(plain, rot):
+        np.testing.assert_array_equal(pb["index"], rb["index"])
+        for j, idx in enumerate(pb["index"]):
+            want = apply_rotate(
+                {"image": pb["image"][j], "mask": pb["mask"][j]},
+                rotate_draw(aug_seed, int(idx), 15.0))
+            np.testing.assert_allclose(rb["image"][j], want["image"],
+                                       atol=1e-5)
+            np.testing.assert_allclose(rb["mask"][j], want["mask"],
+                                       atol=1e-5)
