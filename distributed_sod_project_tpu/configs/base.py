@@ -73,7 +73,7 @@ class LossConfig:
 class OptimConfig:
     """Optimizer + schedule (SURVEY.md §2 C9)."""
 
-    optimizer: str = "sgd"  # sgd | adamw
+    optimizer: str = "sgd"  # sgd | adamw | lars (large-batch)
     lr: float = 0.005
     momentum: float = 0.9
     weight_decay: float = 5e-4

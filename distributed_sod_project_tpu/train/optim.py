@@ -59,6 +59,20 @@ def build_optimizer(
                 optax.add_decayed_weights(optim_cfg.weight_decay, _decay_mask)
             )
         parts.append(optax.scale_by_learning_rate(tx_schedule))
+    elif optim_cfg.optimizer == "lars":
+        # Layer-wise adaptive rates for large-batch DP scaling
+        # (PAPERS.md: efficient large-scale ConvNet training lineage) —
+        # the standard remedy when pod-scale global batches stall plain
+        # SGD.  optax.lars is a complete transformation (includes wd,
+        # momentum and the lr), so it absorbs the whole chain tail.
+        parts = parts[:1] if (optim_cfg.grad_clip_norm or 0) > 0 else []
+        parts.append(optax.lars(
+            learning_rate=tx_schedule,
+            weight_decay=optim_cfg.weight_decay,
+            weight_decay_mask=_decay_mask,
+            momentum=optim_cfg.momentum,
+            nesterov=optim_cfg.nesterov,
+        ))
     else:
         raise ValueError(f"unknown optimizer {optim_cfg.optimizer!r}")
     tx = optax.chain(*parts)

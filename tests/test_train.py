@@ -405,3 +405,26 @@ def test_skip_nonfinite_step_reports_counter_and_freezes(eight_devices):
             jax.tree_util.tree_leaves(state.params),
             jax.tree_util.tree_leaves(jax.device_get(s.params))))
     assert changed
+
+
+def test_lars_optimizer_trains(eight_devices):
+    """LARS (large-batch DP) builds and reduces loss like the others."""
+    from distributed_sod_project_tpu.parallel.mesh import (
+        batch_sharding, replicated_sharding)
+
+    mesh = make_mesh(MeshConfig(data=8), eight_devices)
+    model = TinyNet()
+    tx, sched = build_optimizer(
+        OptimConfig(optimizer="lars", lr=1.0, warmup_steps=0,
+                    weight_decay=1e-4), 20)
+    state = create_train_state(jax.random.key(0), model, tx, _batch(2))
+    lcfg = LossConfig(ssim_window=5)
+    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False)
+    batch = jax.device_put(_batch(8, seed=5), batch_sharding(mesh))
+    s = jax.device_put(state, replicated_sharding(mesh))
+    losses = []
+    for _ in range(10):
+        s, m = step(s, batch)
+        losses.append(float(m["total"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
