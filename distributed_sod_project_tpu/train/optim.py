@@ -64,12 +64,16 @@ def build_optimizer(
         # (PAPERS.md: efficient large-scale ConvNet training lineage) —
         # the standard remedy when pod-scale global batches stall plain
         # SGD.  optax.lars is a complete transformation (includes wd,
-        # momentum and the lr), so it absorbs the whole chain tail.
-        parts = parts[:1] if (optim_cfg.grad_clip_norm or 0) > 0 else []
+        # momentum and the lr), so it absorbs the whole chain tail; any
+        # grad-clip part already in `parts` stays in front.
+        # trust_ratio_mask: standard LARS adapts only rank>=2 kernels —
+        # biases/norm affines keep plain SGD steps (the default True
+        # would scale their updates by ~||b||·1e-3, freezing them).
         parts.append(optax.lars(
             learning_rate=tx_schedule,
             weight_decay=optim_cfg.weight_decay,
             weight_decay_mask=_decay_mask,
+            trust_ratio_mask=_decay_mask,
             momentum=optim_cfg.momentum,
             nesterov=optim_cfg.nesterov,
         ))

@@ -47,3 +47,19 @@ def test_plot_curves_writes_figures(tmp_path):
     for f in ("pr_curve.png", "fbeta_curve.png", "emeasure_curve.png"):
         p = tmp_path / "figs" / f
         assert p.exists() and p.stat().st_size > 5_000
+
+
+def test_plot_curves_partial_entries(tmp_path):
+    """A series with only an Em curve plots without crashing and sizes
+    its threshold axis from that curve."""
+    import json
+
+    import plot_curves
+
+    curves = {"only_em": {"emeasure_macro": [0.5] * 128}}
+    cj = tmp_path / "c.json"
+    cj.write_text(json.dumps(curves))
+    rc = plot_curves.main([str(cj), "--out", str(tmp_path / "f")])
+    assert rc == 0
+    assert (tmp_path / "f" / "emeasure_curve.png").exists()
+    assert not (tmp_path / "f" / "pr_curve.png").exists()

@@ -428,3 +428,12 @@ def test_lars_optimizer_trains(eight_devices):
         losses.append(float(m["total"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+    # Biases must train too: standard LARS exempts rank<=1 params from
+    # trust-ratio scaling (a default-masked optax.lars freezes them).
+    p0 = {jax.tree_util.keystr(p): v for p, v in
+          jax.tree_util.tree_leaves_with_path(state.params)}
+    for path, v in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(s.params)):
+        key = jax.tree_util.keystr(path)
+        if v.ndim == 1 and "bias" in key:
+            assert not np.allclose(v, p0[key], atol=1e-5), key

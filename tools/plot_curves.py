@@ -79,7 +79,8 @@ def plot_curves(curves: dict, out_dir: str, dpi: int = 150):
                 "fbeta_macro" if "fbeta" in fname else "emeasure_macro",)
             if any(k not in c for k in needed):
                 continue
-            n_pts = len(c.get("fbeta_macro", c.get("precision", [])))
+            # Threshold axis sized by the series actually plotted.
+            n_pts = len(c[needed[-1]])
             thresholds = np.arange(n_pts) / max(n_pts - 1, 1)
             x, y = getter(c)
             ax.plot(np.asarray(x, float), np.asarray(y, float),
