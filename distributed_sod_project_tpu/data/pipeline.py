@@ -163,7 +163,7 @@ class HostDataLoader:
 
 
 def prefetch_to_device(iterator, size: int = 2, sharding=None, mesh=None,
-                       transfer_dtype=None):
+                       transfer_dtype=None, drop_keys=()):
     """Wrap a host batch iterator with a background thread that stages
     batches onto device ahead of consumption (H2D overlap, the TPU
     analogue of the reference's pinned-memory ``non_blocking`` H2D copies
@@ -193,12 +193,15 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None, mesh=None,
                         or transfer_dtype)
 
     def maybe_cast(batch):
-        if cast is None:
+        if cast is None and not drop_keys:
             return batch
         out = dict(batch)
-        for k in ("image", "depth"):
-            if k in out:
-                out[k] = np.asarray(out[k]).astype(cast)
+        for k in drop_keys:  # loader metadata the step never reads
+            out.pop(k, None)
+        if cast is not None:
+            for k in ("image", "depth"):
+                if k in out:
+                    out[k] = np.asarray(out[k]).astype(cast)
         return out
 
     q: "queue.Queue" = queue.Queue(maxsize=size)

@@ -183,7 +183,8 @@ def shard_state(state, mesh: Mesh,
 def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
                        schedule=None, donate: bool = True,
                        ema_decay: float = 0.0,
-                       scale_hw: Optional[Tuple[int, int]] = None):
+                       scale_hw: Optional[Tuple[int, int]] = None,
+                       donate_batch: bool = False):
     """Build the GSPMD train step: ``(state, batch) -> (state, metrics)``.
 
     Unlike the shard_map DP step there is no explicit ``pmean`` and no
@@ -230,9 +231,12 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
         return new_state, metrics
 
     replicated = NamedSharding(mesh, P())
+    donated = (0,) if donate else ()
+    if donate_batch:  # see make_train_step: fit feeds each batch once
+        donated = donated + (1,)
     return jax.jit(
         step_fn,
         in_shardings=(state_shardings, batch_sharding(mesh)),
         out_shardings=(state_shardings, replicated),
-        donate_argnums=(0,) if donate else (),
+        donate_argnums=donated,
     )

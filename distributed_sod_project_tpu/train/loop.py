@@ -140,7 +140,7 @@ def fit(
             return make_tp_train_step(
                 model, cfg.loss, tx, mesh, state_shardings,
                 schedule=schedule, ema_decay=cfg.optim.ema_decay,
-                scale_hw=scale_hw)
+                scale_hw=scale_hw, donate_batch=True)
     else:
         state = jax.device_put(state, replicated_sharding(mesh))
 
@@ -148,7 +148,7 @@ def fit(
             return make_train_step(
                 model, cfg.loss, tx, mesh, schedule=schedule,
                 remat=cfg.model.remat, ema_decay=cfg.optim.ema_decay,
-                scale_hw=scale_hw)
+                scale_hw=scale_hw, donate_batch=True)
 
     # Multi-scale training: one compiled step per size in the cycle
     # (each is a distinct static-shape XLA program; the resize happens
@@ -209,7 +209,8 @@ def fit(
             # slice of the global batch — correct on multi-host pods.
             it = prefetch_to_device(
                 iter(loader), size=cfg.data.prefetch_batches, mesh=mesh,
-                transfer_dtype=cfg.data.transfer_dtype)
+                transfer_dtype=cfg.data.transfer_dtype,
+                drop_keys=("index",))
             for batch in it:
                 if step >= total_steps or stop:
                     break

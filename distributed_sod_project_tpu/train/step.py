@@ -107,6 +107,7 @@ def make_train_step(
     remat: bool = False,
     ema_decay: float = 0.0,
     scale_hw: Optional[Tuple[int, int]] = None,
+    donate_batch: bool = False,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build ``(state, batch) -> (state, metrics)``.
 
@@ -176,7 +177,13 @@ def make_train_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    # donate_batch: the training loop feeds each prefetched batch
+    # exactly once, so its HBM can be recycled into activations; OFF by
+    # default because benchmarks/tests re-feed the same buffers.
+    donated = (0,) if donate else ()
+    if donate_batch:
+        donated = donated + (1,)
+    return jax.jit(sharded, donate_argnums=donated)
 
 
 def make_eval_step(model, mesh: Mesh) -> Callable:
