@@ -26,6 +26,9 @@ def main(argv=None):
     p.add_argument("--batch-per-chip", type=int, default=8)
     p.add_argument("--image-size", type=int, default=320)
     p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
+    p.add_argument("--mode", default="train", choices=["train", "eval"],
+                   help="train: full DP step (default); eval: forward-only "
+                        "sigmoid inference, the test.py hot loop")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="PATH=VALUE",
                    help="dotted config override, e.g. --set "
@@ -73,18 +76,51 @@ def main(argv=None):
     state = create_train_state(jax.random.key(0), model, tx, host_batch)
     state = jax.device_put(state, replicated_sharding(mesh))
     dev_batch = jax.device_put(host_batch, batch_sharding(mesh))
-    step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched)
 
-    for _ in range(args.warmup):  # compile + stabilise
-        state, _ = step(state, dev_batch)
-    jax.block_until_ready(state.params)
+    # Each mode provides run_step() -> sync token; sync is a HOST FETCH
+    # (device_get), not jax.block_until_ready: through remote-device
+    # transports (axon) the latter can resolve before execution drains,
+    # inflating throughput ~50x (measured — docs/PERFORMANCE.md).  The
+    # fetched value must depend on EVERY device's shard: the train
+    # metrics are pmean-replicated; eval sums the sharded output.
+    if args.mode == "eval":
+        from distributed_sod_project_tpu.train.step import make_eval_step
+
+        estep = make_eval_step(model, mesh)
+        # Eval steps are independent (no state carry), so the sync token
+        # must chain THROUGH every step or the final fetch only proves
+        # the last dispatch drained: fold each output into an
+        # accumulator and fetch that.
+        acc = [jnp.zeros((), jnp.float32)]
+
+        def run_step():
+            acc[0] = acc[0] + jnp.sum(estep(state, dev_batch))
+            return acc[0]
+
+        def sync(token):
+            return float(token)
+    else:
+        step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
+                               remat=cfg.model.remat)
+        carry = [state]
+
+        def run_step():
+            carry[0], metrics = step(carry[0], dev_batch)
+            return metrics["total"]
+
+        def sync(total):
+            return float(total)
+
+    for _ in range(max(args.warmup, 1)):  # compile + stabilise (≥1: the
+        token = run_step()                # sync token must exist)
+    sync(token)
 
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        state, metrics = step(state, dev_batch)
-    jax.block_until_ready(metrics["total"])
+        token = run_step()
+    sync(token)
     dt = time.perf_counter() - t0
     if args.profile_dir:
         jax.profiler.stop_trace()
@@ -95,6 +131,8 @@ def main(argv=None):
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
     key = f"{args.config}-{hw}-{jax.devices()[0].platform}"
+    if args.mode != "train":
+        key += f"-{args.mode}"
     base = {}
     if os.path.exists(base_path):
         with open(base_path) as f:
@@ -106,7 +144,7 @@ def main(argv=None):
     vs = per_chip / base[key] if base[key] else 1.0
 
     print(json.dumps({
-        "metric": f"train_throughput[{args.config}@{hw}px,"
+        "metric": f"{args.mode}_throughput[{args.config}@{hw}px,"
                   f"{jax.devices()[0].platform}x{n_chips}]",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",

@@ -29,8 +29,14 @@ _LANES = 128  # TPU lane width: the per-image sums ride one lane row.
 
 
 def _sums_kernel(x_ref, t_ref, out_ref):
-    """One image per grid step: [1,N] logits/targets → [1,128] sums
-    (lane 0: BCE sum, 1: Σpt, 2: Σp, 3: Σt; rest zero)."""
+    """One image per grid step: [1,N/128,128] logits/targets →
+    [1,1,128] sums (lane 0: BCE sum, 1: Σpt, 2: Σp, 3: Σt; rest zero).
+
+    The image rides VMEM as (sublanes, lanes) = (N/128, 128) — Mosaic
+    requires the trailing block dims to match the array (or be 8/128
+    multiples), so the caller reshapes pixels into full-lane rows
+    rather than one giant row.
+    """
     x = x_ref[:].astype(jnp.float32)
     t = t_ref[:].astype(jnp.float32)
     bce = jnp.sum(jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x))))
@@ -38,13 +44,14 @@ def _sums_kernel(x_ref, t_ref, out_ref):
     inter = jnp.sum(p * t)
     psum = jnp.sum(p)
     tsum = jnp.sum(t)
-    lane = lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+    lane = lax.broadcasted_iota(jnp.int32, (1, 1, _LANES), 2)
     out = (jnp.where(lane == 0, bce, 0.0) + jnp.where(lane == 1, inter, 0.0)
            + jnp.where(lane == 2, psum, 0.0) + jnp.where(lane == 3, tsum, 0.0))
     out_ref[:] = out
 
 
-def pixel_region_sums(logits: jnp.ndarray, targets: jnp.ndarray
+def pixel_region_sums(logits: jnp.ndarray, targets: jnp.ndarray,
+                      interpret: bool | None = None,
                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
                                  jnp.ndarray]:
     """Per-image (bce_sum, Σσ(x)t, Σσ(x), Σt), each [B], in one pass.
@@ -52,29 +59,36 @@ def pixel_region_sums(logits: jnp.ndarray, targets: jnp.ndarray
     Accepts [B,H,W,1]/[B,H,W]/[B,N]; pixel count must be a multiple of
     128 (true for every SOD config: 320²=800·128; padded inputs would
     bias Σσ(x) and are rejected).
+
+    ``interpret`` defaults to auto (interpret on CPU, Mosaic on TPU);
+    pass False to force the Mosaic lowering, e.g. when exporting for
+    platform='tpu' from a CPU host (tests do this to validate the
+    hardware path without a chip).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
     b = logits.shape[0]
-    x = logits.reshape(b, -1)
-    t = targets.reshape(b, -1)
-    n = x.shape[1]
+    n = int(jnp.size(logits)) // b
     if n % _LANES:
         raise ValueError(f"pixel count {n} not a multiple of {_LANES}")
+    rows = n // _LANES
+    x = logits.reshape(b, rows, _LANES)
+    t = targets.reshape(b, rows, _LANES)
 
     out = pl.pallas_call(
         _sums_kernel,
         grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, n), lambda i: (i, 0)),
-            pl.BlockSpec((1, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, rows, _LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, rows, _LANES), lambda i: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, _LANES), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, _LANES), jnp.float32),
-        interpret=jax.default_backend() == "cpu",
+        out_specs=pl.BlockSpec((1, 1, _LANES), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 1, _LANES), jnp.float32),
+        interpret=(jax.default_backend() == "cpu"
+                   if interpret is None else interpret),
     )(x, t)
-    return out[:, 0], out[:, 1], out[:, 2], out[:, 3]
+    return out[:, 0, 0], out[:, 0, 1], out[:, 0, 2], out[:, 0, 3]
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))

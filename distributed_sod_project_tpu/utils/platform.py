@@ -20,3 +20,36 @@ def select_platform(device: str | None) -> None:
         jax.config.update("jax_platforms", "cpu")
     elif device not in (None, "tpu"):
         raise ValueError(f"unknown --device {device!r}")
+
+
+def maybe_enable_compilation_cache(path: str | None = None) -> None:
+    """Persistent XLA compilation cache: the zoo's 320×320 programs take
+    minutes to compile for TPU, and every CLI invocation is a fresh
+    process — cache compiled executables on disk so only the first run
+    of a (program, shape) pays.  Opt out with DSOD_NO_COMPILE_CACHE=1.
+
+    Call AFTER the first backend touch (``jax.devices()``/``make_mesh``):
+    gating is on the RESOLVED backend, not the ``--device`` flag, because
+    ``--device`` unset can still land on CPU (tunnel down → fallback) and
+    XLA:CPU's AOT cache entries pin host machine features, which can
+    SIGILL when feature detection disagrees across processes (observed
+    in-sandbox).  jax re-reads the config at each compile, so enabling
+    post-init still covers every program the process compiles."""
+    import os
+
+    if os.environ.get("DSOD_NO_COMPILE_CACHE"):
+        return
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return
+    cache = (path or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+             or os.path.expanduser("~/.cache/dsod_xla"))
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        # Cache every program that takes non-trivial compile time.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — older jaxlib: cache is best-effort
+        pass

@@ -74,3 +74,21 @@ def test_fused_rejects_unaligned_pixel_count():
     x = jnp.zeros((2, 5, 5, 1))
     with pytest.raises(ValueError, match="multiple of 128"):
         pixel_region_sums(x, x)
+
+
+def test_fused_loss_lowers_for_real_tpu():
+    """Export for platform='tpu' with interpret=False runs the Mosaic
+    block-mapping checks host-side — this is the path that rejected the
+    original (1, N) block spec on hardware while interpret mode accepted
+    it, so CI guards the real-TPU lowering without needing a chip."""
+    from jax import export
+
+    from distributed_sod_project_tpu.pallas.fused_loss import (
+        pixel_region_sums as sums)
+
+    x, t = _data(b=2, h=320, w=320, seed=5)
+    exp = export.export(
+        jax.jit(lambda a, b: sums(a, b, interpret=False)),
+        platforms=["tpu"])(x, t)
+    assert all(av.shape == (2,) for av in exp.out_avals)
+    assert "tpu_custom_call" in exp.mlir_module()
