@@ -73,6 +73,80 @@ def _save_pngs(items) -> None:
         Image.fromarray(arr).save(path)
 
 
+def make_forward(model):
+    """The canonical eval forward: ``(variables, batch) -> probs``
+    (sigmoid on the primary logit, f32, [B,H,W]).  jitted once with the
+    variables as an ARGUMENT so repeated calls never retrace.  Single
+    definition shared by evaluate(), the in-training eval, and
+    tools/predict.py — the mesh-sharded variant lives in
+    train/step.py::make_eval_step."""
+
+    @jax.jit
+    def forward(variables, batch):
+        outs = model.apply(variables, batch["image"], batch.get("depth"),
+                           train=False)
+        return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
+
+    return forward
+
+
+def pad_to_batch(batch: Dict[str, np.ndarray], batch_size: int
+                 ) -> Dict[str, np.ndarray]:
+    """Zero-pad every leaf's leading dim to ``batch_size`` so the
+    compiled forward only ever sees ONE static shape; callers slice the
+    pad back off the output."""
+    short = batch_size - next(iter(batch.values())).shape[0]
+    if short <= 0:
+        return batch
+    return {k: np.concatenate(
+        [v, np.zeros((short,) + v.shape[1:], v.dtype)])
+        for k, v in batch.items()}
+
+
+def restore_for_eval(ckpt_dir: str, config_name: Optional[str] = None,
+                     overrides=(), step: Optional[int] = None):
+    """Checkpoint directory → ``(cfg, model, state)``, shared by the
+    eval-side CLIs (test.py, tools/predict.py).
+
+    Config comes from the registry when ``config_name`` is given, else
+    from the checkpoint's own ``config.json`` sidecar (checkpoints are
+    self-describing).  The restore template is built from a zeros batch
+    of the config's static eval shape — only shapes matter to orbax,
+    and it must mirror training-time state (EMA slots included).
+    """
+    import json as _json
+
+    from ..ckpt import CheckpointManager
+    from ..configs import apply_overrides, config_from_dict, get_config
+    from ..models import build_model
+    from ..train import build_optimizer, create_train_state
+
+    if config_name:
+        cfg = get_config(config_name)
+    else:
+        sidecar = os.path.join(ckpt_dir, "config.json")
+        if not os.path.exists(sidecar):
+            raise SystemExit(
+                f"no --config given and {sidecar} missing — pass the "
+                "config name explicitly")
+        with open(sidecar) as f:
+            cfg = config_from_dict(_json.load(f))
+    cfg = apply_overrides(cfg, list(overrides))
+
+    model = build_model(cfg.model)
+    tx, _ = build_optimizer(cfg.optim, 1)
+    h, w = cfg.data.image_size
+    probe = {"image": np.zeros((1, h, w, 3), np.float32)}
+    if cfg.data.use_depth:
+        probe["depth"] = np.zeros((1, h, w, 1), np.float32)
+    template = create_train_state(jax.random.key(0), model, tx, probe,
+                                  ema=cfg.optim.ema_decay > 0)
+    mgr = CheckpointManager(ckpt_dir, async_save=False)
+    state = mgr.restore(template, step=step)
+    mgr.close()
+    return cfg, model, state
+
+
 def run_inference(
     forward,
     dataset,
@@ -102,9 +176,7 @@ def run_inference(
         if use_depth:
             batch["depth"] = np.stack([s["depth"] for s in samples])
         if pad:
-            batch = {k: np.concatenate(
-                [v, np.zeros((pad,) + v.shape[1:], v.dtype)]) for k, v in
-                batch.items()}
+            batch = pad_to_batch(batch, batch_size)
         probs = np.asarray(forward(batch))[: len(idxs)]
 
         pending = []
@@ -163,12 +235,7 @@ def evaluate(
         bs = max(1, bs // n_data) * n_data  # divisible by the data axis
         variables = jax.device_put(variables, replicated_sharding(mesh))
 
-    @jax.jit
-    def _apply(variables, batch):
-        outs = model.apply(
-            variables, batch["image"], batch.get("depth"),
-            train=False)
-        return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
+    _apply = make_forward(model)
 
     def forward(batch):
         if mesh is not None:

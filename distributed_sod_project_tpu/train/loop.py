@@ -302,6 +302,7 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
     import dataclasses
 
     from ..eval import run_inference
+    from ..eval.inference import make_forward
     from ..parallel.mesh import batch_sharding
 
     data_cfg = cfg.data
@@ -311,11 +312,7 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
 
     # jit once with the variables as an argument: re-invoking eval does
     # NOT retrace (same shapes), unlike a fresh closure per call.
-    @jax.jit
-    def forward(variables, batch):
-        outs = model.apply(variables, batch["image"], batch.get("depth"),
-                           train=False)
-        return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
+    forward = make_forward(model)
 
     def eval_fn(state) -> Dict[str, float]:
         variables = state.eval_variables()

@@ -50,27 +50,13 @@ def main(argv=None):
 
     import jax
 
-    from distributed_sod_project_tpu.ckpt import CheckpointManager
-    from distributed_sod_project_tpu.configs import apply_overrides, get_config
     from distributed_sod_project_tpu.data import resolve_dataset
     from distributed_sod_project_tpu.eval import evaluate
-    from distributed_sod_project_tpu.models import build_model
-    from distributed_sod_project_tpu.train import (
-        build_optimizer, create_train_state)
+    from distributed_sod_project_tpu.eval.inference import restore_for_eval
 
-    if args.config:
-        cfg = get_config(args.config)
-    else:
-        from distributed_sod_project_tpu.configs import config_from_dict
-
-        sidecar = os.path.join(args.ckpt_dir, "config.json")
-        if not os.path.exists(sidecar):
-            raise SystemExit(
-                f"--config not given and {sidecar} missing — pass "
-                "--config explicitly")
-        with open(sidecar) as f:
-            cfg = config_from_dict(json.load(f))
-    cfg = apply_overrides(cfg, args.overrides)
+    cfg, model, state = restore_for_eval(
+        args.ckpt_dir, config_name=args.config, overrides=args.overrides,
+        step=args.step)
 
     # Named test sets: ["duts_te=/data/DUTS-TE", ...]; default config set.
     datasets = None
@@ -81,23 +67,6 @@ def main(argv=None):
             name = name or os.path.basename(path.rstrip("/")) or "test"
             datasets[name] = resolve_dataset(
                 dataclasses.replace(cfg.data, root=path))
-
-    model = build_model(cfg.model)
-    tx, _ = build_optimizer(cfg.optim, 1)
-    ds0 = next(iter(datasets.values())) if datasets else resolve_dataset(cfg.data)
-    sample = ds0[0]
-    import numpy as np
-
-    batch = {k: np.asarray(v)[None] for k, v in sample.items()
-             if k in ("image", "depth")}
-    # Template must match the training-time state tree: an EMA run's
-    # checkpoint has ema_params, and orbax restores by template shape.
-    template = create_train_state(jax.random.key(0), model, tx, batch,
-                                  ema=cfg.optim.ema_decay > 0)
-
-    mgr = CheckpointManager(args.ckpt_dir, async_save=False)
-    state = mgr.restore(template, step=args.step)
-    mgr.close()
 
     from distributed_sod_project_tpu.parallel.mesh import make_mesh
     from distributed_sod_project_tpu.utils.platform import (

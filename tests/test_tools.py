@@ -63,3 +63,49 @@ def test_plot_curves_partial_entries(tmp_path):
     assert rc == 0
     assert (tmp_path / "f" / "emeasure_curve.png").exists()
     assert not (tmp_path / "f" / "pr_curve.png").exists()
+
+
+def test_predict_cli_writes_original_size_maps(tmp_path, eight_devices):
+    """tools/predict.py: checkpoint (config sidecar) → saliency PNGs at
+    each input's ORIGINAL resolution, batch padding included (3 images,
+    batch 2)."""
+    import numpy as np
+    from PIL import Image
+
+    import predict
+    from distributed_sod_project_tpu.configs.base import (
+        DataConfig, MeshConfig, ModelConfig, OptimConfig)
+    from distributed_sod_project_tpu.configs import get_config
+    from distributed_sod_project_tpu.train.loop import fit
+
+    cfg = get_config("minet_vgg16_ref").replace(
+        data=DataConfig(dataset="synthetic", image_size=(32, 32),
+                        synthetic_size=8, num_workers=0),
+        model=ModelConfig(name="minet", backbone="vgg16", sync_bn=True,
+                          compute_dtype="float32"),
+        optim=OptimConfig(lr=0.01),
+        mesh=MeshConfig(data=-1),
+        global_batch_size=8,
+        checkpoint_every_steps=1,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    fit(cfg, max_steps=1)
+
+    imgs = tmp_path / "imgs"
+    imgs.mkdir()
+    sizes = [(40, 30), (64, 48), (32, 32)]  # (W, H) PIL order
+    rng = np.random.RandomState(0)
+    for i, wh in enumerate(sizes):
+        Image.fromarray(rng.randint(0, 255, (wh[1], wh[0], 3), np.uint8)
+                        ).save(imgs / f"im{i}.jpg")
+
+    out = tmp_path / "preds"
+    rc = predict.main(["--ckpt-dir", str(tmp_path / "ck"),
+                       "--input", str(imgs), "--output", str(out),
+                       "--batch-size", "2"])
+    assert rc == 0
+    for i, wh in enumerate(sizes):
+        with Image.open(out / f"im{i}.png") as im:
+            assert im.size == wh and im.mode == "L"
+            arr = np.asarray(im)
+        assert arr.min() >= 0 and arr.max() <= 255

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Saliency prediction on arbitrary images — no masks, no metrics.
+
+    python tools/predict.py --ckpt-dir runs/minet --input photo.jpg
+    python tools/predict.py --ckpt-dir runs/minet --input photos/ \
+        --output preds/ --device tpu
+
+The quick-inference surface of the reference's test path (SURVEY.md
+§3.2) without its dataset/GT machinery: restore a checkpoint (config
+sidecar aware, via ``eval.inference.restore_for_eval``), resize each
+image to the model's static eval shape, run the shared compiled forward
+(``eval.inference.make_forward``) in fixed-size batches, resize the
+sigmoid map back to the original resolution, and write ``<stem>.png``
+greyscale saliency maps.
+
+RGB-D models (HDFNet) take ``--depth``: a single depth image, or a
+directory whose files pair with ``--input`` by stem.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ckpt-dir", required=True,
+                   help="checkpoint directory written by train.py")
+    p.add_argument("--config", default=None,
+                   help="registered config name (default: the "
+                        "checkpoint's config.json sidecar)")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: newest)")
+    p.add_argument("--input", required=True,
+                   help="an image file, or a directory of images")
+    p.add_argument("--depth", default=None,
+                   help="depth image file/directory (RGB-D models)")
+    p.add_argument("--output", default="predictions",
+                   help="output directory for saliency PNGs")
+    p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="PATH=VALUE", help="dotted config override")
+    return p.parse_args(argv)
+
+
+def _list_images(path: str, flag: str = "--input"):
+    if os.path.isfile(path):
+        return [path]
+    if not os.path.isdir(path):
+        raise SystemExit(f"{flag} {path!r} is neither a file nor a directory")
+    files = [os.path.join(path, f) for f in sorted(os.listdir(path))
+             if f.lower().endswith(_EXTS)]
+    if not files:
+        raise SystemExit(f"no images ({'/'.join(_EXTS)}) under {path!r}")
+    return files
+
+
+def _match_depth(depth_arg: str, image_files):
+    """One depth file per image, paired by filename stem; ambiguous
+    stems (two candidate depth files) are an error, not a guess."""
+    if os.path.isfile(depth_arg):
+        if len(image_files) != 1:
+            raise SystemExit("--depth is a single file but --input has "
+                             f"{len(image_files)} images")
+        return [depth_arg]
+    candidates = _list_images(depth_arg, flag="--depth")
+    by_stem = {}
+    for f in candidates:
+        stem = os.path.splitext(os.path.basename(f))[0]
+        if stem in by_stem:
+            raise SystemExit(
+                f"ambiguous depth for stem {stem!r}: "
+                f"{by_stem[stem]!r} vs {f!r}")
+        by_stem[stem] = f
+    out = []
+    for img in image_files:
+        stem = os.path.splitext(os.path.basename(img))[0]
+        if stem not in by_stem:
+            raise SystemExit(f"no depth image for {stem!r} in {depth_arg!r}")
+        out.append(by_stem[stem])
+    return out
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    from distributed_sod_project_tpu.utils.platform import select_platform
+
+    select_platform(args.device)
+
+    import numpy as np
+    from PIL import Image
+
+    from distributed_sod_project_tpu.eval.inference import (
+        make_forward, pad_to_batch, restore_for_eval)
+    from distributed_sod_project_tpu.utils.platform import (
+        maybe_enable_compilation_cache)
+
+    images = _list_images(args.input)
+    cfg, model, state = restore_for_eval(
+        args.ckpt_dir, config_name=args.config, overrides=args.overrides,
+        step=args.step)
+    depths = None
+    if cfg.data.use_depth:
+        if not args.depth:
+            raise SystemExit(
+                f"model {cfg.model.name!r} is RGB-D — pass --depth")
+        depths = _match_depth(args.depth, images)
+
+    h, w = cfg.data.image_size
+    mean = np.asarray(cfg.data.normalize_mean, np.float32)
+    std = np.asarray(cfg.data.normalize_std, np.float32)
+
+    def load(path, gray):
+        with Image.open(path) as im:
+            orig = im.size[::-1]  # (H, W)
+            im = im.convert("L" if gray else "RGB").resize(
+                (w, h), Image.BILINEAR)
+            arr = np.asarray(im, np.float32) / 255.0
+        return (arr[..., None] if gray else (arr - mean) / std), orig
+
+    maybe_enable_compilation_cache()
+    variables = state.eval_variables()
+    forward = make_forward(model)
+
+    os.makedirs(args.output, exist_ok=True)
+    bs = max(1, args.batch_size)
+    written = []
+    for lo in range(0, len(images), bs):
+        chunk = images[lo:lo + bs]
+        loaded = [load(p, gray=False) for p in chunk]
+        batch = {"image": np.stack([x for x, _ in loaded])}
+        if depths is not None:
+            batch["depth"] = np.stack(
+                [load(p, gray=True)[0] for p in depths[lo:lo + bs]])
+        batch = pad_to_batch(batch, bs)  # ONE compiled (static) shape
+        probs = np.asarray(forward(variables, batch))[: len(chunk)]
+        for (path, (_, orig)), pred in zip(zip(chunk, loaded), probs):
+            out_im = Image.fromarray(
+                (np.clip(pred, 0, 1) * 255).astype(np.uint8))
+            if out_im.size != (orig[1], orig[0]):
+                out_im = out_im.resize((orig[1], orig[0]), Image.BILINEAR)
+            stem = os.path.splitext(os.path.basename(path))[0]
+            out_path = os.path.join(args.output, f"{stem}.png")
+            out_im.save(out_path)
+            written.append(out_path)
+    print(json.dumps({"images": len(written), "output": args.output,
+                      "step": int(state.step)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
