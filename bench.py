@@ -26,9 +26,13 @@ def main(argv=None):
     p.add_argument("--batch-per-chip", type=int, default=8)
     p.add_argument("--image-size", type=int, default=320)
     p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
-    p.add_argument("--mode", default="train", choices=["train", "eval"],
+    p.add_argument("--mode", default="train",
+                   choices=["train", "eval", "data"],
                    help="train: full DP step (default); eval: forward-only "
-                        "sigmoid inference, the test.py hot loop")
+                        "sigmoid inference (the test.py hot loop); data: "
+                        "host input pipeline only — no device work, batch "
+                        "is --batch-per-chip as-is (select the backend "
+                        "with --set data.backend=host|tfdata|grain)")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="PATH=VALUE",
                    help="dotted config override, e.g. --set "
@@ -42,11 +46,27 @@ def main(argv=None):
 
     select_platform(args.device)
 
+    from distributed_sod_project_tpu.configs import apply_overrides, get_config
+
+    hw = args.image_size
+
+    if args.mode == "data":
+        # Pure host path: never touch a jax backend (device_count would
+        # dial the TPU transport for nothing).
+        batch = args.batch_per_chip
+        cfg = get_config(args.config)
+        cfg = apply_overrides(
+            cfg, [f"global_batch_size={batch}",
+                  f"data.image_size={hw},{hw}"] + list(args.overrides))
+        dt = _bench_data(cfg, batch, args.steps, args.warmup)
+        _report(args, batch * args.steps / dt, "cpu", 1,
+                mode=f"data[{cfg.data.backend}]")
+        return 0
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from distributed_sod_project_tpu.configs import apply_overrides, get_config
     from distributed_sod_project_tpu.models import build_model
     from distributed_sod_project_tpu.parallel.mesh import (
         batch_sharding, make_mesh, replicated_sharding)
@@ -55,7 +75,6 @@ def main(argv=None):
 
     n_chips = jax.device_count()
     batch = args.batch_per_chip * n_chips
-    hw = args.image_size
 
     cfg = get_config(args.config)
     cfg = apply_overrides(cfg, [f"global_batch_size={batch}"]
@@ -74,6 +93,13 @@ def main(argv=None):
         host_batch["depth"] = rng.randn(batch, hw, hw, 1).astype(np.float32)
 
     state = create_train_state(jax.random.key(0), model, tx, host_batch)
+    if args.mode == "eval":
+        # Forward-only: ship just the eval variables, not the optimizer
+        # slots (3-4x the param bytes replicated onto every chip).
+        from distributed_sod_project_tpu.train.state import TrainState
+
+        state = TrainState(step=state.step, params=state.params,
+                           batch_stats=state.batch_stats, opt_state=())
     state = jax.device_put(state, replicated_sharding(mesh))
     dev_batch = jax.device_put(host_batch, batch_sharding(mesh))
 
@@ -125,14 +151,57 @@ def main(argv=None):
     if args.profile_dir:
         jax.profiler.stop_trace()
 
-    imgs_per_sec = batch * args.steps / dt
-    per_chip = imgs_per_sec / n_chips
+    _report(args, batch * args.steps / dt, jax.devices()[0].platform,
+            n_chips)
+    return 0
 
+
+def _bench_data(cfg, batch: int, steps: int, warmup: int) -> float:
+    """Time the host input pipeline alone: seconds to produce ``steps``
+    batches (epochs cycled as needed) on the configured backend."""
+    import itertools
+
+    from distributed_sod_project_tpu.data import resolve_dataset
+    from distributed_sod_project_tpu.data.tfdata import make_loader
+
+    dataset = resolve_dataset(cfg.data)
+    loader = make_loader(
+        dataset, cfg.data, global_batch_size=batch, shard_id=0,
+        num_shards=1, shuffle=True, seed=cfg.seed, hflip=cfg.data.hflip,
+        rotate_degrees=cfg.data.rotate_degrees,
+        num_workers=cfg.data.num_workers)
+
+    if loader.steps_per_epoch <= 0:
+        raise SystemExit(
+            f"global batch {batch} > dataset size {len(dataset)}: the "
+            "loader yields zero batches per epoch (drop_last) — shrink "
+            "--batch-per-chip or grow data.synthetic_size")
+
+    def batches():
+        for epoch in itertools.count():
+            loader.set_epoch(epoch)
+            yield from iter(loader)
+
+    it = batches()
+    for _ in range(warmup):
+        next(it)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        next(it)
+    return time.perf_counter() - t0
+
+
+def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
+            mode: str | None = None) -> None:
+    """One JSON line + self-relative baseline tracking (the first run
+    per (config, size, platform, mode) seeds ``bench_baseline.json``)."""
+    mode = mode or args.mode
+    per_chip = imgs_per_sec / n_chips
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
-    key = f"{args.config}-{hw}-{jax.devices()[0].platform}"
-    if args.mode != "train":
-        key += f"-{args.mode}"
+    key = f"{args.config}-{args.image_size}-{platform}"
+    if mode != "train":
+        key += f"-{mode}"
     base = {}
     if os.path.exists(base_path):
         with open(base_path) as f:
@@ -144,13 +213,12 @@ def main(argv=None):
     vs = per_chip / base[key] if base[key] else 1.0
 
     print(json.dumps({
-        "metric": f"{args.mode}_throughput[{args.config}@{hw}px,"
-                  f"{jax.devices()[0].platform}x{n_chips}]",
+        "metric": f"{mode}_throughput[{args.config}@"
+                  f"{args.image_size}px,{platform}x{n_chips}]",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
     }))
-    return 0
 
 
 if __name__ == "__main__":

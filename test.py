@@ -69,10 +69,7 @@ def main(argv=None):
                 dataclasses.replace(cfg.data, root=path))
 
     from distributed_sod_project_tpu.parallel.mesh import make_mesh
-    from distributed_sod_project_tpu.utils.platform import (
-        maybe_enable_compilation_cache)
 
-    maybe_enable_compilation_cache()
     # All local chips share every eval batch (data-sharded forward).
     mesh = make_mesh(cfg.mesh) if jax.device_count() > 1 else None
     results = evaluate(cfg, state, model=model, mesh=mesh, datasets=datasets,

@@ -1,0 +1,30 @@
+"""bench.py CLI: the data-mode path (device modes are exercised against
+real hardware; data mode is pure host and cheap enough for CI)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_bench_data_mode_prints_one_json_line(tmp_path, capsys, monkeypatch):
+    import bench
+
+    # Keep the baseline side file out of the repo root.
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(
+        bench, "__file__", str(tmp_path / "bench.py"), raising=False)
+
+    rc = bench.main([
+        "--device", "cpu", "--mode", "data", "--steps", "4", "--warmup",
+        "1", "--batch-per-chip", "4", "--image-size", "32",
+        "--set", "data.synthetic_size=16", "--set", "data.num_workers=0",
+    ])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["unit"] == "images/sec/chip"
+    assert out["value"] > 0
+    assert "data[host]_throughput" in out["metric"]
+    assert (tmp_path / "bench_baseline.json").exists()

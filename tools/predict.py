@@ -99,8 +99,6 @@ def main(argv=None):
 
     from distributed_sod_project_tpu.eval.inference import (
         make_forward, pad_to_batch, restore_for_eval)
-    from distributed_sod_project_tpu.utils.platform import (
-        maybe_enable_compilation_cache)
 
     images = _list_images(args.input)
     cfg, model, state = restore_for_eval(
@@ -125,7 +123,6 @@ def main(argv=None):
             arr = np.asarray(im, np.float32) / 255.0
         return (arr[..., None] if gray else (arr - mean) / std), orig
 
-    maybe_enable_compilation_cache()
     variables = state.eval_variables()
     forward = make_forward(model)
 
