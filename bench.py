@@ -197,8 +197,9 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
     per (config, size, platform, mode) seeds ``bench_baseline.json``)."""
     mode = mode or args.mode
     per_chip = imgs_per_sec / n_chips
-    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "bench_baseline.json")
+    base_path = (os.environ.get("DSOD_BENCH_BASELINE")
+                 or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_baseline.json"))
     key = f"{args.config}-{args.image_size}-{platform}"
     if mode != "train":
         key += f"-{mode}"

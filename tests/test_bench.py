@@ -11,10 +11,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def test_bench_data_mode_prints_one_json_line(tmp_path, capsys, monkeypatch):
     import bench
 
-    # Keep the baseline side file out of the repo root.
-    monkeypatch.chdir(tmp_path)
-    monkeypatch.setattr(
-        bench, "__file__", str(tmp_path / "bench.py"), raising=False)
+    # DSOD_BENCH_BASELINE keeps the baseline side file out of the repo.
+    monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
 
     rc = bench.main([
         "--device", "cpu", "--mode", "data", "--steps", "4", "--warmup",
@@ -27,4 +25,45 @@ def test_bench_data_mode_prints_one_json_line(tmp_path, capsys, monkeypatch):
     assert out["unit"] == "images/sec/chip"
     assert out["value"] > 0
     assert "data[host]_throughput" in out["metric"]
-    assert (tmp_path / "bench_baseline.json").exists()
+    assert (tmp_path / "base.json").exists()
+
+
+def test_bench_zoo_renders_table(tmp_path, capsys, monkeypatch):
+    """tools/bench_zoo.py: one subprocess per (config, mode) → markdown
+    table; data-mode only (no model compile) keeps this CI-cheap.  The
+    env var propagates into the subprocess, isolating the baseline."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import bench_zoo
+
+    monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
+    out = tmp_path / "zoo.md"
+    rc = bench_zoo.main([
+        "--device", "cpu", "--configs", "minet_vgg16_ref", "--modes",
+        "data", "--steps", "2", "--warmup", "1", "--batch-per-chip", "2",
+        "--image-size", "32", "--set", "data.synthetic_size=8",
+        "--set", "data.num_workers=0", "--out", str(out),
+    ])
+    assert rc == 0
+    text = out.read_text()
+    assert "| minet_vgg16_ref |" in text and "ERR" not in text
+    assert "| minet_vgg16_ref |" in capsys.readouterr().out
+    assert (tmp_path / "base.json").exists()
+
+
+def test_bench_zoo_unknown_config_is_visible_error(tmp_path, monkeypatch):
+    """A typo'd --configs name must surface as an ERR row + exit 1,
+    never a silently dropped row."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import bench_zoo
+
+    monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
+    out = tmp_path / "zoo.md"
+    rc = bench_zoo.main([
+        "--device", "cpu", "--configs", "mynet_typo", "--modes", "data",
+        "--steps", "1", "--warmup", "0", "--batch-per-chip", "2",
+        "--image-size", "32", "--out", str(out),
+    ])
+    assert rc == 1
+    assert "ERR" in out.read_text()
