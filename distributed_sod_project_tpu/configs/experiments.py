@@ -104,3 +104,21 @@ def swin_sod() -> ExperimentConfig:
         global_batch_size=16,
         mesh=MeshConfig(data=-1, model=1, seq=1),
     )
+
+
+@register_config("vit_sod_sp")
+def vit_sod_sp() -> ExperimentConfig:
+    """Long-context member: global-attention ViT-SOD, trainable with
+    the sequence-parallel step (--set mesh.seq=N shards image rows /
+    token blocks over N devices; ring attention crosses them).  SSIM is
+    off — it does not decompose over row blocks (parallel/sp.py)."""
+    return ExperimentConfig(
+        name="vit_sod_sp",
+        data=DataConfig(dataset="duts", image_size=(320, 320)),
+        model=ModelConfig(name="vit_sod", backbone="small", sync_bn=False),
+        loss=LossConfig(bce=1.0, iou=1.0, ssim=0.0),
+        optim=OptimConfig(optimizer="adamw", lr=3e-4, weight_decay=0.01,
+                          warmup_steps=500),
+        global_batch_size=16,
+        mesh=MeshConfig(data=-1, model=1, seq=1),
+    )

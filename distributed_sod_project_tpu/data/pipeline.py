@@ -163,7 +163,7 @@ class HostDataLoader:
 
 
 def prefetch_to_device(iterator, size: int = 2, sharding=None, mesh=None,
-                       transfer_dtype=None, drop_keys=()):
+                       transfer_dtype=None, drop_keys=(), spec=None):
     """Wrap a host batch iterator with a background thread that stages
     batches onto device ahead of consumption (H2D overlap, the TPU
     analogue of the reference's pinned-memory ``non_blocking`` H2D copies
@@ -217,7 +217,7 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None, mesh=None,
                 if mesh is not None:
                     from ..parallel.mesh import global_batch_array
 
-                    batch = global_batch_array(batch, mesh)
+                    batch = global_batch_array(batch, mesh, spec=spec)
                 elif sharding is not None:
                     batch = jax.device_put(batch, sharding)
                 else:

@@ -234,17 +234,19 @@ def evaluate(
     variables = (state.eval_variables() if hasattr(state, "eval_variables")
                  else state.variables())
     if mesh is not None:
-        from ..parallel.mesh import (batch_sharding, replicated_sharding)
+        from ..parallel.mesh import (eval_batch_divisor,
+                                     eval_batch_sharding,
+                                     replicated_sharding)
 
-        n_data = mesh.shape.get("data", 1)
-        bs = max(1, bs // n_data) * n_data  # divisible by the data axis
+        div = eval_batch_divisor(mesh)  # batch over flattened (data, seq)
+        bs = max(1, bs // div) * div
         variables = jax.device_put(variables, replicated_sharding(mesh))
 
     _apply = make_forward(model)
 
     def forward(batch):
         if mesh is not None:
-            batch = jax.device_put(batch, batch_sharding(mesh))
+            batch = jax.device_put(batch, eval_batch_sharding(mesh))
         return _apply(variables, batch)
 
     results = {}

@@ -101,6 +101,21 @@ def _build_swin_sod(cfg, *, dtype, param_dtype, axis_name):
     )
 
 
+@register_model("vit_sod")
+def _build_vit_sod(cfg, *, dtype, param_dtype, axis_name):
+    from .vit_sod import PRESETS, ViTSOD
+
+    if axis_name is not None:
+        raise ValueError("vit_sod has no BatchNorm: set model.sync_bn=false")
+    if cfg.backbone not in PRESETS:
+        raise ValueError(
+            f"vit_sod backbone must be one of {sorted(PRESETS)} "
+            f"(encoder preset), got {cfg.backbone!r}")
+    dim, depth, heads = PRESETS[cfg.backbone]
+    return ViTSOD(dim=dim, depth=depth, heads=heads,
+                  dtype=dtype, param_dtype=param_dtype)
+
+
 @register_model("hdfnet")
 def _build_hdfnet(cfg, *, dtype, param_dtype, axis_name):
     from .hdfnet import HDFNet

@@ -1,0 +1,143 @@
+"""ViT-SOD — the long-context zoo member (SURVEY.md §5 "long-context").
+
+A plain-ViT encoder with GLOBAL attention over every patch token and a
+per-token unpatchify head.  Unlike the CNN zoo (and Swin's windowed
+attention), its attention cost grows quadratically with resolution —
+this is the model whose training genuinely needs sequence parallelism,
+and its architecture is chosen so SP is EXACT:
+
+- ``patchify`` is a stride-``patch`` convolution with kernel ==
+  stride: patches are disjoint tiles, so a block of patch ROWS of the
+  image maps to a block of tokens with no cross-device halo.
+- LayerNorm / MLP / the linear unpatchify head are per-token.
+- Attention is the ONLY cross-token op; under sequence parallelism it
+  is computed exactly by ``parallel.ring_attention`` (K/V blocks on a
+  ``lax.ppermute`` ring), injected via the ``attn_fn`` call argument.
+- No BatchNorm → no cross-replica stat plumbing in the SP step.
+
+So the whole forward/backward decomposes over token blocks: each
+``seq`` device runs this module on its slice of image rows with
+``pos_row_offset`` pointing into the shared positional table
+(``parallel/sp.py`` builds that step).  Run on the full image with the
+default ``attn_fn`` (single-device softmax), the math is identical —
+eval/test/predict need no special casing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..parallel.ring_attention import full_attention
+
+
+class _Block(nn.Module):
+    """Pre-LN transformer block; attention core injected per call."""
+
+    dim: int
+    heads: int
+    mlp_ratio: int
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x, attn_fn: Callable, *, train: bool):
+        b, n, d = x.shape
+        h = self.heads
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+
+        y = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)(x)
+        qkv = nn.Dense(3 * d, **kw)(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # [B, N, D] -> heads-major [B, H, N, D/H] (ring_attention layout).
+        def split_heads(t):
+            return t.reshape(b, n, h, d // h).transpose(0, 2, 1, 3)
+
+        out = attn_fn(split_heads(q), split_heads(k), split_heads(v))
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, d)
+        x = x + nn.Dense(d, **kw)(out)
+
+        y = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)(x)
+        y = nn.Dense(self.mlp_ratio * d, **kw)(y)
+        y = nn.gelu(y)
+        x = x + nn.Dense(d, **kw)(y)
+        return x
+
+
+class ViTSOD(nn.Module):
+    """Global-attention SOD.  Returns ``[logit]`` ([B,H,W,1], f32).
+
+    ``full_grid``: the FULL image's (patch_rows, patch_cols).  Defaults
+    to this call's image — pass it when the image argument is a row
+    SLICE of a larger image (sequence parallelism), together with
+    ``pos_row_offset`` (this slice's first patch row, may be traced)
+    and an ``attn_fn`` that performs global attention across devices.
+    """
+
+    patch: int = 16
+    dim: int = 384
+    depth: int = 8
+    heads: int = 6
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, image, depth=None, *, train: bool = False,
+                 attn_fn: Optional[Callable] = None,
+                 full_grid: Optional[tuple] = None,
+                 pos_row_offset=0) -> List[jnp.ndarray]:
+        del depth  # RGB-only member; uniform zoo signature
+        attn_fn = attn_fn or full_attention
+        x = image.astype(self.dtype)
+        b, hh, ww, _ = x.shape
+        p = self.patch
+        if hh % p or ww % p:
+            raise ValueError(f"image {hh}x{ww} not divisible by patch {p}")
+        rows, cols = hh // p, ww // p
+        grid = tuple(full_grid) if full_grid is not None else (rows, cols)
+
+        # Disjoint-tile patchify: kernel == stride == patch.
+        x = nn.Conv(self.dim, (p, p), strides=(p, p), dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="patch_embed")(x)
+        x = x.reshape(b, rows * cols, self.dim)
+
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.truncated_normal(0.02),
+            (grid[0] * grid[1], self.dim), self.param_dtype)
+        # This call's token window of the full positional table: row
+        # offset may be a traced per-device index (SP), so slice
+        # dynamically; cols always span the full width.
+        start = jnp.asarray(pos_row_offset, jnp.int32) * grid[1]
+        from jax import lax
+
+        pos_win = lax.dynamic_slice_in_dim(pos, start, rows * cols, axis=0)
+        x = x + pos_win[None].astype(self.dtype)
+
+        for i in range(self.depth):
+            x = _Block(dim=self.dim, heads=self.heads,
+                       mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+                       param_dtype=self.param_dtype, name=f"block{i}")(
+                           x, attn_fn, train=train)
+
+        x = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)(x)
+        # Per-token unpatchify head: D -> p*p logits, tiled back.
+        logit = nn.Dense(p * p, dtype=jnp.float32,
+                         param_dtype=self.param_dtype, name="head")(x)
+        logit = logit.reshape(b, rows, cols, p, p)
+        logit = logit.transpose(0, 1, 3, 2, 4).reshape(b, hh, ww, 1)
+        return [logit.astype(jnp.float32)]
+
+
+PRESETS = {
+    # name: (dim, depth, heads) — ViT-S-ish default keeps the 320px
+    # quadratic-attention model trainable on one chip; "base" is the
+    # scale-out variant for SP.
+    "none": (384, 8, 6),
+    "small": (384, 8, 6),
+    "base": (768, 12, 12),
+}

@@ -8,6 +8,7 @@ from .mesh import (
     host_shard,
     global_batch_array,
 )
+from .sp import make_sp_train_step, sp_batch_sharding
 from .tp import (
     SWIN_TP_RULES,
     make_tp_train_step,
@@ -25,6 +26,8 @@ __all__ = [
     "replicated_sharding",
     "host_shard",
     "global_batch_array",
+    "make_sp_train_step",
+    "sp_batch_sharding",
     "SWIN_TP_RULES",
     "make_tp_train_step",
     "param_partition_specs",

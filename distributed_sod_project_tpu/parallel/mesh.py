@@ -89,6 +89,22 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, batch_spec())
 
 
+def eval_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Eval-forward batch sharding: the batch dim shards over the
+    flattened (data, seq) axes so sequence-parallel meshes share eval
+    work across every chip instead of replicating it per seq group.
+    The ``model`` axis is left out — TP evals keep it for the weight
+    sharding.  Equals ``batch_sharding`` on pure-DP meshes."""
+    axes = tuple(a for a in ("data", "seq") if mesh.shape.get(a, 1) > 1)
+    return NamedSharding(mesh, P(axes or ("data",)))
+
+
+def eval_batch_divisor(mesh: Mesh) -> int:
+    """Round eval batch sizes to a multiple of this so the eval
+    sharding divides evenly."""
+    return int(np.prod([mesh.shape.get(a, 1) for a in ("data", "seq")]))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, replicated_spec())
 
@@ -100,12 +116,14 @@ def host_shard() -> Tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 
-def global_batch_array(batch, mesh: Mesh):
+def global_batch_array(batch, mesh: Mesh, spec: Optional[P] = None):
     """Assemble per-host numpy batches into global batch-sharded
     ``jax.Array``s (multi-host: each host contributes its slice via
     ``make_array_from_process_local_data``; single-host this is just a
-    sharded device_put)."""
-    sharding = batch_sharding(mesh)
+    sharded device_put).  ``spec`` overrides the default batch-only
+    sharding (e.g. ``P('data', 'seq')`` for sequence parallelism)."""
+    sharding = (NamedSharding(mesh, spec) if spec is not None
+                else batch_sharding(mesh))
     return jax.tree_util.tree_map(
         lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
         batch,

@@ -1,0 +1,160 @@
+"""Sequence-parallel training step (SURVEY.md §5 "long-context").
+
+The reference has no sequence axis to scale (fixed 320×320 CNNs); this
+is the TPU build's long-context path: ``vit_sod``'s global attention is
+quadratic in tokens, so past single-chip memory/FLOPs the token dim
+must shard.  Layout (the ``seq`` mesh axis):
+
+- every batch leaf is sharded ``P('data', 'seq')``: batch over
+  ``data``, image ROWS over ``seq`` — patch rows map 1:1 to token
+  blocks because the model's patchify is halo-free (models/vit_sod.py),
+- each device runs the FULL module (patchify → blocks → head) on its
+  row slice, with ``parallel.ring_attention`` as the attention core —
+  the ppermute ring is the only cross-device traffic in the forward,
+- the loss decomposes exactly: BCE pixel sums and the IoU/CEL
+  per-image region sums are computed locally and ``psum``-ed over
+  ``seq`` BEFORE the ratios, so the objective equals the single-device
+  one to numerics (tests assert grad equivalence),
+- gradients: every device's autodiff yields its token block's
+  contribution, so the true gradient is ``psum`` over ``seq`` and
+  ``pmean`` over ``data`` (DP semantics on the batch axis).
+
+SSIM is the one loss term that does NOT decompose over row blocks (its
+11×11 windows straddle block edges); configs with ``loss.ssim > 0`` are
+rejected rather than silently approximated.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..train.state import TrainState
+from ..train.step import apply_update, notfinite_count
+from .ring_attention import ring_attention
+
+
+def sp_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch over ``data``, image rows (dim 1) over ``seq``."""
+    return NamedSharding(mesh, P("data", "seq"))
+
+
+def _sp_hybrid_loss(logits, mask, *, bce_w, iou_w, cel_w,
+                    iou_eps=1.0, cel_eps=1e-6, axis="seq"):
+    """BCE + IoU + CEL over row-sharded logits/mask — exact: sufficient
+    statistics psum over the ``seq`` axis before any ratio/mean."""
+    x = logits.astype(jnp.float32).reshape(logits.shape[0], -1)
+    t = mask.astype(jnp.float32).reshape(mask.shape[0], -1)
+    bce_i = jnp.sum(jnp.maximum(x, 0.0) - x * t
+                    + jnp.log1p(jnp.exp(-jnp.abs(x))), axis=-1)
+    p = jax.nn.sigmoid(x)
+    inter_i = jnp.sum(p * t, axis=-1)
+    psum_i = jnp.sum(p, axis=-1)
+    tsum_i = jnp.sum(t, axis=-1)
+    # Global per-image sums: this device's rows + everyone else's.
+    bce_i, inter_i, psum_i, tsum_i = lax.psum(
+        (bce_i, inter_i, psum_i, tsum_i), axis)
+    n_pix_total = x.shape[1] * lax.axis_size(axis)
+
+    comps: Dict[str, jnp.ndarray] = {}
+    total = jnp.float32(0.0)
+    if bce_w:
+        comps["bce"] = bce_i.mean() / n_pix_total
+        total += bce_w * comps["bce"]
+    if iou_w:
+        union = psum_i + tsum_i - inter_i
+        comps["iou"] = jnp.mean(
+            1.0 - (inter_i + iou_eps) / (union + iou_eps))
+        total += iou_w * comps["iou"]
+    if cel_w:
+        tot = psum_i + tsum_i
+        comps["cel"] = jnp.mean((tot - 2.0 * inter_i) / (tot + cel_eps))
+        total += cel_w * comps["cel"]
+    comps["total"] = total
+    return total, comps
+
+
+def make_sp_train_step(
+    model,
+    loss_cfg,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    schedule: Optional[optax.Schedule] = None,
+    donate: bool = True,
+    ema_decay: float = 0.0,
+    donate_batch: bool = False,
+) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
+              Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Build the sequence-parallel ``(state, batch) -> (state, metrics)``.
+
+    Contract: ``state`` replicated; batch leaves ``P('data', 'seq')``
+    (global shapes; each device sees its (batch, rows) tile).  The
+    model must be halo-free over rows with an injectable attention
+    core (``vit_sod``).
+    """
+    if getattr(loss_cfg, "ssim", 0.0):
+        raise ValueError(
+            "loss.ssim does not decompose over the seq axis (11x11 "
+            "windows straddle row-block edges) — set loss.ssim=0 for "
+            "sequence-parallel training")
+    seq = mesh.shape["seq"]
+
+    def step_fn(state: TrainState, batch):
+        rng = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), state.step),
+            lax.axis_index("data") * seq + lax.axis_index("seq"))
+        image, mask = batch["image"], batch["mask"]
+        local_rows = image.shape[1] // model.patch
+        row_off = lax.axis_index("seq") * local_rows
+        full_grid = (local_rows * seq, image.shape[2] // model.patch)
+
+        def loss_fn(params):
+            outs = model.apply(
+                {"params": params}, image, None, train=True,
+                attn_fn=partial(ring_attention, axis_name="seq"),
+                full_grid=full_grid, pos_row_offset=row_off,
+                rngs={"dropout": rng})
+            return _sp_hybrid_loss(
+                outs[0], mask, bce_w=loss_cfg.bce, iou_w=loss_cfg.iou,
+                cel_w=loss_cfg.cel)
+
+        grads, comps = jax.grad(loss_fn, has_aux=True)(state.params)
+        # The true grad is the SUM of per-token-block contributions
+        # over ``seq`` — but under shard_map the loss's psum'd
+        # statistics transpose back as psum (no replication tracking,
+        # check_vma=False), so each device's autodiff already carries
+        # an extra ``seq`` factor on its block contribution.  pmean
+        # over ``seq`` therefore recovers exactly that sum; ``data`` is
+        # the usual DP mean.  Grad equivalence vs a single-device step
+        # is asserted to numerics in tests/test_vit_sod.py.
+        grads = lax.pmean(grads, ("data", "seq"))
+        comps = lax.pmean(comps, "data")  # already seq-global
+
+        new_state = apply_update(state, grads, state.batch_stats, tx,
+                                 ema_decay=ema_decay)
+        metrics = dict(comps)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        nfc = notfinite_count(new_state.opt_state)
+        if nfc is not None:
+            metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)
+        if schedule is not None:
+            metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        step_fn,
+        mesh=mesh,
+        in_specs=(P(), P("data", "seq")),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    donated = (0,) if donate else ()
+    if donate_batch:
+        donated = donated + (1,)
+    return jax.jit(sharded, donate_argnums=donated)

@@ -56,10 +56,13 @@ def fit(
 
     mesh = make_mesh(cfg.mesh)
     n_dev = mesh.devices.size
-    if cfg.global_batch_size % n_dev:
+    # The batch dim only shards over ``data`` (model/seq shard other
+    # dims), so that is the divisibility requirement.
+    data_size = mesh.shape.get("data", n_dev)
+    if cfg.global_batch_size % data_size:
         raise ValueError(
             f"global_batch_size={cfg.global_batch_size} not divisible by "
-            f"mesh size {n_dev}")
+            f"the data mesh axis ({data_size})")
 
     from ..data.tfdata import make_loader
 
@@ -122,10 +125,43 @@ def fit(
             log.info("resumed from checkpoint step %d", start_step)
 
     # Step builder: shard_map DP step for the CNN zoo (named-axis
-    # SyncBN), or the GSPMD step when the mesh has a tensor-parallel
-    # axis and/or ZeRO-1 weight-update sharding is on.
+    # SyncBN), the GSPMD step when the mesh has a tensor-parallel axis
+    # and/or ZeRO-1 is on, or the sequence-parallel step when ``seq``
+    # is sharded (ring attention over token blocks, vit_sod only).
     use_gspmd = mesh.shape.get("model", 1) > 1 or cfg.optim.zero1
-    if use_gspmd:
+    use_sp = mesh.shape.get("seq", 1) > 1
+    if use_sp:
+        from ..parallel.sp import make_sp_train_step
+
+        if use_gspmd:
+            raise ValueError(
+                "mesh.seq>1 cannot combine with mesh.model>1 / "
+                "optim.zero1 (pick one non-data axis per run)")
+        if cfg.model.sync_bn:
+            raise ValueError(
+                "sequence parallelism requires a BatchNorm-free model: "
+                "set model.sync_bn=false (use model.name=vit_sod)")
+        if not hasattr(model, "patch"):
+            raise ValueError(
+                f"model {cfg.model.name!r} does not support sequence "
+                "parallelism — only halo-free token models (vit_sod) "
+                "shard over mesh.seq")
+        if cfg.data.multiscale:
+            raise ValueError(
+                "data.multiscale is not supported with mesh.seq>1")
+        seq = mesh.shape["seq"]
+        rows = cfg.data.image_size[0] // model.patch
+        if cfg.data.image_size[0] % model.patch or rows % seq:
+            raise ValueError(
+                f"image height {cfg.data.image_size[0]} must be a "
+                f"multiple of patch*seq = {model.patch}*{seq}")
+        state = jax.device_put(state, replicated_sharding(mesh))
+
+        def step_factory(scale_hw):
+            return make_sp_train_step(
+                model, cfg.loss, tx, mesh, schedule=schedule,
+                ema_decay=cfg.optim.ema_decay, donate_batch=True)
+    elif use_gspmd:
         from ..parallel.tp import make_tp_train_step, shard_state
 
         if cfg.model.sync_bn:
@@ -161,6 +197,14 @@ def fit(
         for hw in dict.fromkeys(ms_cycle)
     }
     train_step_at = lambda i: step_for_size[ms_cycle[i % len(ms_cycle)]]  # noqa: E731
+
+    # SP shards image rows over ``seq`` in addition to batch over
+    # ``data``; every other path uses the default batch-only sharding.
+    batch_spec_override = None
+    if use_sp:
+        from jax.sharding import PartitionSpec as P
+
+        batch_spec_override = P("data", "seq")
 
     writer = MetricWriter(os.path.join(workdir, "tb")
                           if cfg.tensorboard else None)
@@ -210,7 +254,8 @@ def fit(
             it = prefetch_to_device(
                 iter(loader), size=cfg.data.prefetch_batches, mesh=mesh,
                 transfer_dtype=cfg.data.transfer_dtype,
-                drop_keys=("index",))
+                drop_keys=("index",),
+                spec=batch_spec_override)
             for batch in it:
                 if step >= total_steps or stop:
                     break
@@ -303,7 +348,7 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
 
     from ..eval import run_inference
     from ..eval.inference import make_forward
-    from ..parallel.mesh import batch_sharding
+    from ..parallel.mesh import eval_batch_divisor, eval_batch_sharding
 
     data_cfg = cfg.data
     if cfg.data.val_root:
@@ -314,15 +359,21 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
     # NOT retrace (same shapes), unlike a fresh closure per call.
     forward = make_forward(model)
 
+    # Batch dim over the flattened (data, seq) axes — on SP meshes every
+    # chip takes a slice of the eval batch instead of seq groups
+    # repeating identical work.
+    div = eval_batch_divisor(mesh)
+    bs = max(1, cfg.global_batch_size // div) * div
+
     def eval_fn(state) -> Dict[str, float]:
         variables = state.eval_variables()
         # Every host sweeps the full val set: metrics must be identical
         # across processes for consistent best-k checkpoint ranking.
         return {k: v for k, v in run_inference(
             lambda b: forward(variables,
-                              jax.device_put(b, batch_sharding(mesh))),
+                              jax.device_put(b, eval_batch_sharding(mesh))),
             dataset,
-            batch_size=max(1, cfg.global_batch_size),
+            batch_size=bs,
             use_depth=cfg.data.use_depth,
             compute_structure=False,
         ).items() if isinstance(v, float)}

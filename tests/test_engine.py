@@ -198,7 +198,8 @@ def test_resume_with_no_remaining_steps_is_a_noop(eight_devices, tmp_path):
 
 
 @pytest.mark.parametrize("config_name", ["hdfnet_rgbd", "u2net_ds",
-                                         "basnet_ds", "swin_sod"])
+                                         "basnet_ds", "swin_sod",
+                                         "vit_sod_sp"])
 def test_fit_one_step_every_zoo_config(config_name, eight_devices,
                                        tmp_path):
     """Every BASELINE config trains one real step through fit() —

@@ -29,6 +29,7 @@ ZOO = [
     "u2net_ds",
     "basnet_ds",
     "swin_sod",
+    "vit_sod_sp",
 ]
 
 
