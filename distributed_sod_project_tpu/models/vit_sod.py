@@ -50,20 +50,26 @@ class _Block(nn.Module):
         kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
 
         y = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)(x)
-        qkv = nn.Dense(3 * d, **kw)(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # Separate q/k/v projections (not one fused 3d Dense): the TP
+        # rules column-shard each (d, d) kernel, and with heads % model
+        # == 0 the shard boundary lands on a head boundary — a fused
+        # kernel's packed 3d axis would split mid-k/v and force GSPMD
+        # to re-gather qkv every block (parallel/tp.py VIT_TP_RULES).
+        q = nn.Dense(d, name="q", **kw)(y)
+        k = nn.Dense(d, name="k", **kw)(y)
+        v = nn.Dense(d, name="v", **kw)(y)
         # [B, N, D] -> heads-major [B, H, N, D/H] (ring_attention layout).
         def split_heads(t):
             return t.reshape(b, n, h, d // h).transpose(0, 2, 1, 3)
 
         out = attn_fn(split_heads(q), split_heads(k), split_heads(v))
         out = out.transpose(0, 2, 1, 3).reshape(b, n, d)
-        x = x + nn.Dense(d, **kw)(out)
+        x = x + nn.Dense(d, name="proj", **kw)(out)
 
         y = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)(x)
-        y = nn.Dense(self.mlp_ratio * d, **kw)(y)
+        y = nn.Dense(self.mlp_ratio * d, name="mlp_up", **kw)(y)
         y = nn.gelu(y)
-        x = x + nn.Dense(d, **kw)(y)
+        x = x + nn.Dense(d, name="mlp_down", **kw)(y)
         return x
 
 
@@ -82,6 +88,7 @@ class ViTSOD(nn.Module):
     depth: int = 8
     heads: int = 6
     mlp_ratio: int = 4
+    deep_supervision: bool = True  # aux unpatchify head at mid-depth
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -118,19 +125,31 @@ class ViTSOD(nn.Module):
         pos_win = lax.dynamic_slice_in_dim(pos, start, rows * cols, axis=0)
         x = x + pos_win[None].astype(self.dtype)
 
+        def unpatchify_head(tokens, name):
+            """Per-token D -> p*p logits, tiled back to pixels — the
+            only head shape that keeps the model halo-free for SP."""
+            y = nn.LayerNorm(dtype=jnp.float32,
+                             param_dtype=self.param_dtype,
+                             name=f"{name}_norm")(tokens)
+            l = nn.Dense(p * p, dtype=jnp.float32,
+                         param_dtype=self.param_dtype, name=name)(y)
+            l = l.reshape(b, rows, cols, p, p)
+            return l.transpose(0, 1, 3, 2, 4).reshape(b, hh, ww, 1
+                                                      ).astype(jnp.float32)
+
+        aux = None
         for i in range(self.depth):
             x = _Block(dim=self.dim, heads=self.heads,
                        mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                        param_dtype=self.param_dtype, name=f"block{i}")(
                            x, attn_fn, train=train)
+            if self.deep_supervision and i == self.depth // 2 - 1:
+                aux = unpatchify_head(x, "aux_head")
 
-        x = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)(x)
-        # Per-token unpatchify head: D -> p*p logits, tiled back.
-        logit = nn.Dense(p * p, dtype=jnp.float32,
-                         param_dtype=self.param_dtype, name="head")(x)
-        logit = logit.reshape(b, rows, cols, p, p)
-        logit = logit.transpose(0, 1, 3, 2, 4).reshape(b, hh, ww, 1)
-        return [logit.astype(jnp.float32)]
+        logits = [unpatchify_head(x, "head")]
+        if aux is not None:
+            logits.append(aux)
+        return logits
 
 
 PRESETS = {

@@ -10,7 +10,9 @@ from .mesh import (
 )
 from .sp import make_sp_train_step, sp_batch_sharding
 from .tp import (
+    DEFAULT_TP_RULES,
     SWIN_TP_RULES,
+    VIT_TP_RULES,
     make_tp_train_step,
     param_partition_specs,
     shard_state,
@@ -26,6 +28,8 @@ __all__ = [
     "replicated_sharding",
     "host_shard",
     "global_batch_array",
+    "DEFAULT_TP_RULES",
+    "VIT_TP_RULES",
     "make_sp_train_step",
     "sp_batch_sharding",
     "SWIN_TP_RULES",

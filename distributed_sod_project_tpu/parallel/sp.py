@@ -120,9 +120,22 @@ def make_sp_train_step(
                 attn_fn=partial(ring_attention, axis_name="seq"),
                 full_grid=full_grid, pos_row_offset=row_off,
                 rngs={"dropout": rng})
-            return _sp_hybrid_loss(
-                outs[0], mask, bce_w=loss_cfg.bce, iou_w=loss_cfg.iou,
-                cel_w=loss_cfg.cel)
+            if not loss_cfg.deep_supervision:
+                outs = outs[:1]  # primary head only, uniform across steps
+            # DP convention (losses/deep_supervision.py): SUM over
+            # levels, per-term components summed for logging.
+            total = jnp.float32(0.0)
+            comps: Dict[str, jnp.ndarray] = {}
+            for level in outs:
+                t, c = _sp_hybrid_loss(
+                    level, mask, bce_w=loss_cfg.bce, iou_w=loss_cfg.iou,
+                    cel_w=loss_cfg.cel)
+                total = total + t
+                for k, v in c.items():
+                    if k != "total":
+                        comps[k] = comps.get(k, jnp.float32(0.0)) + v
+            comps["total"] = total
+            return total, comps
 
         grads, comps = jax.grad(loss_fn, has_aux=True)(state.params)
         # The true grad is the SUM of per-token-block contributions

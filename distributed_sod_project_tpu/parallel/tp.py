@@ -48,6 +48,23 @@ SWIN_TP_RULES: Tuple[Tuple[str, P], ...] = (
     (r"SwinBlock_\d+/Dense_1/kernel$", P("model", None)),
 )
 
+# ViT-SOD blocks (models/vit_sod.py::_Block): separate q/k/v
+# projections column-shard head-aligned (heads % model == 0), proj /
+# mlp_down row-shard — same Megatron layout, one allreduce pair per
+# block.
+VIT_TP_RULES: Tuple[Tuple[str, P], ...] = (
+    (r"block\d+/(q|k|v)/kernel$", P(None, "model")),
+    (r"block\d+/(q|k|v)/bias$", P("model")),
+    (r"block\d+/proj/kernel$", P("model", None)),
+    (r"block\d+/mlp_up/kernel$", P(None, "model")),
+    (r"block\d+/mlp_up/bias$", P("model")),
+    (r"block\d+/mlp_down/kernel$", P("model", None)),
+)
+
+# The regex namespaces are disjoint, so one combined default covers the
+# whole transformer zoo — non-matching models simply replicate.
+DEFAULT_TP_RULES: Tuple[Tuple[str, P], ...] = SWIN_TP_RULES + VIT_TP_RULES
+
 
 def _leaf_path(path) -> str:
     parts = []
@@ -74,7 +91,7 @@ def _divisible(shape, spec: P, mesh: Mesh) -> bool:
 
 
 def param_partition_specs(params, mesh: Mesh,
-                          rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES):
+                          rules: Sequence[Tuple[str, P]] = DEFAULT_TP_RULES):
     """Spec pytree for ``params``: first rule whose regex matches the
     '/'-joined path wins; non-matching (or non-divisible) leaves
     replicate.  Specs longer than the leaf's rank are an error caught
@@ -143,7 +160,7 @@ def _zero1_specs(params, param_specs, mesh: Mesh):
 
 
 def state_partition_specs(state, mesh: Mesh,
-                          rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES,
+                          rules: Sequence[Tuple[str, P]] = DEFAULT_TP_RULES,
                           zero1: bool = False):
     """A TrainState-shaped pytree of PartitionSpecs: params per the TP
     rules, optimizer buffers matching their parameters (or sharded over
@@ -170,7 +187,7 @@ def to_shardings(spec_tree, mesh: Mesh):
 
 
 def shard_state(state, mesh: Mesh,
-                rules: Sequence[Tuple[str, P]] = SWIN_TP_RULES,
+                rules: Sequence[Tuple[str, P]] = DEFAULT_TP_RULES,
                 zero1: bool = False):
     """Place a host/replicated TrainState onto the mesh with the TP
     (and optionally ZeRO-1) layout; returns (sharded_state,
@@ -214,6 +231,8 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
                 {"params": params, "batch_stats": state.batch_stats},
                 batch["image"], batch.get("depth"), train=True,
                 mutable=["batch_stats"], rngs={"dropout": rng})
+            if not loss_cfg.deep_supervision:
+                outs = outs[:1]  # primary head only, uniform across steps
             total, comps = deep_supervision_loss(outs, batch["mask"], **lkw)
             return total, (comps, mut.get("batch_stats", state.batch_stats))
 

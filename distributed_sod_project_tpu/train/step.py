@@ -150,6 +150,8 @@ def make_train_step(
         def loss_fn(params):
             outs, mut = forward(params, state.batch_stats,
                                 batch["image"], batch.get("depth"))
+            if not loss_cfg.deep_supervision:
+                outs = outs[:1]  # primary head only, uniform across steps
             total, comps = deep_supervision_loss(outs, batch["mask"], **lkw)
             return total, (comps, mut.get("batch_stats", state.batch_stats))
 
