@@ -54,6 +54,12 @@ class ModelConfig:
     param_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the backbone stages
     pretrained: Optional[str] = None  # .npz from tools/port_torch_weights.py
+    # Structural deep supervision for models where aux heads are
+    # optional add-ons (vit_sod's mid-depth head).  U²-Net/BASNet side
+    # outputs are integral to their architectures and ignore this.
+    # LossConfig.deep_supervision separately gates which returned
+    # outputs the loss consumes.
+    deep_supervision: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

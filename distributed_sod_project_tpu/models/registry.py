@@ -113,6 +113,7 @@ def _build_vit_sod(cfg, *, dtype, param_dtype, axis_name):
             f"(encoder preset), got {cfg.backbone!r}")
     dim, depth, heads = PRESETS[cfg.backbone]
     return ViTSOD(dim=dim, depth=depth, heads=heads,
+                  deep_supervision=cfg.deep_supervision,
                   dtype=dtype, param_dtype=param_dtype)
 
 

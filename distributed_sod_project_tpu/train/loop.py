@@ -169,6 +169,16 @@ def fit(
                 "mesh.model>1 / optim.zero1 route through the GSPMD step, "
                 "which has no named mesh axis: set model.sync_bn=false "
                 "(BN stats are global-batch there, strictly stronger)")
+        n_model = mesh.shape.get("model", 1)
+        heads = getattr(model, "heads", None)
+        if n_model > 1 and heads is not None and heads % n_model:
+            # Column shards must land on head boundaries or GSPMD
+            # re-gathers q/k/v every block (the Megatron layout's whole
+            # point) — fail loudly instead of degrading silently.
+            raise ValueError(
+                f"mesh.model={n_model} does not divide the model's "
+                f"{heads} attention heads — pick a model-axis degree "
+                "that divides the head count")
         state, state_shardings = shard_state(state, mesh,
                                              zero1=cfg.optim.zero1)
 

@@ -109,3 +109,19 @@ def test_predict_cli_writes_original_size_maps(tmp_path, eight_devices):
             assert im.size == wh and im.mode == "L"
             arr = np.asarray(im)
         assert arr.min() >= 0 and arr.max() <= 255
+
+
+def test_check_determinism_tool(tmp_path, capsys, monkeypatch):
+    """tools/check_determinism.py: two identical runs → bitwise-equal
+    params, exit 0 (the §5 'race detection' audit)."""
+    import check_determinism
+
+    rc = check_determinism.main([
+        "--config", "minet_vgg16_ref", "--device", "cpu", "--steps", "2",
+        "--image-size", "32", "--batch-size", "8",
+        "--set", "data.synthetic_size=16",
+        "--set", "model.compute_dtype=float32",
+        "--set", "data.num_workers=0",
+    ])
+    assert rc == 0
+    assert "deterministic" in capsys.readouterr().out

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Determinism audit: run the same short training twice, diff the states.
+
+    python tools/check_determinism.py --config minet_r50_dp --steps 5
+    python tools/check_determinism.py --config vit_sod_sp \
+        --set mesh.seq=4 --set mesh.data=2   # (8 virtual CPU devices)
+
+The TPU-era analogue of the reference stack's race detection (SURVEY.md
+§5): a functional `jit(shard_map(step))` has no shared mutable state to
+race on, so nondeterminism can only enter through the input pipeline,
+RNG folding, or unstable collective reductions.  This tool runs two
+fresh ``fit()`` s from the same seed and compares the final parameter
+trees BITWISE — any drift prints the offending leaves and exits 1.
+
+Exact repeatability is also the property checkpoint-resume correctness
+rests on, so run this after touching the loader, RNG, or step code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="minet_vgg16_ref")
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="PATH=VALUE", help="dotted config override")
+    return p.parse_args(argv)
+
+
+def _run_once(cfg, tmpdir, steps):
+    from distributed_sod_project_tpu.train.loop import fit
+
+    captured = {}
+
+    def grab(step, metrics):
+        captured["last"] = dict(metrics)
+
+    fit(cfg, workdir=tmpdir, max_steps=steps,
+        hooks={"on_metrics": grab})
+
+    # Re-read the final state from the checkpoint (fit saves at the
+    # final step), so the comparison covers the full persisted tree:
+    # params, BN stats, and optimizer state.
+    from distributed_sod_project_tpu.eval.inference import restore_for_eval
+
+    _, _, state = restore_for_eval(tmpdir)
+    return state, captured.get("last", {})
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    from distributed_sod_project_tpu.utils.platform import select_platform
+
+    select_platform(args.device)
+
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from distributed_sod_project_tpu.configs import (
+        apply_overrides, get_config)
+
+    hw = args.image_size
+    cfg = get_config(args.config)
+    cfg = apply_overrides(
+        cfg,
+        [f"data.image_size={hw},{hw}", "data.dataset=synthetic",
+         f"global_batch_size={args.batch_size}", f"seed={args.seed}",
+         "data.num_workers=2", "checkpoint_every_steps=1000000",
+         "eval_every_steps=0", "tensorboard=false",
+         "log_every_steps=1"] + list(args.overrides))
+
+    states = []
+    for run in range(2):
+        with tempfile.TemporaryDirectory() as td:
+            state, metrics = _run_once(cfg, td, args.steps)
+            states.append(state)
+            print(f"run {run}: final loss {metrics.get('total', 'n/a')}",
+                  file=sys.stderr)
+
+    # The FULL persisted tree — params, BN stats, optimizer buffers,
+    # EMA — since checkpoint-resume correctness rests on all of it.
+    trees = [
+        {"params": s.params, "batch_stats": s.batch_stats,
+         "opt_state": s.opt_state, "ema_params": s.ema_params}
+        for s in states
+    ]
+    bad = []
+    leaves0, _ = jax.tree_util.tree_flatten_with_path(trees[0])
+    leaves1 = jax.tree_util.tree_leaves(trees[1])
+    for (path, a), b in zip(leaves0, leaves1):
+        a, b = np.asarray(a), np.asarray(b)
+        if not np.array_equal(a, b):
+            name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+            bad.append((name, float(np.abs(
+                a.astype(np.float64) - b.astype(np.float64)).max())))
+
+    if bad:
+        print(f"NONDETERMINISTIC: {len(bad)} state leaves differ "
+              "between identical runs")
+        for name, delta in bad[:20]:
+            print(f"  {name}: max |delta| = {delta:g}")
+        return 1
+    n = len(leaves1)
+    print(f"deterministic: {n} state leaves (params + BN stats + "
+          f"optimizer + EMA) bitwise-identical over {args.steps} steps "
+          f"({args.config})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

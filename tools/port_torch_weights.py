@@ -26,10 +26,14 @@ through torchvision's forward and ours agree to float tolerance.
 from __future__ import annotations
 
 import argparse
+import os
 import re
+import sys
 from typing import Dict, List, Tuple
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _t2n(t) -> np.ndarray:

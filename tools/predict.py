@@ -24,6 +24,8 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 _EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
 
 

@@ -49,6 +49,11 @@ def parse_args(argv=None):
     p.add_argument("--eval-every", type=int, default=None,
                    help="run held-out eval every N steps (overrides "
                         "config eval_every_steps)")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="jax.config debug_nans: every compiled step "
+                        "re-checks for NaN production and fails loudly "
+                        "at the producing op (slow — debugging only; "
+                        "for production guards use optim.skip_nonfinite)")
     return p.parse_args(argv)
 
 
@@ -73,6 +78,8 @@ def main(argv=None):
 
     import jax
 
+    if args.debug_nans:
+        jax.config.update("jax_debug_nans", True)
     if args.distributed:
         jax.distributed.initialize()
 
