@@ -8,7 +8,7 @@ from .mesh import (
     host_shard,
     global_batch_array,
 )
-from .sp import make_sp_train_step, sp_batch_sharding
+from .sp import make_sp_eval_step, make_sp_train_step, sp_batch_sharding
 from .tp import (
     DEFAULT_TP_RULES,
     SWIN_TP_RULES,
@@ -30,6 +30,7 @@ __all__ = [
     "global_batch_array",
     "DEFAULT_TP_RULES",
     "VIT_TP_RULES",
+    "make_sp_eval_step",
     "make_sp_train_step",
     "sp_batch_sharding",
     "SWIN_TP_RULES",

@@ -80,6 +80,37 @@ def _sp_hybrid_loss(logits, mask, *, bce_w, iou_w, cel_w,
     return total, comps
 
 
+def make_sp_eval_step(model, mesh: Mesh) -> Callable:
+    """Sequence-parallel forward-only step: ``(variables, batch) ->
+    probs`` with image rows sharded over ``seq`` and ring attention
+    crossing the blocks — the eval/inference path for resolutions whose
+    full-attention scores ([B,h,N,N]) exceed one chip's memory.  Output
+    probs come back sharded the same way; a host ``np.asarray`` gathers
+    them.  Math is identical to the single-device forward (ring
+    attention is exact)."""
+
+    def eval_fn(variables, batch):
+        image = batch["image"]
+        local_rows = image.shape[1] // model.patch
+        seq = lax.axis_size("seq")
+        row_off = lax.axis_index("seq") * local_rows
+        full_grid = (local_rows * seq, image.shape[2] // model.patch)
+        outs = model.apply(
+            variables, image, None, train=False,
+            attn_fn=partial(ring_attention, axis_name="seq"),
+            full_grid=full_grid, pos_row_offset=row_off)
+        return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
+
+    sharded = jax.shard_map(
+        eval_fn,
+        mesh=mesh,
+        in_specs=(P(), P("data", "seq")),
+        out_specs=P("data", "seq"),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def make_sp_train_step(
     model,
     loss_cfg,

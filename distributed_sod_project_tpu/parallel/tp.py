@@ -24,6 +24,14 @@ Sharding a leaf is skipped (replicated) when its dimension does not
 divide the axis size, so the same rules work for any ``model`` degree
 that divides the widths — degrees that do not divide simply fall back
 per-leaf.
+
+Alignment caveat: Swin packs q/k/v into one fused ``Dense(3d)`` (the
+layout the official checkpoints — and our weight porter — use), so a
+column shard of the packed axis cannot land on all q/k/v + per-stage
+head boundaries at once; GSPMD keeps the math exact by resharding
+where needed, at some extra collective cost.  ViT-SOD uses separate
+head-aligned q/k/v projections instead (``VIT_TP_RULES``), and fit()
+enforces its ``heads % model == 0`` precondition.
 """
 
 from __future__ import annotations

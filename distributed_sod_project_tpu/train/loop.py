@@ -170,11 +170,14 @@ def fit(
                 "which has no named mesh axis: set model.sync_bn=false "
                 "(BN stats are global-batch there, strictly stronger)")
         n_model = mesh.shape.get("model", 1)
+        # Head-alignment guard — models exposing a scalar ``heads``
+        # (vit_sod) promise boundary-aligned column shards; fail loudly
+        # when the promise can't hold (GSPMD would re-gather q/k/v
+        # every block).  Swin's per-stage head counts (3,6,12,24) and
+        # fused qkv packing can't all align; its TP layout is correct
+        # but leans on GSPMD resharding (see parallel/tp.py docstring).
         heads = getattr(model, "heads", None)
-        if n_model > 1 and heads is not None and heads % n_model:
-            # Column shards must land on head boundaries or GSPMD
-            # re-gathers q/k/v every block (the Megatron layout's whole
-            # point) — fail loudly instead of degrading silently.
+        if n_model > 1 and isinstance(heads, int) and heads % n_model:
             raise ValueError(
                 f"mesh.model={n_model} does not divide the model's "
                 f"{heads} attention heads — pick a model-axis degree "
@@ -358,30 +361,49 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
 
     from ..eval import run_inference
     from ..eval.inference import make_forward
-    from ..parallel.mesh import eval_batch_divisor, eval_batch_sharding
+    from ..parallel.mesh import (eval_batch_divisor, eval_batch_sharding,
+                                 replicated_sharding)
 
     data_cfg = cfg.data
     if cfg.data.val_root:
         data_cfg = dataclasses.replace(cfg.data, root=cfg.data.val_root)
     dataset = resolve_dataset(data_cfg)
 
-    # jit once with the variables as an argument: re-invoking eval does
-    # NOT retrace (same shapes), unlike a fresh closure per call.
-    forward = make_forward(model)
+    use_sp = mesh.shape.get("seq", 1) > 1 and hasattr(model, "patch")
+    if use_sp:
+        # Sequence-parallel forward: image rows shard over ``seq`` with
+        # ring attention, matching the train step's memory profile — a
+        # full-attention eval would materialise the NxN scores the SP
+        # run exists to avoid.  Batch shards over ``data`` only.
+        from ..parallel.sp import make_sp_eval_step, sp_batch_sharding
 
-    # Batch dim over the flattened (data, seq) axes — on SP meshes every
-    # chip takes a slice of the eval batch instead of seq groups
-    # repeating identical work.
-    div = eval_batch_divisor(mesh)
-    bs = max(1, cfg.global_batch_size // div) * div
+        sp_forward = make_sp_eval_step(model, mesh)
+        div = mesh.shape.get("data", 1)
+        bs = max(1, cfg.global_batch_size // div) * div
+
+        def make_eval_forward(variables):
+            variables = jax.device_put(variables,
+                                       replicated_sharding(mesh))
+            return lambda b: sp_forward(
+                variables, jax.device_put(b, sp_batch_sharding(mesh)))
+    else:
+        # jit once with the variables as an argument: re-invoking eval
+        # does NOT retrace (same shapes), unlike a fresh closure per
+        # call.  Batch dim over the flattened (data, seq) axes.
+        forward = make_forward(model)
+        div = eval_batch_divisor(mesh)
+        bs = max(1, cfg.global_batch_size // div) * div
+
+        def make_eval_forward(variables):
+            return lambda b: forward(
+                variables, jax.device_put(b, eval_batch_sharding(mesh)))
 
     def eval_fn(state) -> Dict[str, float]:
-        variables = state.eval_variables()
+        fwd = make_eval_forward(state.eval_variables())
         # Every host sweeps the full val set: metrics must be identical
         # across processes for consistent best-k checkpoint ranking.
         return {k: v for k, v in run_inference(
-            lambda b: forward(variables,
-                              jax.device_put(b, eval_batch_sharding(mesh))),
+            fwd,
             dataset,
             batch_size=bs,
             use_depth=cfg.data.use_depth,

@@ -146,6 +146,28 @@ def test_fit_sp_smoke(tmp_path, eight_devices):
     assert 0.0 <= out["eval_mae"] <= 1.0
 
 
+def test_sp_eval_step_matches_single_device(eight_devices):
+    """Forward-only SP (ring attention over row blocks) equals the
+    single-device sigmoid forward — the long-context inference path."""
+    from distributed_sod_project_tpu.parallel.sp import make_sp_eval_step
+
+    model = _tiny_model()
+    batch = _data(b=4, hw=32, seed=7)
+    variables = model.init(jax.random.key(1), batch["image"], None,
+                           train=False)
+    mesh = make_mesh(MeshConfig(data=2, seq=4), eight_devices)
+
+    dev_vars = jax.device_put(variables, replicated_sharding(mesh))
+    dev_batch = jax.device_put(batch, sp_batch_sharding(mesh))
+    probs = np.asarray(make_sp_eval_step(model, mesh)(dev_vars, dev_batch))
+
+    ref = np.asarray(jax.nn.sigmoid(
+        model.apply(variables, batch["image"], None,
+                    train=False)[0][..., 0].astype(jnp.float32)))
+    assert probs.shape == ref.shape == (4, 32, 32)
+    np.testing.assert_allclose(probs, ref, atol=2e-6)
+
+
 def test_vit_tensor_parallel_shards_params(eight_devices):
     """The combined DEFAULT_TP_RULES give vit_sod a real Megatron
     layout on a (data, model) mesh — qkv/MLP kernels actually shard."""
