@@ -63,6 +63,17 @@ def deep_supervision_loss(
             if cel_w:
                 add("cel", lw * cel_loss(logit, target), cel_w)
         if ssim_w:
-            add("ssim", lw * ssim_loss(logit, target, window_size=ssim_window), ssim_w)
+            if fused:
+                from ..pallas.fused_ssim import (fused_ssim_available,
+                                                 fused_ssim_loss)
+            # Odd windows only: the kernel's analytic backward needs
+            # symmetric taps (pallas/fused_ssim.py).
+            if (fused and ssim_window % 2 == 1
+                    and fused_ssim_available(logit.shape)):
+                add("ssim", lw * fused_ssim_loss(
+                    logit, target, window_size=ssim_window), ssim_w)
+            else:
+                add("ssim", lw * ssim_loss(
+                    logit, target, window_size=ssim_window), ssim_w)
     comps["total"] = total
     return total, comps

@@ -1,3 +1,14 @@
 from .fused_loss import fused_bce_iou_cel, pixel_region_sums
+from .fused_ssim import (
+    fused_ssim_available,
+    fused_ssim_loss,
+    fused_ssim_mean,
+)
 
-__all__ = ["fused_bce_iou_cel", "pixel_region_sums"]
+__all__ = [
+    "fused_bce_iou_cel",
+    "fused_ssim_available",
+    "fused_ssim_loss",
+    "fused_ssim_mean",
+    "pixel_region_sums",
+]

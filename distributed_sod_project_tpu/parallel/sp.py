@@ -80,6 +80,22 @@ def _sp_hybrid_loss(logits, mask, *, bce_w, iou_w, cel_w,
     return total, comps
 
 
+def _sp_apply(model, variables, image, *, train: bool, rngs=None):
+    """The shared SP forward: derive this device's (row offset, full
+    grid) from its ``seq`` position and run the module on its row slice
+    with ring attention as the attention core.  Single definition so
+    train and eval geometry cannot diverge."""
+    local_rows = image.shape[1] // model.patch
+    seq = lax.axis_size("seq")
+    row_off = lax.axis_index("seq") * local_rows
+    full_grid = (local_rows * seq, image.shape[2] // model.patch)
+    return model.apply(
+        variables, image, None, train=train,
+        attn_fn=partial(ring_attention, axis_name="seq"),
+        full_grid=full_grid, pos_row_offset=row_off,
+        **({"rngs": rngs} if rngs is not None else {}))
+
+
 def make_sp_eval_step(model, mesh: Mesh) -> Callable:
     """Sequence-parallel forward-only step: ``(variables, batch) ->
     probs`` with image rows sharded over ``seq`` and ring attention
@@ -90,15 +106,7 @@ def make_sp_eval_step(model, mesh: Mesh) -> Callable:
     attention is exact)."""
 
     def eval_fn(variables, batch):
-        image = batch["image"]
-        local_rows = image.shape[1] // model.patch
-        seq = lax.axis_size("seq")
-        row_off = lax.axis_index("seq") * local_rows
-        full_grid = (local_rows * seq, image.shape[2] // model.patch)
-        outs = model.apply(
-            variables, image, None, train=False,
-            attn_fn=partial(ring_attention, axis_name="seq"),
-            full_grid=full_grid, pos_row_offset=row_off)
+        outs = _sp_apply(model, variables, batch["image"], train=False)
         return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
 
     sharded = jax.shard_map(
@@ -141,16 +149,10 @@ def make_sp_train_step(
             jax.random.fold_in(jax.random.PRNGKey(0), state.step),
             lax.axis_index("data") * seq + lax.axis_index("seq"))
         image, mask = batch["image"], batch["mask"]
-        local_rows = image.shape[1] // model.patch
-        row_off = lax.axis_index("seq") * local_rows
-        full_grid = (local_rows * seq, image.shape[2] // model.patch)
 
         def loss_fn(params):
-            outs = model.apply(
-                {"params": params}, image, None, train=True,
-                attn_fn=partial(ring_attention, axis_name="seq"),
-                full_grid=full_grid, pos_row_offset=row_off,
-                rngs={"dropout": rng})
+            outs = _sp_apply(model, {"params": params}, image,
+                             train=True, rngs={"dropout": rng})
             if not loss_cfg.deep_supervision:
                 outs = outs[:1]  # primary head only, uniform across steps
             # DP convention (losses/deep_supervision.py): SUM over
