@@ -455,3 +455,135 @@ def test_swin_port_adapts_bias_tables_to_small_inputs():
     assert deep.shape[0] < want.shape[0]  # genuinely resized
     outs = model.apply(merged, x, train=False)
     assert np.isfinite(np.asarray(outs[0])).all()
+
+
+def _vit_state_dict(rng, d=32, depth=2, heads=2, mlp_ratio=2, src_grid=3):
+    """timm/DeiT-schema state dict with random weights (tiny dims)."""
+    sd = {}
+    t = lambda *s: torch.from_numpy(  # noqa: E731
+        rng.normal(0, 0.5, s).astype(np.float32))
+    sd["patch_embed.proj.weight"] = t(d, 3, 16, 16)
+    sd["patch_embed.proj.bias"] = t(d)
+    sd["pos_embed"] = t(1, 1 + src_grid * src_grid, d)  # cls + grid
+    sd["cls_token"] = t(1, 1, d)
+    for i in range(depth):
+        pre = f"blocks.{i}"
+        sd[pre + ".norm1.weight"] = t(d)
+        sd[pre + ".norm1.bias"] = t(d)
+        sd[pre + ".attn.qkv.weight"] = t(3 * d, d)
+        sd[pre + ".attn.qkv.bias"] = t(3 * d)
+        sd[pre + ".attn.proj.weight"] = t(d, d)
+        sd[pre + ".attn.proj.bias"] = t(d)
+        sd[pre + ".norm2.weight"] = t(d)
+        sd[pre + ".norm2.bias"] = t(d)
+        sd[pre + ".mlp.fc1.weight"] = t(mlp_ratio * d, d)
+        sd[pre + ".mlp.fc1.bias"] = t(mlp_ratio * d)
+        sd[pre + ".mlp.fc2.weight"] = t(d, mlp_ratio * d)
+        sd[pre + ".mlp.fc2.bias"] = t(d)
+    sd["norm.weight"] = t(d)
+    sd["norm.bias"] = t(d)
+    sd["head.weight"] = t(10, d)  # classifier: must be ignored
+    sd["head.bias"] = t(10)
+    return sd
+
+
+def _timm_block_numpy(x, sd, pre, heads):
+    """Reference timm ViT block forward (float64 numpy oracle)."""
+
+    def ln(v, p):
+        w = sd[p + ".weight"].numpy().astype(np.float64)
+        b = sd[p + ".bias"].numpy().astype(np.float64)
+        mu = v.mean(-1, keepdims=True)
+        var = v.var(-1, keepdims=True)
+        return (v - mu) / np.sqrt(var + 1e-6) * w + b
+
+    def lin(v, p):
+        w = sd[p + ".weight"].numpy().astype(np.float64)
+        b = sd[p + ".bias"].numpy().astype(np.float64)
+        return v @ w.T + b
+
+    n, d = x.shape
+    hd = d // heads
+    y = ln(x, pre + ".norm1")
+    qkv = lin(y, pre + ".attn.qkv").reshape(n, 3, heads, hd)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [n, heads, hd]
+    out = np.zeros((n, heads, hd))
+    for h in range(heads):
+        s = q[:, h] @ k[:, h].T / np.sqrt(hd)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out[:, h] = p @ v[:, h]
+    x = x + lin(out.reshape(n, d), pre + ".attn.proj")
+    y = ln(x, pre + ".norm2")
+    y = lin(y, pre + ".mlp.fc1")
+    from scipy.special import erf
+
+    y = 0.5 * y * (1.0 + erf(y / np.sqrt(2.0)))  # exact GELU, as timm
+    return x + lin(y, pre + ".mlp.fc2")
+
+
+def test_vit_port_block_matches_timm_math():
+    """Ported block0 forward through our _Block == the timm reference
+    math — catches qkv row-splitting / transpose mistakes."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import port_torch_weights as ptw
+
+    from distributed_sod_project_tpu.models.vit_sod import _Block
+    from distributed_sod_project_tpu.parallel.ring_attention import (
+        full_attention)
+
+    rng = np.random.default_rng(0)
+    d, heads = 32, 2
+    sd = _vit_state_dict(rng, d=d, heads=heads)
+    params, stats = ptw.port_vit(sd, grid=(2, 2))
+    assert stats == {}
+
+    n = 4
+    x = rng.normal(0, 1, (1, n, d)).astype(np.float32)
+    block = _Block(dim=d, heads=heads, mlp_ratio=2,
+                   dtype=jnp.float32, param_dtype=jnp.float32)
+    out = block.apply({"params": params["block0"]}, jnp.asarray(x),
+                      full_attention, train=False)
+    oracle = _timm_block_numpy(x[0].astype(np.float64), sd, "blocks.0",
+                               heads)
+    np.testing.assert_allclose(np.asarray(out)[0], oracle,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_vit_port_loads_into_vit_sod():
+    """Full ported tree (pos embed resized 3x3 -> 2x2 grid) grafts into
+    a matching ViTSOD and the model runs; SOD heads stay fresh."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import tempfile
+
+    import port_torch_weights as ptw
+
+    from distributed_sod_project_tpu.models.pretrained import (
+        load_pretrained, save_npz)
+    from distributed_sod_project_tpu.models.vit_sod import ViTSOD
+
+    rng = np.random.default_rng(1)
+    sd = _vit_state_dict(rng, d=32, depth=2, heads=2)
+    params, stats = ptw.port_vit(sd, grid=(2, 2))
+    assert params["pos_embed"].shape == (4, 32)
+
+    model = ViTSOD(patch=16, dim=32, depth=2, heads=2, mlp_ratio=2)
+    x = jnp.asarray(rng.normal(0, 1, (1, 32, 32, 3)), jnp.float32)
+    variables = model.init(jax.random.key(0), x, None, train=False)
+
+    with tempfile.TemporaryDirectory() as td:
+        npz = os.path.join(td, "vit.npz")
+        save_npz(npz, params, stats)
+        merged = load_pretrained(variables, npz)
+
+    got = np.asarray(merged["params"]["block0"]["q"]["kernel"])
+    want = sd["blocks.0.attn.qkv.weight"].numpy()[:32].T
+    np.testing.assert_allclose(got, want)
+    # head_norm ported from the final `norm`; the SOD head stays fresh.
+    np.testing.assert_allclose(
+        np.asarray(merged["params"]["head_norm"]["scale"]),
+        sd["norm.weight"].numpy())
+    outs = model.apply(merged, x, None, train=False)
+    assert np.isfinite(np.asarray(outs[0])).all()

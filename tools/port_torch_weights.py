@@ -268,6 +268,90 @@ def port_swin_t(state_dict,
     return params, {}
 
 
+def _port_pos_embed(pe: np.ndarray, grid: Tuple[int, int]) -> np.ndarray:
+    """[1, (cls…)+N, D] → [grid_h*grid_w, D]: drop class/dist tokens,
+    bicubic-resize the source grid to the target (the standard
+    fine-tune-at-new-resolution practice for ViT)."""
+    import torch
+    import torch.nn.functional as F
+
+    pe = np.asarray(pe)[0]
+    n = pe.shape[0]
+    for lead in (0, 1, 2):  # none / cls / cls+dist leading tokens
+        side = int(round((n - lead) ** 0.5))
+        if side * side == n - lead:
+            pe = pe[lead:]
+            break
+    else:
+        raise ValueError(f"cannot infer a square grid from pos_embed "
+                         f"with {n} positions")
+    g = torch.from_numpy(
+        np.ascontiguousarray(pe.reshape(side, side, -1).transpose(2, 0, 1))
+    )[None].float()
+    g = F.interpolate(g, size=tuple(grid), mode="bicubic",
+                      align_corners=False)
+    return np.asarray(g[0].permute(1, 2, 0).reshape(
+        grid[0] * grid[1], -1), np.float32)
+
+
+def port_vit(state_dict, grid: Tuple[int, int] = (20, 20)
+             ) -> Tuple[Dict, Dict]:
+    """timm/DeiT ViT checkpoint (``vit_*_patch16_*``) →
+    models/vit_sod.py tree.
+
+    Schema: ``patch_embed.proj``, ``pos_embed`` (cls token dropped,
+    grid bicubic-resized to ``grid`` — pass the TARGET grid, e.g.
+    20,20 for 320px/patch16), ``blocks.{i}.{norm1,attn.qkv,attn.proj,
+    norm2,mlp.fc1,mlp.fc2}``, final ``norm`` → our ``head_norm``.  The
+    fused qkv rows split into our separate q/k/v projections (timm
+    packs rows [0:D]=q, [D:2D]=k, [2D:3D]=v).  The classifier head and
+    our SOD heads stay fresh.
+    """
+    d = int(state_dict["patch_embed.proj.weight"].shape[0])
+    params: Dict = {
+        "patch_embed": {
+            "kernel": _conv_kernel(state_dict["patch_embed.proj.weight"]),
+            "bias": _t2n(state_dict["patch_embed.proj.bias"]),
+        },
+        "pos_embed": _port_pos_embed(_t2n(state_dict["pos_embed"]), grid),
+    }
+    i = 0
+    while f"blocks.{i}.norm1.weight" in state_dict:
+        pre = f"blocks.{i}"
+        qkv_w = _t2n(state_dict[pre + ".attn.qkv.weight"])  # [3D, D]
+        qkv_b = _t2n(state_dict[pre + ".attn.qkv.bias"])
+        params[f"block{i}"] = {
+            "LayerNorm_0": _ln(state_dict, pre + ".norm1"),
+            "q": {"kernel": qkv_w[0:d].T, "bias": qkv_b[0:d]},
+            "k": {"kernel": qkv_w[d:2 * d].T, "bias": qkv_b[d:2 * d]},
+            "v": {"kernel": qkv_w[2 * d:].T, "bias": qkv_b[2 * d:]},
+            "proj": {
+                "kernel": _linear_kernel(state_dict[pre + ".attn.proj.weight"]),
+                "bias": _t2n(state_dict[pre + ".attn.proj.bias"]),
+            },
+            "LayerNorm_1": _ln(state_dict, pre + ".norm2"),
+            "mlp_up": {
+                "kernel": _linear_kernel(state_dict[pre + ".mlp.fc1.weight"]),
+                "bias": _t2n(state_dict[pre + ".mlp.fc1.bias"]),
+            },
+            "mlp_down": {
+                "kernel": _linear_kernel(state_dict[pre + ".mlp.fc2.weight"]),
+                "bias": _t2n(state_dict[pre + ".mlp.fc2.bias"]),
+            },
+        }
+        i += 1
+    if i == 0:
+        # Without this, a schema-mismatched checkpoint would port only
+        # patch_embed/pos_embed — which the subset-matching loader
+        # happily grafts, leaving every encoder block at random init.
+        raise ValueError(
+            "no 'blocks.{i}.*' keys found — not a timm/DeiT ViT "
+            "state dict?")
+    if "norm.weight" in state_dict:
+        params["head_norm"] = _ln(state_dict, "norm")
+    return params, {}
+
+
 # npz IO lives in the package (the training path loads these files);
 # re-exported here for script users.
 from distributed_sod_project_tpu.models.pretrained import (  # noqa: E402
@@ -278,11 +362,14 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--arch", required=True,
                    choices=["vgg16", "vgg16_bn", "resnet34", "resnet50",
-                            "swin_t"])
+                            "swin_t", "vit"])
     p.add_argument("--out", required=True, help="output .npz path")
     p.add_argument("--state-dict", default=None,
                    help="local .pth state_dict (default: download via "
                         "torchvision, needs network)")
+    p.add_argument("--grid", default="20,20",
+                   help="vit only: target patch grid rows,cols — "
+                        "image_size/16 (default 20,20 for 320px)")
     args = p.parse_args(argv)
 
     import torch
@@ -296,6 +383,10 @@ def main(argv=None):
             "swin_t ports the official microsoft/Swin-Transformer "
             "checkpoint schema — pass it via --state-dict "
             "(torchvision's swin_t uses a different naming)")
+    elif args.arch == "vit":
+        raise SystemExit(
+            "vit ports the timm/DeiT checkpoint schema "
+            "(vit_*_patch16_*) — pass it via --state-dict")
     else:
         import torchvision.models as tvm
 
@@ -308,6 +399,9 @@ def main(argv=None):
         params, stats = port_vgg16(sd, use_bn=args.arch.endswith("_bn"))
     elif args.arch == "swin_t":
         params, stats = port_swin_t(sd)
+    elif args.arch == "vit":
+        grid = tuple(int(x) for x in args.grid.split(","))
+        params, stats = port_vit(sd, grid=grid)
     else:
         params, stats = port_resnet(sd, args.arch)
     save_npz(args.out, params, stats)
