@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 
@@ -23,7 +24,11 @@ def main(argv=None):
     p.add_argument("--config", default="minet_r50_dp")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--batch-per-chip", type=int, default=8)
+    p.add_argument("--batch-per-chip", type=int, default=32,
+                   help="per-chip batch (default 32: small batches "
+                        "underreport — per-step dispatch latency "
+                        "dominates under ~16 imgs/chip on remote-device "
+                        "transports)")
     p.add_argument("--image-size", type=int, default=320)
     p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
     p.add_argument("--mode", default="train",
@@ -40,8 +45,35 @@ def main(argv=None):
                         "(bench always times the shard_map DP step)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the timed window")
+    p.add_argument("--watchdog", type=int, default=1800,
+                   help="hard-exit with a diagnostic after this many "
+                        "seconds (the remote-TPU transport can wedge "
+                        "indefinitely; 0 disables)")
     args = p.parse_args(argv)
 
+    timer = None
+    if args.watchdog:
+        import threading
+
+        def _abort():
+            print(f"bench watchdog: no result after {args.watchdog}s — "
+                  "device transport likely wedged (see "
+                  "docs/PERFORMANCE.md tunnel notes)", file=sys.stderr,
+                  flush=True)
+            os._exit(3)
+
+        timer = threading.Timer(args.watchdog, _abort)
+        timer.daemon = True
+        timer.start()
+
+    try:
+        return _run(args)
+    finally:
+        if timer is not None:  # in-process callers outlive the bench
+            timer.cancel()
+
+
+def _run(args):
     from distributed_sod_project_tpu.utils.platform import select_platform
 
     select_platform(args.device)
@@ -200,7 +232,10 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
     base_path = (os.environ.get("DSOD_BENCH_BASELINE")
                  or os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json"))
-    key = f"{args.config}-{args.image_size}-{platform}"
+    # Batch is in the key: throughput scales with it (dispatch-latency
+    # amortisation), so baselines only compare like with like.
+    key = (f"{args.config}-{args.image_size}-b{args.batch_per_chip}"
+           f"-{platform}")
     if mode != "train":
         key += f"-{mode}"
     base = {}
