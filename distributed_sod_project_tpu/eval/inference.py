@@ -203,6 +203,21 @@ def run_inference(
     return out
 
 
+def flip_tta(forward):
+    """Wrap an eval ``forward(batch)->probs`` with horizontal-flip
+    test-time augmentation: average the prediction with the unflipped
+    prediction of the mirrored input (the classic SOD eval trick;
+    masks are flip-equivariant).  Costs 2x forward."""
+
+    def wrapped(batch):
+        probs = forward(batch)
+        flipped = {k: (v[:, :, ::-1] if k in ("image", "depth") else v)
+                   for k, v in batch.items()}
+        return 0.5 * (probs + forward(flipped)[:, :, ::-1])
+
+    return wrapped
+
+
 def evaluate(
     cfg,
     state,
@@ -212,13 +227,15 @@ def evaluate(
     save_root: Optional[str] = None,
     batch_size: Optional[int] = None,
     compute_structure: bool = True,
+    tta: bool = False,
 ) -> Dict[str, Dict[str, float]]:
     """Test-entrypoint engine: run every test set through the model.
 
     ``datasets`` maps name → dataset; defaults to the config's dataset.
     Pass ``mesh`` to shard the forward over its ``data`` axis (all local
     chips work on every batch — the pod/donut eval path); without it the
-    jit runs on the default device.
+    jit runs on the default device.  ``tta`` averages in the
+    horizontally-flipped prediction (2x forward cost).
     """
     from ..data import resolve_dataset
     from ..models import build_model
@@ -248,6 +265,9 @@ def evaluate(
         if mesh is not None:
             batch = jax.device_put(batch, eval_batch_sharding(mesh))
         return _apply(variables, batch)
+
+    if tta:
+        forward = flip_tta(forward)
 
     results = {}
     for name, ds in datasets.items():

@@ -35,6 +35,9 @@ def parse_args(argv=None):
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--no-structure", action="store_true",
                    help="skip S/E-measure (faster)")
+    p.add_argument("--tta", action="store_true",
+                   help="average in the horizontally-flipped prediction "
+                        "(2x forward cost)")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="PATH=VALUE",
                    help="dotted config override (repeatable)")
@@ -74,7 +77,8 @@ def main(argv=None):
     mesh = make_mesh(cfg.mesh) if jax.device_count() > 1 else None
     results = evaluate(cfg, state, model=model, mesh=mesh, datasets=datasets,
                        save_root=args.save_dir, batch_size=args.batch_size,
-                       compute_structure=not args.no_structure)
+                       compute_structure=not args.no_structure,
+                       tta=args.tta)
     print(json.dumps(results, indent=2))
     return 0
 

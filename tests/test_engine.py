@@ -275,3 +275,36 @@ def test_fit_aborts_on_persistent_divergence(eight_devices, tmp_path,
     )
     with pytest.raises(RuntimeError, match="non-finite gradient"):
         fit(cfg, workdir=str(tmp_path), max_steps=4)
+
+
+def test_flip_tta_is_identity_for_equivariant_forward():
+    """For a flip-equivariant forward, TTA averaging must be exact."""
+    from distributed_sod_project_tpu.eval.inference import flip_tta
+
+    rng = np.random.RandomState(0)
+    batch = {"image": rng.randn(2, 8, 8, 3).astype(np.float32)}
+    forward = lambda b: np.asarray(b["image"])[..., 0]  # noqa: E731
+    out = flip_tta(forward)(batch)
+    np.testing.assert_allclose(out, batch["image"][..., 0], rtol=1e-6)
+
+
+def test_evaluate_with_tta(tmp_path, eight_devices):
+    from distributed_sod_project_tpu.data import resolve_dataset
+    from distributed_sod_project_tpu.eval import evaluate
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.train import (
+        build_optimizer, create_train_state)
+
+    cfg = _smoke_cfg(tmp_path)
+    model = build_model(cfg.model.__class__(
+        name="minet", backbone="vgg16", sync_bn=False,
+        compute_dtype="float32"))
+    tx, _ = build_optimizer(cfg.optim, 1)
+    ds = resolve_dataset(cfg.data)
+    batch = {"image": np.asarray(ds[0]["image"])[None]}
+    state = create_train_state(jax.random.key(0), model, tx, batch)
+
+    res = evaluate(cfg, state, model=model, batch_size=4,
+                   compute_structure=False, tta=True)
+    m = res["synthetic"]
+    assert 0.0 <= m["mae"] <= 1.0 and m["num_images"] == len(ds)
