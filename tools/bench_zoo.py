@@ -54,10 +54,18 @@ def parse_args(argv=None):
 
 
 def run_one(cfg_name, mode, args):
+    # The child's watchdog must fire with margin before our subprocess
+    # timeout: its error JSON line (wedge diagnostic) is only emitted if
+    # the child gets to die on its own terms.  The margin scales down
+    # with small --timeout budgets so the invariant child < parent holds
+    # for any value, without eating most of a short budget.
+    margin = min(120, max(10, int(args.timeout * 0.25)))
+    child_watchdog = max(1, min(args.timeout - 1, args.timeout - margin))
     cmd = [sys.executable, os.path.join(_REPO, "bench.py"),
            "--config", cfg_name, "--mode", mode,
            "--steps", str(args.steps), "--warmup", str(args.warmup),
-           "--image-size", str(args.image_size)]
+           "--image-size", str(args.image_size),
+           "--watchdog", str(child_watchdog)]
     if args.device:
         cmd += ["--device", args.device]
     if args.batch_per_chip is not None:
@@ -76,6 +84,10 @@ def run_one(cfg_name, mode, args):
             except json.JSONDecodeError:
                 continue
             if isinstance(parsed, dict) and "value" in parsed:
+                if "error" in parsed:
+                    # bench.py's graceful-failure line (rc=0, value=0,
+                    # error=...) — a transport outage, not a number.
+                    return {"error": parsed["error"][:200]}
                 return parsed
     tail = (proc.stderr or proc.stdout).strip().splitlines()
     return {"error": tail[-1][:200] if tail else f"rc={proc.returncode}"}
