@@ -19,9 +19,15 @@ must shard.  Layout (the ``seq`` mesh axis):
   contribution, so the true gradient is ``psum`` over ``seq`` and
   ``pmean`` over ``data`` (DP semantics on the batch axis).
 
-SSIM is the one loss term that does NOT decompose over row blocks (its
-11×11 windows straddle block edges); configs with ``loss.ssim > 0`` are
-rejected rather than silently approximated.
+SSIM does not decompose pointwise over row blocks (its 11×11 windows
+straddle block edges), but it is exactly computable with a 5-row halo
+exchange: each device ppermutes its boundary rows of the five windowed
+moment maps to its ``seq`` neighbors, blurs the extended block, and
+keeps only the window outputs centred on its own rows.  ``ppermute``
+leaves zeros where no neighbor exists, which is exactly the SAME
+zero-padding the single-device blur applies at global image edges — so
+the full BASNet hybrid loss (BCE+IoU+SSIM, [B:5]) trains under SP to
+numerics (grad-equivalence asserted in tests/test_vit_sod.py).
 """
 
 from __future__ import annotations
@@ -35,6 +41,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..losses.ssim import _C1, _C2, _blur, gaussian_window
 from ..train.state import TrainState
 from ..train.step import apply_update, notfinite_count
 from .ring_attention import ring_attention
@@ -80,6 +87,53 @@ def _sp_hybrid_loss(logits, mask, *, bce_w, iou_w, cel_w,
     return total, comps
 
 
+def _exchange_row_halo(x, halo: int, axis: str):
+    """Attach ``halo`` rows from each ``seq`` neighbor to a row-sharded
+    NHWC block: ``[prev's bottom rows, x, next's top rows]``.  Devices
+    with no neighbor on a side receive ppermute's zero fill — identical
+    to the SAME zero padding the single-device blur sees at the global
+    image edge, so no special-casing of edge devices is needed."""
+    n = lax.axis_size(axis)
+    top = lax.ppermute(x[:, -halo:], axis,
+                       [(i, i + 1) for i in range(n - 1)])
+    bot = lax.ppermute(x[:, :halo], axis,
+                       [(i + 1, i) for i in range(n - 1)])
+    return jnp.concatenate([top, x, bot], axis=1)
+
+
+def _sp_ssim_loss(logits, mask, *, axis="seq", window_size=11, sigma=1.5):
+    """Exact ``1 − SSIM`` over row-sharded maps (losses/ssim.py math).
+
+    The five windowed moments (a, b, a², b², ab) are formed locally —
+    products of rows live wholly on the row's owner — so ONE halo
+    exchange of the stacked moment maps feeds the blur; outputs centred
+    on halo rows are sliced away (they belong to the neighbor), and the
+    map mean is a psum of local sums over the global pixel count.
+    """
+    halo = window_size // 2
+    if logits.shape[1] < halo:
+        raise ValueError(
+            f"sequence-parallel SSIM needs >= {halo} image rows per "
+            f"device (window {window_size}), got {logits.shape[1]} — "
+            "use fewer seq shards or a larger image")
+    a = jax.nn.sigmoid(logits.astype(jnp.float32))
+    b = mask.astype(jnp.float32)
+    c = a.shape[-1]
+    stack = jnp.concatenate([a, b, a * a, b * b, a * b], axis=-1)
+    ext = _exchange_row_halo(stack, halo, axis)
+    blurred = _blur(ext, gaussian_window(window_size, sigma))
+    blurred = blurred[:, halo:-halo]  # windows centred on OUR rows
+    mu_a, mu_b, e_aa, e_bb, e_ab = (
+        blurred[..., i * c:(i + 1) * c] for i in range(5))
+    mu_aa, mu_bb, mu_ab = mu_a * mu_a, mu_b * mu_b, mu_a * mu_b
+    num = (2.0 * mu_ab + _C1) * (2.0 * (e_ab - mu_ab) + _C2)
+    den = (mu_aa + mu_bb + _C1) * ((e_aa - mu_aa) + (e_bb - mu_bb) + _C2)
+    local_sum = jnp.sum(num / den)
+    global_sum = lax.psum(local_sum, axis)
+    n_global = (num.size) * lax.axis_size(axis)  # uniform row blocks
+    return 1.0 - global_sum / n_global
+
+
 def _sp_apply(model, variables, image, *, train: bool, rngs=None):
     """The shared SP forward: derive this device's (row offset, full
     grid) from its ``seq`` position and run the module on its row slice
@@ -119,6 +173,40 @@ def make_sp_eval_step(model, mesh: Mesh) -> Callable:
     return jax.jit(sharded)
 
 
+def wants_sp_eval(model, mesh) -> bool:
+    """Should eval route through the sequence-parallel forward?  True
+    on a seq-sharded mesh when the model is SP-capable (halo-free
+    patchify with an injectable attention core — ``vit_sod``'s
+    ``patch`` attribute is the capability marker).  Single predicate
+    shared by test.py's evaluate() and fit()'s inline eval so the two
+    can never route the same model differently."""
+    return (mesh is not None and mesh.shape.get("seq", 1) > 1
+            and hasattr(model, "patch"))
+
+
+def sp_eval_batch_size(mesh: Mesh, batch_size: int) -> int:
+    """Round an eval batch to the ``data``-axis divisor (rows shard
+    over ``seq``, so only ``data`` constrains the batch dim)."""
+    div = mesh.shape.get("data", 1)
+    return max(1, batch_size // div) * div
+
+
+def make_sp_eval_forward(model, mesh: Mesh):
+    """Compile the SP eval step once; returns ``bind(variables) ->
+    forward(batch) -> probs`` so callers whose variables change between
+    sweeps (the inline train eval) rebind without retracing."""
+    sp_forward = make_sp_eval_step(model, mesh)
+
+    def bind(variables):
+        from .mesh import replicated_sharding
+
+        variables = jax.device_put(variables, replicated_sharding(mesh))
+        return lambda b: sp_forward(
+            variables, jax.device_put(b, sp_batch_sharding(mesh)))
+
+    return bind
+
+
 def make_sp_train_step(
     model,
     loss_cfg,
@@ -137,11 +225,13 @@ def make_sp_train_step(
     model must be halo-free over rows with an injectable attention
     core (``vit_sod``).
     """
-    if getattr(loss_cfg, "ssim", 0.0):
-        raise ValueError(
-            "loss.ssim does not decompose over the seq axis (11x11 "
-            "windows straddle row-block edges) — set loss.ssim=0 for "
-            "sequence-parallel training")
+    if getattr(loss_cfg, "fused_kernel", False):
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "loss.fused_kernel is a no-op on the sequence-parallel "
+            "path: the SP loss already psums sufficient statistics "
+            "inline (docs/PERFORMANCE.md)")
     seq = mesh.shape["seq"]
 
     def step_fn(state: TrainState, batch):
@@ -163,6 +253,11 @@ def make_sp_train_step(
                 t, c = _sp_hybrid_loss(
                     level, mask, bce_w=loss_cfg.bce, iou_w=loss_cfg.iou,
                     cel_w=loss_cfg.cel)
+                if getattr(loss_cfg, "ssim", 0.0):
+                    c["ssim"] = _sp_ssim_loss(
+                        level, mask,
+                        window_size=getattr(loss_cfg, "ssim_window", 11))
+                    t = t + loss_cfg.ssim * c["ssim"]
                 total = total + t
                 for k, v in c.items():
                     if k != "total":

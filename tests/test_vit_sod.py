@@ -36,13 +36,18 @@ def _data(b=4, hw=32, seed=0):
 
 
 def _ref_loss(model, params, image, mask, *, bce_w=1.0, iou_w=1.0,
-              cel_w=0.0):
+              cel_w=0.0, ssim_w=0.0, ssim_window=11):
     """Single-device objective with the same formulas as
     parallel.sp (psum-free: one device sees all rows); deep-supervision
     convention = SUM over output levels."""
+    from distributed_sod_project_tpu.losses.ssim import ssim_loss
+
     outs = model.apply({"params": params}, image, None, train=True)
     total = jnp.float32(0.0)
     for level in outs:
+        if ssim_w:
+            total += ssim_w * ssim_loss(level, mask,
+                                        window_size=ssim_window)
         x = level.astype(jnp.float32).reshape(image.shape[0], -1)
         t = mask.astype(jnp.float32).reshape(image.shape[0], -1)
         bce_i = jnp.sum(jnp.maximum(x, 0.0) - x * t
@@ -61,6 +66,7 @@ def _ref_loss(model, params, image, mask, *, bce_w=1.0, iou_w=1.0,
     return total
 
 
+@pytest.mark.slow
 def test_forward_shape_and_finite_grads():
     model = _tiny_model()
     batch = _data(b=2)
@@ -75,6 +81,7 @@ def test_forward_shape_and_finite_grads():
     assert all(np.isfinite(np.sum(l)) for l in jax.tree_util.tree_leaves(g))
 
 
+@pytest.mark.slow
 def test_sp_step_matches_single_device(eight_devices):
     model = _tiny_model()
     batch = _data(b=4, hw=32)  # 4 patch rows -> seq=4 x 1 row each
@@ -114,15 +121,56 @@ def test_sp_step_matches_single_device(eight_devices):
                                    atol=2e-5, rtol=2e-4)
 
 
-def test_sp_step_rejects_ssim(eight_devices):
+@pytest.mark.slow
+@pytest.mark.parametrize("window", [11, 7])
+def test_sp_step_with_ssim_matches_single_device(window, eight_devices):
+    """The full BASNet hybrid loss (BCE+IoU+SSIM) under SP: the
+    halo exchange (window//2 rows) must make the windowed SSIM blur
+    exact across row-block edges — gradients equal the single-device
+    objective, at the configured loss.ssim_window, not just 11."""
+    import dataclasses
+
     from distributed_sod_project_tpu.configs import LossConfig
+    from distributed_sod_project_tpu.train.state import TrainState
 
+    model = _tiny_model()
+    batch = _data(b=4, hw=32, seed=3)  # 8 pixel rows/device >= halo 5
     mesh = make_mesh(MeshConfig(data=2, seq=4), eight_devices)
-    with pytest.raises(ValueError, match="ssim"):
-        make_sp_train_step(_tiny_model(), LossConfig(ssim=1.0),
-                           optax.sgd(0.1), mesh)
+
+    variables = model.init(jax.random.key(0), batch["image"], None,
+                           train=False)
+    params = variables["params"]
+    tx = optax.sgd(0.1)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       batch_stats={}, opt_state=tx.init(params))
+    state = jax.device_put(state, replicated_sharding(mesh))
+    dev_batch = jax.device_put(batch, sp_batch_sharding(mesh))
+
+    step = make_sp_train_step(
+        model, LossConfig(bce=1.0, iou=1.0, ssim=1.0, ssim_window=window),
+        tx, mesh, donate=False)
+    new_state, metrics = step(state, dev_batch)
+
+    ref_total, ref_grads = jax.value_and_grad(
+        lambda p: _ref_loss(model, p, batch["image"], batch["mask"],
+                            ssim_w=1.0, ssim_window=window))(params)
+    assert 0.0 <= float(metrics["ssim"]) <= 2.0 * len(
+        model.apply({"params": params}, batch["image"], None,
+                    train=False))
+    np.testing.assert_allclose(float(metrics["total"]), float(ref_total),
+                               rtol=2e-5)
+    np.testing.assert_allclose(float(metrics["grad_norm"]),
+                               float(optax.global_norm(ref_grads)),
+                               rtol=2e-4)
+    updates, _ = tx.update(ref_grads, tx.init(params), params)
+    ref_params = optax.apply_updates(params, updates)
+    for got, want in zip(jax.tree_util.tree_leaves(new_state.params),
+                         jax.tree_util.tree_leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_fit_sp_smoke(tmp_path, eight_devices):
     """fit() routes mesh.seq>1 through the SP step end-to-end."""
     from distributed_sod_project_tpu.configs import get_config
@@ -200,6 +248,7 @@ def test_vit_tensor_parallel_shards_params(eight_devices):
     assert n_sharded >= 8
 
 
+@pytest.mark.slow
 def test_fit_sp_rejects_bad_geometry(tmp_path, eight_devices):
     """Image height not divisible by patch*seq fails fast."""
     from distributed_sod_project_tpu.configs import get_config
@@ -215,3 +264,40 @@ def test_fit_sp_rejects_bad_geometry(tmp_path, eight_devices):
     )
     with pytest.raises(ValueError, match="patch"):
         fit(cfg, max_steps=1)
+
+
+@pytest.mark.slow
+def test_evaluate_routes_through_sp_on_seq_mesh(tmp_path, eight_devices):
+    """test.py's evaluate() must use the ring-attention SP forward on a
+    seq>1 mesh (never the full-attention make_forward, whose NxN scores
+    are the memory profile SP exists to avoid) — and produce the same
+    metrics as a single-device evaluate of the same variables."""
+    import dataclasses
+
+    from distributed_sod_project_tpu.configs import get_config
+    from distributed_sod_project_tpu.configs.base import DataConfig
+    from distributed_sod_project_tpu.eval import evaluate
+    from distributed_sod_project_tpu.train.state import TrainState
+
+    cfg = get_config("vit_sod_sp").replace(
+        data=DataConfig(dataset="synthetic", image_size=(32, 32),
+                        synthetic_size=8, num_workers=0),
+        mesh=MeshConfig(data=2, seq=4),
+        global_batch_size=4,
+    )
+    cfg = cfg.replace(model=dataclasses.replace(
+        cfg.model, compute_dtype="float32"))
+    model = _tiny_model()
+    batch = _data(b=1, hw=32)
+    variables = model.init(jax.random.key(2), batch["image"], None,
+                           train=False)
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params=variables["params"], batch_stats={},
+                       opt_state=())
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4), eight_devices)
+    kw = dict(model=model, batch_size=4, compute_structure=False)
+    sp = evaluate(cfg, state, mesh=mesh, **kw)["synthetic"]
+    solo = evaluate(cfg, state, mesh=None, **kw)["synthetic"]
+    for k in ("max_fbeta", "mae", "num_images"):
+        np.testing.assert_allclose(sp[k], solo[k], atol=1e-5, err_msg=k)
