@@ -309,18 +309,24 @@ def _run(args):
     # fetched value must depend on EVERY device's shard: the train
     # metrics are pmean-replicated; eval sums the sharded output.
     if args.mode == "eval":
+        from distributed_sod_project_tpu.metrics.streaming import (
+            init_fbeta_state, update_fbeta_state)
         from distributed_sod_project_tpu.train.step import make_eval_step
 
         estep = make_eval_step(model, mesh)
-        # Eval steps are independent (no state carry), so the sync token
-        # must chain THROUGH every step or the final fetch only proves
-        # the last dispatch drained: fold each output into an
-        # accumulator and fetch that.
-        acc = [jnp.zeros((), jnp.float32)]
+        # The measured eval step is forward + DEVICE-SIDE metric
+        # accumulation (the test.py --fast-metrics / inline-eval hot
+        # loop), so the number includes what eval actually does.  The
+        # metric state also chains every step: eval forwards are
+        # independent, so without the carry the final fetch would only
+        # prove the last dispatch drained.
+        upd = jax.jit(update_fbeta_state, donate_argnums=0)
+        acc = [init_fbeta_state()]
 
         def run_step():
-            acc[0] = acc[0] + jnp.sum(estep(state, dev_batch))
-            return acc[0]
+            probs = estep(state, dev_batch)
+            acc[0] = upd(acc[0], probs, dev_batch["mask"])
+            return acc[0].mae_sum + acc[0].f_curve_sum.sum()
 
         def sync(token):
             return float(token)

@@ -160,35 +160,60 @@ def run_inference(
     save_dir: Optional[str] = None,
     compute_metrics: bool = True,
     compute_structure: bool = True,
+    device_metrics: bool = False,
 ) -> Dict[str, float]:
     """Sweep ``dataset`` through a compiled ``forward(batch)->probs``.
 
     ``forward`` maps a dict with 'image' (and optionally 'depth') of the
     static eval shape to per-pixel probabilities [B,H,W].  Returns the
     SOD metric dict (empty when ``compute_metrics=False``).
+
+    ``device_metrics=True`` accumulates the threshold-curve metrics
+    (max/mean-Fβ, Em, MAE) INSIDE jit at the eval resolution — the
+    prediction never reaches the host unless PNGs or the per-image
+    structure measures need it, and the device pipelines batch k+1's
+    forward under batch k's update.  The host convention (PySODMetrics)
+    scores at each image's ORIGINAL resolution, so numbers differ
+    slightly from the default path; use it where throughput matters and
+    the ranking is what counts (inline train eval, benchmarking).
+
+    Host post-processing (original-size resize, S/E-measure, PNG
+    encode) runs on a worker thread so it overlaps the next batch's
+    device work instead of serialising after it.
     """
     log = get_logger()
     if save_dir:
         os.makedirs(save_dir, exist_ok=True)
-    agg = SODMetrics(compute_structure=compute_structure)
 
-    n = len(dataset)
-    for lo in range(0, n, batch_size):
-        idxs = list(range(lo, min(lo + batch_size, n)))
-        pad = batch_size - len(idxs)
-        samples = [dataset[i] for i in idxs]
-        batch = {"image": np.stack([s["image"] for s in samples])}
-        if use_depth:
-            batch["depth"] = np.stack([s["depth"] for s in samples])
-        if pad:
-            batch = pad_to_batch(batch, batch_size)
-        probs = np.asarray(forward(batch))[: len(idxs)]
+    host_fbeta = compute_metrics and not device_metrics
+    host_structure = compute_metrics and compute_structure
+    agg = (SODMetrics(compute_structure=host_structure,
+                      compute_fbeta=host_fbeta)
+           if (host_fbeta or host_structure) else None)
+    need_host = agg is not None or bool(save_dir)
 
+    dev_state = dev_update = None
+    if compute_metrics and device_metrics:
+        from ..metrics.streaming import init_fbeta_state, update_fbeta_state
+
+        dev_state = init_fbeta_state()
+        dev_update = jax.jit(update_fbeta_state, donate_argnums=0)
+
+    # Host worker: drains (device probs, indices, samples) and does the
+    # original-resolution work.  maxsize bounds in-flight device
+    # outputs; np.asarray inside the worker is the blocking fetch.
+    import queue
+    import threading
+
+    errors: list = []
+    work_q: queue.Queue = queue.Queue(maxsize=2)
+
+    def _host_batch(probs_np, idxs, samples):
         pending = []
         for j, i in enumerate(idxs):
             gt = _original_mask(dataset, i, samples[j])
-            pred = _resize_pred(probs[j], gt.shape[:2])
-            if compute_metrics:
+            pred = _resize_pred(probs_np[j], gt.shape[:2])
+            if agg is not None:
                 agg.add(pred, gt)
             if save_dir:
                 pending.append((
@@ -196,7 +221,65 @@ def run_inference(
                     (np.clip(pred, 0, 1) * 255).astype(np.uint8)))
         if pending:
             _save_pngs(pending)
-    out = agg.results() if compute_metrics else {}
+
+    def _worker():
+        while True:
+            item = work_q.get()
+            try:
+                if item is None:
+                    return
+                probs_dev, idxs, samples = item
+                _host_batch(np.asarray(probs_dev)[: len(idxs)], idxs,
+                            samples)
+            except Exception as e:  # noqa: BLE001 — re-raised on main
+                errors.append(e)
+            finally:
+                work_q.task_done()
+
+    worker = None
+    if need_host:
+        worker = threading.Thread(target=_worker, daemon=True)
+        worker.start()
+
+    n = len(dataset)
+    try:
+        for lo in range(0, n, batch_size):
+            if errors:
+                break
+            idxs = list(range(lo, min(lo + batch_size, n)))
+            pad = batch_size - len(idxs)
+            samples = [dataset[i] for i in idxs]
+            batch = {"image": np.stack([s["image"] for s in samples])}
+            if use_depth:
+                batch["depth"] = np.stack([s["depth"] for s in samples])
+            if pad:
+                batch = pad_to_batch(batch, batch_size)
+            probs = forward(batch)  # async dispatch — no host sync here
+            if dev_update is not None:
+                gts = np.stack([s["mask"] for s in samples])
+                if pad:
+                    gts = np.concatenate(
+                        [gts, np.zeros((pad,) + gts.shape[1:], gts.dtype)])
+                valid = np.concatenate(
+                    [np.ones((len(idxs),), np.float32),
+                     np.zeros((pad,), np.float32)])
+                dev_state = dev_update(dev_state, probs, gts, valid=valid)
+            if need_host:
+                work_q.put((probs, idxs, samples))
+    finally:
+        if worker is not None:
+            work_q.put(None)
+            worker.join()
+    if errors:
+        raise errors[0]
+
+    out: Dict[str, float] = {}
+    if dev_state is not None:
+        from ..metrics.aggregator import results_from_state
+
+        out.update(results_from_state(jax.device_get(dev_state)))
+    if agg is not None:
+        out.update(agg.results())
     if out:
         log.info("eval: %s", {k: round(v, 4) if isinstance(v, float) else v
                               for k, v in out.items()})
@@ -228,6 +311,7 @@ def evaluate(
     batch_size: Optional[int] = None,
     compute_structure: bool = True,
     tta: bool = False,
+    device_metrics: bool = False,
 ) -> Dict[str, Dict[str, float]]:
     """Test-entrypoint engine: run every test set through the model.
 
@@ -236,6 +320,8 @@ def evaluate(
     chips work on every batch — the pod/donut eval path); without it the
     jit runs on the default device.  ``tta`` averages in the
     horizontally-flipped prediction (2x forward cost).
+    ``device_metrics`` accumulates Fβ/Em/MAE in the compiled step at
+    eval resolution (see run_inference).
     """
     from ..data import resolve_dataset
     from ..models import build_model
@@ -250,21 +336,34 @@ def evaluate(
     # (3-4x the param bytes, replicated onto every chip for nothing).
     variables = (state.eval_variables() if hasattr(state, "eval_variables")
                  else state.variables())
-    if mesh is not None:
-        from ..parallel.mesh import (eval_batch_divisor,
-                                     eval_batch_sharding,
-                                     replicated_sharding)
+    from ..parallel.sp import (make_sp_eval_forward, sp_eval_batch_size,
+                               wants_sp_eval)
 
-        div = eval_batch_divisor(mesh)  # batch over flattened (data, seq)
-        bs = max(1, bs // div) * div
-        variables = jax.device_put(variables, replicated_sharding(mesh))
-
-    _apply = make_forward(model)
-
-    def forward(batch):
+    if wants_sp_eval(model, mesh):
+        # Row-sharded ring-attention forward (same helper as the inline
+        # eval in train/loop.py): a full-attention eval would
+        # materialise the NxN score matrix per chip — the memory
+        # profile an SP-trained model exists to avoid at long-context
+        # resolutions.
+        bs = sp_eval_batch_size(mesh, bs)
+        forward = make_sp_eval_forward(model, mesh)(variables)
+    else:
         if mesh is not None:
-            batch = jax.device_put(batch, eval_batch_sharding(mesh))
-        return _apply(variables, batch)
+            from ..parallel.mesh import (eval_batch_divisor,
+                                         eval_batch_sharding,
+                                         replicated_sharding)
+
+            div = eval_batch_divisor(mesh)  # batch over flat (data, seq)
+            bs = max(1, bs // div) * div
+            variables = jax.device_put(variables,
+                                       replicated_sharding(mesh))
+
+        _apply = make_forward(model)
+
+        def forward(batch):
+            if mesh is not None:
+                batch = jax.device_put(batch, eval_batch_sharding(mesh))
+            return _apply(variables, batch)
 
     if tta:
         forward = flip_tta(forward)
@@ -277,5 +376,6 @@ def evaluate(
             use_depth=cfg.data.use_depth,
             save_dir=os.path.join(save_root, name) if save_root else None,
             compute_structure=compute_structure,
+            device_metrics=device_metrics,
         )
     return results

@@ -24,9 +24,15 @@ from .weighted import adaptive_fbeta, weighted_fmeasure
 
 
 class SODMetrics:
-    def __init__(self, compute_structure: bool = True):
+    def __init__(self, compute_structure: bool = True,
+                 compute_fbeta: bool = True):
+        """``compute_fbeta=False`` skips the threshold-curve/MAE state —
+        used when those accumulate on-device (eval/inference.py
+        ``device_metrics``) and this aggregator only owns the
+        host-side per-image structure measures."""
         self._state: FBetaState = init_fbeta_state()
         self._compute_structure = compute_structure
+        self._compute_fbeta = compute_fbeta
         self._sm: list = []
         self._em: list = []
         self._adp: list = []
@@ -38,9 +44,11 @@ class SODMetrics:
         g = np.asarray(gt).squeeze()
         if p.shape != g.shape:
             raise ValueError(f"pred {p.shape} vs gt {g.shape}")
-        self._state = update_fbeta_state(
-            self._state, p[None, ..., None], g[None, ..., None].astype(np.float32)
-        )
+        if self._compute_fbeta:
+            self._state = update_fbeta_state(
+                self._state, p[None, ..., None],
+                g[None, ..., None].astype(np.float32)
+            )
         if self._compute_structure:
             self._sm.append(s_measure(p, g))
             self._em.append(e_measure(p, g))
@@ -63,20 +71,27 @@ class SODMetrics:
         }
 
     def results(self) -> Dict[str, float]:
-        f = mean_fbeta_curve(self._state)  # macro curve, one finalise pass
-        em = mean_emeasure_curve(self._state)
-        n = max(float(self._state.count), 1.0)
-        out = {
-            "max_fbeta": float(f.max()),
-            "mean_fbeta": float(f.mean()),
-            "max_emeasure": float(em.max()),
-            "mean_emeasure": float(em.mean()),
-            "mae": float(self._state.mae_sum) / n,
-            "num_images": int(self._state.count),
-        }
+        out = (results_from_state(self._state) if self._compute_fbeta
+               else {"num_images": len(self._sm)})
         if self._compute_structure and self._sm:
             out["s_measure"] = float(np.mean(self._sm))
             out["e_measure"] = float(np.mean(self._em))
             out["adp_fbeta"] = float(np.mean(self._adp))
             out["weighted_fmeasure"] = float(np.mean(self._wfm))
         return out
+
+
+def results_from_state(state: FBetaState) -> Dict[str, float]:
+    """The standard result dict from accumulated threshold-curve state —
+    shared by the host aggregator and the device-side eval path."""
+    f = np.asarray(mean_fbeta_curve(state))  # macro, one finalise pass
+    em = np.asarray(mean_emeasure_curve(state))
+    n = max(float(state.count), 1.0)
+    return {
+        "max_fbeta": float(f.max()),
+        "mean_fbeta": float(f.mean()),
+        "max_emeasure": float(em.max()),
+        "mean_emeasure": float(em.mean()),
+        "mae": float(state.mae_sum) / n,
+        "num_images": int(state.count),
+    }

@@ -96,12 +96,17 @@ def _em_curves_from_hists(pos, neg, *, eps: float = 1e-12):
 
 
 def update_fbeta_state(
-    state: FBetaState, pred, gt, *, beta2: float = BETA2, eps: float = 1e-8
+    state: FBetaState, pred, gt, *, beta2: float = BETA2, eps: float = 1e-8,
+    valid=None,
 ) -> FBetaState:
     """Accumulate a batch.  pred ∈ [0,1] float, gt binary, both [B,H,W,1]
-    (or [B,H,W]); static shapes, no host sync."""
+    (or [B,H,W]); static shapes, no host sync.  ``valid`` ([B], 0/1)
+    masks out zero-padded tail images so fixed-size compiled eval
+    batches accumulate exactly — a padded slot contributes nothing."""
     p = pred.astype(jnp.float32).reshape(pred.shape[0], -1)
     t = (gt.astype(jnp.float32) > 0.5).reshape(gt.shape[0], -1).astype(jnp.float32)
+    v = (jnp.ones((p.shape[0],), jnp.float32) if valid is None
+         else valid.astype(jnp.float32))
     bins = jnp.clip((p * (NUM_BINS - 1)).astype(jnp.int32), 0, NUM_BINS - 1)
 
     def hists(b, tt):
@@ -112,14 +117,14 @@ def update_fbeta_state(
     pos_b, neg_b = jax.vmap(hists)(bins, t)  # [B,256] each
     _, _, f_b = _curves_from_hists(pos_b, neg_b, beta2=beta2, eps=eps)
     em_b = _em_curves_from_hists(pos_b, neg_b)
-    mae = jnp.abs(p - t).mean(axis=-1).sum()
+    mae_i = jnp.abs(p - t).mean(axis=-1)
     return FBetaState(
-        f_curve_sum=state.f_curve_sum + f_b.sum(axis=0),
-        e_curve_sum=state.e_curve_sum + em_b.sum(axis=0),
-        pos_hist=state.pos_hist + pos_b.sum(axis=0),
-        neg_hist=state.neg_hist + neg_b.sum(axis=0),
-        mae_sum=state.mae_sum + mae,
-        count=state.count + p.shape[0],
+        f_curve_sum=state.f_curve_sum + (f_b * v[:, None]).sum(axis=0),
+        e_curve_sum=state.e_curve_sum + (em_b * v[:, None]).sum(axis=0),
+        pos_hist=state.pos_hist + (pos_b * v[:, None]).sum(axis=0),
+        neg_hist=state.neg_hist + (neg_b * v[:, None]).sum(axis=0),
+        mae_sum=state.mae_sum + (mae_i * v).sum(),
+        count=state.count + v.sum(),
     )
 
 

@@ -361,31 +361,24 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
 
     from ..eval import run_inference
     from ..eval.inference import make_forward
-    from ..parallel.mesh import (eval_batch_divisor, eval_batch_sharding,
-                                 replicated_sharding)
+    from ..parallel.mesh import eval_batch_divisor, eval_batch_sharding
 
     data_cfg = cfg.data
     if cfg.data.val_root:
         data_cfg = dataclasses.replace(cfg.data, root=cfg.data.val_root)
     dataset = resolve_dataset(data_cfg)
 
-    use_sp = mesh.shape.get("seq", 1) > 1 and hasattr(model, "patch")
-    if use_sp:
-        # Sequence-parallel forward: image rows shard over ``seq`` with
-        # ring attention, matching the train step's memory profile — a
+    from ..parallel.sp import (make_sp_eval_forward, sp_eval_batch_size,
+                               wants_sp_eval)
+
+    if wants_sp_eval(model, mesh):
+        # Sequence-parallel forward (same helper as test.py's
+        # evaluate()): image rows shard over ``seq`` with ring
+        # attention, matching the train step's memory profile — a
         # full-attention eval would materialise the NxN scores the SP
         # run exists to avoid.  Batch shards over ``data`` only.
-        from ..parallel.sp import make_sp_eval_step, sp_batch_sharding
-
-        sp_forward = make_sp_eval_step(model, mesh)
-        div = mesh.shape.get("data", 1)
-        bs = max(1, cfg.global_batch_size // div) * div
-
-        def make_eval_forward(variables):
-            variables = jax.device_put(variables,
-                                       replicated_sharding(mesh))
-            return lambda b: sp_forward(
-                variables, jax.device_put(b, sp_batch_sharding(mesh)))
+        bs = sp_eval_batch_size(mesh, cfg.global_batch_size)
+        make_eval_forward = make_sp_eval_forward(model, mesh)
     else:
         # jit once with the variables as an argument: re-invoking eval
         # does NOT retrace (same shapes), unlike a fresh closure per
@@ -402,12 +395,17 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
         fwd = make_eval_forward(state.eval_variables())
         # Every host sweeps the full val set: metrics must be identical
         # across processes for consistent best-k checkpoint ranking.
+        # device_metrics: Fβ/MAE accumulate inside jit at eval
+        # resolution — the prediction never crosses to the host, so the
+        # inline eval costs ~the forward sweep, not the forward sweep
+        # plus a host metrics pass.
         return {k: v for k, v in run_inference(
             fwd,
             dataset,
             batch_size=bs,
             use_depth=cfg.data.use_depth,
             compute_structure=False,
+            device_metrics=True,
         ).items() if isinstance(v, float)}
 
     return eval_fn

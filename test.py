@@ -35,6 +35,12 @@ def parse_args(argv=None):
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--no-structure", action="store_true",
                    help="skip S/E-measure (faster)")
+    p.add_argument("--fast-metrics", action="store_true",
+                   help="accumulate Fβ/Em/MAE on-device at the eval "
+                        "resolution instead of the host-side "
+                        "original-resolution convention — much faster, "
+                        "slightly different numbers (PySODMetrics "
+                        "scores at each image's native size)")
     p.add_argument("--tta", action="store_true",
                    help="average in the horizontally-flipped prediction "
                         "(2x forward cost)")
@@ -78,7 +84,7 @@ def main(argv=None):
     results = evaluate(cfg, state, model=model, mesh=mesh, datasets=datasets,
                        save_root=args.save_dir, batch_size=args.batch_size,
                        compute_structure=not args.no_structure,
-                       tta=args.tta)
+                       tta=args.tta, device_metrics=args.fast_metrics)
     print(json.dumps(results, indent=2))
     return 0
 

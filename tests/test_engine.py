@@ -136,6 +136,7 @@ def test_apply_overrides_types_and_errors():
         apply_overrides(cfg, ["optim.lr"])
 
 
+@pytest.mark.slow
 def test_fit_with_inline_eval_and_tensorboard(tmp_path, eight_devices):
     cfg = _smoke_cfg(tmp_path).replace(
         eval_every_steps=2, best_metric="max_fbeta")
@@ -147,6 +148,7 @@ def test_fit_with_inline_eval_and_tensorboard(tmp_path, eight_devices):
     assert tb, "no tensorboard event files"
 
 
+@pytest.mark.slow
 def test_preemption_guard_checkpoints_and_stops(tmp_path, eight_devices):
     import signal
 
@@ -170,6 +172,7 @@ def test_preemption_guard_checkpoints_and_stops(tmp_path, eight_devices):
     assert out["final_step"] in steps
 
 
+@pytest.mark.slow
 def test_resume_with_no_remaining_steps_is_a_noop(eight_devices, tmp_path):
     """Resuming at max_steps must not force-save over the existing
     checkpoint (orbax StepAlreadyExistsError regression)."""
@@ -200,6 +203,7 @@ def test_resume_with_no_remaining_steps_is_a_noop(eight_devices, tmp_path):
 @pytest.mark.parametrize("config_name", ["hdfnet_rgbd", "u2net_ds",
                                          "basnet_ds", "swin_sod",
                                          "vit_sod_sp"])
+@pytest.mark.slow
 def test_fit_one_step_every_zoo_config(config_name, eight_devices,
                                        tmp_path):
     """Every BASELINE config trains one real step through fit() —
@@ -228,6 +232,7 @@ def test_fit_one_step_every_zoo_config(config_name, eight_devices,
     assert np.isfinite(metrics["total"])
 
 
+@pytest.mark.slow
 def test_fit_aborts_on_persistent_divergence(eight_devices, tmp_path,
                                              monkeypatch):
     """skip_nonfinite: bad updates are never applied, and fit raises
@@ -288,6 +293,7 @@ def test_flip_tta_is_identity_for_equivariant_forward():
     np.testing.assert_allclose(out, batch["image"][..., 0], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_evaluate_with_tta(tmp_path, eight_devices):
     from distributed_sod_project_tpu.data import resolve_dataset
     from distributed_sod_project_tpu.eval import evaluate
@@ -308,3 +314,60 @@ def test_evaluate_with_tta(tmp_path, eight_devices):
                    compute_structure=False, tta=True)
     m = res["synthetic"]
     assert 0.0 <= m["mae"] <= 1.0 and m["num_images"] == len(ds)
+
+
+def test_device_metrics_match_host_path(tmp_path, eight_devices):
+    """run_inference(device_metrics=True) — threshold metrics fused into
+    the compiled step — must agree with the host-side aggregator when
+    original resolution == eval resolution (synthetic data), proving the
+    fast path computes the same numbers, not an approximation."""
+    from distributed_sod_project_tpu.data import resolve_dataset
+    from distributed_sod_project_tpu.eval.inference import (
+        make_forward, run_inference)
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.train import (
+        build_optimizer, create_train_state)
+
+    cfg = _smoke_cfg(tmp_path)
+    model = build_model(cfg.model.__class__(
+        name="minet", backbone="vgg16", sync_bn=False,
+        compute_dtype="float32"))
+    tx, _ = build_optimizer(cfg.optim, 1)
+    ds = resolve_dataset(cfg.data)
+    batch = {"image": np.asarray(ds[0]["image"])[None]}
+    state = create_train_state(jax.random.key(0), model, tx, batch)
+    fwd = make_forward(model)
+    variables = state.eval_variables()
+
+    kw = dict(batch_size=4, compute_structure=False)
+    host = run_inference(lambda b: fwd(variables, b), ds, **kw)
+    dev = run_inference(lambda b: fwd(variables, b), ds,
+                        device_metrics=True, **kw)
+    assert dev["num_images"] == host["num_images"] == len(ds)
+    for k in ("max_fbeta", "mean_fbeta", "max_emeasure", "mae"):
+        np.testing.assert_allclose(dev[k], host[k], atol=1e-5, err_msg=k)
+
+
+def test_run_inference_worker_thread_raises_on_host_error(tmp_path,
+                                                          eight_devices):
+    """An exception in the host post-processing worker (here: the PNG
+    path is unwritable because a directory squats on it) must surface
+    on the caller, not vanish in the thread."""
+    from distributed_sod_project_tpu.data import SyntheticSOD
+    from distributed_sod_project_tpu.eval.inference import run_inference
+
+    ds = SyntheticSOD(size=8, image_size=(32, 32), use_depth=False)
+    save_dir = tmp_path / "preds"
+    save_dir.mkdir()
+    (save_dir / "000000.png").mkdir()  # first image's output path
+
+    def forward(batch):
+        import jax.numpy as jnp
+
+        return jnp.zeros(batch["image"].shape[:3], jnp.float32)
+
+    # PIL raises IsADirectoryError (OSError); the native C++ batch
+    # writer raises RuntimeError — either way it must cross the thread.
+    with pytest.raises((OSError, RuntimeError)):
+        run_inference(forward, ds, batch_size=4, compute_metrics=False,
+                      save_dir=str(save_dir))
