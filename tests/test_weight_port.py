@@ -369,6 +369,7 @@ def test_swin_port_block_matches_official_math():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_swin_port_loads_into_swin_sod():
     """The full ported tree grafts into SwinSOD's SwinT_0 scope via the
     structural matcher, and the model still runs."""
@@ -410,6 +411,7 @@ def test_swin_port_loads_into_swin_sod():
     assert np.isfinite(np.asarray(outs[0])).all()
 
 
+@pytest.mark.slow
 def test_swin_port_adapts_bias_tables_to_small_inputs():
     """At 64px the deep stages shrink their windows (<7), so the target
     bias tables are smaller than the checkpoint's — the loader resizes
@@ -587,3 +589,149 @@ def test_vit_port_loads_into_vit_sod():
         sd["norm.weight"].numpy())
     outs = model.apply(merged, x, None, train=False)
     assert np.isfinite(np.asarray(outs[0])).all()
+
+
+# ------------------------------------------------- full-model parity
+
+class _TCBA(tnn.Module):
+    """torch twin of models/layers.py::ConvBNAct (conv→BN→ReLU,
+    padding=k//2 — the layout port_minet_vgg16 documents)."""
+
+    def __init__(self, cin, cout, k=3, bn=True):
+        super().__init__()
+        self.conv = tnn.Conv2d(cin, cout, k, padding=k // 2, bias=not bn)
+        self.bn = tnn.BatchNorm2d(cout) if bn else None
+
+    def forward(self, x):
+        x = self.conv(x)
+        if self.bn is not None:
+            x = self.bn(x)
+        return torch.relu(x)
+
+
+def _t_resize(x, hw):
+    import torch.nn.functional as F
+
+    if x.shape[-2:] == tuple(hw):
+        return x
+    # antialias on downscale matches jax.image.resize's default.
+    return F.interpolate(x, size=tuple(hw), mode="bilinear",
+                         align_corners=False, antialias=True)
+
+
+class _TorchAIM(tnn.Module):
+    def __init__(self, w, c_cur, c_below, c_above):
+        super().__init__()
+        cbas = [_TCBA(c_cur, w)]
+        n_parts = 1
+        if c_below is not None:
+            cbas.append(_TCBA(c_below, w))
+            n_parts += 1
+        if c_above is not None:
+            cbas.append(_TCBA(c_above, w))
+            n_parts += 1
+        cbas.append(_TCBA(w * n_parts, w))
+        self.cbas = tnn.ModuleList(cbas)
+        self.has_below = c_below is not None
+        self.has_above = c_above is not None
+
+    def forward(self, below, cur, above):
+        parts = [self.cbas[0](cur)]
+        j = 1
+        if self.has_below:
+            parts.append(_t_resize(self.cbas[j](below), cur.shape[-2:]))
+            j += 1
+        if self.has_above:
+            parts.append(_t_resize(self.cbas[j](above), cur.shape[-2:]))
+            j += 1
+        return self.cbas[j](torch.cat(parts, dim=1))
+
+
+class _TorchSIM(tnn.Module):
+    def __init__(self, w, cin):
+        super().__init__()
+        # Index order = flax linen CREATION order, which is
+        # outer-before-inner for `ConvBNAct(...)(ConvBNAct(...)(x))`
+        # (the constructor expression evaluates before its arguments) —
+        # verified against the flax SIM's param shapes.
+        self.cbas = tnn.ModuleList([
+            _TCBA(cin, w),           # 0: h
+            _TCBA(cin, w // 2),      # 1: l (pre-pool)
+            _TCBA(w, w),             # 2: h2 (outer)
+            _TCBA(w // 2, w),        # 3: l -> h exchange (inner)
+            _TCBA(w // 2, w // 2),   # 4: l2 (outer)
+            _TCBA(w, w // 2),        # 5: h -> l exchange (inner)
+            _TCBA(w + w // 2, w),    # 6: merge
+        ])
+
+    def forward(self, x):
+        import torch.nn.functional as F
+
+        pool = lambda t: F.max_pool2d(t, 2, 2)  # noqa: E731
+        h = self.cbas[0](x)
+        l = pool(self.cbas[1](x))
+        h2 = self.cbas[2](h + _t_resize(self.cbas[3](l), h.shape[-2:]))
+        l2 = self.cbas[4](l + pool(self.cbas[5](h)))
+        merged = torch.cat([h2, _t_resize(l2, h2.shape[-2:])], dim=1)
+        return self.cbas[6](merged)
+
+
+class _TorchMINet(tnn.Module):
+    """Full torch composition mirroring models/minet.py::MINet —
+    the oracle for the logit-level port-parity test."""
+
+    def __init__(self, w=64):
+        super().__init__()
+        chans = [64, 128, 256, 512, 512]
+        self.backbone = _torch_vgg16(True)
+        self.aims = tnn.ModuleList([
+            _TorchAIM(w, chans[i],
+                      chans[i - 1] if i > 0 else None,
+                      chans[i + 1] if i < 4 else None)
+            for i in range(5)])
+        self.sims = tnn.ModuleList(
+            [_TorchSIM(w, w) for _ in range(5)])
+        self.head_cba = _TCBA(w, 32)
+        self.head_conv = tnn.Conv2d(32, 1, 3, padding=1, bias=True)
+
+    def forward(self, x):
+        feats = _vgg_torch_pyramid(self.backbone, x, bn=True)
+        agg = [self.aims[i](feats[i - 1] if i > 0 else None, feats[i],
+                            feats[i + 1] if i < 4 else None)
+               for i in range(5)]
+        d = self.sims[0](agg[-1])
+        for n, i in enumerate(range(3, -1, -1)):
+            d = _t_resize(d, agg[i].shape[-2:]) + agg[i]
+            d = self.sims[n + 1](d)
+        h = self.head_cba(d)
+        logit = self.head_conv(h)
+        return _t_resize(logit, x.shape[-2:])
+
+
+@pytest.mark.slow
+def test_full_minet_port_logit_parity(tmp_path):
+    """Port a COMPLETE torch MINet-VGG16 state_dict and assert
+    logit-level forward parity — the composition-level guarantee
+    (feature indexing, AIM/SIM wiring, resize conventions, head) that
+    module-level ports cannot give (VERDICT r1 item 9)."""
+    from distributed_sod_project_tpu.models.minet import MINet
+    from tools.port_torch_weights import port_minet_vgg16
+
+    tm = _TorchMINet().eval()
+    with torch.no_grad():
+        _randomize_bn_stats(tm)
+        x = torch.randn(1, 3, 32, 32,
+                        generator=torch.Generator().manual_seed(5))
+        ref = tm(x)[:, 0].numpy()  # [B,H,W]
+
+    params, stats = port_minet_vgg16(tm.state_dict(), use_bn=True)
+    fm = MINet(backbone="vgg16", backbone_bn=True)
+    variables = jax.tree_util.tree_map(
+        jnp.asarray, {"params": params, "batch_stats": stats})
+    # The ported tree must be structurally complete for the flax model:
+    # apply with the ported variables alone (no init-merging).
+    outs = fm.apply(variables,
+                    jnp.asarray(x.permute(0, 2, 3, 1).numpy()),
+                    train=False)
+    got = np.asarray(outs[0][..., 0])
+    np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
