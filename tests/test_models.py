@@ -59,6 +59,7 @@ def test_model_forward_from_config(config_name):
     assert outs[0].dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_minet_train_mode_updates_batch_stats_and_grads_finite():
     cfg = get_config("minet_vgg16_ref")
     model = build_model(cfg.model.__class__(
@@ -92,6 +93,7 @@ def test_minet_train_mode_updates_batch_stats_and_grads_finite():
     assert any(not np.allclose(a, b) for a, b in zip(old, new))
 
 
+@pytest.mark.slow
 def test_minet_bf16_compute_keeps_f32_output():
     cfg = get_config("minet_vgg16_ref")
     model = build_model(cfg.model.__class__(
@@ -132,6 +134,7 @@ def _finite_grad_check(model, x, y, depth=None, n_outputs=None):
     assert any(float(jnp.abs(g).max()) > 0 for g in flat)
 
 
+@pytest.mark.slow
 def test_u2net_seven_outputs_and_finite_grads():
     from distributed_sod_project_tpu.models.u2net import U2Net
 
@@ -142,6 +145,7 @@ def test_u2net_seven_outputs_and_finite_grads():
     _finite_grad_check(model, x, y, n_outputs=7)
 
 
+@pytest.mark.slow
 def test_basnet_eight_outputs_and_finite_grads():
     from distributed_sod_project_tpu.models.basnet import BASNet
 
@@ -152,6 +156,7 @@ def test_basnet_eight_outputs_and_finite_grads():
     _finite_grad_check(model, x, y, n_outputs=8)
 
 
+@pytest.mark.slow
 def test_hdfnet_rgbd_outputs_and_finite_grads():
     from distributed_sod_project_tpu.models.hdfnet import HDFNet
 
@@ -200,6 +205,7 @@ def test_registry_builds_all_zoo_models():
     assert {"minet", "u2net", "basnet", "hdfnet"} <= set(list_models())
 
 
+@pytest.mark.slow
 def test_swin_backbone_pyramid_shapes():
     from distributed_sod_project_tpu.models.backbones.swin import SwinT
 
@@ -222,6 +228,7 @@ def test_swin_window_partition_roundtrip():
     np.testing.assert_allclose(np.asarray(back), np.asarray(x))
 
 
+@pytest.mark.slow
 def test_swin_sod_outputs_and_finite_grads():
     from distributed_sod_project_tpu.models.swin_sod import SwinSOD
 
@@ -232,6 +239,7 @@ def test_swin_sod_outputs_and_finite_grads():
     _finite_grad_check(model, x, y, n_outputs=3)
 
 
+@pytest.mark.slow
 def test_swin_nondivisible_input_padding():
     # 56 = 8*7: stride-4 map is 14 (divisible by 7), stride-8 is 7,
     # stride-16 is 3 (needs pad→window clamp), stride-32 is 1.

@@ -50,6 +50,7 @@ def _setup():
     return cfg, model, tx, sched, batch, state
 
 
+@pytest.mark.slow
 def test_tp_step_matches_single_device_step(eight_devices):
     cfg, model, tx, sched, batch, state0 = _setup()
 
@@ -90,6 +91,7 @@ def test_tp_step_matches_single_device_step(eight_devices):
     assert int(tp_state.step) == 1
 
 
+@pytest.mark.slow
 def test_tp_rules_shard_attention_kernels(eight_devices):
     _, model, tx, _, batch, state0 = _setup()
     tp_mesh = make_mesh(MeshConfig(data=2, model=2), eight_devices[:4])
@@ -180,6 +182,7 @@ def test_zero1_shards_opt_state_and_matches_oracle(eight_devices):
     assert p0.addressable_shards[0].data.shape == p0.shape
 
 
+@pytest.mark.slow
 def test_fit_routes_through_gspmd_for_zero1(eight_devices, tmp_path):
     """cfg.optim.zero1 routes fit() through the GSPMD step end-to-end."""
     import dataclasses

@@ -3,9 +3,12 @@
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 
+@pytest.mark.slow
 def test_dump_hlo_writes_stablehlo(tmp_path):
     import dump_hlo
 
@@ -65,6 +68,7 @@ def test_plot_curves_partial_entries(tmp_path):
     assert not (tmp_path / "f" / "pr_curve.png").exists()
 
 
+@pytest.mark.slow
 def test_predict_cli_writes_original_size_maps(tmp_path, eight_devices):
     """tools/predict.py: checkpoint (config sidecar) → saliency PNGs at
     each input's ORIGINAL resolution, batch padding included (3 images,
@@ -111,6 +115,7 @@ def test_predict_cli_writes_original_size_maps(tmp_path, eight_devices):
         assert arr.min() >= 0 and arr.max() <= 255
 
 
+@pytest.mark.slow
 def test_check_determinism_tool(tmp_path, capsys, monkeypatch):
     """tools/check_determinism.py: two identical runs → bitwise-equal
     params, exit 0 (the §5 'race detection' audit)."""

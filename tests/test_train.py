@@ -180,6 +180,7 @@ def test_eval_step_shapes(eight_devices):
     assert p.min() >= 0.0 and p.max() <= 1.0
 
 
+@pytest.mark.slow
 def test_remat_step_matches_baseline(eight_devices):
     """jax.checkpoint must not change the numbers, only the memory."""
     from distributed_sod_project_tpu.configs import get_config
