@@ -47,9 +47,18 @@ def maybe_enable_compilation_cache(path: str | None = None) -> None:
              or os.path.expanduser("~/.cache/dsod_xla"))
     try:
         os.makedirs(cache, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache)
-        # Cache every program that takes non-trivial compile time.
+        # Thresholds first, the cache dir LAST: the dir update is the
+        # switch that turns the cache on, so any failure before it
+        # leaves the cache fully off and the warning below accurate.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:  # noqa: BLE001 — older jaxlib: cache is best-effort
-        pass
+        jax.config.update("jax_compilation_cache_dir", cache)
+    except (OSError, AttributeError, ValueError) as e:
+        # Unwritable cache dir, or an older jaxlib without these config
+        # keys.  Best-effort, but never silent: cache-off must be
+        # distinguishable from cache-on when debugging slow compiles.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "persistent compilation cache disabled (%s: %s)",
+            type(e).__name__, e)

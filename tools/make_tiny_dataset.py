@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Generate a tiny real-file SOD dataset for convergence/overfit runs.
+
+The shapes are learnable (masks are ellipses the image actually
+contains, depth correlates with the mask), so a model that optimizes
+end-to-end drives eval max-Fβ toward 1 on a held-in sweep — the
+BASELINE.md convergence-evidence protocol.
+
+    python tools/make_tiny_dataset.py --out /tmp/duts16 --n 16
+    python tools/make_tiny_dataset.py --out /tmp/rgbd16 --n 16 --rgbd
+
+Layouts match data/folder.py:
+  DUTS:  <out>/DUTS-TR-Image/*.jpg + <out>/DUTS-TR-Mask/*.png
+  RGB-D: <out>/{RGB,depth,GT}/ with matching stems.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+from PIL import Image, ImageDraw
+
+
+def make_sample(rng: np.random.RandomState, size: int):
+    """(image RGB, mask L, depth L) with 1–3 salient ellipses."""
+    img = Image.new(
+        "RGB", (size, size),
+        tuple(int(c) for c in rng.randint(0, 90, size=3)))
+    mask = Image.new("L", (size, size), 0)
+    di, dm = ImageDraw.Draw(img), ImageDraw.Draw(mask)
+    for _ in range(rng.randint(1, 4)):
+        w, h = rng.randint(size // 6, size // 2, size=2)
+        x0, y0 = rng.randint(0, size - w), rng.randint(0, size - h)
+        color = tuple(int(c) for c in rng.randint(140, 255, size=3))
+        di.ellipse([x0, y0, x0 + w, y0 + h], fill=color)
+        dm.ellipse([x0, y0, x0 + w, y0 + h], fill=255)
+    # speckle noise so the mapping isn't a pure threshold
+    noise = rng.randint(0, 25, size=(size, size, 3)).astype(np.uint8)
+    img = Image.fromarray(
+        np.clip(np.asarray(img, np.int16) + noise, 0, 255).astype(np.uint8))
+    m = np.asarray(mask, np.float32) / 255.0
+    depth = (0.25 + 0.6 * m) * 255.0 + rng.randn(size, size) * 8.0
+    depth_im = Image.fromarray(np.clip(depth, 0, 255).astype(np.uint8), "L")
+    return img, mask, depth_im
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", required=True)
+    p.add_argument("--n", type=int, default=16)
+    p.add_argument("--size", type=int, default=96)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--rgbd", action="store_true",
+                   help="NJU2K/NLPR-style RGB+depth+GT layout")
+    args = p.parse_args(argv)
+
+    rng = np.random.RandomState(args.seed)
+    if args.rgbd:
+        dirs = {"img": "RGB", "mask": "GT", "depth": "depth"}
+    else:
+        dirs = {"img": "DUTS-TR-Image", "mask": "DUTS-TR-Mask"}
+    for d in dirs.values():
+        os.makedirs(os.path.join(args.out, d), exist_ok=True)
+
+    for i in range(args.n):
+        img, mask, depth = make_sample(rng, args.size)
+        stem = f"tiny_{i:04d}"
+        img.save(os.path.join(args.out, dirs["img"], stem + ".jpg"),
+                 quality=95)
+        mask.save(os.path.join(args.out, dirs["mask"], stem + ".png"))
+        if args.rgbd:
+            depth.save(os.path.join(args.out, dirs["depth"], stem + ".png"))
+    print(f"wrote {args.n} samples to {args.out} "
+          f"({'RGB-D' if args.rgbd else 'DUTS'} layout)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
