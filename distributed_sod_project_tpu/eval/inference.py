@@ -161,6 +161,8 @@ def run_inference(
     compute_metrics: bool = True,
     compute_structure: bool = True,
     device_metrics: bool = False,
+    shard: Optional[tuple] = None,
+    return_state: bool = False,
 ) -> Dict[str, float]:
     """Sweep ``dataset`` through a compiled ``forward(batch)->probs``.
 
@@ -180,7 +182,20 @@ def run_inference(
     Host post-processing (original-size resize, S/E-measure, PNG
     encode) runs on a worker thread so it overlaps the next batch's
     device work instead of serialising after it.
+
+    ``shard=(shard_id, num_shards)`` sweeps only every num_shards-th
+    image (the multi-host split: each host scores a disjoint slice
+    instead of all hosts duplicating the full set).
+    ``return_state=True`` (requires ``device_metrics``) returns the raw
+    ``FBetaState`` instead of the result dict so the caller can psum
+    shard states across hosts before finalising.
     """
+    if return_state and not (compute_metrics and device_metrics
+                             and not compute_structure):
+        raise ValueError(
+            "return_state needs device_metrics=True and "
+            "compute_structure=False (host structure measures have "
+            "nowhere to go when only the device state is returned)")
     log = get_logger()
     if save_dir:
         os.makedirs(save_dir, exist_ok=True)
@@ -241,12 +256,14 @@ def run_inference(
         worker = threading.Thread(target=_worker, daemon=True)
         worker.start()
 
-    n = len(dataset)
+    all_idxs = (list(range(len(dataset))) if shard is None
+                else list(range(shard[0], len(dataset), shard[1])))
+    n = len(all_idxs)
     try:
         for lo in range(0, n, batch_size):
             if errors:
                 break
-            idxs = list(range(lo, min(lo + batch_size, n)))
+            idxs = all_idxs[lo:lo + batch_size]
             pad = batch_size - len(idxs)
             samples = [dataset[i] for i in idxs]
             batch = {"image": np.stack([s["image"] for s in samples])}
@@ -272,6 +289,9 @@ def run_inference(
             worker.join()
     if errors:
         raise errors[0]
+
+    if return_state:
+        return jax.device_get(dev_state)
 
     out: Dict[str, float] = {}
     if dev_state is not None:

@@ -371,14 +371,52 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
     from ..parallel.sp import (make_sp_eval_forward, sp_eval_batch_size,
                                wants_sp_eval)
 
+    # Which slice of the val set this process sweeps.  Host-disjoint
+    # slices need batches that are NOT placed on the global mesh
+    # (device_put onto non-addressable devices requires the same value
+    # on every process), so the sharded sweep pairs with a HOST-LOCAL
+    # eval mesh; the per-host metric states psum afterwards.
+    shard = (0, 1)
     if wants_sp_eval(model, mesh):
         # Sequence-parallel forward (same helper as test.py's
         # evaluate()): image rows shard over ``seq`` with ring
         # attention, matching the train step's memory profile — a
         # full-attention eval would materialise the NxN scores the SP
-        # run exists to avoid.  Batch shards over ``data`` only.
+        # run exists to avoid.  Batch shards over ``data`` only; the
+        # seq axis may span hosts, so every host sweeps the full set
+        # with identical batches (the global-placement contract).
         bs = sp_eval_batch_size(mesh, cfg.global_batch_size)
         make_eval_forward = make_sp_eval_forward(model, mesh)
+    elif jax.process_count() > 1 and mesh.shape.get("model", 1) == 1:
+        # Disjoint 1/num_hosts slice per host, on this host's own
+        # chips only — total eval work is O(1) in host count and no
+        # per-batch cross-host collectives.  Requires replicated
+        # variables (model axis == 1): tensor-parallel params span
+        # other hosts' devices and cannot be fetched host-locally, so
+        # TP falls through to the global-mesh path below.
+        import numpy as _np
+        from jax.sharding import Mesh as _Mesh
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _P
+
+        from ..parallel.mesh import host_shard
+
+        shard = host_shard()
+        local = jax.local_devices()
+        local_sharding = NamedSharding(
+            _Mesh(_np.asarray(local), ("data",)), _P("data"))
+        forward = make_forward(model)
+        bs = max(1, cfg.global_batch_size // (len(local) *
+                                              jax.process_count())
+                 ) * len(local)
+
+        def make_eval_forward(variables):
+            # Off the global mesh first: arrays committed to a mesh
+            # spanning other hosts' devices cannot join a host-local
+            # computation (replicated arrays fetch locally for free).
+            variables = jax.device_get(variables)
+            return lambda b: forward(
+                variables, jax.device_put(b, local_sharding))
     else:
         # jit once with the variables as an argument: re-invoking eval
         # does NOT retrace (same shapes), unlike a fresh closure per
@@ -392,20 +430,32 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
                 variables, jax.device_put(b, eval_batch_sharding(mesh)))
 
     def eval_fn(state) -> Dict[str, float]:
+        from ..metrics.aggregator import results_from_state
+
         fwd = make_eval_forward(state.eval_variables())
-        # Every host sweeps the full val set: metrics must be identical
-        # across processes for consistent best-k checkpoint ranking.
-        # device_metrics: Fβ/MAE accumulate inside jit at eval
-        # resolution — the prediction never crosses to the host, so the
-        # inline eval costs ~the forward sweep, not the forward sweep
-        # plus a host metrics pass.
-        return {k: v for k, v in run_inference(
+        # Each host sweeps a DISJOINT 1/num_hosts slice of the val set
+        # (not every host duplicating the full sweep), accumulating the
+        # psum-able FBetaState inside jit at eval resolution; shard
+        # states then sum across processes, so every host still
+        # finalises identical metrics — best-k checkpoint ranking stays
+        # consistent while total eval work is O(1) in host count.
+        fstate = run_inference(
             fwd,
             dataset,
             batch_size=bs,
             use_depth=cfg.data.use_depth,
             compute_structure=False,
             device_metrics=True,
-        ).items() if isinstance(v, float)}
+            shard=shard,
+            return_state=True,
+        )
+        if shard[1] > 1:
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(fstate)
+            fstate = jax.tree_util.tree_map(lambda x: x.sum(axis=0),
+                                            gathered)
+        return {k: v for k, v in results_from_state(fstate).items()
+                if isinstance(v, float)}
 
     return eval_fn
