@@ -36,20 +36,20 @@ def _smoke_cfg(tmp_path, **kw):
 def test_fit_trains_checkpoints_and_resumes(tmp_path, eight_devices):
     cfg = _smoke_cfg(tmp_path)
     seen = []
-    out = fit(cfg, max_steps=4,
+    out = fit(cfg, max_steps=2,
               hooks={"on_metrics": lambda s, m: seen.append((s, m))})
-    assert out["final_step"] == 4
+    assert out["final_step"] == 2
     assert np.isfinite(out["total"])
     assert seen and all(np.isfinite(m["total"]) for _, m in seen)
     # checkpoints exist on disk
     assert os.path.exists(os.path.join(cfg.checkpoint_dir, "config.json"))
     steps = [int(os.path.basename(d)) for d in
              glob.glob(os.path.join(cfg.checkpoint_dir, "[0-9]*"))]
-    assert 4 in steps
+    assert 2 in steps
 
-    # resume continues from step 4
-    out2 = fit(cfg, resume=True, max_steps=6)
-    assert out2["final_step"] == 6
+    # resume continues from step 2
+    out2 = fit(cfg, resume=True, max_steps=3)
+    assert out2["final_step"] == 3
 
 
 def test_fit_rejects_indivisible_batch(tmp_path, eight_devices):
@@ -104,7 +104,7 @@ def test_train_cli_smoke(tmp_path, eight_devices, monkeypatch):
         "--config", "minet_vgg16_ref",
         "--workdir", str(tmp_path / "cli_ck"),
         "--batch-size", "8",
-        "--max-steps", "2",
+        "--max-steps", "1",
     ] + small)
     assert rc == 0
     assert os.path.exists(str(tmp_path / "cli_ck" / "config.json"))
@@ -329,6 +329,7 @@ def test_device_metrics_match_host_path(tmp_path, eight_devices):
         build_optimizer, create_train_state)
 
     cfg = _smoke_cfg(tmp_path)
+    cfg = cfg.replace(data=dataclasses.replace(cfg.data, synthetic_size=8))
     model = build_model(cfg.model.__class__(
         name="minet", backbone="vgg16", sync_bn=False,
         compute_dtype="float32"))

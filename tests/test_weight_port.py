@@ -735,3 +735,116 @@ def test_full_minet_port_logit_parity(tmp_path):
                     train=False)
     got = np.asarray(outs[0][..., 0])
     np.testing.assert_allclose(got, ref, atol=1e-4, rtol=1e-4)
+
+
+class _TorchKGU(tnn.Module):
+    def __init__(self, w=64):
+        super().__init__()
+        self.cba = _TCBA(w, 64)
+        self.conv = tnn.Conv2d(64, 9, 3, padding=1)
+
+    def forward(self, g):
+        return torch.softmax(self.conv(self.cba(g)).float(), dim=1)
+
+
+def _t_dynamic_filter(x, kern, dilation):
+    """torch twin of models/hdfnet.py::dynamic_local_filter — F.unfold
+    is (C, kh, kw)-major, matching conv_general_dilated_patches."""
+    import torch.nn.functional as F
+
+    b, c, h, w = x.shape
+    patches = F.unfold(x, 3, dilation=dilation, padding=dilation)
+    patches = patches.view(b, c, 9, h, w)
+    return (patches * kern.unsqueeze(1)).sum(2)
+
+
+class _TorchDDPM(tnn.Module):
+    def __init__(self, w, cin):
+        super().__init__()
+        self.cba_in = _TCBA(cin, w)
+        self.kgus = tnn.ModuleList([_TorchKGU(w) for _ in range(3)])
+        self.cba_out = _TCBA(4 * w, w)
+
+    def forward(self, fused, guide):
+        x = self.cba_in(fused)
+        outs = [x]
+        for rate, kgu in zip((1, 2, 4), self.kgus):
+            outs.append(_t_dynamic_filter(x, kgu(guide), rate))
+        return self.cba_out(torch.cat(outs, dim=1))
+
+
+class _TorchHDFNet(tnn.Module):
+    """torch twin of models/hdfnet.py::HDFNet(backbone='vgg16') — the
+    oracle for the RGB-D full-model port-parity test."""
+
+    def __init__(self, w=64):
+        super().__init__()
+        chans = [64, 128, 256, 512, 512]
+        self.backbone_rgb = _torch_vgg16(True)
+        self.backbone_depth = _torch_vgg16(True)
+        self.guides = tnn.ModuleList(
+            [_TCBA(chans[lvl], w) for lvl in (2, 3, 4)])
+        self.ddpms = tnn.ModuleList(
+            [_TorchDDPM(w, 2 * chans[lvl]) for lvl in (2, 3, 4)])
+        self.dec_cbas = tnn.ModuleList([
+            _TCBA(w, w), _TCBA(w, w),            # sides loop
+            _TCBA(chans[1], w), _TCBA(w, w),     # lvl 1: skip, dec
+            _TCBA(chans[0], w), _TCBA(w, w),     # lvl 0: skip, dec
+        ])
+        self.heads = tnn.ModuleList(
+            [tnn.Conv2d(w, 1, 3, padding=1) for _ in range(3)])
+
+    def forward(self, x, d):
+        rgb = _vgg_torch_pyramid(self.backbone_rgb, x, bn=True)
+        dep = _vgg_torch_pyramid(self.backbone_depth,
+                                 d.repeat(1, 3, 1, 1), bn=True)
+        filtered = []
+        for i, lvl in enumerate((2, 3, 4)):
+            fused = torch.cat([rgb[lvl], dep[lvl]], dim=1)
+            guide = self.guides[i](dep[lvl])
+            filtered.append(self.ddpms[i](fused, guide))
+        dec = filtered[-1]
+        sides = []
+        for j, skip in enumerate((filtered[1], filtered[0])):
+            dec = _t_resize(dec, skip.shape[-2:]) + skip
+            dec = self.dec_cbas[j](dec)
+            sides.append(dec)
+        k = 2
+        for lvl in (1, 0):
+            skip = self.dec_cbas[k](rgb[lvl])
+            k += 1
+            dec = _t_resize(dec, skip.shape[-2:]) + skip
+            dec = self.dec_cbas[k](dec)
+            k += 1
+        return [_t_resize(head(s), x.shape[-2:])
+                for s, head in zip((dec, sides[1], sides[0]), self.heads)]
+
+
+@pytest.mark.slow
+def test_full_hdfnet_port_logit_parity(tmp_path):
+    """Port a COMPLETE torch HDFNet-VGG16 (two streams + dynamic
+    filtering + decoder) and assert logit-level parity on all three
+    deep-supervision outputs — the RGB-D composition guarantee [B:9]."""
+    from distributed_sod_project_tpu.models.hdfnet import HDFNet
+    from tools.port_torch_weights import port_hdfnet_vgg16
+
+    tm = _TorchHDFNet().eval()
+    with torch.no_grad():
+        _randomize_bn_stats(tm)
+        g = torch.Generator().manual_seed(6)
+        x = torch.randn(1, 3, 32, 32, generator=g)
+        d = torch.rand(1, 1, 32, 32, generator=g)
+        refs = [t[:, 0].numpy() for t in tm(x, d)]
+
+    params, stats = port_hdfnet_vgg16(tm.state_dict(), use_bn=True)
+    fm = HDFNet(backbone="vgg16", backbone_bn=True)
+    variables = jax.tree_util.tree_map(
+        jnp.asarray, {"params": params, "batch_stats": stats})
+    outs = fm.apply(variables,
+                    jnp.asarray(x.permute(0, 2, 3, 1).numpy()),
+                    jnp.asarray(d.permute(0, 2, 3, 1).numpy()),
+                    train=False)
+    assert len(outs) == len(refs) == 3
+    for lvl, (o, r) in enumerate(zip(outs, refs)):
+        np.testing.assert_allclose(np.asarray(o[..., 0]), r, atol=2e-4,
+                                   rtol=2e-4, err_msg=f"logit {lvl}")

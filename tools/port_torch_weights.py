@@ -177,6 +177,71 @@ def port_minet_vgg16(state_dict, use_bn: bool = True):
     return params, stats
 
 
+def port_hdfnet_vgg16(state_dict, use_bn: bool = True):
+    """FULL-model port: a torch HDFNet-VGG16 state_dict → (params,
+    batch_stats) for models/hdfnet.py::HDFNet(backbone='vgg16').
+
+    Expected torch layout (mirrored by the oracle replica in
+    tests/test_weight_port.py): ``backbone_rgb.*`` / ``backbone_depth.*``
+    torchvision-style VGG16 features, ``guides.{0..2}``,
+    ``ddpms.{i}.cba_in|cba_out|kgus.{j}.(cba|conv)``,
+    ``dec_cbas.{0..5}``, ``heads.{0..2}`` — protecting the RGB-D
+    composition ([B:9]): two-stream wiring, dynamic-filter kernel
+    generation, decoder and deep-supervision heads.
+    """
+    def bb(prefix):
+        sub = {k[len(prefix):]: v for k, v in state_dict.items()
+               if k.startswith(prefix)}
+        return port_vgg16(sub, use_bn=use_bn)
+
+    rgb_p, rgb_s = bb("backbone_rgb.")
+    dep_p, dep_s = bb("backbone_depth.")
+    params: Dict = {"vgg_rgb": rgb_p, "vgg_depth": dep_p}
+    stats: Dict = {}
+    if rgb_s:
+        stats["vgg_rgb"] = rgb_s
+        stats["vgg_depth"] = dep_s
+
+    def put_cba(flax_scope, torch_prefix):
+        p, s = _port_cba(state_dict, torch_prefix)
+        params[flax_scope] = p
+        if s:
+            stats[flax_scope] = s
+
+    for i in range(3):
+        put_cba(f"ConvBNAct_{i}", f"guides.{i}")
+    for i in range(3):
+        scope_p: Dict = {}
+        scope_s: Dict = {}
+        for flax_name, torch_prefix in (("ConvBNAct_0", f"ddpms.{i}.cba_in"),
+                                        ("ConvBNAct_1", f"ddpms.{i}.cba_out")):
+            p, s = _port_cba(state_dict, torch_prefix)
+            scope_p[flax_name] = p
+            if s:
+                scope_s[flax_name] = s
+        for j in range(3):
+            p, s = _port_cba(state_dict, f"ddpms.{i}.kgus.{j}.cba")
+            kgu: Dict = {"ConvBNAct_0": p, "Conv_0": {
+                "kernel": _conv_kernel(
+                    state_dict[f"ddpms.{i}.kgus.{j}.conv.weight"]),
+                "bias": _t2n(state_dict[f"ddpms.{i}.kgus.{j}.conv.bias"]),
+            }}
+            scope_p[f"KernelGenUnit_{j}"] = kgu
+            if s:
+                scope_s[f"KernelGenUnit_{j}"] = {"ConvBNAct_0": s}
+        params[f"DDPM_{i}"] = scope_p
+        if scope_s:
+            stats[f"DDPM_{i}"] = scope_s
+    for j in range(6):
+        put_cba(f"ConvBNAct_{j + 3}", f"dec_cbas.{j}")
+    for j in range(3):
+        params[f"Conv_{j}"] = {
+            "kernel": _conv_kernel(state_dict[f"heads.{j}.weight"]),
+            "bias": _t2n(state_dict[f"heads.{j}.bias"]),
+        }
+    return params, stats
+
+
 def _resnet_block_unit_counts(arch: str) -> Tuple[List[int], int]:
     if arch in ("resnet34",):
         return [3, 4, 6, 3], 2  # convs per BasicBlock
@@ -432,7 +497,7 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--arch", required=True,
                    choices=["vgg16", "vgg16_bn", "resnet34", "resnet50",
-                            "swin_t", "vit"])
+                            "swin_t", "vit", "minet_vgg16", "hdfnet_vgg16"])
     p.add_argument("--out", required=True, help="output .npz path")
     p.add_argument("--state-dict", default=None,
                    help="local .pth state_dict (default: download via "
@@ -457,6 +522,11 @@ def main(argv=None):
         raise SystemExit(
             "vit ports the timm/DeiT checkpoint schema "
             "(vit_*_patch16_*) — pass it via --state-dict")
+    elif args.arch in ("minet_vgg16", "hdfnet_vgg16"):
+        raise SystemExit(
+            f"{args.arch} is a FULL-model port (the canonical torch "
+            "composition documented on its port_* function) — pass the "
+            "checkpoint via --state-dict")
     else:
         import torchvision.models as tvm
 
@@ -465,7 +535,17 @@ def main(argv=None):
 
     if "model" in sd and isinstance(sd["model"], dict):
         sd = sd["model"]  # official Swin repo wraps the state_dict
-    if args.arch.startswith("vgg16"):
+    if args.arch in ("minet_vgg16", "hdfnet_vgg16"):
+        # BN-ness is a property of the checkpoint, not a flag: detect it
+        # from the backbone keys (plain-VGG16 compositions have no
+        # running stats) so both variants port without guesswork.
+        bb = "backbone." if args.arch == "minet_vgg16" else "backbone_rgb."
+        use_bn = any(k.startswith(bb) and k.endswith("running_mean")
+                     for k in sd)
+        port_fn = (port_minet_vgg16 if args.arch == "minet_vgg16"
+                   else port_hdfnet_vgg16)
+        params, stats = port_fn(sd, use_bn=use_bn)
+    elif args.arch.startswith("vgg16"):
         params, stats = port_vgg16(sd, use_bn=args.arch.endswith("_bn"))
     elif args.arch == "swin_t":
         params, stats = port_swin_t(sd)
