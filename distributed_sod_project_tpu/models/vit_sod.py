@@ -164,4 +164,7 @@ PRESETS = {
     "none": (384, 8, 6),
     "small": (384, 12, 6),
     "base": (768, 12, 12),
+    # Debug/CI variant: compiles in seconds on one CPU — the model for
+    # engine-plumbing smokes where the architecture is irrelevant.
+    "tiny": (32, 2, 2),
 }

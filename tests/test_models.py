@@ -24,6 +24,7 @@ def test_vgg16_pyramid_shapes(size):
         assert f.shape == (2, h // s, w // s, c), f"level {i}: {f.shape}"
 
 
+@pytest.mark.slow
 def test_resnet50_pyramid_shapes():
     m = ResNet50()
     x = jnp.zeros((1, 64, 64, 3))

@@ -109,6 +109,7 @@ def test_tp_rules_shard_attention_kernels(eight_devices):
     assert sharded >= 3 * 12, f"only {sharded}/{total} leaves TP-sharded"
 
 
+@pytest.mark.slow
 def test_param_specs_fall_back_on_indivisible_axes(eight_devices):
     """A model degree that does not divide a width must replicate that
     leaf rather than crash inside jit."""
