@@ -91,7 +91,13 @@ class WindowAttention(nn.Module):
         qkv = nn.Dense(self.dim * 3, use_bias=True,
                        kernel_init=nn.initializers.xavier_uniform(),
                        **dense_kw)(x)
-        qkv = qkv.reshape(nb, n, 3, self.heads, hd).transpose(2, 0, 3, 1, 4)
+        # HEAD-major packed columns — (heads, 3, hd), not the official
+        # (3, heads, hd): a tensor-parallel column shard of the fused
+        # kernel then lands on complete per-head (q,k,v) triples
+        # whenever heads % model == 0, so the attention below needs no
+        # GSPMD resharding (parallel/tp.py).  The weight porter permutes
+        # official checkpoints into this order (_qkv_to_head_major).
+        qkv = qkv.reshape(nb, n, self.heads, 3, hd).transpose(3, 0, 2, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]  # [nB, H, n, hd]
 
         bias_table = self.param(

@@ -18,9 +18,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def save_npz(path: str, params: Dict, stats: Dict) -> None:
+def save_npz(path: str, params: Dict, stats: Dict,
+             meta: Dict[str, str] | None = None) -> None:
     """Flatten {params, batch_stats} into an npz with /-joined keys
-    (the interchange format tools/port_torch_weights.py writes)."""
+    (the interchange format tools/port_torch_weights.py writes).
+    ``meta`` string pairs ride along under ``meta/`` keys — layout
+    markers (e.g. the Swin qkv column order) that loaders use to
+    reject stale ports whose shapes still match."""
     flat: Dict[str, np.ndarray] = {}
 
     def walk(prefix, tree, out):
@@ -32,7 +36,16 @@ def save_npz(path: str, params: Dict, stats: Dict) -> None:
 
     walk("params/", params, flat)
     walk("batch_stats/", stats, flat)
+    for k, v in (meta or {}).items():
+        flat[f"meta/{k}"] = np.asarray(str(v))
     np.savez(path, **flat)
+
+
+def load_npz_meta(path: str) -> Dict[str, str]:
+    """The ``meta/`` string pairs of an npz (empty for older files)."""
+    flat = np.load(path)
+    return {k[len("meta/"):]: str(flat[k])
+            for k in flat.files if k.startswith("meta/")}
 
 
 def load_npz(path: str) -> Tuple[Dict, Dict]:
@@ -42,6 +55,8 @@ def load_npz(path: str) -> Tuple[Dict, Dict]:
     stats: Dict = {}
     for key in flat.files:
         parts = key.split("/")
+        if parts[0] == "meta":
+            continue  # string markers, not weights (load_npz_meta)
         root = params if parts[0] == "params" else stats
         node = root
         for p in parts[1:-1]:
@@ -129,11 +144,33 @@ def _find_and_merge(tree: Dict, ported: Dict, path="") -> Tuple[Dict, List[str]]
     return out, hits
 
 
+def _check_qkv_layout(npz_path: str, p_params) -> None:
+    """Reject Swin ports whose fused-qkv columns predate the head-major
+    packing: shapes are unchanged, so a stale file would load cleanly
+    and silently scramble q/k/v inside every attention."""
+    def has_window_attn(tree) -> bool:
+        if not isinstance(tree, dict):
+            return False
+        return any(k.startswith("WindowAttention") or has_window_attn(v)
+                   for k, v in tree.items())
+
+    if not has_window_attn(p_params):
+        return
+    if load_npz_meta(npz_path).get("qkv_layout") != "head_major":
+        raise ValueError(
+            f"{npz_path}: Swin port predates the head-major qkv column "
+            "packing (no meta/qkv_layout=head_major marker) — its "
+            "shapes still match, but q/k/v would be scrambled inside "
+            "every attention.  Re-port the checkpoint with the current "
+            "tools/port_torch_weights.py")
+
+
 def load_pretrained(variables: Dict[str, Any], npz_path: str) -> Dict[str, Any]:
     """Return ``variables`` with every matching backbone subtree replaced
     by the ported weights from ``npz_path``.  Raises if nothing matches
     (a silently ignored checkpoint is the worst failure mode)."""
     p_params, p_stats = load_npz(npz_path)
+    _check_qkv_layout(npz_path, p_params)
     new_params, hits = _find_and_merge(variables["params"], p_params)
     if not hits:
         raise ValueError(

@@ -25,12 +25,17 @@ divide the axis size, so the same rules work for any ``model`` degree
 that divides the widths — degrees that do not divide simply fall back
 per-leaf.
 
-Alignment caveat: Swin packs q/k/v into one fused ``Dense(3d)`` (the
-layout the official checkpoints — and our weight porter — use), so a
-column shard of the packed axis cannot land on all q/k/v + per-stage
-head boundaries at once; GSPMD keeps the math exact by resharding
-where needed, at some extra collective cost.  ViT-SOD uses separate
-head-aligned q/k/v projections instead (``VIT_TP_RULES``), and fit()
+Alignment note: Swin packs q/k/v into one fused ``Dense(3d)`` whose
+output columns are ordered HEAD-major — (heads, 3, hd), a deliberate
+departure from the official (3, heads, hd) checkpoints (the weight
+porter permutes them) — so a column shard of the packed axis lands on
+complete per-head (q,k,v) triples whenever ``model`` divides the
+stage's head count (heads % model == 0).  Measured on the (data=4, model=2) compiled train
+step: 116 → 16 all-gathers vs the qkv-major packing
+(tests/test_tensor_parallel.py::test_tp_step_avoids_qkv_resharding).
+Stage 1 of Swin-T has 3 heads, which does not divide model=2 — GSPMD
+reshards just that stage, keeping the math exact.  ViT-SOD uses
+separate head-aligned q/k/v projections (``VIT_TP_RULES``), and fit()
 enforces its ``heads % model == 0`` precondition.
 """
 

@@ -173,9 +173,11 @@ def fit(
         # Head-alignment guard — models exposing a scalar ``heads``
         # (vit_sod) promise boundary-aligned column shards; fail loudly
         # when the promise can't hold (GSPMD would re-gather q/k/v
-        # every block).  Swin's per-stage head counts (3,6,12,24) and
-        # fused qkv packing can't all align; its TP layout is correct
-        # but leans on GSPMD resharding (see parallel/tp.py docstring).
+        # every block).  Swin's head-major qkv packing aligns whenever
+        # ``model`` divides a stage's head count (3,6,12,24) — only
+        # non-dividing stages fall back to GSPMD resharding (see
+        # parallel/tp.py docstring; stage 1 with model=2 is the one
+        # case for Swin-T).
         heads = getattr(model, "heads", None)
         if n_model > 1 and isinstance(heads, int) and heads % n_model:
             raise ValueError(

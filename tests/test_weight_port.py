@@ -398,14 +398,20 @@ def test_swin_port_loads_into_swin_sod():
     import tempfile
     with tempfile.TemporaryDirectory() as d:
         npz = os.path.join(d, "swin_t.npz")
-        save_npz(npz, params, stats)
+        save_npz(npz, params, stats, meta={"qkv_layout": "head_major"})
         merged = load_pretrained(variables, npz)
 
-    # The qkv kernel of the first block must be the ported one.
+    # The qkv kernel of the first block must be the ported one — in
+    # our HEAD-major column order (stage-0 heads=3), not the official
+    # qkv-major layout.
+    from tools.port_torch_weights import _qkv_to_head_major
+
     got = np.asarray(
         merged["params"]["SwinT_0"]["SwinBlock_0"]["WindowAttention_0"]
         ["Dense_0"]["kernel"])
-    want = np.asarray(sd["layers.0.blocks.0.attn.qkv.weight"].numpy()).T
+    raw = np.asarray(sd["layers.0.blocks.0.attn.qkv.weight"].numpy()).T
+    raw_b = np.asarray(sd["layers.0.blocks.0.attn.qkv.bias"].numpy())
+    want, _ = _qkv_to_head_major(raw, raw_b, heads=3)
     np.testing.assert_allclose(got, want)
     outs = model.apply(merged, x, train=False)
     assert np.isfinite(np.asarray(outs[0])).all()
@@ -441,7 +447,7 @@ def test_swin_port_adapts_bias_tables_to_small_inputs():
 
     with tempfile.TemporaryDirectory() as d:
         npz = os.path.join(d, "swin_t.npz")
-        save_npz(npz, params, stats)
+        save_npz(npz, params, stats, meta={"qkv_layout": "head_major"})
         merged = load_pretrained(variables, npz)  # must not raise
 
     # Full-window tables copied exactly; shrunken ones resized.
@@ -848,3 +854,32 @@ def test_full_hdfnet_port_logit_parity(tmp_path):
     for lvl, (o, r) in enumerate(zip(outs, refs)):
         np.testing.assert_allclose(np.asarray(o[..., 0]), r, atol=2e-4,
                                    rtol=2e-4, err_msg=f"logit {lvl}")
+
+
+def test_stale_qkv_layout_npz_is_rejected(tmp_path):
+    """A Swin port saved BEFORE the head-major qkv repacking loads
+    shape-clean but would scramble q/k/v — the meta marker must make
+    load_pretrained refuse it, and load_npz must not leak meta keys
+    into the weight trees."""
+    from distributed_sod_project_tpu.models.pretrained import (
+        _check_qkv_layout, load_npz, load_npz_meta, save_npz)
+
+    tree = {"SwinT_0": {"SwinBlock_0": {"WindowAttention_0": {
+        "Dense_0": {"kernel": np.zeros((4, 12), np.float32)}}}}}
+    stale = str(tmp_path / "stale.npz")
+    save_npz(stale, tree, {})
+    with pytest.raises(ValueError, match="head-major"):
+        _check_qkv_layout(stale, load_npz(stale)[0])
+
+    fresh = str(tmp_path / "fresh.npz")
+    save_npz(fresh, tree, {}, meta={"qkv_layout": "head_major"})
+    assert load_npz_meta(fresh) == {"qkv_layout": "head_major"}
+    p, s = load_npz(fresh)
+    assert "meta" not in p and "meta" not in s
+    _check_qkv_layout(fresh, p)  # no raise
+
+    # Non-Swin trees (no WindowAttention) are exempt regardless.
+    plain = str(tmp_path / "plain.npz")
+    save_npz(plain, {"VGG16_0": {"ConvBNAct_0": {"Conv_0": {
+        "kernel": np.zeros((3, 3, 3, 4), np.float32)}}}}, {})
+    _check_qkv_layout(plain, load_npz(plain)[0])  # no raise

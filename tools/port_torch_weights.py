@@ -328,8 +328,23 @@ def _ln(state_dict, prefix: str) -> Dict[str, np.ndarray]:
             "bias": _t2n(state_dict[prefix + ".bias"])}
 
 
+def _qkv_to_head_major(kernel: np.ndarray, bias: np.ndarray,
+                       heads: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Permute a fused qkv projection's OUTPUT columns from the official
+    (3, heads, hd) order to our WindowAttention's (heads, 3, hd) order
+    (head-major columns keep a tensor-parallel column shard aligned to
+    whole heads — parallel/tp.py)."""
+    n_in, out = kernel.shape
+    d = out // 3
+    hd = d // heads
+    k = kernel.reshape(n_in, 3, heads, hd).transpose(0, 2, 1, 3)
+    b = bias.reshape(3, heads, hd).transpose(1, 0, 2)
+    return k.reshape(n_in, out), b.reshape(out)
+
+
 def port_swin_t(state_dict,
-                depths=(2, 2, 6, 2)) -> Tuple[Dict, Dict]:
+                depths=(2, 2, 6, 2),
+                heads=(3, 6, 12, 24)) -> Tuple[Dict, Dict]:
     """Official Swin-Transformer checkpoint → our backbones/swin.py tree.
 
     Key schema is the microsoft/Swin-Transformer repo's (also used by
@@ -338,8 +353,10 @@ def port_swin_t(state_dict,
     mlp.fc2}``, ``layers.{s}.downsample.{norm,reduction}``.  Layout
     notes (verified numerically in tests/test_weight_port.py):
 
-    - qkv packing: torch reshapes [.,3C]→(3,heads,hd) exactly like our
-      WindowAttention, so the kernel ports as one transpose;
+    - qkv packing: torch reshapes [.,3C]→(3,heads,hd); our
+      WindowAttention packs HEAD-major (heads,3,hd) for tensor-parallel
+      alignment, so the kernel ports as a transpose plus a fixed column
+      permutation (_qkv_to_head_major);
     - the relative-position bias table is [(2w-1)², heads] under the
       identical index formula — copied as-is;
     - official attaches ``downsample`` at the END of stage s; our merge
@@ -364,13 +381,16 @@ def port_swin_t(state_dict,
                 state_dict[f"layers.{s - 1}.downsample.reduction.weight"])}
         for b in range(depth):
             pre = f"layers.{s}.blocks.{b}"
+            qkv_w, qkv_b = _qkv_to_head_major(
+                _linear_kernel(state_dict[pre + ".attn.qkv.weight"]),
+                _t2n(state_dict[pre + ".attn.qkv.bias"]),
+                heads[s])
             params[f"SwinBlock_{block_idx}"] = {
                 "LayerNorm_0": _ln(state_dict, pre + ".norm1"),
                 "WindowAttention_0": {
                     "Dense_0": {
-                        "kernel": _linear_kernel(
-                            state_dict[pre + ".attn.qkv.weight"]),
-                        "bias": _t2n(state_dict[pre + ".attn.qkv.bias"]),
+                        "kernel": qkv_w,
+                        "bias": qkv_b,
                     },
                     "rel_pos_bias": _t2n(
                         state_dict[pre + ".attn.relative_position_bias_table"]),
@@ -554,7 +574,8 @@ def main(argv=None):
         params, stats = port_vit(sd, grid=grid)
     else:
         params, stats = port_resnet(sd, args.arch)
-    save_npz(args.out, params, stats)
+    meta = {"qkv_layout": "head_major"} if args.arch == "swin_t" else None
+    save_npz(args.out, params, stats, meta=meta)
     n = sum(v.size for v in np.load(args.out).values())
     print(f"wrote {args.out}: {n/1e6:.1f}M params")
     return 0
