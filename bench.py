@@ -366,7 +366,14 @@ def _run(args):
 
 def _bench_data(cfg, batch: int, steps: int, warmup: int) -> float:
     """Time the host input pipeline alone: seconds to produce ``steps``
-    batches (epochs cycled as needed) on the configured backend."""
+    batches (epochs cycled as needed) on the configured backend.
+
+    Use enough --steps to overwhelm the backend's prefetch depth:
+    deep-prefetch backends (grain) serve short runs from buffers filled
+    during warmup — measured in-sandbox: grain "203 img/s" over 10
+    steps collapsed to its true ~5 img/s sustained rate at 40 steps,
+    while the host backend reported the same number at both lengths.
+    """
     import itertools
 
     from distributed_sod_project_tpu.data import resolve_dataset
