@@ -126,6 +126,106 @@ def _port_cba(state_dict, prefix: str):
     return p, s
 
 
+def _put(params: Dict, stats: Dict, flax_scope: str, ported) -> None:
+    """Stash a ported ``(params, stats)`` subtree, skipping empty
+    stats — the shared idiom of every full-model port below."""
+    p, s = ported
+    params[flax_scope] = p
+    if s:
+        stats[flax_scope] = s
+
+
+def _walk_cbas(state_dict, torch_scope: str):
+    """All ``{torch_scope}.cbas.{j}`` units → (params, stats) subtrees
+    keyed ``ConvBNAct_{j}`` (the shared torch-replica convention for
+    full-model ports)."""
+    scope_p: Dict = {}
+    scope_s: Dict = {}
+    j = 0
+    while f"{torch_scope}.cbas.{j}.conv.weight" in state_dict:
+        p, s = _port_cba(state_dict, f"{torch_scope}.cbas.{j}")
+        scope_p[f"ConvBNAct_{j}"] = p
+        if s:
+            scope_s[f"ConvBNAct_{j}"] = s
+        j += 1
+    if not j:
+        raise ValueError(f"no ConvBNAct units under {torch_scope!r}")
+    return scope_p, scope_s
+
+
+def port_u2net(state_dict):
+    """FULL-model port: a torch U²-Net state_dict → (params,
+    batch_stats) for models/u2net.py::U2Net.
+
+    Expected torch layout (mirrored by the oracle replica in
+    tests/test_weight_port.py): ``enc_rsus.{0..3}``, ``enc5``, ``en6``,
+    ``dec5``, ``dec_rsus.{0..3}`` each holding ``cbas.{j}`` units in
+    flax creation order, plus ``side.{0..5}`` and ``fuse`` head convs —
+    protecting the nested-U deep-supervision composition ([B:10])
+    at the 7-logit level.
+    """
+    params: Dict = {}
+    stats: Dict = {}
+    for i in range(4):
+        _put(params, stats, f"RSU_{i}",
+             _walk_cbas(state_dict, f"enc_rsus.{i}"))
+    _put(params, stats, "RSU4F_0", _walk_cbas(state_dict, "enc5"))
+    _put(params, stats, "RSU4F_1", _walk_cbas(state_dict, "en6"))
+    _put(params, stats, "RSU4F_2", _walk_cbas(state_dict, "dec5"))
+    for i in range(4):
+        _put(params, stats, f"RSU_{i + 4}",
+             _walk_cbas(state_dict, f"dec_rsus.{i}"))
+    for j in range(6):
+        params[f"Conv_{j}"] = {
+            "kernel": _conv_kernel(state_dict[f"side.{j}.weight"]),
+            "bias": _t2n(state_dict[f"side.{j}.bias"]),
+        }
+    params["Conv_6"] = {
+        "kernel": _conv_kernel(state_dict["fuse.weight"]),
+        "bias": _t2n(state_dict["fuse.bias"]),
+    }
+    return params, stats
+
+
+def port_basnet(state_dict):
+    """FULL-model port: a torch BASNet state_dict → (params,
+    batch_stats) for models/basnet.py::BASNet.
+
+    Expected torch layout (mirrored by the oracle replica in
+    tests/test_weight_port.py): ``stem``, ``blocks.{0..21}`` (BasicBlock
+    as cbas units incl. the optional 1×1 downsample), ``bridge.{0..2}``,
+    ``dec.{0..5}.cbas.{0..2}``, ``side.{0..6}``, and ``refine`` (cbas +
+    ``conv``) — protecting the predict+refine composition at the
+    8-logit level ([B:10]).
+    """
+    params: Dict = {}
+    stats: Dict = {}
+    _put(params, stats, "ConvBNAct_0", _port_cba(state_dict, "stem"))
+    for i in range(22):
+        _put(params, stats, f"BasicBlock_{i}",
+             _walk_cbas(state_dict, f"blocks.{i}"))
+    for i in range(3):
+        _put(params, stats, f"ConvBNAct_{i + 1}",
+             _port_cba(state_dict, f"bridge.{i}"))
+    for i in range(6):
+        _put(params, stats, f"_DecoderStage_{i}",
+             _walk_cbas(state_dict, f"dec.{i}"))
+    for j in range(7):
+        params[f"Conv_{j}"] = {
+            "kernel": _conv_kernel(state_dict[f"side.{j}.weight"]),
+            "bias": _t2n(state_dict[f"side.{j}.bias"]),
+        }
+    rp, rs = _walk_cbas(state_dict, "refine")
+    rp["Conv_0"] = {
+        "kernel": _conv_kernel(state_dict["refine.conv.weight"]),
+        "bias": _t2n(state_dict["refine.conv.bias"]),
+    }
+    params["RefineModule_0"] = rp
+    if rs:
+        stats["RefineModule_0"] = rs
+    return params, stats
+
+
 def port_minet_vgg16(state_dict, use_bn: bool = True):
     """FULL-model port: a torch MINet-VGG16 state_dict → (params,
     batch_stats) for models/minet.py::MINet(backbone='vgg16').
@@ -146,30 +246,13 @@ def port_minet_vgg16(state_dict, use_bn: bool = True):
     params: Dict = {"VGG16_0": vgg_p}
     stats: Dict = {"VGG16_0": vgg_s} if vgg_s else {}
 
-    def walk(torch_scope: str, flax_scope: str) -> None:
-        scope_p: Dict = {}
-        scope_s: Dict = {}
-        j = 0
-        while f"{torch_scope}.cbas.{j}.conv.weight" in state_dict:
-            p, s = _port_cba(state_dict, f"{torch_scope}.cbas.{j}")
-            scope_p[f"ConvBNAct_{j}"] = p
-            if s:
-                scope_s[f"ConvBNAct_{j}"] = s
-            j += 1
-        if not j:
-            raise ValueError(f"no ConvBNAct units under {torch_scope!r}")
-        params[flax_scope] = scope_p
-        if scope_s:
-            stats[flax_scope] = scope_s
-
     for i in range(5):
-        walk(f"aims.{i}", f"AIM_{i}")
+        _put(params, stats, f"AIM_{i}",
+             _walk_cbas(state_dict, f"aims.{i}"))
     for i in range(5):
-        walk(f"sims.{i}", f"SIM_{i}")
-    head_p, head_s = _port_cba(state_dict, "head_cba")
-    params["ConvBNAct_0"] = head_p
-    if head_s:
-        stats["ConvBNAct_0"] = head_s
+        _put(params, stats, f"SIM_{i}",
+             _walk_cbas(state_dict, f"sims.{i}"))
+    _put(params, stats, "ConvBNAct_0", _port_cba(state_dict, "head_cba"))
     params["Conv_0"] = {
         "kernel": _conv_kernel(state_dict["head_conv.weight"]),
         "bias": _t2n(state_dict["head_conv.bias"]),
@@ -202,23 +285,16 @@ def port_hdfnet_vgg16(state_dict, use_bn: bool = True):
         stats["vgg_rgb"] = rgb_s
         stats["vgg_depth"] = dep_s
 
-    def put_cba(flax_scope, torch_prefix):
-        p, s = _port_cba(state_dict, torch_prefix)
-        params[flax_scope] = p
-        if s:
-            stats[flax_scope] = s
-
     for i in range(3):
-        put_cba(f"ConvBNAct_{i}", f"guides.{i}")
+        _put(params, stats, f"ConvBNAct_{i}",
+             _port_cba(state_dict, f"guides.{i}"))
     for i in range(3):
         scope_p: Dict = {}
         scope_s: Dict = {}
         for flax_name, torch_prefix in (("ConvBNAct_0", f"ddpms.{i}.cba_in"),
                                         ("ConvBNAct_1", f"ddpms.{i}.cba_out")):
-            p, s = _port_cba(state_dict, torch_prefix)
-            scope_p[flax_name] = p
-            if s:
-                scope_s[flax_name] = s
+            _put(scope_p, scope_s, flax_name,
+                 _port_cba(state_dict, torch_prefix))
         for j in range(3):
             p, s = _port_cba(state_dict, f"ddpms.{i}.kgus.{j}.cba")
             kgu: Dict = {"ConvBNAct_0": p, "Conv_0": {
@@ -233,7 +309,8 @@ def port_hdfnet_vgg16(state_dict, use_bn: bool = True):
         if scope_s:
             stats[f"DDPM_{i}"] = scope_s
     for j in range(6):
-        put_cba(f"ConvBNAct_{j + 3}", f"dec_cbas.{j}")
+        _put(params, stats, f"ConvBNAct_{j + 3}",
+             _port_cba(state_dict, f"dec_cbas.{j}"))
     for j in range(3):
         params[f"Conv_{j}"] = {
             "kernel": _conv_kernel(state_dict[f"heads.{j}.weight"]),
@@ -517,7 +594,8 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--arch", required=True,
                    choices=["vgg16", "vgg16_bn", "resnet34", "resnet50",
-                            "swin_t", "vit", "minet_vgg16", "hdfnet_vgg16"])
+                            "swin_t", "vit", "minet_vgg16", "hdfnet_vgg16",
+                            "u2net", "basnet"])
     p.add_argument("--out", required=True, help="output .npz path")
     p.add_argument("--state-dict", default=None,
                    help="local .pth state_dict (default: download via "
@@ -542,7 +620,7 @@ def main(argv=None):
         raise SystemExit(
             "vit ports the timm/DeiT checkpoint schema "
             "(vit_*_patch16_*) — pass it via --state-dict")
-    elif args.arch in ("minet_vgg16", "hdfnet_vgg16"):
+    elif args.arch in ("minet_vgg16", "hdfnet_vgg16", "u2net", "basnet"):
         raise SystemExit(
             f"{args.arch} is a FULL-model port (the canonical torch "
             "composition documented on its port_* function) — pass the "
@@ -555,7 +633,11 @@ def main(argv=None):
 
     if "model" in sd and isinstance(sd["model"], dict):
         sd = sd["model"]  # official Swin repo wraps the state_dict
-    if args.arch in ("minet_vgg16", "hdfnet_vgg16"):
+    if args.arch == "u2net":
+        params, stats = port_u2net(sd)
+    elif args.arch == "basnet":
+        params, stats = port_basnet(sd)
+    elif args.arch in ("minet_vgg16", "hdfnet_vgg16"):
         # BN-ness is a property of the checkpoint, not a flag: detect it
         # from the backbone keys (plain-VGG16 compositions have no
         # running stats) so both variants port without guesswork.
