@@ -53,6 +53,11 @@ class ModelConfig:
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
     param_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the backbone stages
+    # Attention core for the transformer zoo member (vit_sod only):
+    # "xla" materializes the score matrix, "flash" runs the Pallas
+    # tiled-softmax kernel (pallas/flash_attention.py) — required for
+    # high-resolution single-chip work where N² scores exceed HBM.
+    attn_impl: str = "xla"  # xla | flash
     pretrained: Optional[str] = None  # .npz from tools/port_torch_weights.py
     # Structural deep supervision for models where aux heads are
     # optional add-ons (vit_sod's mid-depth head).  U²-Net/BASNet side

@@ -38,6 +38,13 @@ def build_model(model_cfg):
         raise KeyError(
             f"unknown model {model_cfg.name!r}; known: {list_models()}"
         )
+    if model_cfg.attn_impl != "xla" and model_cfg.name != "vit_sod":
+        # Loud instead of a silent no-op (the CNN zoo has no attention
+        # to swap; ADVICE.md round 1 flagged exactly this failure mode
+        # for ignored knobs).
+        raise ValueError(
+            f"model.attn_impl={model_cfg.attn_impl!r} only applies to "
+            f"vit_sod, not {model_cfg.name!r}")
     dtype = jnp.dtype(model_cfg.compute_dtype)
     param_dtype = jnp.dtype(model_cfg.param_dtype)
     axis_name = "data" if model_cfg.sync_bn else None
@@ -114,6 +121,7 @@ def _build_vit_sod(cfg, *, dtype, param_dtype, axis_name):
     dim, depth, heads = PRESETS[cfg.backbone]
     return ViTSOD(dim=dim, depth=depth, heads=heads,
                   deep_supervision=cfg.deep_supervision,
+                  attn_impl=cfg.attn_impl,
                   dtype=dtype, param_dtype=param_dtype)
 
 

@@ -92,6 +92,12 @@ class ViTSOD(nn.Module):
     heads: int = 6
     mlp_ratio: int = 4
     deep_supervision: bool = True  # aux unpatchify head at mid-depth
+    # Default attention core when no attn_fn is injected: "xla" is the
+    # materialized-scores softmax (full_attention), "flash" the Pallas
+    # tiled kernel (pallas/flash_attention.py) — same math, O(N·D) HBM
+    # instead of O(N²), which is what makes high-resolution single-chip
+    # training/eval fit.  An explicit attn_fn (the SP ring) always wins.
+    attn_impl: str = "xla"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -101,7 +107,17 @@ class ViTSOD(nn.Module):
                  full_grid: Optional[tuple] = None,
                  pos_row_offset=0) -> List[jnp.ndarray]:
         del depth  # RGB-only member; uniform zoo signature
-        attn_fn = attn_fn or full_attention
+        if attn_fn is None:
+            if self.attn_impl == "flash":
+                from ..pallas.flash_attention import flash_attention
+
+                attn_fn = flash_attention
+            elif self.attn_impl == "xla":
+                attn_fn = full_attention
+            else:
+                raise ValueError(
+                    f"attn_impl must be 'xla' or 'flash', got "
+                    f"{self.attn_impl!r}")
         x = image.astype(self.dtype)
         b, hh, ww, _ = x.shape
         p = self.patch

@@ -1,0 +1,328 @@
+"""Pallas flash attention — the ViT-SOD hot op (SURVEY.md §2.2, §5).
+
+``models/vit_sod.py`` is the long-context zoo member: global attention
+over every patch token, quadratic in resolution.  The XLA path
+(``parallel/ring_attention.full_attention``) materialises the [N, N]
+score matrix in HBM — at 1024px/patch16 that is 4096² floats *per head*
+per block, which is exactly the memory wall flash attention exists to
+remove.  This kernel computes attention tile-by-tile in VMEM with an
+online softmax: HBM traffic is O(N·D) (read q/k/v, write out + one
+lse row) instead of O(N²).
+
+Design (mirrors the layout conventions of the other kernels here):
+
+- Heads-major [B, H, N, D] public layout (``ring_attention``'s), folded
+  to [B·H, N, D] for the grid.  N is zero-padded to a multiple of the
+  128-lane tile; padded KEY columns are masked with a large negative
+  bias (never ``-inf`` — a fully-finite path keeps ``exp`` NaN-free),
+  padded QUERY rows compute garbage that the wrapper slices off, and
+  their zero upstream gradients keep the backward exact.
+- Running (m, l) softmax statistics live in VMEM scratch as
+  (block_q, 128) lane-replicated tiles (the Mosaic-native layout),
+  carried across the innermost KV grid dimension; the accumulator is
+  rescaled once per visiting block and divided once at the end.
+- The MXU sees three dots per tile pair — q·kᵀ, p·v, and (backward)
+  ds·k / dsᵀ·q / pᵀ·do — all with ``preferred_element_type=float32``;
+  ``p`` is cast to the value dtype so bf16 inputs ride the MXU at full
+  rate.
+- Backward is two more kernels (custom VJP, no O(N²) residual): dq
+  accumulates over KV blocks; dk/dv swap the grid so the KV block is
+  resident while Q blocks stream past.  Both rebuild ``p`` from the
+  saved lse row, flash-attention style; ``delta = Σ do·out`` is reduced
+  in-kernel from the streamed q/out tiles.
+
+Exactness: forward AND gradients match the XLA oracle to float32
+round-off (tests/test_pallas_flash.py); the real-TPU Mosaic lowering is
+guarded by ``jax.export(platforms=['tpu'])`` tests, same as
+fused_ssim/fused_loss.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+# Large-but-finite mask bias (the official TPU kernels' choice): keeps
+# every intermediate finite so exp/max never see -inf - -inf = NaN.
+_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+def _widen(x, n: int):
+    """Lane-replicated (rows, 128) tile -> (rows, n): slice for n < 128,
+    tile for multiples of 128 (the Mosaic-proven broadcast pattern)."""
+    if n < _LANES:
+        return x[:, :n]
+    reps, rem = divmod(n, _LANES)
+    if rem:
+        raise ValueError(f"width {n} not a multiple of {_LANES}")
+    return jnp.tile(x, (1, reps)) if reps > 1 else x
+
+
+def _key_mask_bias(j, bkv: int, bq: int, n: int):
+    """(bq, bkv) additive bias masking key columns >= n (padding)."""
+    col = lax.broadcasted_iota(jnp.int32, (bq, bkv), 1) + j * bkv
+    return jnp.where(col < n, 0.0, _MASK_VALUE).astype(jnp.float32)
+
+
+def _scores(q_ref, k_ref, blk, *, scale, n, padded):
+    """(bq, bkv) masked, scaled logits for one tile pair; ``blk`` is the
+    kv-block index the key columns belong to."""
+    s = lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if padded:
+        s = s + _key_mask_bias(blk, k_ref.shape[1], q_ref.shape[1], n)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_s, l_s, acc_s, *, scale: float, n: int, padded: bool):
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    d = acc_s.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        m_s[...] = jnp.full(m_s.shape, _MASK_VALUE, jnp.float32)
+        l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    s = _scores(q_ref, k_ref, j, scale=scale, n=n, padded=padded)
+
+    m_prev = m_s[...]                                   # (bq, 128)
+    m_curr = jnp.max(s, axis=1)[:, None]                # (bq, 1)
+    m_next = jnp.maximum(m_prev, m_curr)                # (bq, 128)
+    p = jnp.exp(s - _widen(m_next, k_ref.shape[1]))     # (bq, bkv)
+    corr = jnp.exp(m_prev - m_next)                     # (bq, 128)
+    l_s[...] = l_s[...] * corr + jnp.sum(p, axis=1)[:, None]
+    m_s[...] = m_next
+    pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                         (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    acc_s[...] = acc_s[...] * _widen(corr, d) + pv
+
+    @pl.when(j == nj - 1)
+    def _():
+        l_safe = jnp.where(l_s[...] == 0.0, 1.0, l_s[...])
+        o_ref[0] = (acc_s[...] / _widen(l_safe, d)).astype(o_ref.dtype)
+        lse_ref[0] = m_s[...] + jnp.log(l_safe)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_s, *, scale: float, n: int, padded: bool):
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
+
+    s = _scores(q_ref, k_ref, j, scale=scale, n=n, padded=padded)
+    p = jnp.exp(s - _widen(lse_ref[0], k_ref.shape[1]))
+    do = do_ref[0].astype(jnp.float32)
+    dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                         (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0][:, :1]) * scale
+    dq_s[...] += lax.dot_general(ds.astype(k_ref.dtype), k_ref[0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_s, dv_s,
+                *, scale: float, n: int, padded: bool):
+    i = pl.program_id(1)      # kv block (resident)
+    j = pl.program_id(2)      # q block (streams past)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
+        dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
+
+    s = _scores(q_ref, k_ref, i, scale=scale, n=n, padded=padded)
+    p = jnp.exp(s - _widen(lse_ref[0], k_ref.shape[1]))
+    do = do_ref[0].astype(jnp.float32)
+    # dv += pᵀ · do   (contract over the q rows)
+    dv_s[...] += lax.dot_general(p.astype(do_ref.dtype), do_ref[0],
+                                 (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                         (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0][:, :1]) * scale
+    dk_s[...] += lax.dot_general(ds.astype(q_ref.dtype), q_ref[0],
+                                 (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+
+def _pad_n(x, np_):
+    pad = np_ - x.shape[1]
+    return x if pad == 0 else jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+
+
+def _specs(bq, bkv, d, *, kv_resident: bool):
+    """BlockSpecs for the two grid orders.  ``kv_resident=False``: grid
+    (bh, qi, kj) — q-like blocks follow dim 1, kv-like dim 2.
+    ``kv_resident=True``: grid (bh, ki, qj) — swapped."""
+    if kv_resident:
+        q_ix = lambda b, i, j: (b, j, 0)
+        kv_ix = lambda b, i, j: (b, i, 0)
+    else:
+        q_ix = lambda b, i, j: (b, i, 0)
+        kv_ix = lambda b, i, j: (b, j, 0)
+    qs = pl.BlockSpec((1, bq, d), q_ix)
+    kv = pl.BlockSpec((1, bkv, d), kv_ix)
+    row = pl.BlockSpec((1, bq, _LANES), q_ix)
+    return qs, kv, row
+
+
+def _fwd_call(q, k, v, cfg):
+    bq, bkv, interpret, n = cfg
+    bh, np_, d = q.shape
+    qs, kvs, row = _specs(bq, bkv, d, kv_resident=False)
+    return pl.pallas_call(
+        partial(_fwd_kernel, scale=1.0 / d**0.5, n=n, padded=np_ != n),
+        grid=(bh, np_ // bq, np_ // bkv),
+        in_specs=[qs, kvs, kvs],
+        out_specs=[qs, row],
+        out_shape=[jax.ShapeDtypeStruct((bh, np_, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, np_, _LANES), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * np_ * np_ * d,
+            transcendentals=bh * np_ * np_,
+            bytes_accessed=4 * q.size * q.dtype.itemsize),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _bwd_call(q, k, v, out, lse, do, cfg):
+    bq, bkv, interpret, n = cfg
+    bh, np_, d = q.shape
+    scale = 1.0 / d**0.5
+    # delta_i = Σ_d out·do — loop-invariant per query row, so computed
+    # ONCE here (one fused XLA pass) and streamed to both kernels as a
+    # lane-replicated row tile, the same layout as lse.
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, np_, _LANES))
+
+    qs, kvs, row = _specs(bq, bkv, d, kv_resident=False)
+    dq = pl.pallas_call(
+        partial(_dq_kernel, scale=scale, n=n, padded=np_ != n),
+        grid=(bh, np_ // bq, np_ // bkv),
+        in_specs=[qs, kvs, kvs, qs, row, row],
+        out_specs=qs,
+        out_shape=jax.ShapeDtypeStruct((bh, np_, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=6 * bh * np_ * np_ * d,
+            transcendentals=bh * np_ * np_,
+            bytes_accessed=6 * q.size * q.dtype.itemsize),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    qs, kvs, row = _specs(bq, bkv, d, kv_resident=True)
+    dk, dv = pl.pallas_call(
+        partial(_dkv_kernel, scale=scale, n=n, padded=np_ != n),
+        grid=(bh, np_ // bkv, np_ // bq),
+        in_specs=[kvs, kvs, qs, qs, row, row],
+        out_specs=[kvs, kvs],
+        out_shape=[jax.ShapeDtypeStruct((bh, np_, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, np_, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bkv, d), jnp.float32),
+                        pltpu.VMEM((bkv, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=10 * bh * np_ * np_ * d,
+            transcendentals=bh * np_ * np_,
+            bytes_accessed=6 * q.size * q.dtype.itemsize),
+        interpret=interpret,
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, cfg):
+    out, _ = _fwd_call(q, k, v, cfg)
+    return out
+
+
+def _flash_fwd(q, k, v, cfg):
+    out, lse = _fwd_call(q, k, v, cfg)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(cfg, res, g):
+    q, k, v, out, lse = res
+    return _bwd_call(q, k, v, out, lse, g, cfg)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, block_q: int = 128, block_kv: int = 128,
+                    interpret: bool | None = None) -> jnp.ndarray:
+    """Drop-in for ``ring_attention.full_attention`` (non-causal).
+
+    q/k/v: [B, H, N, D] (any N; zero-padded internally to the 128-lane
+    tile), D ≤ 128 or a multiple of 128.  Differentiable via the Pallas
+    backward kernels.  ``interpret`` defaults to auto (interpret on
+    CPU, Mosaic on TPU).
+    """
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, H, N, D], got {q.shape}")
+    b, h, n, d = q.shape
+    if d > _LANES and d % _LANES:
+        raise ValueError(
+            f"head dim {d} unsupported (need <= {_LANES} or a multiple); "
+            "use parallel.ring_attention.full_attention")
+    if block_q % _LANES or block_kv % _LANES:
+        raise ValueError("block sizes must be multiples of 128")
+    # Pad to a COMMON multiple of both blocks — rounding to only the
+    # larger would leave valid rows uncovered by the floor-divided grid
+    # whenever the blocks don't divide each other.
+    step = math.lcm(block_q, block_kv)
+    np_ = -(-n // step) * step
+    interpret = jax.default_backend() == "cpu" if interpret is None else interpret
+    cfg = (min(block_q, np_), min(block_kv, np_), interpret, n)
+
+    fold = lambda t: _pad_n(t.reshape(b * h, n, d), np_)
+    out = _flash(fold(q), fold(k), fold(v), cfg)
+    return out[:, :n].reshape(b, h, n, d)
