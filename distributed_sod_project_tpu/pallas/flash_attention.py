@@ -231,15 +231,20 @@ def _fwd_call(q, k, v, cfg):
     )(q, k, v)
 
 
-def _bwd_call(q, k, v, out, lse, do, cfg):
+def _bwd_call(q, k, v, out, lse, do, cfg, dlse=None):
     bq, bkv, interpret, n = cfg
     bh, np_, d = q.shape
     scale = 1.0 / d**0.5
     # delta_i = Σ_d out·do — loop-invariant per query row, so computed
     # ONCE here (one fused XLA pass) and streamed to both kernels as a
-    # lane-replicated row tile, the same layout as lse.
+    # lane-replicated row tile, the same layout as lse.  A cotangent on
+    # lse folds in exactly here: ∂lse_i/∂s_ij = p_ij, so
+    # s̄_ij = p_ij·(dp_ij − delta_i + dlse_i) — i.e. dlse just shifts
+    # delta, and the kernels need no second code path.
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (bh, np_, _LANES))
 
     qs, kvs, row = _specs(bq, bkv, d, kv_resident=False)
@@ -277,33 +282,30 @@ def _bwd_call(q, k, v, out, lse, do, cfg):
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, cfg):
-    out, _ = _fwd_call(q, k, v, cfg)
-    return out
-
-
-def _flash_fwd(q, k, v, cfg):
+def _flash_lse(q, k, v, cfg):
+    """Like ``_flash`` but also returns the per-row logsumexp
+    ([bh, np] f32) — the merge statistic ring attention needs."""
     out, lse = _fwd_call(q, k, v, cfg)
-    return out, (q, k, v, out, lse)
+    return out, lse[:, :, 0]
 
 
-def _flash_bwd(cfg, res, g):
+def _flash_lse_fwd(q, k, v, cfg):
+    out, lse = _fwd_call(q, k, v, cfg)
+    return (out, lse[:, :, 0]), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(cfg, res, gs):
     q, k, v, out, lse = res
-    return _bwd_call(q, k, v, out, lse, g, cfg)
+    g_out, g_lse = gs
+    return _bwd_call(q, k, v, out, lse, g_out, cfg, dlse=g_lse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def flash_attention(q, k, v, *, block_q: int = 128, block_kv: int = 128,
-                    interpret: bool | None = None) -> jnp.ndarray:
-    """Drop-in for ``ring_attention.full_attention`` (non-causal).
-
-    q/k/v: [B, H, N, D] (any N; zero-padded internally to the 128-lane
-    tile), D ≤ 128 or a multiple of 128.  Differentiable via the Pallas
-    backward kernels.  ``interpret`` defaults to auto (interpret on
-    CPU, Mosaic on TPU).
-    """
+def _prepare(q, k, v, block_q, block_kv, interpret):
+    """Validate, fold heads into batch, pad N; returns folded q/k/v,
+    the static kernel cfg, and the original (b, h, n, d)."""
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
     if q.ndim != 4:
@@ -322,7 +324,39 @@ def flash_attention(q, k, v, *, block_q: int = 128, block_kv: int = 128,
     np_ = -(-n // step) * step
     interpret = jax.default_backend() == "cpu" if interpret is None else interpret
     cfg = (min(block_q, np_), min(block_kv, np_), interpret, n)
-
     fold = lambda t: _pad_n(t.reshape(b * h, n, d), np_)
-    out = _flash(fold(q), fold(k), fold(v), cfg)
+    return fold(q), fold(k), fold(v), cfg, (b, h, n, d)
+
+
+def flash_attention(q, k, v, *, block_q: int = 128, block_kv: int = 128,
+                    interpret: bool | None = None) -> jnp.ndarray:
+    """Drop-in for ``ring_attention.full_attention`` (non-causal).
+
+    q/k/v: [B, H, N, D] (any N; zero-padded internally to the 128-lane
+    tile), D ≤ 128 or a multiple of 128.  Differentiable via the Pallas
+    backward kernels.  ``interpret`` defaults to auto (interpret on
+    CPU, Mosaic on TPU).
+    """
+    qf, kf, vf, cfg, (b, h, n, d) = _prepare(q, k, v, block_q, block_kv,
+                                             interpret)
+    # Single custom-VJP definition shared with the lse variant: the
+    # dropped lse output arrives in the backward as a zero cotangent,
+    # which reduces the dlse delta-shift to a no-op subtract.
+    out, _ = _flash_lse(qf, kf, vf, cfg)
     return out[:, :n].reshape(b, h, n, d)
+
+
+def flash_attention_with_lse(q, k, v, *, block_q: int = 128,
+                             block_kv: int = 128,
+                             interpret: bool | None = None):
+    """``flash_attention`` that also returns lse ([B, H, N] f32, the
+    per-row logsumexp of the scaled scores) — the statistic that makes
+    per-block results mergeable, which is how the SP ring composes
+    flash blocks (parallel/ring_attention.py).  Both outputs are
+    differentiable: an lse cotangent folds into the same backward
+    kernels as a shift of delta."""
+    qf, kf, vf, cfg, (b, h, n, d) = _prepare(q, k, v, block_q, block_kv,
+                                             interpret)
+    out, lse = _flash_lse(qf, kf, vf, cfg)
+    return (out[:, :n].reshape(b, h, n, d),
+            lse[:, :n].reshape(b, h, n))

@@ -56,6 +56,7 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str = "seq",
     causal: bool = False,
+    attn_impl: str = "xla",
 ) -> jnp.ndarray:
     """Exact attention with K/V ring-rotated over ``axis_name``.
 
@@ -66,7 +67,22 @@ def ring_attention(
     ``causal`` masks by *global* position: block offsets are derived
     from ``lax.axis_index``, so tokens attend only to global positions
     ≤ their own.
+
+    ``attn_impl='flash'`` computes each visiting block pair with the
+    Pallas flash kernel (O(N_local·D) HBM per step instead of a
+    materialized N_local² score tile) and merges per-block
+    (out, lse) results — composition of the two memory levers: shard
+    the sequence over chips, then tile it through VMEM within each.
+    Non-causal only (the kernel has no causal mask).
     """
+    if attn_impl == "flash":
+        if causal:
+            raise ValueError(
+                "attn_impl='flash' has no causal mask; use the xla core")
+        return _ring_flash(q, k, v, axis_name)
+    if attn_impl != "xla":
+        raise ValueError(f"attn_impl must be 'xla' or 'flash', "
+                         f"got {attn_impl!r}")
     n_blocks = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -81,8 +97,7 @@ def ring_attention(
         k_pos = src_idx * n_local + jnp.arange(n_local)[None, :]
         return (q_pos >= k_pos)[None, None]  # broadcast over B,H
 
-    def body(i, carry):
-        k_blk, v_blk, num, den, m = carry
+    def fold(i, k_blk, v_blk, num, den, m):
         # Block i arrived from device (my_idx - i) around the ring.
         src = (my_idx - i) % n_blocks
         mask = causal_mask(src) if causal else None
@@ -93,9 +108,14 @@ def ring_attention(
         corr_new = jnp.exp(b_max - new_m)
         num = num * corr_old[..., None] + b_num * corr_new[..., None]
         den = den * corr_old + b_den * corr_new
+        return num, den, new_m
+
+    def body(i, carry):
+        k_blk, v_blk, num, den, m = carry
+        num, den, m = fold(i, k_blk, v_blk, num, den, m)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return k_blk, v_blk, num, den, new_m
+        return k_blk, v_blk, num, den, m
 
     b, h, _, d = q.shape
     init = (
@@ -104,10 +124,53 @@ def ring_attention(
         jnp.zeros((b, h, n_local), jnp.float32),
         jnp.full((b, h, n_local), -jnp.inf, jnp.float32),
     )
-    _, _, num, den, m = lax.fori_loop(0, n_blocks, body, init)
+    # Rotate only n_blocks-1 times: the last visiting block is folded
+    # in outside the loop — its ppermute result would be discarded, and
+    # a collective can't be DCE'd, so it would be pure wasted ICI.
+    k_l, v_l, num, den, m = lax.fori_loop(0, n_blocks - 1, body, init)
+    num, den, m = fold(n_blocks - 1, k_l, v_l, num, den, m)
     out = num / jnp.maximum(den, 1e-30)[..., None]
     # Rows that attended to nothing (fully masked) return zeros.
     out = jnp.where(jnp.isfinite(m)[..., None], out, 0.0)
+    return out.astype(q.dtype)
+
+
+def _ring_flash(q, k, v, axis_name: str) -> jnp.ndarray:
+    """Flash-kernel ring body: each visiting K/V block is attended with
+    ``pallas.flash_attention_with_lse`` and folded into the running
+    result by lse-weighted merge — algebraically the same online
+    softmax as the xla body, just with the per-block inner loop pushed
+    into VMEM.  Exact vs ``full_attention`` (tests)."""
+    from ..pallas.flash_attention import flash_attention_with_lse
+
+    n_blocks = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+    b, h, n_local, d = q.shape
+
+    def fold(k_blk, v_blk, out, lse):
+        o_b, lse_b = flash_attention_with_lse(q, k_blk, v_blk)
+        m = jnp.maximum(lse, lse_b)
+        w_prev = jnp.exp(lse - m)          # 0 on the first visit
+        w_blk = jnp.exp(lse_b - m)
+        den = w_prev + w_blk
+        out = (out * w_prev[..., None]
+               + o_b.astype(jnp.float32) * w_blk[..., None]) / den[..., None]
+        return out, m + jnp.log(den)
+
+    def body(i, carry):
+        k_blk, v_blk, out, lse = carry
+        out, lse = fold(k_blk, v_blk, out, lse)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, out, lse
+
+    init = (k, v,
+            jnp.zeros((b, h, n_local, d), jnp.float32),
+            jnp.full((b, h, n_local), -jnp.inf, jnp.float32))
+    # Same n_blocks-1 rotation structure as the xla body: the final
+    # visiting block folds in without a dead trailing ppermute.
+    k_l, v_l, out, lse = lax.fori_loop(0, n_blocks - 1, body, init)
+    out, _ = fold(k_l, v_l, out, lse)
     return out.astype(q.dtype)
 
 
@@ -124,7 +187,8 @@ def full_attention(q, k, v, causal: bool = False) -> jnp.ndarray:
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def make_ring_attention_fn(mesh, causal: bool = False):
+def make_ring_attention_fn(mesh, causal: bool = False,
+                           attn_impl: str = "xla"):
     """jit(shard_map(...)) wrapper: global [B,H,N,D] arrays sharded on
     N over the mesh's ``seq`` axis; drop-in replacement for
     ``full_attention`` at pod scale."""
@@ -133,7 +197,8 @@ def make_ring_attention_fn(mesh, causal: bool = False):
     spec = P(None, None, "seq", None)
 
     def fn(q, k, v):
-        return ring_attention(q, k, v, axis_name="seq", causal=causal)
+        return ring_attention(q, k, v, axis_name="seq", causal=causal,
+                              attn_impl=attn_impl)
 
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                             out_specs=spec, check_vma=False)

@@ -143,28 +143,19 @@ def _sp_apply(model, variables, image, *, train: bool, rngs=None):
     seq = lax.axis_size("seq")
     row_off = lax.axis_index("seq") * local_rows
     full_grid = (local_rows * seq, image.shape[2] // model.patch)
+    # model.attn_impl composes with the ring: 'flash' runs each
+    # visiting K/V block through the Pallas kernel inside the ring
+    # (sequence sharded over chips, then tiled through VMEM within
+    # each), 'xla' keeps the materialized per-block scores.
     return model.apply(
         variables, image, None, train=train,
-        attn_fn=partial(ring_attention, axis_name="seq"),
+        attn_fn=partial(ring_attention, axis_name="seq",
+                        attn_impl=getattr(model, "attn_impl", "xla")),
         full_grid=full_grid, pos_row_offset=row_off,
         **({"rngs": rngs} if rngs is not None else {}))
 
 
-def _warn_flash_overridden(model):
-    """model.attn_impl='flash' loses to the injected ring core on SP
-    meshes — say so instead of silently ignoring the knob (the same
-    contract as the loss.fused_kernel warning below; ADVICE.md r1)."""
-    if getattr(model, "attn_impl", "xla") == "flash":
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "model.attn_impl='flash' is overridden on sequence-parallel "
-            "meshes: the SP step injects the ring-attention core; the "
-            "Pallas flash kernel applies to mesh.seq==1 paths")
-
-
 def make_sp_eval_step(model, mesh: Mesh) -> Callable:
-    _warn_flash_overridden(model)
     """Sequence-parallel forward-only step: ``(variables, batch) ->
     probs`` with image rows sharded over ``seq`` and ring attention
     crossing the blocks — the eval/inference path for resolutions whose
@@ -246,7 +237,6 @@ def make_sp_train_step(
             "loss.fused_kernel is a no-op on the sequence-parallel "
             "path: the SP loss already psums sufficient statistics "
             "inline (docs/PERFORMANCE.md)")
-    _warn_flash_overridden(model)
     seq = mesh.shape["seq"]
 
     def step_fn(state: TrainState, batch):

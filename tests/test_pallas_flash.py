@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from distributed_sod_project_tpu.pallas.flash_attention import (
-    _bwd_call, _fwd_call, flash_attention)
+    _bwd_call, _fwd_call, flash_attention, flash_attention_with_lse)
 from distributed_sod_project_tpu.parallel.ring_attention import full_attention
 
 
@@ -57,6 +57,40 @@ def test_non_dividing_block_pair():
     out = flash_attention(q, k, v, block_q=256, block_kv=640)
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(full_attention(q, k, v)), atol=2e-6)
+
+
+def test_with_lse_values_and_cotangent():
+    """The lse output equals logsumexp of the scaled scores, and a
+    NONZERO lse cotangent backpropagates correctly (it folds into the
+    kernels as a delta shift) — the contract the SP ring merge needs."""
+    q, k, v = _qkv(1, 2, 200, 32)
+
+    def oracle(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / q.shape[-1] ** 0.5
+        return (jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v),
+                jax.scipy.special.logsumexp(s, axis=-1))
+
+    out, lse = flash_attention_with_lse(q, k, v)
+    ref_out, ref_lse = oracle(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=2e-6)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               atol=2e-6)
+
+    co = jax.random.normal(jax.random.PRNGKey(3), out.shape)
+    cl = jax.random.normal(jax.random.PRNGKey(4), lse.shape)
+
+    def loss(fn):
+        def f(*a):
+            o, l = fn(*a)
+            return jnp.sum(o * co) + jnp.sum(l * cl)
+        return f
+
+    g_fl = jax.grad(loss(flash_attention_with_lse), argnums=(0, 1, 2))(q, k, v)
+    g_or = jax.grad(loss(oracle), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_fl, g_or):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-6, err_msg=f"d{name}")
 
 
 def test_bfloat16_inputs():

@@ -121,6 +121,42 @@ def test_sp_step_matches_single_device(eight_devices):
                                    atol=2e-5, rtol=2e-4)
 
 
+def test_sp_step_flash_matches_single_device(eight_devices):
+    """SP + model.attn_impl='flash': the ring runs the Pallas kernel
+    per visiting block; the compiled step must equal the single-device
+    objective exactly (same protocol as the xla-core test above)."""
+    model = ViTSOD(patch=8, dim=32, depth=2, heads=2, mlp_ratio=2,
+                   attn_impl="flash")
+    batch = _data(b=4, hw=32)
+    mesh = make_mesh(MeshConfig(data=2, seq=4), eight_devices)
+
+    variables = model.init(jax.random.key(0), batch["image"], None,
+                           train=False)
+    params = variables["params"]
+    tx = optax.sgd(0.1)
+
+    from distributed_sod_project_tpu.train.state import TrainState
+
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       batch_stats={}, opt_state=tx.init(params))
+    state = jax.device_put(state, replicated_sharding(mesh))
+    dev_batch = jax.device_put(batch, sp_batch_sharding(mesh))
+
+    from distributed_sod_project_tpu.configs import LossConfig
+
+    step = make_sp_train_step(model, LossConfig(bce=1.0, iou=1.0, ssim=0.0),
+                              tx, mesh, donate=False)
+    _, metrics = step(state, dev_batch)
+
+    ref_total, ref_grads = jax.value_and_grad(
+        lambda p: _ref_loss(model, p, batch["image"], batch["mask"]))(params)
+    np.testing.assert_allclose(float(metrics["total"]), float(ref_total),
+                               rtol=2e-5)
+    np.testing.assert_allclose(float(metrics["grad_norm"]),
+                               float(optax.global_norm(ref_grads)),
+                               rtol=2e-4)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("window", [11, 7])
 def test_sp_step_with_ssim_matches_single_device(window, eight_devices):
