@@ -122,6 +122,11 @@ class MeshConfig:
     data: int = -1
     model: int = 1
     seq: int = 1
+    # Sequence-parallel strategy over the ``seq`` axis: 'ring' rotates
+    # K/V blocks (ppermute; any head count), 'ulysses' redistributes
+    # heads with two all-to-alls (needs model heads % seq == 0; lower
+    # collective latency, full-sequence tiles for the flash kernel).
+    sp_strategy: str = "ring"  # ring | ulysses
 
 
 @dataclasses.dataclass(frozen=True)

@@ -366,7 +366,9 @@ def evaluate(
         # profile an SP-trained model exists to avoid at long-context
         # resolutions.
         bs = sp_eval_batch_size(mesh, bs)
-        forward = make_sp_eval_forward(model, mesh)(variables)
+        forward = make_sp_eval_forward(
+            model, mesh, getattr(cfg.mesh, "sp_strategy", "ring")
+        )(variables)
     else:
         if mesh is not None:
             from ..parallel.mesh import (eval_batch_divisor,

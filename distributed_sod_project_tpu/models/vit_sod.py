@@ -31,9 +31,6 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
-from ..parallel.ring_attention import full_attention
-
-
 class _Block(nn.Module):
     """Pre-LN transformer block; attention core injected per call."""
 
@@ -108,16 +105,9 @@ class ViTSOD(nn.Module):
                  pos_row_offset=0) -> List[jnp.ndarray]:
         del depth  # RGB-only member; uniform zoo signature
         if attn_fn is None:
-            if self.attn_impl == "flash":
-                from ..pallas.flash_attention import flash_attention
+            from ..parallel.ring_attention import resolve_attn_fn
 
-                attn_fn = flash_attention
-            elif self.attn_impl == "xla":
-                attn_fn = full_attention
-            else:
-                raise ValueError(
-                    f"attn_impl must be 'xla' or 'flash', got "
-                    f"{self.attn_impl!r}")
+            attn_fn = resolve_attn_fn(self.attn_impl)
         x = image.astype(self.dtype)
         b, hh, ww, _ = x.shape
         p = self.patch

@@ -231,14 +231,14 @@ def _fwd_call(q, k, v, cfg):
     )(q, k, v)
 
 
-def _bwd_call(q, k, v, out, lse, do, cfg, dlse=None):
+def _bwd_call(q, k, v, out, lse_row, do, cfg, dlse=None):
     bq, bkv, interpret, n = cfg
     bh, np_, d = q.shape
     scale = 1.0 / d**0.5
     # delta_i = Σ_d out·do — loop-invariant per query row, so computed
     # ONCE here (one fused XLA pass) and streamed to both kernels as a
-    # lane-replicated row tile, the same layout as lse.  A cotangent on
-    # lse folds in exactly here: ∂lse_i/∂s_ij = p_ij, so
+    # lane-replicated row tile.  A cotangent on lse folds in exactly
+    # here: ∂lse_i/∂s_ij = p_ij, so
     # s̄_ij = p_ij·(dp_ij − delta_i + dlse_i) — i.e. dlse just shifts
     # delta, and the kernels need no second code path.
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
@@ -246,6 +246,9 @@ def _bwd_call(q, k, v, out, lse, do, cfg, dlse=None):
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (bh, np_, _LANES))
+    # lse is saved as one lane per row ((bh, np), 1/128th the tile the
+    # kernels stream) and re-broadcast here, same as delta.
+    lse = jnp.broadcast_to(lse_row[..., None], (bh, np_, _LANES))
 
     qs, kvs, row = _specs(bq, bkv, d, kv_resident=False)
     dq = pl.pallas_call(
@@ -283,21 +286,24 @@ def _bwd_call(q, k, v, out, lse, do, cfg, dlse=None):
 
 @partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_lse(q, k, v, cfg):
-    """Like ``_flash`` but also returns the per-row logsumexp
-    ([bh, np] f32) — the merge statistic ring attention needs."""
+    """The forward+lse primitive ([bh, np] f32 lse — the merge
+    statistic ring attention needs; the plain wrapper drops it)."""
     out, lse = _fwd_call(q, k, v, cfg)
     return out, lse[:, :, 0]
 
 
 def _flash_lse_fwd(q, k, v, cfg):
     out, lse = _fwd_call(q, k, v, cfg)
-    return (out, lse[:, :, 0]), (q, k, v, out, lse)
+    # Residuals keep ONE lane of the lane-replicated lse tile — the
+    # backward re-broadcasts; holding all 128 copies across the
+    # fwd→bwd gap would rival the q/k/v residuals themselves.
+    return (out, lse[:, :, 0]), (q, k, v, out, lse[:, :, 0])
 
 
 def _flash_lse_bwd(cfg, res, gs):
-    q, k, v, out, lse = res
+    q, k, v, out, lse_row = res
     g_out, g_lse = gs
-    return _bwd_call(q, k, v, out, lse, g_out, cfg, dlse=g_lse)
+    return _bwd_call(q, k, v, out, lse_row, g_out, cfg, dlse=g_lse)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)

@@ -9,6 +9,7 @@ from .mesh import (
     global_batch_array,
 )
 from .sp import make_sp_eval_step, make_sp_train_step, sp_batch_sharding
+from .ulysses import make_ulysses_attention_fn, ulysses_attention
 from .tp import (
     DEFAULT_TP_RULES,
     SWIN_TP_RULES,
@@ -32,6 +33,8 @@ __all__ = [
     "VIT_TP_RULES",
     "make_sp_eval_step",
     "make_sp_train_step",
+    "make_ulysses_attention_fn",
+    "ulysses_attention",
     "sp_batch_sharding",
     "SWIN_TP_RULES",
     "make_tp_train_step",

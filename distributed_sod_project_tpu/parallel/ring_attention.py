@@ -32,6 +32,26 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def resolve_attn_fn(attn_impl: str, causal: bool = False):
+    """The single attn_impl → dense-attention-callable dispatch, shared
+    by ``ulysses_attention``, ``ViTSOD``'s default core, and (for
+    validation) the ring: 'xla' materializes scores, 'flash' is the
+    Pallas kernel (non-causal only).  Raises the one canonical error
+    for anything else."""
+    if attn_impl == "flash":
+        if causal:
+            raise ValueError(
+                "attn_impl='flash' has no causal mask; use the xla core")
+        from ..pallas.flash_attention import flash_attention
+
+        return flash_attention
+    if attn_impl == "xla":
+        return partial(full_attention, causal=causal) if causal \
+            else full_attention
+    raise ValueError(
+        f"attn_impl must be 'xla' or 'flash', got {attn_impl!r}")
+
+
 def _block_attend(q, k, v, *, scale, mask=None):
     """One block pair: returns (numerator, denominator, block_max).
 
@@ -75,14 +95,9 @@ def ring_attention(
     the sequence over chips, then tile it through VMEM within each.
     Non-causal only (the kernel has no causal mask).
     """
+    resolve_attn_fn(attn_impl, causal=causal)  # one shared validation
     if attn_impl == "flash":
-        if causal:
-            raise ValueError(
-                "attn_impl='flash' has no causal mask; use the xla core")
         return _ring_flash(q, k, v, axis_name)
-    if attn_impl != "xla":
-        raise ValueError(f"attn_impl must be 'xla' or 'flash', "
-                         f"got {attn_impl!r}")
     n_blocks = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     scale = 1.0 / (q.shape[-1] ** 0.5)

@@ -134,28 +134,59 @@ def _sp_ssim_loss(logits, mask, *, axis="seq", window_size=11, sigma=1.5):
     return 1.0 - global_sum / n_global
 
 
-def _sp_apply(model, variables, image, *, train: bool, rngs=None):
+def _sp_apply(model, variables, image, *, train: bool, rngs=None,
+              sp_strategy: str = "ring"):
     """The shared SP forward: derive this device's (row offset, full
     grid) from its ``seq`` position and run the module on its row slice
-    with ring attention as the attention core.  Single definition so
-    train and eval geometry cannot diverge."""
+    with a sequence-parallel attention core.  Single definition so
+    train and eval geometry cannot diverge.
+
+    ``sp_strategy`` picks the core: 'ring' (K/V blocks on a ppermute
+    ring) or 'ulysses' (two all-to-alls redistribute heads, full
+    sequence per device — needs heads % seq == 0).  Either composes
+    with ``model.attn_impl``: 'flash' runs the Pallas kernel inside
+    the strategy (per visiting block for the ring, on the full
+    sequence for ulysses), 'xla' keeps materialized scores.
+    """
+    if sp_strategy == "ring":
+        core = ring_attention
+    elif sp_strategy == "ulysses":
+        from .ulysses import ulysses_attention
+
+        core = ulysses_attention
+    else:
+        raise ValueError(f"mesh.sp_strategy must be 'ring' or "
+                         f"'ulysses', got {sp_strategy!r}")
     local_rows = image.shape[1] // model.patch
     seq = lax.axis_size("seq")
     row_off = lax.axis_index("seq") * local_rows
     full_grid = (local_rows * seq, image.shape[2] // model.patch)
-    # model.attn_impl composes with the ring: 'flash' runs each
-    # visiting K/V block through the Pallas kernel inside the ring
-    # (sequence sharded over chips, then tiled through VMEM within
-    # each), 'xla' keeps the materialized per-block scores.
     return model.apply(
         variables, image, None, train=train,
-        attn_fn=partial(ring_attention, axis_name="seq",
+        attn_fn=partial(core, axis_name="seq",
                         attn_impl=getattr(model, "attn_impl", "xla")),
         full_grid=full_grid, pos_row_offset=row_off,
         **({"rngs": rngs} if rngs is not None else {}))
 
 
-def make_sp_eval_step(model, mesh: Mesh) -> Callable:
+def validate_sp_strategy(model, mesh: Mesh, sp_strategy: str) -> None:
+    """Build-time geometry check shared by every SP entry point (train
+    step, eval step — so test.py gets the friendly error too, not a
+    mid-trace shard_map failure).  The runtime check inside
+    ``ulysses_attention`` stays as the backstop for direct callers."""
+    if sp_strategy == "ulysses":
+        seq = mesh.shape.get("seq", 1)
+        heads = getattr(model, "heads", 0)
+        if heads % seq:
+            raise ValueError(
+                f"mesh.sp_strategy=ulysses needs heads % seq == 0, got "
+                f"heads={heads} seq={seq} — use sp_strategy=ring for "
+                "this head count")
+
+
+def make_sp_eval_step(model, mesh: Mesh,
+                      sp_strategy: str = "ring") -> Callable:
+    validate_sp_strategy(model, mesh, sp_strategy)
     """Sequence-parallel forward-only step: ``(variables, batch) ->
     probs`` with image rows sharded over ``seq`` and ring attention
     crossing the blocks — the eval/inference path for resolutions whose
@@ -165,7 +196,8 @@ def make_sp_eval_step(model, mesh: Mesh) -> Callable:
     attention is exact)."""
 
     def eval_fn(variables, batch):
-        outs = _sp_apply(model, variables, batch["image"], train=False)
+        outs = _sp_apply(model, variables, batch["image"], train=False,
+                         sp_strategy=sp_strategy)
         return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
 
     sharded = jax.shard_map(
@@ -196,11 +228,11 @@ def sp_eval_batch_size(mesh: Mesh, batch_size: int) -> int:
     return max(1, batch_size // div) * div
 
 
-def make_sp_eval_forward(model, mesh: Mesh):
+def make_sp_eval_forward(model, mesh: Mesh, sp_strategy: str = "ring"):
     """Compile the SP eval step once; returns ``bind(variables) ->
     forward(batch) -> probs`` so callers whose variables change between
     sweeps (the inline train eval) rebind without retracing."""
-    sp_forward = make_sp_eval_step(model, mesh)
+    sp_forward = make_sp_eval_step(model, mesh, sp_strategy)
 
     def bind(variables):
         from .mesh import replicated_sharding
@@ -221,6 +253,7 @@ def make_sp_train_step(
     donate: bool = True,
     ema_decay: float = 0.0,
     donate_batch: bool = False,
+    sp_strategy: str = "ring",
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
               Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the sequence-parallel ``(state, batch) -> (state, metrics)``.
@@ -228,7 +261,8 @@ def make_sp_train_step(
     Contract: ``state`` replicated; batch leaves ``P('data', 'seq')``
     (global shapes; each device sees its (batch, rows) tile).  The
     model must be halo-free over rows with an injectable attention
-    core (``vit_sod``).
+    core (``vit_sod``).  ``sp_strategy`` picks ring vs ulysses —
+    see ``_sp_apply``.
     """
     if getattr(loss_cfg, "fused_kernel", False):
         import logging
@@ -237,6 +271,7 @@ def make_sp_train_step(
             "loss.fused_kernel is a no-op on the sequence-parallel "
             "path: the SP loss already psums sufficient statistics "
             "inline (docs/PERFORMANCE.md)")
+    validate_sp_strategy(model, mesh, sp_strategy)
     seq = mesh.shape["seq"]
 
     def step_fn(state: TrainState, batch):
@@ -247,7 +282,8 @@ def make_sp_train_step(
 
         def loss_fn(params):
             outs = _sp_apply(model, {"params": params}, image,
-                             train=True, rngs={"dropout": rng})
+                             train=True, rngs={"dropout": rng},
+                             sp_strategy=sp_strategy)
             if not loss_cfg.deep_supervision:
                 outs = outs[:1]  # primary head only, uniform across steps
             # DP convention (losses/deep_supervision.py): SUM over

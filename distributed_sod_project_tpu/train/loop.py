@@ -160,7 +160,8 @@ def fit(
         def step_factory(scale_hw):
             return make_sp_train_step(
                 model, cfg.loss, tx, mesh, schedule=schedule,
-                ema_decay=cfg.optim.ema_decay, donate_batch=True)
+                ema_decay=cfg.optim.ema_decay, donate_batch=True,
+                sp_strategy=cfg.mesh.sp_strategy)
     elif use_gspmd:
         from ..parallel.tp import make_tp_train_step, shard_state
 
@@ -388,7 +389,8 @@ def _make_inline_eval(cfg: ExperimentConfig, model, mesh) -> Callable:
         # seq axis may span hosts, so every host sweeps the full set
         # with identical batches (the global-placement contract).
         bs = sp_eval_batch_size(mesh, cfg.global_batch_size)
-        make_eval_forward = make_sp_eval_forward(model, mesh)
+        make_eval_forward = make_sp_eval_forward(model, mesh,
+                                                 cfg.mesh.sp_strategy)
     elif jax.process_count() > 1 and mesh.shape.get("model", 1) == 1:
         # Disjoint 1/num_hosts slice per host, on this host's own
         # chips only — total eval work is O(1) in host count and no

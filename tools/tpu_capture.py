@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""One-command TPU measurement session — run the moment the tunnel lives.
+
+The axon TPU tunnel has been available for exactly one session across
+two rounds; when it comes back the window may be short.  This tool
+captures the full round-2 measurement agenda (VERDICT.md items 1-3)
+in one invocation, each step bounded and failure-isolated, appending
+everything to an output directory the BASELINE.md tables can be
+written from:
+
+    python tools/tpu_capture.py --out tpu_results/
+
+Agenda (each a bounded subprocess; a wedge or failure in one step
+never loses the others):
+
+  1. probe        — out-of-process dial with timeout; abort if no TPU
+  2. headline     — MINet-R50 @320 bf16 train, batch 64 + remat
+                    (the BASELINE.md governing number)
+  3. batch sweep  — batch 32 / 96 / 128 (remat on) around the headline
+  4. eval         — forward+device-metrics throughput (test.py hot loop)
+  5. zoo          — tools/bench_zoo.py over every config, train+eval
+  6. fused A/B    — loss.fused_kernel on/off (basnet_ds, the 8-output
+                    deep-supervision hybrid-loss member)
+  7. flash A/B    — vit_sod attention xla vs Pallas flash @512px
+  8. profile      — jax.profiler trace of the headline step for the
+                    MFU push (VERDICT.md "what's weak" #1)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(name: str, cmd: list[str], out_dir: str, timeout: int,
+         results: dict) -> dict | None:
+    """Run one step; parse the last JSON line of stdout; log everything."""
+    log_path = os.path.join(out_dir, f"{name}.log")
+    t0 = time.time()
+    print(f"[{name}] {' '.join(cmd)}", flush=True)
+    try:
+        proc = subprocess.run(cmd, cwd=_REPO, capture_output=True,
+                              text=True, timeout=timeout)
+        out, err, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+        rc = f"timeout>{timeout}s"
+    with open(log_path, "w") as f:
+        f.write(f"$ {' '.join(cmd)}\nrc={rc}\n--- stdout ---\n{out}"
+                f"\n--- stderr ---\n{err}\n")
+    parsed = None
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    results[name] = {"rc": rc, "seconds": round(time.time() - t0, 1),
+                     "parsed": parsed}
+    status = "ok" if parsed and "error" not in (parsed or {}) else f"rc={rc}"
+    val = (parsed or {}).get("value")
+    unit = (parsed or {}).get("unit", "")
+    print(f"[{name}] {status}  value={val} {unit}  "
+          f"({results[name]['seconds']}s)", flush=True)
+    return parsed
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default="tpu_results")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--step-timeout", type=int, default=1200,
+                   help="per-step subprocess bound (compile ~20-40s + "
+                        "timed steps; zoo gets 4x this)")
+    p.add_argument("--skip", default="",
+                   help="comma-separated step names to skip "
+                        "(e.g. zoo,profile)")
+    p.add_argument("--device", default="tpu", choices=["tpu", "cpu"],
+                   help="cpu = smoke-test THIS TOOL's machinery "
+                        "(tiny shapes); the measurement agenda is tpu")
+    args = p.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    skip = {s.strip() for s in args.skip.split(",") if s.strip()}
+    smoke = args.device == "cpu"
+    results: dict = {}
+    py = sys.executable
+
+    # 1. probe — out of process, so a wedge is a clean abort.
+    try:
+        probe = subprocess.run(
+            [py, "-c",
+             "import jax; jax.config.update('jax_platforms', 'cpu'); "
+             "print('cpu', jax.device_count())" if smoke else
+             "import jax; d=jax.devices(); print(d[0].platform, len(d))"],
+            cwd=_REPO, capture_output=True, text=True, timeout=150)
+        plat = probe.stdout.strip().split()
+    except subprocess.TimeoutExpired:
+        plat = []
+    want = ("cpu",) if smoke else ("tpu", "axon")
+    if not plat or plat[0] not in want:
+        print(f"no {want[0]} (probe said {plat or 'wedge/timeout'}); "
+              "aborting", flush=True)
+        results["probe"] = {"ok": False, "detail": plat}
+        with open(os.path.join(args.out, "results.json"), "w") as f:
+            json.dump(results, f, indent=2)
+        return 1
+    results["probe"] = {"ok": True, "platform": plat}
+    print(f"{plat[0]} up: {plat}", flush=True)
+
+    # CPU smoke shrinks every shape so one pass finishes in minutes.
+    hw, hw_hi, b_head, b_mid, b_hi, b_vit = (
+        ("64", "64", "2", "1", "2", "1") if smoke
+        else ("320", "512", "64", "32", "96", "8"))
+    bench = [py, "bench.py", "--device", args.device,
+             "--steps", str(args.steps), "--image-size", hw]
+    agenda = [
+        ("headline", bench + ["--config", "minet_r50_dp",
+                              "--batch-per-chip", b_head,
+                              "--set", "model.remat=true"]),
+        ("batch_lo", bench + ["--config", "minet_r50_dp",
+                              "--batch-per-chip", b_mid]),
+        ("batch_hi_remat", bench + ["--config", "minet_r50_dp",
+                                    "--batch-per-chip", b_hi,
+                                    "--set", "model.remat=true"]),
+        ("batch_max_remat", bench + ["--config", "minet_r50_dp",
+                                     "--batch-per-chip",
+                                     "4" if smoke else "128",
+                                     "--set", "model.remat=true"]),
+        ("eval", bench + ["--config", "minet_r50_dp", "--mode", "eval",
+                          "--batch-per-chip", b_head]),
+        ("fused_off", bench + ["--config", "basnet_ds",
+                               "--batch-per-chip", b_mid]),
+        ("fused_on", bench + ["--config", "basnet_ds",
+                              "--batch-per-chip", b_mid,
+                              "--set", "loss.fused_kernel=true"]),
+        ("flash_off", [*bench[:-1], hw_hi, "--config", "vit_sod_sp",
+                       "--batch-per-chip", b_vit,
+                       "--set", "mesh.seq=1",
+                       "--set", "model.attn_impl=xla"]),
+        ("flash_on", [*bench[:-1], hw_hi, "--config", "vit_sod_sp",
+                      "--batch-per-chip", b_vit,
+                      "--set", "mesh.seq=1",
+                      "--set", "model.attn_impl=flash"]),
+        ("profile", bench + ["--config", "minet_r50_dp",
+                             "--batch-per-chip", b_head,
+                             "--set", "model.remat=true",
+                             "--profile-dir",
+                             os.path.join(args.out, "trace")]),
+    ]
+    for name, cmd in agenda:
+        if name in skip:
+            continue
+        _run(name, cmd, args.out, args.step_timeout, results)
+        with open(os.path.join(args.out, "results.json"), "w") as f:
+            json.dump(results, f, indent=2)
+
+    if "zoo" not in skip:
+        _run("zoo", [py, "tools/bench_zoo.py", "--device", args.device,
+                     "--modes", "train,eval", "--steps", str(args.steps),
+                     "--image-size", hw,
+                     *([] if not smoke else ["--batch-per-chip", "1"]),
+                     "--out", os.path.join(args.out, "zoo_table.md")],
+             args.out, 4 * args.step_timeout, results)
+        with open(os.path.join(args.out, "results.json"), "w") as f:
+            json.dump(results, f, indent=2)
+
+    # Markdown summary for BASELINE.md.
+    lines = ["| step | value | unit | seconds |", "|---|---|---|---|"]
+    for name, r in results.items():
+        if name == "probe":
+            continue
+        parsed = r.get("parsed") or {}
+        lines.append(f"| {name} | {parsed.get('value', '—')} | "
+                     f"{parsed.get('unit', '')} | {r.get('seconds', '')} |")
+    md = os.path.join(args.out, "summary.md")
+    with open(md, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {md}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
