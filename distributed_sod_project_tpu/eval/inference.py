@@ -366,9 +366,8 @@ def evaluate(
         # profile an SP-trained model exists to avoid at long-context
         # resolutions.
         bs = sp_eval_batch_size(mesh, bs)
-        forward = make_sp_eval_forward(
-            model, mesh, getattr(cfg.mesh, "sp_strategy", "ring")
-        )(variables)
+        forward = make_sp_eval_forward(model, mesh,
+                                       cfg.mesh.sp_strategy)(variables)
     else:
         if mesh is not None:
             from ..parallel.mesh import (eval_batch_divisor,

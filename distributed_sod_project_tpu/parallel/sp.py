@@ -186,14 +186,14 @@ def validate_sp_strategy(model, mesh: Mesh, sp_strategy: str) -> None:
 
 def make_sp_eval_step(model, mesh: Mesh,
                       sp_strategy: str = "ring") -> Callable:
-    validate_sp_strategy(model, mesh, sp_strategy)
     """Sequence-parallel forward-only step: ``(variables, batch) ->
-    probs`` with image rows sharded over ``seq`` and ring attention
-    crossing the blocks — the eval/inference path for resolutions whose
-    full-attention scores ([B,h,N,N]) exceed one chip's memory.  Output
-    probs come back sharded the same way; a host ``np.asarray`` gathers
-    them.  Math is identical to the single-device forward (ring
-    attention is exact)."""
+    probs`` with image rows sharded over ``seq`` and the SP attention
+    core crossing the blocks — the eval/inference path for resolutions
+    whose full-attention scores ([B,h,N,N]) exceed one chip's memory.
+    Output probs come back sharded the same way; a host ``np.asarray``
+    gathers them.  Math is identical to the single-device forward
+    (both strategies are exact)."""
+    validate_sp_strategy(model, mesh, sp_strategy)
 
     def eval_fn(variables, batch):
         outs = _sp_apply(model, variables, batch["image"], train=False,
