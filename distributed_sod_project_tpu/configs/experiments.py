@@ -106,6 +106,24 @@ def swin_sod() -> ExperimentConfig:
     )
 
 
+@register_config("gatenet_vgg16")
+def gatenet_vgg16() -> ExperimentConfig:
+    """Zoo extension beyond the 5 driver configs: GateNet (ECCV 2020,
+    lartpang et al.) — gated skip connections + dilated-pyramid
+    bridge, 5-level deep supervision."""
+    return ExperimentConfig(
+        name="gatenet_vgg16",
+        data=DataConfig(dataset="duts", image_size=(320, 320)),
+        model=ModelConfig(name="gatenet", backbone="vgg16"),
+        loss=LossConfig(bce=1.0, iou=1.0, ssim=1.0, deep_supervision=True),
+        optim=OptimConfig(optimizer="sgd", lr=0.01, momentum=0.9,
+                          weight_decay=5e-4, schedule="poly",
+                          warmup_steps=200),
+        global_batch_size=32,
+        mesh=MeshConfig(data=-1, model=1, seq=1),
+    )
+
+
 @register_config("vit_sod_sp")
 def vit_sod_sp() -> ExperimentConfig:
     """Long-context member: global-attention ViT-SOD, trainable with

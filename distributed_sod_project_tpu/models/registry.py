@@ -108,6 +108,20 @@ def _build_swin_sod(cfg, *, dtype, param_dtype, axis_name):
     )
 
 
+@register_model("gatenet")
+def _build_gatenet(cfg, *, dtype, param_dtype, axis_name):
+    from .gatenet import GateNet
+
+    return GateNet(
+        backbone=cfg.backbone,
+        backbone_bn=cfg.backbone_bn,
+        axis_name=axis_name,
+        bn_momentum=cfg.bn_momentum,
+        dtype=dtype,
+        param_dtype=param_dtype,
+    )
+
+
 @register_model("vit_sod")
 def _build_vit_sod(cfg, *, dtype, param_dtype, axis_name):
     from .vit_sod import PRESETS, ViTSOD

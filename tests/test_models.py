@@ -46,7 +46,7 @@ def test_resnet34_pyramid_shapes():
     assert [f.shape[-1] for f in feats] == [64, 64, 128, 256, 512]
 
 
-@pytest.mark.parametrize("config_name", ["minet_vgg16_ref"])
+@pytest.mark.parametrize("config_name", ["minet_vgg16_ref", "gatenet_vgg16"])
 def test_model_forward_from_config(config_name):
     cfg = get_config(config_name)
     model = build_model(cfg.model.__class__(
@@ -200,10 +200,41 @@ def test_dynamic_local_filter_mean_kernel_matches_avgpool():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
+def test_gatenet_five_outputs_and_finite_grads():
+    from distributed_sod_project_tpu.models.gatenet import GateNet
+
+    model = GateNet(backbone="vgg16")
+    x = jax.random.normal(jax.random.key(1), (1, 64, 64, 3))
+    y = (jax.random.uniform(jax.random.key(2), (1, 64, 64, 1)) > 0.5).astype(
+        jnp.float32)
+    _finite_grad_check(model, x, y, n_outputs=5)
+
+
+def test_gatenet_gate_actually_gates():
+    """A zeroed gate conv (bias -inf-ish) must suppress the skip: the
+    GateUnit output scales with sigmoid of the gate logit."""
+    from distributed_sod_project_tpu.models.gatenet import GateUnit
+
+    gu = GateUnit()
+    enc = jnp.ones((1, 8, 8, 4))
+    dec = jnp.zeros((1, 8, 8, 4))
+    vars_ = gu.init(jax.random.key(0), enc, dec)
+    out = gu.apply(vars_, enc, dec)
+    assert out.shape == enc.shape
+    # Force a hugely negative gate logit (conv kernel ≪ 0, BN at its
+    # identity init): sigmoid → 0, so the skip is fully suppressed.
+    neg = jax.tree.map(lambda a: jnp.full_like(a, -50.0)
+                       if a.ndim == 4 else a, vars_)
+    out0 = gu.apply(neg, enc, dec)
+    assert float(jnp.abs(out0).max()) < 1e-6
+
+
 def test_registry_builds_all_zoo_models():
     from distributed_sod_project_tpu.models import list_models
 
-    assert {"minet", "u2net", "basnet", "hdfnet"} <= set(list_models())
+    assert {"minet", "u2net", "basnet", "hdfnet",
+            "gatenet"} <= set(list_models())
 
 
 @pytest.mark.slow
