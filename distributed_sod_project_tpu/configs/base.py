@@ -58,6 +58,10 @@ class ModelConfig:
     # tiled-softmax kernel (pallas/flash_attention.py) — required for
     # high-resolution single-chip work where N² scores exceed HBM.
     attn_impl: str = "xla"  # xla | flash
+    # Dynamic-local-filter core (hdfnet only): "xla" = im2col+einsum,
+    # "pallas" = fused VMEM shifted-FMA kernel
+    # (pallas/dynamic_filter.py) — no ksize²-wide patch tensor in HBM.
+    dlf_impl: str = "xla"  # xla | pallas
     pretrained: Optional[str] = None  # .npz from tools/port_torch_weights.py
     # Structural deep supervision for models where aux heads are
     # optional add-ons (vit_sod's mid-depth head).  U²-Net/BASNet side

@@ -35,13 +35,24 @@ from .layers import ConvBNAct, resize_to, upsample_like
 
 
 def dynamic_local_filter(x: jnp.ndarray, kernels: jnp.ndarray, ksize: int,
-                         dilation: int = 1) -> jnp.ndarray:
+                         dilation: int = 1,
+                         impl: str = "xla") -> jnp.ndarray:
     """Apply per-position ``ksize×ksize`` depthwise kernels to ``x``.
 
     x: (B,H,W,C); kernels: (B,H,W,ksize*ksize) — one kernel per spatial
     location, shared across channels (HDFNet's kernel-generation units
     emit channel-shared spatial kernels).
+
+    ``impl='pallas'`` routes through the fused VMEM kernel
+    (``pallas/dynamic_filter.py``) — same math, no ksize²-wide im2col
+    materialisation in HBM.
     """
+    if impl == "pallas":
+        from ..pallas.dynamic_filter import fused_dynamic_filter
+
+        return fused_dynamic_filter(x, kernels, ksize, dilation)
+    if impl != "xla":
+        raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")
     b, h, w, c = x.shape
     # im2col: (B,H,W, C*ksize*ksize) with channel-major ordering.
     patches = jax.lax.conv_general_dilated_patches(
@@ -82,6 +93,7 @@ class DDPM(nn.Module):
     dilations: tuple = (1, 2, 4)
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    dlf_impl: str = "xla"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -96,7 +108,8 @@ class DDPM(nn.Module):
                                  bn_momentum=self.bn_momentum,
                                  dtype=self.dtype,
                                  param_dtype=self.param_dtype)(guide, train)
-            outs.append(dynamic_local_filter(x, kern, ksize=3, dilation=rate))
+            outs.append(dynamic_local_filter(x, kern, ksize=3, dilation=rate,
+                                             impl=self.dlf_impl))
         y = jnp.concatenate(outs, axis=-1)
         return ConvBNAct(self.width, (3, 3), **kw)(y, train)
 
@@ -107,6 +120,7 @@ class HDFNet(nn.Module):
     width: int = 64
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    dlf_impl: str = "xla"  # xla (im2col+einsum) | pallas (fused VMEM)
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -144,6 +158,7 @@ class HDFNet(nn.Module):
             guide = ConvBNAct(self.width, (3, 3), **kw)(dep_feats[lvl], train)
             filtered.append(DDPM(self.width, axis_name=self.axis_name,
                                  bn_momentum=self.bn_momentum,
+                                 dlf_impl=self.dlf_impl,
                                  dtype=self.dtype,
                                  param_dtype=self.param_dtype)(
                 fused, guide, train))

@@ -45,6 +45,10 @@ def build_model(model_cfg):
         raise ValueError(
             f"model.attn_impl={model_cfg.attn_impl!r} only applies to "
             f"vit_sod, not {model_cfg.name!r}")
+    if model_cfg.dlf_impl != "xla" and model_cfg.name != "hdfnet":
+        raise ValueError(
+            f"model.dlf_impl={model_cfg.dlf_impl!r} only applies to "
+            f"hdfnet, not {model_cfg.name!r}")
     dtype = jnp.dtype(model_cfg.compute_dtype)
     param_dtype = jnp.dtype(model_cfg.param_dtype)
     axis_name = "data" if model_cfg.sync_bn else None
@@ -148,6 +152,7 @@ def _build_hdfnet(cfg, *, dtype, param_dtype, axis_name):
         backbone_bn=cfg.backbone_bn,
         axis_name=axis_name,
         bn_momentum=cfg.bn_momentum,
+        dlf_impl=cfg.dlf_impl,
         dtype=dtype,
         param_dtype=param_dtype,
     )

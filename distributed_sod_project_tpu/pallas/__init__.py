@@ -1,3 +1,4 @@
+from .dynamic_filter import fused_dynamic_filter
 from .flash_attention import flash_attention
 from .fused_loss import fused_bce_iou_cel, pixel_region_sums
 from .fused_ssim import (
@@ -8,6 +9,7 @@ from .fused_ssim import (
 
 __all__ = [
     "flash_attention",
+    "fused_dynamic_filter",
     "fused_bce_iou_cel",
     "fused_ssim_available",
     "fused_ssim_loss",
