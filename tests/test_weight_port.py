@@ -1169,3 +1169,77 @@ def test_full_basnet_port_logit_parity(tmp_path):
         np.testing.assert_allclose(np.asarray(got[..., 0]), ref,
                                    atol=5e-4, rtol=5e-4,
                                    err_msg=f"logit {lvl}")
+
+
+class _TorchGateBridge(tnn.Module):
+    def __init__(self, w=64):
+        super().__init__()
+        self.branches = tnn.ModuleList(
+            [_TCBA(w, w, dil=d) for d in (1, 2, 4, 6)])
+        self.gconv = _TCBA(w, w, k=1)
+        self.fuse = _TCBA(5 * w, w, k=1)
+
+    def forward(self, x):
+        outs = [b(x) for b in self.branches]
+        g = self.gconv(x.mean((2, 3), keepdim=True))
+        outs.append(g.expand(-1, -1, x.shape[2], x.shape[3]))
+        return self.fuse(torch.cat(outs, 1))
+
+
+class _TorchGateNet(tnn.Module):
+    """Full torch composition mirroring models/gatenet.py::GateNet —
+    the oracle for the logit-level port-parity test."""
+
+    def __init__(self, w=64):
+        super().__init__()
+        chans = [64, 128, 256, 512, 512]
+        self.backbone = _torch_vgg16(True)
+        self.transfers = tnn.ModuleList([_TCBA(c, w) for c in chans])
+        self.bridge = _TorchGateBridge(w)
+        self.gates = tnn.ModuleList(
+            [_TCBA(2 * w, w, act=False) for _ in range(4)])
+        self.decs = tnn.ModuleList([_TCBA(2 * w, w) for _ in range(4)])
+        self.sides = tnn.ModuleList(
+            [tnn.Conv2d(w, 1, 3, padding=1) for _ in range(5)])
+
+    def forward(self, x):
+        feats = _vgg_torch_pyramid(self.backbone, x, bn=True)
+        trans = [t(f) for t, f in zip(self.transfers, feats)]
+        d = self.bridge(trans[-1])
+        logits = [_t_resize(self.sides[0](d), x.shape[-2:])]
+        for n, i in enumerate(range(3, -1, -1)):
+            up = _t_resize(d, trans[i].shape[-2:])
+            gate = torch.sigmoid(self.gates[n](torch.cat([trans[i], up], 1)))
+            d = self.decs[n](torch.cat([trans[i] * gate, up], 1))
+            logits.append(_t_resize(self.sides[n + 1](d), x.shape[-2:]))
+        return logits[::-1]
+
+
+@pytest.mark.slow
+def test_full_gatenet_port_logit_parity(tmp_path):
+    """Port a COMPLETE torch GateNet state_dict and assert logit-level
+    parity on all five outputs — transfer indexing, gate wiring against
+    the upsampled decoder state, bridge branches, and the finest-first
+    output ordering."""
+    from distributed_sod_project_tpu.models.gatenet import GateNet
+    from tools.port_torch_weights import port_gatenet_vgg16
+
+    tm = _TorchGateNet().eval()
+    with torch.no_grad():
+        _randomize_bn_stats(tm)
+        x = torch.randn(1, 3, 32, 32,
+                        generator=torch.Generator().manual_seed(11))
+        refs = [r[:, 0].numpy() for r in tm(x)]
+
+    params, stats = port_gatenet_vgg16(tm.state_dict(), use_bn=True)
+    fm = GateNet(backbone="vgg16", backbone_bn=True)
+    variables = jax.tree_util.tree_map(
+        jnp.asarray, {"params": params, "batch_stats": stats})
+    outs = fm.apply(variables,
+                    jnp.asarray(x.permute(0, 2, 3, 1).numpy()),
+                    train=False)
+    assert len(outs) == len(refs) == 5
+    for lvl, (got, ref) in enumerate(zip(outs, refs)):
+        np.testing.assert_allclose(np.asarray(got[..., 0]), ref,
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"logit {lvl}")

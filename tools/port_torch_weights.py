@@ -319,6 +319,59 @@ def port_hdfnet_vgg16(state_dict, use_bn: bool = True):
     return params, stats
 
 
+def port_gatenet_vgg16(state_dict, use_bn: bool = True):
+    """FULL-model port: a torch GateNet-VGG16 state_dict → (params,
+    batch_stats) for models/gatenet.py::GateNet(backbone='vgg16').
+
+    Expected torch layout (mirrored by the oracle replica in
+    tests/test_weight_port.py): ``backbone.*`` torchvision-style VGG16
+    features, ``transfers.{0..4}``, bridge ``bridge.branches.{0..3}`` /
+    ``bridge.gconv`` / ``bridge.fuse``, ``gates.{0..3}`` (creation
+    order matches the decoder loop: gates.0 pairs with level 3),
+    ``decs.{0..3}``, side heads ``sides.{0..4}`` (coarse → fine) —
+    protecting the gated-skip composition: transfer indexing, gate
+    wiring against the upsampled decoder state, bridge branches, and
+    the reversed (finest-first) logit ordering.
+    """
+    bb = {k[len("backbone."):]: v for k, v in state_dict.items()
+          if k.startswith("backbone.")}
+    vgg_p, vgg_s = port_vgg16(bb, use_bn=use_bn)
+    params: Dict = {"VGG16_0": vgg_p}
+    stats: Dict = {"VGG16_0": vgg_s} if vgg_s else {}
+
+    for i in range(5):  # transfers → ConvBNAct_0..4
+        _put(params, stats, f"ConvBNAct_{i}",
+             _port_cba(state_dict, f"transfers.{i}"))
+    bridge_p: Dict = {}
+    bridge_s: Dict = {}
+    for j in range(4):
+        _put(bridge_p, bridge_s, f"ConvBNAct_{j}",
+             _port_cba(state_dict, f"bridge.branches.{j}"))
+    _put(bridge_p, bridge_s, "ConvBNAct_4",
+         _port_cba(state_dict, "bridge.gconv"))
+    _put(bridge_p, bridge_s, "ConvBNAct_5",
+         _port_cba(state_dict, "bridge.fuse"))
+    params["DilatedPyramidBridge_0"] = bridge_p
+    if bridge_s:
+        stats["DilatedPyramidBridge_0"] = bridge_s
+    for i in range(4):
+        gate_p: Dict = {}
+        gate_s: Dict = {}
+        _put(gate_p, gate_s, "ConvBNAct_0",
+             _port_cba(state_dict, f"gates.{i}"))
+        params[f"GateUnit_{i}"] = gate_p
+        if gate_s:
+            stats[f"GateUnit_{i}"] = gate_s
+        _put(params, stats, f"ConvBNAct_{i + 5}",
+             _port_cba(state_dict, f"decs.{i}"))
+    for j in range(5):  # side heads, coarse → fine = Conv_0..4
+        params[f"Conv_{j}"] = {
+            "kernel": _conv_kernel(state_dict[f"sides.{j}.weight"]),
+            "bias": _t2n(state_dict[f"sides.{j}.bias"]),
+        }
+    return params, stats
+
+
 def _resnet_block_unit_counts(arch: str) -> Tuple[List[int], int]:
     if arch in ("resnet34",):
         return [3, 4, 6, 3], 2  # convs per BasicBlock
@@ -595,7 +648,7 @@ def main(argv=None):
     p.add_argument("--arch", required=True,
                    choices=["vgg16", "vgg16_bn", "resnet34", "resnet50",
                             "swin_t", "vit", "minet_vgg16", "hdfnet_vgg16",
-                            "u2net", "basnet"])
+                            "u2net", "basnet", "gatenet_vgg16"])
     p.add_argument("--out", required=True, help="output .npz path")
     p.add_argument("--state-dict", default=None,
                    help="local .pth state_dict (default: download via "
@@ -620,7 +673,8 @@ def main(argv=None):
         raise SystemExit(
             "vit ports the timm/DeiT checkpoint schema "
             "(vit_*_patch16_*) — pass it via --state-dict")
-    elif args.arch in ("minet_vgg16", "hdfnet_vgg16", "u2net", "basnet"):
+    elif args.arch in ("minet_vgg16", "hdfnet_vgg16", "u2net", "basnet",
+                       "gatenet_vgg16"):
         raise SystemExit(
             f"{args.arch} is a FULL-model port (the canonical torch "
             "composition documented on its port_* function) — pass the "
@@ -637,15 +691,16 @@ def main(argv=None):
         params, stats = port_u2net(sd)
     elif args.arch == "basnet":
         params, stats = port_basnet(sd)
-    elif args.arch in ("minet_vgg16", "hdfnet_vgg16"):
+    elif args.arch in ("minet_vgg16", "hdfnet_vgg16", "gatenet_vgg16"):
         # BN-ness is a property of the checkpoint, not a flag: detect it
         # from the backbone keys (plain-VGG16 compositions have no
         # running stats) so both variants port without guesswork.
-        bb = "backbone." if args.arch == "minet_vgg16" else "backbone_rgb."
+        bb = "backbone_rgb." if args.arch == "hdfnet_vgg16" else "backbone."
         use_bn = any(k.startswith(bb) and k.endswith("running_mean")
                      for k in sd)
-        port_fn = (port_minet_vgg16 if args.arch == "minet_vgg16"
-                   else port_hdfnet_vgg16)
+        port_fn = {"minet_vgg16": port_minet_vgg16,
+                   "hdfnet_vgg16": port_hdfnet_vgg16,
+                   "gatenet_vgg16": port_gatenet_vgg16}[args.arch]
         params, stats = port_fn(sd, use_bn=use_bn)
     elif args.arch.startswith("vgg16"):
         params, stats = port_vgg16(sd, use_bn=args.arch.endswith("_bn"))

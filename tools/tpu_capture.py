@@ -144,6 +144,11 @@ def main(argv=None):
         ("fused_on", bench + ["--config", "basnet_ds",
                               "--batch-per-chip", b_mid,
                               "--set", "loss.fused_kernel=true"]),
+        ("dlf_off", bench + ["--config", "hdfnet_rgbd",
+                             "--batch-per-chip", b_mid]),
+        ("dlf_on", bench + ["--config", "hdfnet_rgbd",
+                            "--batch-per-chip", b_mid,
+                            "--set", "model.dlf_impl=pallas"]),
         ("flash_off", [*bench[:-1], hw_hi, "--config", "vit_sod_sp",
                        "--batch-per-chip", b_vit,
                        "--set", "mesh.seq=1",
