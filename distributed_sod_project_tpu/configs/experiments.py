@@ -106,6 +106,28 @@ def swin_sod() -> ExperimentConfig:
     )
 
 
+@register_config("vit_sod_hires")
+def vit_sod_hires() -> ExperimentConfig:
+    """Long-context flagship recipe: ViT-SOD at 1024px (4096 global
+    tokens).  The two memory levers stack — image rows shard over
+    ``mesh.seq`` (ring attention; ``--set mesh.sp_strategy=ulysses``
+    for the all-to-all variant when heads divide), and each block runs
+    the Pallas flash kernel (`model.attn_impl=flash`) so N² scores
+    never touch HBM.  On fewer chips, drop ``mesh.seq`` to 1 and the
+    flash kernel alone carries the memory load."""
+    return ExperimentConfig(
+        name="vit_sod_hires",
+        data=DataConfig(dataset="duts", image_size=(1024, 1024)),
+        model=ModelConfig(name="vit_sod", backbone="small", sync_bn=False,
+                          attn_impl="flash", remat=True),
+        loss=LossConfig(bce=1.0, iou=1.0, ssim=1.0),
+        optim=OptimConfig(optimizer="adamw", lr=3e-4, weight_decay=0.01,
+                          warmup_steps=500),
+        global_batch_size=8,
+        mesh=MeshConfig(data=1, model=1, seq=-1),
+    )
+
+
 @register_config("gatenet_vgg16")
 def gatenet_vgg16() -> ExperimentConfig:
     """Zoo extension beyond the 5 driver configs: GateNet (ECCV 2020,

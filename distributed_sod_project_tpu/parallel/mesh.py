@@ -21,6 +21,7 @@ Axes (SURVEY.md §2.3):
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -116,15 +117,95 @@ def host_shard() -> Tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 
+def host_axis_blocks(mesh: Mesh):
+    """This process's contiguous index block along every mesh axis.
+
+    ``{axis: [ids...]}`` where ids are the positions of this host's
+    devices on that axis.  The multi-host data plane is only
+    well-defined when each host's devices form an axis-aligned
+    contiguous block (the default device order gives exactly that);
+    anything else raises rather than silently mis-sharding batches.
+    Cached per mesh — the result is a constant, and the per-device
+    Python scan must not run per batch in the prefetch worker.
+    """
+    return _host_axis_blocks_cached(mesh)
+
+
+@functools.lru_cache(maxsize=16)
+def _host_axis_blocks_cached(mesh: Mesh):
+    local = {d.id for d in jax.local_devices()}
+    dev = mesh.devices  # ndarray shaped by mesh.axis_names
+    mask = np.vectorize(lambda d: d.id in local)(dev)
+    coords = np.argwhere(mask)
+    if not len(coords):
+        raise ValueError(
+            "this host owns none of the mesh's devices (a pinned mesh "
+            "smaller than the pod excludes whole hosts) — every "
+            "participating process must contribute devices to the mesh")
+    blocks = {}
+    for i, name in enumerate(mesh.axis_names):
+        ids = sorted({int(c[i]) for c in coords})
+        if ids != list(range(ids[0], ids[0] + len(ids))):
+            raise ValueError(
+                f"host devices are non-contiguous on mesh axis "
+                f"{name!r}: {ids} — reorder the mesh so each host is "
+                "an axis-aligned block")
+        blocks[name] = ids
+    if len(coords) != int(np.prod([len(v) for v in blocks.values()])):
+        raise ValueError(
+            "host devices do not form an axis-aligned block on the "
+            f"mesh (got {len(coords)} devices vs block "
+            f"{ {k: len(v) for k, v in blocks.items()} }) — per-host "
+            "batch sharding is undefined for this layout")
+    return blocks
+
+
+def host_batch_shard(mesh: Mesh) -> Tuple[int, int]:
+    """(shard_id, num_shards) for the TRAIN loader, derived from where
+    this host sits on the ``data`` axis — NOT from process_index: when
+    a non-data axis (``seq``, ``model``) spans processes, several hosts
+    share one data block and must load IDENTICAL batches (their devices
+    hold different row/weight shards of the same images).  For pure-DP
+    meshes this reduces to (process_index, process_count)."""
+    blocks = host_axis_blocks(mesh)
+    data_ids = blocks.get("data") or [0]
+    data_size = mesh.shape.get("data", 1)
+    return data_ids[0] // len(data_ids), data_size // len(data_ids)
+
+
 def global_batch_array(batch, mesh: Mesh, spec: Optional[P] = None):
     """Assemble per-host numpy batches into global batch-sharded
     ``jax.Array``s (multi-host: each host contributes its slice via
     ``make_array_from_process_local_data``; single-host this is just a
     sharded device_put).  ``spec`` overrides the default batch-only
-    sharding (e.g. ``P('data', 'seq')`` for sequence parallelism)."""
+    sharding (e.g. ``P('data', 'seq')`` for sequence parallelism).
+
+    The host batch must be this host's DATA block (``host_batch_shard``
+    is the loader contract).  When ``spec`` row-shards dim 1 over a
+    ``seq`` axis that spans processes, each host hands
+    ``make_array_from_process_local_data`` only its row block — the
+    local data must exactly cover the host's addressable shards.
+    """
     sharding = (NamedSharding(mesh, spec) if spec is not None
                 else batch_sharding(mesh))
-    return jax.tree_util.tree_map(
-        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
-        batch,
-    )
+    sp = spec if spec is not None else batch_spec()
+    row_slice = None
+    if len(sp) > 1 and sp[1] == "seq":
+        seq_ids = host_axis_blocks(mesh).get("seq") or [0]
+        seq_size = mesh.shape.get("seq", 1)
+        if len(seq_ids) < seq_size:
+            row_slice = (seq_ids[0], len(seq_ids), seq_size)
+
+    def place(x):
+        x = np.asarray(x)
+        if row_slice is not None:
+            first, n, total = row_slice
+            if x.shape[1] % total:
+                raise ValueError(
+                    f"dim 1 ({x.shape[1]}) not divisible by the seq "
+                    f"axis ({total})")
+            blk = x.shape[1] // total
+            x = x[:, first * blk:(first + n) * blk]
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(place, batch)

@@ -66,12 +66,19 @@ def fit(
 
     from ..data.tfdata import make_loader
 
+    from ..parallel.mesh import host_batch_shard
+
+    # Mesh-position-derived, NOT process_index: hosts that share a
+    # data block (a seq/model axis spanning processes) must load
+    # IDENTICAL batches — their devices hold different shards of the
+    # same images.  Pure DP reduces to (process_index, process_count).
+    shard_id, num_shards = host_batch_shard(mesh)
     dataset = resolve_dataset(cfg.data)
     loader = make_loader(
         dataset, cfg.data,
         global_batch_size=cfg.global_batch_size,
-        shard_id=jax.process_index(),
-        num_shards=jax.process_count(),
+        shard_id=shard_id,
+        num_shards=num_shards,
         shuffle=True,
         seed=cfg.seed,
         hflip=cfg.data.hflip,

@@ -77,3 +77,20 @@ def test_cli_table_and_outputs(pred_gt_dirs, tmp_path, capsys):
     assert lines[0].startswith("dataset,") and lines[1].startswith("mini,")
     with open(curves) as f:
         assert "mini" in json.load(f)
+
+
+def test_cli_markdown_and_latex_exports(pred_gt_dirs, tmp_path):
+    """The PySODEvalToolkit-style paper-table exports."""
+    pd, gd = pred_gt_dirs
+    md = str(tmp_path / "t.md")
+    tex = str(tmp_path / "t.tex")
+    rc = eval_preds.main([f"mini={pd}:{gd}", "--markdown", md,
+                          "--latex", tex])
+    assert rc == 0
+    md_text = open(md).read()
+    assert md_text.startswith("| dataset |")
+    assert "| mini |" in md_text and "max_fbeta" in md_text
+    tex_text = open(tex).read()
+    assert tex_text.startswith("\\begin{tabular}")
+    assert "max\\_fbeta" in tex_text and "mini" in tex_text
+    assert tex_text.rstrip().endswith("\\end{tabular}")

@@ -139,3 +139,83 @@ def test_two_process_fit_matches_single_process(tmp_path, eight_devices):
     for a, b in zip(duo_leaves, solo_leaves):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-3)
+
+
+def _sp_cfg(workdir: str):
+    """vit_sod on a (data=1, seq=8) mesh: with 4 devices per process,
+    the seq axis NECESSARILY spans both processes — the ring-attention
+    K/V rotation and the SSIM row-halo exchange run over the
+    cross-process transport, not just intra-host."""
+    from distributed_sod_project_tpu.configs import get_config
+    from distributed_sod_project_tpu.configs.base import (
+        DataConfig, LossConfig, MeshConfig, ModelConfig, OptimConfig)
+
+    cfg = get_config("vit_sod_sp")
+    return cfg.replace(
+        # 128px / patch 16 = 8 patch rows — one per seq device.
+        data=DataConfig(dataset="synthetic", image_size=(128, 128),
+                        synthetic_size=8, num_workers=0, hflip=False,
+                        rotate_degrees=0.0),
+        model=ModelConfig(name="vit_sod", backbone="tiny", sync_bn=False,
+                          compute_dtype="float32"),
+        # SSIM on: its 5-row halo ppermute crosses the process boundary.
+        loss=LossConfig(bce=1.0, iou=1.0, ssim=1.0),
+        optim=OptimConfig(optimizer="adamw", lr=1e-4, weight_decay=0.0),
+        mesh=MeshConfig(data=1, seq=8),
+        global_batch_size=2,
+        num_epochs=1,
+        log_every_steps=1,
+        eval_every_steps=2,
+        checkpoint_every_steps=0,
+        tensorboard=False,
+        checkpoint_dir=workdir,
+    )
+
+
+@pytest.mark.slow
+def test_two_process_sequence_parallel_ring(tmp_path, eight_devices):
+    """Sequence parallelism across a REAL process boundary: mesh
+    (data=1, seq=8) over 2 processes x 4 devices, so every ring step's
+    ppermute (and the SSIM halo exchange) is a cross-process
+    collective.  Ranks must agree with each other and with the
+    8-device single-process oracle."""
+    from distributed_sod_project_tpu.train.loop import fit
+
+    solo_dir = str(tmp_path / "solo")
+    cfg = _sp_cfg(solo_dir)
+    solo = fit(cfg, max_steps=3)
+    assert solo["final_step"] == 3
+
+    duo_dir = str(tmp_path / "duo")
+    cfg_path = str(tmp_path / "sp_cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(dataclasses.asdict(cfg.replace(checkpoint_dir=duo_dir)),
+                  f, default=str)
+    addr = f"localhost:{_free_port()}"
+    worker = os.path.join(_REPO, "tests", "two_process_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, addr, str(pid), cfg_path, duo_dir,
+         "--max-steps", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=_REPO) for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=900)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"rank failed:\n{out[-3000:]}"
+
+    results = {}
+    for out in outs:
+        lines = [l for l in out.splitlines()
+                 if l.startswith("WORKER_RESULT ")]
+        assert lines, f"no result line:\n{out[-3000:]}"
+        r = json.loads(lines[-1].removeprefix("WORKER_RESULT "))
+        results[r["pid"]] = r
+
+    for key in ("final_step", "eval_max_fbeta", "eval_mae", "total"):
+        assert results[0][key] == pytest.approx(results[1][key],
+                                                abs=1e-6), key
+    assert results[0]["final_step"] == 3
+    for key in ("eval_max_fbeta", "eval_mae", "total"):
+        assert results[0][key] == pytest.approx(solo[key], abs=1e-3), key

@@ -26,6 +26,9 @@ jax.config.update("jax_platforms", "cpu")
 def main():
     addr, pid, cfg_path, workdir = (
         sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4])
+    max_steps = 4
+    if "--max-steps" in sys.argv:
+        max_steps = int(sys.argv[sys.argv.index("--max-steps") + 1])
     jax.distributed.initialize(coordinator_address=addr, num_processes=2,
                                process_id=pid)
     assert jax.process_count() == 2, jax.process_count()
@@ -39,7 +42,7 @@ def main():
     with open(cfg_path) as f:
         cfg = config_from_dict(json.load(f))
 
-    out = fit(cfg, workdir=workdir, max_steps=4)
+    out = fit(cfg, workdir=workdir, max_steps=max_steps)
     # One parseable line per rank; the parent asserts cross-rank
     # agreement of train/eval metrics (every host sweeps the full val
     # set, so ranking inputs must be identical).

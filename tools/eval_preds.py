@@ -99,6 +99,11 @@ def main(argv=None):
     p.add_argument("--curves", default=None,
                    help="write per-dataset PR/Fβ curves to this JSON")
     p.add_argument("--csv", default=None, help="write the table as CSV")
+    p.add_argument("--markdown", default=None,
+                   help="write the table as a GitHub-style markdown file")
+    p.add_argument("--latex", default=None,
+                   help="write the table as a LaTeX tabular (the "
+                        "PySODEvalToolkit paper-table export)")
     args = p.parse_args(argv)
 
     all_results = {}
@@ -135,12 +140,33 @@ def main(argv=None):
                     ).rjust(widths[c]) + "  "
         print(row.rstrip())
 
+    def _fmt(v):
+        return ("" if v is None else
+                f"{v:.4f}" if isinstance(v, float) else str(v))
+
     if args.csv:
         with open(args.csv, "w") as f:
             f.write("dataset," + ",".join(present) + "\n")
             for name, res in all_results.items():
                 f.write(name + "," + ",".join(
                     str(res.get(c, "")) for c in present) + "\n")
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write("| dataset | " + " | ".join(present) + " |\n")
+            f.write("|---" * (len(present) + 1) + "|\n")
+            for name, res in all_results.items():
+                f.write("| " + name + " | " + " | ".join(
+                    _fmt(res.get(c)) for c in present) + " |\n")
+    if args.latex:
+        with open(args.latex, "w") as f:
+            f.write("\\begin{tabular}{l" + "r" * len(present) + "}\n")
+            f.write("\\toprule\ndataset & "
+                    + " & ".join(c.replace("_", "\\_") for c in present)
+                    + " \\\\\n\\midrule\n")
+            for name, res in all_results.items():
+                f.write(name.replace("_", "\\_") + " & " + " & ".join(
+                    _fmt(res.get(c)) for c in present) + " \\\\\n")
+            f.write("\\bottomrule\n\\end{tabular}\n")
     if args.curves:
         with open(args.curves, "w") as f:
             json.dump(all_curves, f)
