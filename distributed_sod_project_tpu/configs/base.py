@@ -97,6 +97,10 @@ class OptimConfig:
     poly_power: float = 0.9
     warmup_steps: int = 0
     grad_clip_norm: float = 0.0  # 0 disables
+    # Layer-wise LR decay for transformer fine-tuning (BEiT-style):
+    # heads at full LR, encoder block i at decay^(n_blocks+1-(i+1)),
+    # the patch/pos embedding deepest.  1.0 disables (from-scratch).
+    layer_decay: float = 1.0
     accum_steps: int = 1  # >1: optax.MultiSteps gradient accumulation
     ema_decay: float = 0.0  # >0: track an EMA of params; eval uses it
     # >0: skip updates whose gradients are non-finite (bad batch / bf16

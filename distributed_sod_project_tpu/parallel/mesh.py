@@ -170,6 +170,15 @@ def host_batch_shard(mesh: Mesh) -> Tuple[int, int]:
     blocks = host_axis_blocks(mesh)
     data_ids = blocks.get("data") or [0]
     data_size = mesh.shape.get("data", 1)
+    if data_size % len(data_ids) or data_ids[0] % len(data_ids):
+        # E.g. a pinned data=6 mesh over 2 hosts of 4: host A would
+        # cover ids 0-3 (2/3 of the batch) and host B ids 4-5 — no
+        # uniform (shard_id, num_shards) describes that; raise per the
+        # module contract instead of mis-sharding.
+        raise ValueError(
+            f"host data block {data_ids} does not tile the data axis "
+            f"(size {data_size}) uniformly — size the mesh so every "
+            "host covers an equal, aligned data block")
     return data_ids[0] // len(data_ids), data_size // len(data_ids)
 
 

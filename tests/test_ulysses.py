@@ -98,6 +98,42 @@ def test_sp_step_ulysses_matches_single_device(eight_devices):
                                rtol=2e-4)
 
 
+def test_sp_eval_step_ulysses_matches_single_device(eight_devices):
+    """Forward-only SP with the all-to-all strategy equals the
+    single-device sigmoid forward (mirrors the ring eval test)."""
+    import jax.numpy as jnp
+
+    from distributed_sod_project_tpu.parallel.sp import make_sp_eval_step
+    from tests.test_vit_sod import _data
+
+    model = ViTSOD(patch=8, dim=32, depth=2, heads=2, mlp_ratio=2)
+    batch = _data(b=4, hw=32, seed=7)
+    variables = model.init(jax.random.key(1), batch["image"], None,
+                           train=False)
+    mesh = make_mesh(MeshConfig(data=4, seq=2), eight_devices)
+
+    dev_vars = jax.device_put(variables, replicated_sharding(mesh))
+    dev_batch = jax.device_put(batch, sp_batch_sharding(mesh))
+    probs = np.asarray(make_sp_eval_step(model, mesh, "ulysses")(
+        dev_vars, dev_batch))
+
+    ref = np.asarray(jax.nn.sigmoid(
+        model.apply(variables, batch["image"], None,
+                    train=False)[0][..., 0].astype(jnp.float32)))
+    np.testing.assert_allclose(probs, ref, atol=2e-6)
+
+
+def test_eval_step_rejects_bad_ulysses_geometry(eight_devices):
+    """make_sp_eval_step fails fast (build time) on heads % seq != 0 —
+    the validate_sp_strategy contract covers eval, not just train."""
+    from distributed_sod_project_tpu.parallel.sp import make_sp_eval_step
+
+    model = ViTSOD(patch=8, dim=36, depth=1, heads=3, mlp_ratio=2)
+    mesh = make_mesh(MeshConfig(data=4, seq=2), eight_devices)
+    with pytest.raises(ValueError, match="heads % seq"):
+        make_sp_eval_step(model, mesh, "ulysses")
+
+
 def test_fit_rejects_ulysses_bad_head_count(tmp_path, eight_devices):
     """fit() refuses ulysses when the model's heads don't divide seq —
     at build time, not with a shard_map error mid-compile."""

@@ -122,6 +122,12 @@ def main(argv=None):
         if curve:
             all_curves[name] = curve
 
+    def _fmt(v):
+        """Shared value formatting for console/markdown/LaTeX (CSV
+        stays full-precision — it is the machine-readable output)."""
+        return ("" if v is None else
+                f"{v:.4f}" if isinstance(v, float) else str(v))
+
     cols = ["mae", "max_fbeta", "mean_fbeta", "adp_fbeta",
             "weighted_fmeasure", "s_measure", "e_measure", "max_emeasure",
             "mean_emeasure", "num_images"]
@@ -134,15 +140,8 @@ def main(argv=None):
     for name, res in all_results.items():
         row = name.ljust(12)
         for c in present:
-            v = res.get(c)
-            row += ("" if v is None else
-                    (f"{v:.4f}" if isinstance(v, float) else str(v))
-                    ).rjust(widths[c]) + "  "
+            row += _fmt(res.get(c)).rjust(widths[c]) + "  "
         print(row.rstrip())
-
-    def _fmt(v):
-        return ("" if v is None else
-                f"{v:.4f}" if isinstance(v, float) else str(v))
 
     if args.csv:
         with open(args.csv, "w") as f:
