@@ -332,7 +332,8 @@ def _run(args):
             return float(token)
     else:
         step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
-                               remat=cfg.model.remat)
+                               remat=cfg.model.remat,
+                               remat_policy=cfg.model.remat_policy)
         carry = [state]
 
         def run_step():

@@ -52,7 +52,13 @@ class ModelConfig:
     bn_momentum: float = 0.9
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
     param_dtype: str = "float32"
-    remat: bool = False  # jax.checkpoint the backbone stages
+    remat: bool = False  # jax.checkpoint the forward (train step)
+    # What remat SAVES (only read when remat=true): "none" recomputes
+    # everything (min memory, +~1/3 FLOPs); "dots" keeps matmul/conv
+    # outputs and recomputes elementwise (the usual best-MFU
+    # compromise); "dots_no_batch" keeps only batch-free dots (weights'
+    # contractions).  A/B on hardware via bench.py --set.
+    remat_policy: str = "none"  # none | dots | dots_no_batch
     # Attention core for the transformer zoo member (vit_sod only):
     # "xla" materializes the score matrix, "flash" runs the Pallas
     # tiled-softmax kernel (pallas/flash_attention.py) — required for

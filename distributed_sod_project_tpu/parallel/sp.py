@@ -43,7 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..losses.ssim import _C1, _C2, _blur, gaussian_window
 from ..train.state import TrainState
-from ..train.step import apply_update, notfinite_count
+from ..train.step import apply_update, maybe_remat, notfinite_count
 from .ring_attention import ring_attention
 
 
@@ -254,6 +254,8 @@ def make_sp_train_step(
     ema_decay: float = 0.0,
     donate_batch: bool = False,
     sp_strategy: str = "ring",
+    remat: bool = False,
+    remat_policy: str = "none",
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
               Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the sequence-parallel ``(state, batch) -> (state, metrics)``.
@@ -272,6 +274,9 @@ def make_sp_train_step(
             "path: the SP loss already psums sufficient statistics "
             "inline (docs/PERFORMANCE.md)")
     validate_sp_strategy(model, mesh, sp_strategy)
+    from ..train.step import resolve_remat_policy
+
+    resolve_remat_policy(remat_policy)  # fail fast on typos, remat or not
     seq = mesh.shape["seq"]
 
     def step_fn(state: TrainState, batch):
@@ -280,10 +285,18 @@ def make_sp_train_step(
             lax.axis_index("data") * seq + lax.axis_index("seq"))
         image, mask = batch["image"], batch["mask"]
 
-        def loss_fn(params):
-            outs = _sp_apply(model, {"params": params}, image,
+        def apply_fn(params, image):
+            return _sp_apply(model, {"params": params}, image,
                              train=True, rngs={"dropout": rng},
                              sp_strategy=sp_strategy)
+
+        # The long-context memory lever: at hires SP shapes the
+        # per-block activations dominate; recompute them in the
+        # backward per model.remat_policy.
+        apply_fn = maybe_remat(apply_fn, remat, remat_policy)
+
+        def loss_fn(params):
+            outs = apply_fn(params, image)
             if not loss_cfg.deep_supervision:
                 outs = outs[:1]  # primary head only, uniform across steps
             # DP convention (losses/deep_supervision.py): SUM over

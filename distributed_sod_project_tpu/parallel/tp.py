@@ -214,7 +214,8 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
                        schedule=None, donate: bool = True,
                        ema_decay: float = 0.0,
                        scale_hw: Optional[Tuple[int, int]] = None,
-                       donate_batch: bool = False):
+                       donate_batch: bool = False,
+                       remat: bool = False, remat_policy: str = "none"):
     """Build the GSPMD train step: ``(state, batch) -> (state, metrics)``.
 
     Unlike the shard_map DP step there is no explicit ``pmean`` and no
@@ -229,21 +230,29 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
     import optax
 
     from ..losses import deep_supervision_loss
-    from ..train.step import (_loss_kwargs, apply_update, notfinite_count,
-                              rescale_batch)
+    from ..train.step import (_loss_kwargs, apply_update, maybe_remat,
+                              notfinite_count, rescale_batch,
+                              resolve_remat_policy)
     from .mesh import batch_sharding
 
+    resolve_remat_policy(remat_policy)  # fail fast on typos, remat or not
     lkw = _loss_kwargs(loss_cfg)
 
     def step_fn(state, batch):
         batch = rescale_batch(batch, scale_hw)
         rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
 
-        def loss_fn(params):
-            outs, mut = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                batch["image"], batch.get("depth"), train=True,
+        def apply_fn(params, batch_stats, image, depth):
+            return model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                image, depth, train=True,
                 mutable=["batch_stats"], rngs={"dropout": rng})
+
+        apply_fn = maybe_remat(apply_fn, remat, remat_policy)
+
+        def loss_fn(params):
+            outs, mut = apply_fn(params, state.batch_stats,
+                                 batch["image"], batch.get("depth"))
             if not loss_cfg.deep_supervision:
                 outs = outs[:1]  # primary head only, uniform across steps
             total, comps = deep_supervision_loss(outs, batch["mask"], **lkw)

@@ -168,7 +168,9 @@ def fit(
             return make_sp_train_step(
                 model, cfg.loss, tx, mesh, schedule=schedule,
                 ema_decay=cfg.optim.ema_decay, donate_batch=True,
-                sp_strategy=cfg.mesh.sp_strategy)
+                sp_strategy=cfg.mesh.sp_strategy,
+                remat=cfg.model.remat,
+                remat_policy=cfg.model.remat_policy)
     elif use_gspmd:
         from ..parallel.tp import make_tp_train_step, shard_state
 
@@ -199,7 +201,9 @@ def fit(
             return make_tp_train_step(
                 model, cfg.loss, tx, mesh, state_shardings,
                 schedule=schedule, ema_decay=cfg.optim.ema_decay,
-                scale_hw=scale_hw, donate_batch=True)
+                scale_hw=scale_hw, donate_batch=True,
+                remat=cfg.model.remat,
+                remat_policy=cfg.model.remat_policy)
     else:
         state = jax.device_put(state, replicated_sharding(mesh))
 
@@ -207,7 +211,8 @@ def fit(
             return make_train_step(
                 model, cfg.loss, tx, mesh, schedule=schedule,
                 remat=cfg.model.remat, ema_decay=cfg.optim.ema_decay,
-                scale_hw=scale_hw, donate_batch=True)
+                scale_hw=scale_hw, donate_batch=True,
+                remat_policy=cfg.model.remat_policy)
 
     # Multi-scale training: one compiled step per size in the cycle
     # (each is a distinct static-shape XLA program; the resize happens

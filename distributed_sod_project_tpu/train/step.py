@@ -28,6 +28,32 @@ from ..losses import deep_supervision_loss
 from .state import TrainState
 
 
+def resolve_remat_policy(name: str):
+    """model.remat_policy → a jax.checkpoint policy.  "none" recomputes
+    everything; "dots" saves matmul/conv outputs (recompute only
+    elementwise — the usual FLOPs/HBM sweet spot on the MXU);
+    "dots_no_batch" saves only batch-free contractions."""
+    policies = {
+        "none": None,  # jax.checkpoint default: nothing saveable
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_no_batch":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if name not in policies:
+        raise ValueError(
+            f"model.remat_policy must be one of {sorted(policies)}, "
+            f"got {name!r}")
+    return policies[name]
+
+
+def maybe_remat(fn, remat: bool, remat_policy: str):
+    """The one remat wrap shared by the DP/SP/TP step builders: resolve
+    the policy EAGERLY (a typo'd policy name fails at build time, even
+    with remat off) and checkpoint ``fn`` when remat is on."""
+    policy = resolve_remat_policy(remat_policy)
+    return jax.checkpoint(fn, policy=policy) if remat else fn
+
+
 def _loss_kwargs(loss_cfg) -> Dict[str, Any]:
     return dict(
         bce_w=loss_cfg.bce,
@@ -108,6 +134,7 @@ def make_train_step(
     ema_decay: float = 0.0,
     scale_hw: Optional[Tuple[int, int]] = None,
     donate_batch: bool = False,
+    remat_policy: str = "none",
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build ``(state, batch) -> (state, metrics)``.
 
@@ -118,13 +145,15 @@ def make_train_step(
     (``jax.checkpoint``): activations are recomputed instead of stored,
     trading ~⅓ more FLOPs for the activation memory — the standard lever
     when a bigger per-chip batch is HBM-bound (SURVEY.md "HBM
-    bandwidth" row).
+    bandwidth" row).  ``remat_policy`` picks what the checkpoint SAVES
+    (``resolve_remat_policy``).
 
     ``scale_hw`` is the multi-scale training hook: the step resizes
     image/mask/depth to that (H, W) on-device before the forward, so
     the loader keeps emitting one static shape and every train size is
     its own compiled program (no dynamic shapes anywhere).
     """
+    resolve_remat_policy(remat_policy)  # fail fast on typos, remat or not
     lkw = _loss_kwargs(loss_cfg)
 
     def step_fn(state: TrainState, batch):
@@ -144,8 +173,7 @@ def make_train_step(
                 rngs={"dropout": rng},
             )
 
-        if remat:
-            forward = jax.checkpoint(forward)
+        forward = maybe_remat(forward, remat, remat_policy)
 
         def loss_fn(params):
             outs, mut = forward(params, state.batch_stats,

@@ -202,14 +202,26 @@ def test_remat_step_matches_baseline(eight_devices):
                                  jnp.float32)}
     state0 = create_train_state(jax.random.key(0), model, tx, batch)
     outs = {}
-    for remat in (False, True):
+    cases = [(False, "none"), (True, "none"), (True, "dots"),
+             (True, "dots_no_batch")]
+    for remat, policy in cases:
         state = jax.device_put(state0, replicated_sharding(mesh))
         step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
-                               donate=False, remat=remat)
+                               donate=False, remat=remat,
+                               remat_policy=policy)
         db = jax.device_put(batch, batch_sharding(mesh))
         _, metrics = step(state, db)
-        outs[remat] = float(metrics["total"])
-    assert outs[False] == pytest.approx(outs[True], rel=1e-6)
+        outs[(remat, policy)] = float(metrics["total"])
+    base = outs[(False, "none")]
+    for key, val in outs.items():
+        assert val == pytest.approx(base, rel=1e-6), key
+
+
+def test_remat_policy_validation():
+    from distributed_sod_project_tpu.train.step import resolve_remat_policy
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        resolve_remat_policy("everything")
 
 
 def test_grad_accumulation_matches_large_batch():

@@ -337,3 +337,33 @@ def test_evaluate_routes_through_sp_on_seq_mesh(tmp_path, eight_devices):
     solo = evaluate(cfg, state, mesh=None, **kw)["synthetic"]
     for k in ("max_fbeta", "mae", "num_images"):
         np.testing.assert_allclose(sp[k], solo[k], atol=1e-5, err_msg=k)
+
+
+def test_sp_step_remat_matches_baseline(eight_devices):
+    """jax.checkpoint on the SP forward (the hires memory lever) must
+    not change the numbers — any policy."""
+    model = _tiny_model()
+    batch = _data(b=4, hw=32)
+    mesh = make_mesh(MeshConfig(data=2, seq=4), eight_devices)
+    variables = model.init(jax.random.key(0), batch["image"], None,
+                           train=False)
+    tx = optax.sgd(0.1)
+
+    from distributed_sod_project_tpu.configs import LossConfig
+    from distributed_sod_project_tpu.train.state import TrainState
+
+    state0 = TrainState(step=jnp.zeros((), jnp.int32),
+                        params=variables["params"], batch_stats={},
+                        opt_state=tx.init(variables["params"]))
+    outs = {}
+    for remat, policy in [(False, "none"), (True, "none"), (True, "dots")]:
+        state = jax.device_put(state0, replicated_sharding(mesh))
+        step = make_sp_train_step(
+            model, LossConfig(bce=1.0, iou=1.0, ssim=1.0), tx, mesh,
+            donate=False, remat=remat, remat_policy=policy)
+        dev_batch = jax.device_put(batch, sp_batch_sharding(mesh))
+        _, metrics = step(state, dev_batch)
+        outs[(remat, policy)] = float(metrics["total"])
+    base = outs[(False, "none")]
+    for key, val in outs.items():
+        assert val == pytest.approx(base, rel=1e-6), key
