@@ -131,6 +131,17 @@ class TFDataLoader:
         }
         if use_depth:
             tensors["depth_path"] = [ds_obj.depth_paths[s] for s in stems]
+        if self.hflip:
+            # The SHARED per-index draws (data/augment.py), precomputed
+            # host-side: TF's stateless RNG disagrees with the numpy
+            # draws per sample, which would silently make the training
+            # stream depend on the backend choice; a graph-constant
+            # column keeps the map pure (no py callbacks on the decode
+            # path, dataset stays serializable).
+            from .augment import hflip_draw
+
+            tensors["flip"] = np.array(
+                [hflip_draw(aug_seed, int(i)) for i in my], np.bool_)
 
         def decode(rec):
             img = tf.io.decode_image(tf.io.read_file(rec["img_path"]),
@@ -150,8 +161,7 @@ class TFDataLoader:
                 out["depth"] = tf.image.resize(
                     tf.cast(d, tf.float32), (h, w), antialias=True) / 255.0
             if self.hflip:
-                flip = tf.random.stateless_uniform(
-                    [], seed=[aug_seed, rec["index"]]) < 0.5
+                flip = rec["flip"]
                 for k in ("image", "mask", "depth"):
                     if k in out:
                         out[k] = tf.cond(

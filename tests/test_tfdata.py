@@ -77,6 +77,23 @@ def test_tfdata_matches_host_loader_composition(folder_ds):
     assert t_idx == h_idx
 
 
+def test_tfdata_hflip_content_matches_host_loader(folder_ds):
+    """Regression: hflip DECISIONS must come from the shared
+    data/augment.py draws — tf.random.stateless disagrees per sample,
+    which made the training stream depend on the backend (content
+    equality, not just index order)."""
+    from distributed_sod_project_tpu.data.pipeline import HostDataLoader
+
+    tfl = TFDataLoader(folder_ds, global_batch_size=4, seed=3, hflip=True)
+    hl = HostDataLoader(folder_ds, global_batch_size=4, seed=3, hflip=True)
+    tfl.set_epoch(1)
+    hl.set_epoch(1)
+    for tb, hb in zip(tfl, hl):
+        np.testing.assert_array_equal(tb["index"], hb["index"])
+        np.testing.assert_allclose(tb["image"], hb["image"], atol=2e-3)
+        np.testing.assert_allclose(tb["mask"], hb["mask"], atol=2e-3)
+
+
 def test_make_loader_dispatch(folder_ds):
     import dataclasses
 

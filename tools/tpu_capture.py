@@ -119,9 +119,13 @@ def main(argv=None):
     print(f"{plat[0]} up: {plat}", flush=True)
 
     # CPU smoke shrinks every shape so one pass finishes in minutes.
-    hw, hw_hi, b_head, b_mid, b_hi, b_vit = (
-        ("64", "64", "2", "1", "2", "1") if smoke
-        else ("320", "512", "64", "32", "96", "8"))
+    # b_vit=2 for the flash A/B: the XLA core materialises
+    # B·H·N² f32 scores (batch 8 @512px ≈ 25 GB — past v5e HBM), so
+    # the apples-to-apples pair runs at a batch both cores survive;
+    # flash_big then shows the lever at a batch the XLA core cannot.
+    hw, hw_hi, b_head, b_mid, b_hi, b_vit, b_vit_big = (
+        ("64", "64", "2", "1", "2", "1", "2") if smoke
+        else ("320", "512", "64", "32", "96", "2", "16"))
     bench = [py, "bench.py", "--device", args.device,
              "--steps", str(args.steps), "--image-size", hw]
     agenda = [
@@ -157,6 +161,12 @@ def main(argv=None):
                       "--batch-per-chip", b_vit,
                       "--set", "mesh.seq=1",
                       "--set", "model.attn_impl=flash"]),
+        ("flash_big", [*bench[:-1], hw_hi, "--config", "vit_sod_sp",
+                       "--batch-per-chip", b_vit_big,
+                       "--set", "mesh.seq=1",
+                       "--set", "model.attn_impl=flash",
+                       "--set", "model.remat=true",
+                       "--set", "model.remat_policy=dots"]),
         ("profile", bench + ["--config", "minet_r50_dp",
                              "--batch-per-chip", b_head,
                              "--set", "model.remat=true",
