@@ -83,6 +83,7 @@ def test_vmem_fallback_actually_runs(monkeypatch):
     assert np.all(np.isfinite(np.asarray(g)))
 
 
+@pytest.mark.slow
 def test_hdfnet_dlf_impl_parity():
     """HDFNet(dlf_impl='pallas') is numerically the same model."""
     from distributed_sod_project_tpu.models.hdfnet import HDFNet

@@ -59,6 +59,7 @@ def test_non_dividing_block_pair():
                                np.asarray(full_attention(q, k, v)), atol=2e-6)
 
 
+@pytest.mark.slow
 def test_with_lse_values_and_cotangent():
     """The lse output equals logsumexp of the scaled scores, and a
     NONZERO lse cotangent backpropagates correctly (it folds into the
@@ -114,6 +115,7 @@ def test_shape_validation():
         flash_attention(q, k, v, block_q=64)
 
 
+@pytest.mark.slow
 def test_vit_sod_flash_wiring_matches_xla():
     """attn_impl='flash' is numerically the same model as 'xla'."""
     from distributed_sod_project_tpu.models.vit_sod import ViTSOD

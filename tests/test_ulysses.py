@@ -63,6 +63,7 @@ def test_ulysses_rejects_bad_heads(eight_devices):
         uly(q, k, v)
 
 
+@pytest.mark.slow
 def test_sp_step_ulysses_matches_single_device(eight_devices):
     """The full SP train step with sp_strategy='ulysses' equals the
     single-device objective — same protocol as the ring tests in
@@ -134,6 +135,7 @@ def test_eval_step_rejects_bad_ulysses_geometry(eight_devices):
         make_sp_eval_step(model, mesh, "ulysses")
 
 
+@pytest.mark.slow
 def test_fit_rejects_ulysses_bad_head_count(tmp_path, eight_devices):
     """fit() refuses ulysses when the model's heads don't divide seq —
     at build time, not with a shard_map error mid-compile."""

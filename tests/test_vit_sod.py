@@ -121,6 +121,7 @@ def test_sp_step_matches_single_device(eight_devices):
                                    atol=2e-5, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_sp_step_flash_matches_single_device(eight_devices):
     """SP + model.attn_impl='flash': the ring runs the Pallas kernel
     per visiting block; the compiled step must equal the single-device
@@ -339,6 +340,7 @@ def test_evaluate_routes_through_sp_on_seq_mesh(tmp_path, eight_devices):
         np.testing.assert_allclose(sp[k], solo[k], atol=1e-5, err_msg=k)
 
 
+@pytest.mark.slow
 def test_sp_step_remat_matches_baseline(eight_devices):
     """jax.checkpoint on the SP forward (the hires memory lever) must
     not change the numbers — any policy."""

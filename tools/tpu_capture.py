@@ -21,7 +21,10 @@ never loses the others):
   5. zoo          — tools/bench_zoo.py over every config, train+eval
   6. fused A/B    — loss.fused_kernel on/off (basnet_ds, the 8-output
                     deep-supervision hybrid-loss member)
-  7. flash A/B    — vit_sod attention xla vs Pallas flash @512px
+  7. flash A/B    — vit_sod attention xla vs Pallas flash @512px at a
+                    batch both cores survive, plus a flash_big step
+                    (batch 16 + remat=dots) at a batch whose XLA-core
+                    scores would exceed HBM — the memory-lever demo
   8. profile      — jax.profiler trace of the headline step for the
                     MFU push (VERDICT.md "what's weak" #1)
 """
