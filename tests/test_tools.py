@@ -130,3 +130,40 @@ def test_check_determinism_tool(tmp_path, capsys, monkeypatch):
     ])
     assert rc == 0
     assert "deterministic" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_inspect_ckpt_census_and_diff(tmp_path, capsys, eight_devices):
+    """tools/inspect_ckpt.py: steps/config/param census from the
+    sidecar, and the cross-checkpoint diff (identical dirs → 0)."""
+    import inspect_ckpt
+    from distributed_sod_project_tpu.configs import get_config
+    from distributed_sod_project_tpu.configs.base import (
+        DataConfig, MeshConfig, ModelConfig, OptimConfig)
+    from distributed_sod_project_tpu.train.loop import fit
+
+    cfg = get_config("minet_vgg16_ref").replace(
+        data=DataConfig(dataset="synthetic", image_size=(32, 32),
+                        synthetic_size=8, num_workers=0),
+        model=ModelConfig(name="minet", backbone="vgg16", sync_bn=True,
+                          compute_dtype="float32"),
+        optim=OptimConfig(lr=0.01),
+        mesh=MeshConfig(data=-1),
+        global_batch_size=8,
+        checkpoint_every_steps=1,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    fit(cfg, max_steps=1)
+
+    rc = inspect_ckpt.main([str(tmp_path / "ck")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "available steps: [1]" in out
+    assert "minet" in out and "params:" in out
+    assert "VGG16_0" in out  # per-module census row
+
+    rc = inspect_ckpt.main([str(tmp_path / "ck"),
+                            "--diff", str(tmp_path / "ck")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "0.000e+00" in out  # identical checkpoints diff to zero

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Inspect a framework checkpoint — steps, config, parameter census.
+
+The TPU-era analogue of poking a ``torch.load``ed state_dict in a
+REPL: point it at a workdir and get what is actually in there —
+available steps, the config sidecar, per-module parameter counts and
+bytes, optimizer/EMA state presence — plus an optional numerical diff
+against a second checkpoint (did fine-tuning move the backbone? are
+two runs' weights actually different?).
+
+Usage:
+    python tools/inspect_ckpt.py runs/minet
+    python tools/inspect_ckpt.py runs/minet --step 4000
+    python tools/inspect_ckpt.py runs/a --diff runs/b
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pin_cpu() -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already up: leave it
+        pass
+
+
+def _load(ckpt_dir: str, step):
+    """(cfg, state, step) from a workdir via the config sidecar."""
+    import jax
+
+    from distributed_sod_project_tpu.ckpt import CheckpointManager
+    from distributed_sod_project_tpu.configs import config_from_dict
+    from distributed_sod_project_tpu.data import resolve_dataset
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.train import (build_optimizer,
+                                                   create_train_state)
+
+    mgr = CheckpointManager(ckpt_dir, async_save=False)
+    steps = list(mgr.all_steps())
+    if not steps:
+        raise SystemExit(f"no checkpoints under {ckpt_dir!r}")
+    cfg_dict = mgr.load_config_dict()
+    if cfg_dict is None:
+        raise SystemExit(f"no config sidecar under {ckpt_dir!r} "
+                         "(config.json) — cannot rebuild the state tree")
+    cfg = config_from_dict(cfg_dict)
+    model = build_model(cfg.model)
+    tx, _ = build_optimizer(cfg.optim, 1)
+    ds = resolve_dataset(cfg.data)
+    probe = {k: np.asarray(v)[None] for k, v in ds[0].items()
+             if k in ("image", "mask", "depth")}
+    template = create_train_state(jax.random.key(cfg.seed), model, tx,
+                                  probe, ema=cfg.optim.ema_decay > 0)
+    state = mgr.restore(template, step=step)
+    mgr.close()
+    return cfg, state, (step if step is not None else steps[-1]), steps
+
+
+def _census(tree, title: str) -> int:
+    import jax
+
+    by_scope = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        scope = str(getattr(path[0], "key", path[0]))
+        n, b = by_scope.get(scope, (0, 0))
+        by_scope[scope] = (n + leaf.size, b + leaf.size * leaf.dtype.itemsize)
+    total_n = sum(n for n, _ in by_scope.values())
+    total_b = sum(b for _, b in by_scope.values())
+    print(f"\n{title}: {total_n / 1e6:.2f}M params, "
+          f"{total_b / 1e6:.1f} MB")
+    for scope in sorted(by_scope, key=lambda s: -by_scope[s][0]):
+        n, b = by_scope[scope]
+        print(f"  {scope:<28} {n / 1e6:9.3f}M  {b / 1e6:8.2f} MB "
+              f"({100.0 * n / max(total_n, 1):5.1f}%)")
+    return total_n
+
+
+def main(argv=None):
+    _pin_cpu()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("ckpt_dir")
+    p.add_argument("--step", type=int, default=None,
+                   help="step to inspect (default: latest)")
+    p.add_argument("--diff", default=None,
+                   help="second checkpoint dir: report per-module max "
+                        "abs param difference at the same (or latest) "
+                        "step of each")
+    args = p.parse_args(argv)
+
+    import jax
+
+    cfg, state, step, steps = _load(args.ckpt_dir, args.step)
+    print(f"checkpoint dir : {args.ckpt_dir}")
+    print(f"available steps: {steps}")
+    print(f"inspected step : {step}")
+    print(f"config         : {cfg.name} (model={cfg.model.name}, "
+          f"backbone={cfg.model.backbone}, optimizer={cfg.optim.optimizer})")
+    _census(state.params, "params")
+    if getattr(state, "batch_stats", None):
+        n = sum(leaf.size for leaf in
+                jax.tree_util.tree_leaves(state.batch_stats))
+        print(f"\nbatch_stats: {n / 1e6:.3f}M values (BatchNorm running "
+              "statistics)")
+    if getattr(state, "ema_params", None) is not None:
+        print("ema_params: present")
+    n_opt = sum(leaf.size for leaf in
+                jax.tree_util.tree_leaves(state.opt_state)
+                if hasattr(leaf, "size"))
+    print(f"opt_state: {n_opt / 1e6:.2f}M values")
+
+    if args.diff:
+        import jax
+
+        _, other, other_step, _ = _load(args.diff, args.step)
+        if (jax.tree_util.tree_structure(state.params)
+                != jax.tree_util.tree_structure(other.params)):
+            raise SystemExit(
+                f"param trees differ in STRUCTURE between {args.ckpt_dir} "
+                f"and {args.diff} (different model configs?) — a "
+                "numerical diff would pair unrelated leaves")
+        print(f"\ndiff vs {args.diff} @ step {other_step} "
+              "(max abs param delta per module):")
+        diffs = {}
+        mismatched = set()
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(state.params),
+                jax.tree_util.tree_leaves_with_path(other.params)):
+            scope = str(getattr(pa[0], "key", pa[0]))
+            if a.shape != b.shape:
+                mismatched.add(scope)
+                continue
+            d = float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+            diffs[scope] = max(diffs.get(scope, 0.0), d)
+        for scope in sorted(diffs, key=lambda s: -diffs[s]):
+            note = "  (+ shape-mismatched leaves!)" \
+                if scope in mismatched else ""
+            print(f"  {scope:<28} {diffs[scope]:.3e}{note}")
+        for scope in sorted(mismatched - set(diffs)):
+            print(f"  {scope:<28} shape mismatch — not comparable")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
