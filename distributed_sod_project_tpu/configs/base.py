@@ -23,6 +23,10 @@ class DataConfig:
     image_size: Tuple[int, int] = (320, 320)  # H, W — static for XLA
     use_depth: bool = False  # RGB-D datasets carry a depth channel
     hflip: bool = True
+    # ColorJitter-style photometric aug: brightness/saturation/contrast
+    # factors each drawn in [1-s, 1+s] per sample (0 disables; image
+    # only, identical across backends via data/augment.py draws).
+    color_jitter: float = 0.0
     rotate_degrees: float = 0.0  # ±deg random rotation (MINet-style
     #   aug); identical per-index draws on every backend
     normalize_mean: Tuple[float, float, float] = (0.485, 0.456, 0.406)

@@ -32,6 +32,46 @@ def rotate_draw(aug_seed: int, idx: int, degrees: float) -> float:
     return float((rng.random() * 2.0 - 1.0) * degrees)
 
 
+def jitter_draw(aug_seed: int, idx: int, strength: float):
+    """Deterministic (brightness, saturation, contrast) factors, each
+    in [1-strength, 1+strength] — a distinct stream from hflip/rotate
+    (offset key) so all draws stay independent."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence([aug_seed ^ 0xC0108, int(idx)]))
+    f = 1.0 + (rng.random(3) * 2.0 - 1.0) * strength
+    return float(f[0]), float(f[1]), float(f[2])
+
+
+_LUMA = np.asarray([0.299, 0.587, 0.114], np.float32)
+
+
+def apply_color_jitter(sample: Dict[str, np.ndarray], factors,
+                       mean, std) -> Dict[str, np.ndarray]:
+    """Brightness → saturation → contrast on the IMAGE only (masks and
+    depth untouched), computed in the unnormalized [0, 1] space (the
+    sample arrives mean/std-normalized) and clipped back to the data
+    range — the torchvision ColorJitter semantics with a fixed
+    application order so every backend agrees bit-for-bit.
+
+    Applied BEFORE rotation: contrast normalizes around the gray mean,
+    and rotation's zero-fill corners would shift that statistic.
+    """
+    b, s, c = factors
+    mean = np.asarray(mean if mean is not None else 0.0, np.float32)
+    std = np.asarray(std if std is not None else 1.0, np.float32)
+    img = sample["image"].astype(np.float32)
+    raw = img * std + mean
+    raw = raw * b
+    gray = (raw @ _LUMA)[..., None]
+    raw = gray + (raw - gray) * s
+    gmean = np.float32(gray.mean())
+    raw = gmean + (raw - gmean) * c
+    raw = np.clip(raw, 0.0, 1.0)
+    out = dict(sample)
+    out["image"] = ((raw - mean) / std).astype(sample["image"].dtype)
+    return out
+
+
 def apply_hflip(sample: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     out = dict(sample)
     for k in ("image", "mask", "depth"):
@@ -60,9 +100,15 @@ def apply_rotate(sample: Dict[str, np.ndarray],
 
 
 def augment_sample(sample: Dict[str, np.ndarray], idx: int, aug_seed: int,
-                   *, hflip: bool, rotate_degrees: float
+                   *, hflip: bool, rotate_degrees: float,
+                   color_jitter: float = 0.0, norm_mean=None, norm_std=None
                    ) -> Dict[str, np.ndarray]:
-    """The full deterministic train-time augmentation for one sample."""
+    """The full deterministic train-time augmentation for one sample:
+    color jitter (photometric, image only) → hflip → rotation."""
+    if color_jitter:
+        sample = apply_color_jitter(
+            sample, jitter_draw(aug_seed, idx, color_jitter),
+            norm_mean, norm_std)
     if hflip and hflip_draw(aug_seed, idx):
         sample = apply_hflip(sample)
     if rotate_degrees:

@@ -26,12 +26,14 @@ class _ShardView:
     host backend via data/augment.py)."""
 
     def __init__(self, dataset, keys: np.ndarray, hflip: bool,
-                 aug_seed: int, rotate_degrees: float = 0.0):
+                 aug_seed: int, rotate_degrees: float = 0.0,
+                 color_jitter: float = 0.0):
         self._dataset = dataset
         self._keys = keys
         self._hflip = hflip
         self._aug_seed = aug_seed
         self._rotate = rotate_degrees
+        self._jitter = color_jitter
 
     def __len__(self) -> int:
         return len(self._keys)
@@ -42,7 +44,10 @@ class _ShardView:
         idx = int(self._keys[int(i)])
         return augment_sample(dict(self._dataset[idx]), idx,
                               self._aug_seed, hflip=self._hflip,
-                              rotate_degrees=self._rotate)
+                              rotate_degrees=self._rotate,
+                              color_jitter=self._jitter,
+                              norm_mean=getattr(self._dataset, "mean", None),
+                              norm_std=getattr(self._dataset, "std", None))
 
 
 class GrainLoader:
@@ -59,6 +64,7 @@ class GrainLoader:
         drop_last: bool = True,
         hflip: bool = False,
         rotate_degrees: float = 0.0,
+        color_jitter: float = 0.0,
         num_workers: int = 0,
     ):
         if global_batch_size % num_shards != 0:
@@ -66,6 +72,7 @@ class GrainLoader:
                 f"global_batch_size={global_batch_size} not divisible by "
                 f"num_shards={num_shards}")
         self.rotate_degrees = float(rotate_degrees)
+        self.color_jitter = float(color_jitter)
         self.dataset = dataset
         self.global_batch_size = global_batch_size
         self.local_batch_size = global_batch_size // num_shards
@@ -127,7 +134,8 @@ class GrainLoader:
             return iter(())
 
         view = _ShardView(self.dataset, keys, self.hflip, aug_seed,
-                          rotate_degrees=self.rotate_degrees)
+                          rotate_degrees=self.rotate_degrees,
+                          color_jitter=self.color_jitter)
         sampler = grain.IndexSampler(
             num_records=len(view),
             shard_options=grain.NoSharding(),  # host sharding is in `keys`

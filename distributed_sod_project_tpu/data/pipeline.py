@@ -39,6 +39,7 @@ class HostDataLoader:
         drop_last: bool = True,
         hflip: bool = False,
         rotate_degrees: float = 0.0,
+        color_jitter: float = 0.0,
         num_workers: int = 0,
     ):
         if global_batch_size % num_shards != 0:
@@ -56,6 +57,7 @@ class HostDataLoader:
         self.drop_last = drop_last
         self.hflip = hflip
         self.rotate_degrees = float(rotate_degrees)
+        self.color_jitter = float(color_jitter)
         self.num_workers = num_workers
         self._epoch = 0
         self._skip = 0
@@ -101,7 +103,10 @@ class HostDataLoader:
         sample = dict(self.dataset[int(idx)])
         return augment_sample(sample, int(idx), aug_seed,
                               hflip=self.hflip,
-                              rotate_degrees=self.rotate_degrees)
+                              rotate_degrees=self.rotate_degrees,
+                              color_jitter=self.color_jitter,
+                              norm_mean=getattr(self.dataset, "mean", None),
+                              norm_std=getattr(self.dataset, "std", None))
 
     def _rotate_batch(self, batch, idxs, aug_seed: int):
         """Rotation for the native-decode path (which handled decode +
@@ -116,6 +121,25 @@ class HostDataLoader:
         out = dict(batch)
         for k in per_image[0]:
             out[k] = np.stack([s[k] for s in per_image])
+        return out
+
+    def _jitter_batch(self, batch, idxs, aug_seed: int):
+        """Color jitter for the native-decode path — same per-index
+        draws as the PIL path.  Jitter commutes with hflip (pixelwise
+        given per-image stats), so applying it after the C++ flip is
+        identical to the augment_sample order; it must still run
+        BEFORE rotation (zero-fill corners shift the contrast mean)."""
+        from .augment import apply_color_jitter, jitter_draw
+
+        mean = getattr(self.dataset, "mean", None)
+        std = getattr(self.dataset, "std", None)
+        imgs = [apply_color_jitter(
+                    {"image": batch["image"][j]},
+                    jitter_draw(aug_seed, int(i), self.color_jitter),
+                    mean, std)["image"]
+                for j, i in enumerate(idxs)]
+        out = dict(batch)
+        out["image"] = np.stack(imgs)
         return out
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
@@ -142,6 +166,8 @@ class HostDataLoader:
                              for i in idxs]
                     batch = native_batch(idxs, hflip=flags)
                     if batch is not None:
+                        if self.color_jitter:
+                            batch = self._jitter_batch(batch, idxs, aug_seed)
                         if self.rotate_degrees:
                             batch = self._rotate_batch(batch, idxs, aug_seed)
                         yield batch

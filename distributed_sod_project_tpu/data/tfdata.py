@@ -49,9 +49,11 @@ class TFDataLoader:
         drop_last: bool = True,
         hflip: bool = False,
         rotate_degrees: float = 0.0,
+        color_jitter: float = 0.0,
         num_workers: int = 4,
     ):
         self.rotate_degrees = float(rotate_degrees)
+        self.color_jitter = float(color_jitter)
         if global_batch_size % num_shards != 0:
             raise ValueError(
                 f"global_batch_size={global_batch_size} not divisible by "
@@ -142,18 +144,46 @@ class TFDataLoader:
 
             tensors["flip"] = np.array(
                 [hflip_draw(aug_seed, int(i)) for i in my], np.bool_)
+        if self.color_jitter:
+            # Same precomputed-constant pattern as the flip column:
+            # the (brightness, saturation, contrast) factors come from
+            # the shared data/augment.py draws, the arithmetic below
+            # mirrors apply_color_jitter in pure TF ops.
+            from .augment import jitter_draw
+
+            tensors["jitter"] = np.array(
+                [jitter_draw(aug_seed, int(i), self.color_jitter)
+                 for i in my], np.float32)
 
         def decode(rec):
             img = tf.io.decode_image(tf.io.read_file(rec["img_path"]),
                                      channels=3, expand_animations=False)
             img = tf.image.resize(tf.cast(img, tf.float32), (h, w),
                                   antialias=True) / 255.0
-            img = (img - mean) / std
             mask = tf.io.decode_image(tf.io.read_file(rec["mask_path"]),
                                       channels=1, expand_animations=False)
             mask = tf.image.resize(tf.cast(mask, tf.float32), (h, w),
                                    antialias=True) / 255.0
             mask = tf.cast(mask > 0.5, tf.float32)
+            if self.color_jitter:
+                # Mirrors augment.apply_color_jitter: brightness ->
+                # saturation -> contrast on the still-unnormalized
+                # [0, 1] image (jitter here, THEN normalize once — no
+                # denorm/renorm round trip).  Runs before hflip
+                # (commutes) and before the rotation py_function (must
+                # not see zero-fill corners in the contrast mean).
+                from .augment import _LUMA
+
+                b, s_, c = (rec["jitter"][0], rec["jitter"][1],
+                            rec["jitter"][2])
+                raw = img * b
+                gray = tf.reduce_sum(
+                    raw * tf.constant(_LUMA), axis=-1, keepdims=True)
+                raw = gray + (raw - gray) * s_
+                gmean = tf.reduce_mean(gray)
+                raw = gmean + (raw - gmean) * c
+                img = tf.clip_by_value(raw, 0.0, 1.0)
+            img = (img - mean) / std
             out = {"image": img, "mask": mask, "index": rec["index"]}
             if use_depth:
                 d = tf.io.decode_image(tf.io.read_file(rec["depth_path"]),

@@ -83,6 +83,7 @@ def fit(
         seed=cfg.seed,
         hflip=cfg.data.hflip,
         rotate_degrees=cfg.data.rotate_degrees,
+        color_jitter=cfg.data.color_jitter,
         num_workers=cfg.data.num_workers,
     )
     steps_per_epoch = cfg.steps_per_epoch or loader.steps_per_epoch

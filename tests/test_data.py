@@ -196,3 +196,37 @@ def test_prefetch_transfer_dtype_bf16():
     np.testing.assert_allclose(
         np.asarray(batches[0]["image"], np.float32),
         ref["image"].astype(np.float32), atol=0.02, rtol=0.02)
+
+
+def test_color_jitter_semantics():
+    """apply_color_jitter: deterministic draws, image-only effect,
+    strength 0 → identity draws, round-trips through normalization."""
+    from distributed_sod_project_tpu.data.augment import (
+        apply_color_jitter, jitter_draw)
+
+    assert jitter_draw(7, 3, 0.4) == jitter_draw(7, 3, 0.4)
+    assert jitter_draw(7, 3, 0.4) != jitter_draw(7, 4, 0.4)
+    assert jitter_draw(7, 3, 0.0) == (1.0, 1.0, 1.0)
+
+    rng = np.random.RandomState(0)
+    mean = np.asarray([0.485, 0.456, 0.406], np.float32)
+    std = np.asarray([0.229, 0.224, 0.225], np.float32)
+    raw = rng.rand(8, 8, 3).astype(np.float32)
+    sample = {"image": (raw - mean) / std,
+              "mask": (rng.rand(8, 8, 1) > 0.5).astype(np.float32)}
+
+    out = apply_color_jitter(sample, (1.0, 1.0, 1.0), mean, std)
+    np.testing.assert_allclose(out["image"], sample["image"], atol=1e-6)
+
+    out = apply_color_jitter(sample, (1.3, 0.7, 1.2), mean, std)
+    assert not np.allclose(out["image"], sample["image"])
+    np.testing.assert_array_equal(out["mask"], sample["mask"])
+    # Unnormalized result stays in the data range (clip).
+    unnorm = out["image"] * std + mean
+    assert unnorm.min() >= -1e-6 and unnorm.max() <= 1 + 1e-6
+
+    # Pure brightness scales the unnormalized image linearly (no clip
+    # at factor < 1).
+    out_b = apply_color_jitter(sample, (0.5, 1.0, 1.0), mean, std)
+    np.testing.assert_allclose(out_b["image"] * std + mean, raw * 0.5,
+                               atol=1e-6)

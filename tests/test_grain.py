@@ -73,3 +73,17 @@ def test_make_loader_dispatch_grain():
     batches = list(ld)
     assert len(batches) == 2
     assert batches[0]["image"].shape == (4, 8, 8, 3)
+
+
+def test_grain_color_jitter_matches_host():
+    """Photometric aug draws/application are shared: grain == host with
+    color_jitter on (content equality through the full
+    denormalize→jitter→renormalize path — SyntheticSOD carries
+    mean/std like FolderSOD)."""
+    a = _mk(GrainLoader, color_jitter=0.4, num_workers=0)
+    b = _mk(HostDataLoader, color_jitter=0.4)
+    a.set_epoch(1)
+    b.set_epoch(1)
+    for ga, gb in zip(a, b):
+        np.testing.assert_array_equal(ga["index"], gb["index"])
+        np.testing.assert_allclose(ga["image"], gb["image"], atol=1e-6)

@@ -162,3 +162,19 @@ def test_tfdata_rotation_matches_shared_augment(folder_ds):
                                        atol=1e-5)
             np.testing.assert_allclose(rb["mask"][j], want["mask"],
                                        atol=1e-5)
+
+
+def test_tfdata_color_jitter_content_matches_host_loader(folder_ds):
+    """The TF-ops jitter mirrors augment.apply_color_jitter exactly:
+    content equality with the host backend, jitter + hflip on."""
+    from distributed_sod_project_tpu.data.pipeline import HostDataLoader
+
+    tfl = TFDataLoader(folder_ds, global_batch_size=4, seed=3, hflip=True,
+                       color_jitter=0.4)
+    hl = HostDataLoader(folder_ds, global_batch_size=4, seed=3, hflip=True,
+                        color_jitter=0.4)
+    tfl.set_epoch(1)
+    hl.set_epoch(1)
+    for tb, hb in zip(tfl, hl):
+        np.testing.assert_array_equal(tb["index"], hb["index"])
+        np.testing.assert_allclose(tb["image"], hb["image"], atol=2e-3)
