@@ -62,3 +62,17 @@ def maybe_enable_compilation_cache(path: str | None = None) -> None:
         logging.getLogger(__name__).warning(
             "persistent compilation cache disabled (%s: %s)",
             type(e).__name__, e)
+
+
+def pin_cpu() -> None:
+    """Pin jax to the CPU backend (config path, NOT the JAX_PLATFORMS
+    env var — with the remote-TPU PJRT plugin registered by
+    sitecustomize, the env path eagerly dials the tunnel and hangs when
+    it is down).  Shared by the offline tools (eval_preds,
+    inspect_ckpt, export_model); a no-op when a backend is already up."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already initialized
+        pass

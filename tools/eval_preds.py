@@ -30,16 +30,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _pin_cpu() -> None:
-    """Offline scoring never needs an accelerator; with a remote-TPU
-    PJRT plugin registered (sitecustomize), letting jax auto-pick would
-    dial the tunnel — and hang when it is down.  Config path, not env:
-    the plugin re-exports JAX_PLATFORMS at interpreter start."""
-    import jax
+    from distributed_sod_project_tpu.utils.platform import pin_cpu
 
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:  # noqa: BLE001 — backend already up: leave it
-        pass
+    pin_cpu()
 
 
 IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp")

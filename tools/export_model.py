@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Export a trained checkpoint as a serialized AOT inference artifact.
+
+The serving story the reference stack never had: ``jax.export``
+serializes the FULL inference computation (StableHLO + the trained
+weights baked in as constants) for a chosen platform, so the artifact
+runs anywhere jax runs — no model code, no checkpoint format, no
+framework version coupling beyond StableHLO's compatibility window.
+Load side is three lines:
+
+    from jax import export
+    fn = export.deserialize(open("model.bin", "rb").read())
+    probs = fn.call(images)        # [B,H,W] float32 in [0,1]
+
+Input spec: float32 NHWC images, mean/std-normalized at the training
+resolution (the config sidecar records both); RGB-D members take
+``fn.call(images, depths)``.
+
+Usage:
+    python tools/export_model.py --ckpt-dir runs/minet \
+        --out minet_320.bin --platform tpu --batch-size 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pin_cpu() -> None:
+    from distributed_sod_project_tpu.utils.platform import pin_cpu
+
+    pin_cpu()
+
+
+def export_checkpoint(ckpt_dir: str, out_path: str, platform: str = "tpu",
+                      batch_size: int = 1, step=None,
+                      use_ema: bool = True) -> dict:
+    """Serialize the checkpoint's eval forward for ``platform``;
+    returns summary metadata."""
+    import jax
+    from jax import export as jexport
+
+    from distributed_sod_project_tpu.eval.inference import restore_for_eval
+
+    cfg, model, state = restore_for_eval(ckpt_dir, step=step)
+    variables = (state.eval_variables()
+                 if use_ema and hasattr(state, "eval_variables")
+                 else state.variables())
+    h, w = cfg.data.image_size
+    use_depth = cfg.data.use_depth
+
+    def forward(image, depth=None):
+        outs = model.apply(variables, image, depth, train=False)
+        return jax.nn.sigmoid(outs[0][..., 0].astype(np.float32))
+
+    img_spec = jax.ShapeDtypeStruct((batch_size, h, w, 3), np.float32)
+    if use_depth:
+        dep_spec = jax.ShapeDtypeStruct((batch_size, h, w, 1), np.float32)
+        exported = jexport.export(jax.jit(forward),
+                                  platforms=[platform])(img_spec, dep_spec)
+    else:
+        exported = jexport.export(
+            jax.jit(lambda image: forward(image)),
+            platforms=[platform])(img_spec)
+
+    blob = exported.serialize()
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return {
+        "out": out_path,
+        "bytes": len(blob),
+        "platform": platform,
+        "config": cfg.name,
+        "model": cfg.model.name,
+        "input": [batch_size, h, w, 3],
+        "rgbd": bool(use_depth),
+    }
+
+
+def main(argv=None):
+    _pin_cpu()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--out", required=True, help="output artifact path")
+    p.add_argument("--platform", default="tpu",
+                   choices=["tpu", "cpu", "cuda"],
+                   help="target platform baked into the artifact")
+    p.add_argument("--batch-size", type=int, default=1)
+    p.add_argument("--step", type=int, default=None)
+    p.add_argument("--no-ema", action="store_true",
+                   help="export raw params even when EMA slots exist")
+    args = p.parse_args(argv)
+    info = export_checkpoint(args.ckpt_dir, args.out,
+                             platform=args.platform,
+                             batch_size=args.batch_size, step=args.step,
+                             use_ema=not args.no_ema)
+    for k, v in info.items():
+        print(f"{k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,12 +26,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _pin_cpu() -> None:
-    import jax
+    from distributed_sod_project_tpu.utils.platform import pin_cpu
 
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:  # noqa: BLE001 — backend already up: leave it
-        pass
+    pin_cpu()
 
 
 def _load(ckpt_dir: str, step):
