@@ -29,7 +29,32 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "float32")
 # Persistent compilation cache: this sandbox has ONE core, and the
 # model-zoo compiles dominate suite time — cache them across runs.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_pytest_cache")
+# The dir is keyed by this HOST's CPU feature set: XLA:CPU AOT entries
+# pin machine features at compile time, and /tmp can outlive a sandbox
+# session that lands on different silicon — loading a stale entry
+# compiled with (e.g.) AMX/AVX-512 on a host without them aborts the
+# process mid-test ("Fatal Python error: Aborted", observed 2026-07-31).
+import hashlib  # noqa: E402
+
+
+def _cpu_key() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            # x86 spells it "flags", ARM "Features"; hash every match so
+            # hosts differing in ANY ISA extension get distinct caches.
+            flags = "".join(line for line in f
+                            if line.startswith(("flags", "Features")))
+        if not flags:
+            raise OSError("no flags/Features lines")
+    except OSError:
+        import platform
+
+        flags = (platform.processor() or platform.machine() or "unknown")
+    return hashlib.sha1(flags.encode()).hexdigest()[:10]
+
+
+jax.config.update("jax_compilation_cache_dir",
+                  f"/tmp/jax_pytest_cache_{_cpu_key()}")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_enable_xla_caches",
                   "xla_gpu_per_fusion_autotune_cache_dir")
