@@ -75,10 +75,101 @@ def max_pool(x, window: int = 2, stride: int = 2):
     return nn.max_pool(x, (window, window), strides=(stride, stride), padding="SAME")
 
 
+def _upsample_axis(x, axis: int, s: int):
+    """Integer-factor bilinear upsample along one spatial axis.
+
+    Numerically identical to ``jax.image.resize(method='bilinear')``
+    (half-pixel centers; at the edges the out-of-range tap's weight is
+    renormalised away, which for a 2-tap kernel equals index clamping):
+    ``out[s*i + p] = (1-f_p)*x[i + d_p] + f_p*x[i + d_p + 1]`` with the
+    phase constants baked in at trace time.  Pure slice/lerp/interleave
+    — a single VPU pass, where the generic resize lowers to per-axis
+    ``dot_general``s whose operand layouts cost two relayout copies per
+    call (measured 15% of the MINet-R50 train step on v5e;
+    docs/PERFORMANCE.md).
+    """
+    import jax.lax as lax
+
+    n = x.shape[axis]
+    first = lax.slice_in_dim(x, 0, 1, axis=axis)
+    last = lax.slice_in_dim(x, n - 1, n, axis=axis)
+    left = jnp.concatenate(
+        [first, lax.slice_in_dim(x, 0, n - 1, axis=axis)], axis)
+    right = jnp.concatenate(
+        [lax.slice_in_dim(x, 1, n, axis=axis), last], axis)
+    phases = []
+    for p in range(s):
+        c = (p + 0.5) / s - 0.5
+        if c < 0:  # taps x[i-1], x[i]
+            a, b, f = left, x, c + 1.0
+        else:  # taps x[i], x[i+1]
+            a, b, f = x, right, c
+        f = jnp.asarray(f, x.dtype)
+        phases.append(a * (1 - f) + b * f)
+    y = jnp.stack(phases, axis=axis + 1)
+    return y.reshape(x.shape[:axis] + (n * s,) + x.shape[axis + 1:])
+
+
+def _downsample2_axis(x, axis: int):
+    """Antialiased factor-2 bilinear downsample along one spatial axis.
+
+    Matches ``jax.image.resize``'s default (antialias=True) triangle
+    kernel [1,3,3,1]/8 at half-pixel phase, with the edge rows
+    renormalised over their in-range taps exactly as the reference
+    implementation does (verified by impulse response — the edge sum is
+    7/8, hence the /0.875).
+    """
+    import jax.lax as lax
+
+    n = x.shape[axis]
+    xe = lax.slice_in_dim(x, 0, n, stride=2, axis=axis)  # x[2i]
+    xo = lax.slice_in_dim(x, 1, n, stride=2, axis=axis)  # x[2i+1]
+    m = n // 2
+    if m == 1:  # both outer taps cut: renorm [_,3,3,_]/6 = plain mean
+        return (xe + xo) * jnp.asarray(0.5, x.dtype)
+    zero_first = jnp.zeros_like(lax.slice_in_dim(xo, 0, 1, axis=axis))
+    xo_m1 = jnp.concatenate(  # x[2i-1]; cut tap at i=0
+        [zero_first, lax.slice_in_dim(xo, 0, m - 1, axis=axis)], axis)
+    xe_p1 = jnp.concatenate(  # x[2i+2]; cut tap at i=m-1
+        [lax.slice_in_dim(xe, 1, m, axis=axis), zero_first], axis)
+    w1, w3 = jnp.asarray(0.125, x.dtype), jnp.asarray(0.375, x.dtype)
+    y = w1 * xo_m1 + w3 * xe + w3 * xo + w1 * xe_p1
+    renorm = jnp.asarray(1.0 / 0.875, x.dtype)
+    return jnp.concatenate([
+        lax.slice_in_dim(y, 0, 1, axis=axis) * renorm,
+        lax.slice_in_dim(y, 1, m - 1, axis=axis),
+        lax.slice_in_dim(y, m - 1, m, axis=axis) * renorm,
+    ], axis)
+
+
+def _fast_bilinear_axis(x, axis: int, out_n: int):
+    """One axis of ``resize_to``'s fast path; None if unsupported."""
+    n = x.shape[axis]
+    if out_n == n:
+        return x
+    if out_n % n == 0:
+        return _upsample_axis(x, axis, out_n // n)
+    if n == 2 * out_n and n % 2 == 0:
+        return _downsample2_axis(x, axis)
+    return None
+
+
 def resize_to(x, hw: Tuple[int, int], method: str = "bilinear"):
-    """Static-shape spatial resize (the upsample path of every decoder)."""
+    """Static-shape spatial resize (the upsample path of every decoder).
+
+    Bilinear integer-factor resizes — every resize the zoo performs —
+    take the fused slice/lerp path above; anything else falls back to
+    ``jax.image.resize`` (same numerics either way, asserted in
+    tests/test_models.py).
+    """
     import jax
 
+    if method == "bilinear":
+        h = _fast_bilinear_axis(x, 1, hw[0])
+        if h is not None:
+            w = _fast_bilinear_axis(h, 2, hw[1])
+            if w is not None:
+                return w
     out = jax.image.resize(x, (x.shape[0], hw[0], hw[1], x.shape[3]), method=method)
     return out.astype(x.dtype)
 

@@ -281,3 +281,35 @@ def test_swin_nondivisible_input_padding():
     x = jnp.zeros((1, 56, 56, 3))
     feats = m.apply(m.init(jax.random.key(0), x), x)
     assert [f.shape[1] for f in feats] == [14, 7, 3, 1]
+
+
+@pytest.mark.parametrize("shape,hw", [
+    ((2, 10, 10, 3), (20, 20)),   # 2x up (every decoder stage)
+    ((1, 5, 5, 2), (40, 40)),     # 8x up (deep-supervision heads)
+    ((2, 16, 16, 3), (8, 8)),     # 2x antialiased down (AIM below)
+    ((2, 12, 8, 3), (6, 16)),     # mixed: down2 in H, up2 in W
+    ((1, 9, 9, 1), (3, 3)),       # non-integer factor -> fallback
+])
+def test_resize_fast_path_matches_jax_image(shape, hw):
+    # The slice/lerp fast paths (layers._upsample_axis/_downsample2_axis)
+    # must be numerically identical to jax.image.resize's bilinear
+    # (half-pixel centers, antialias on downscale, edge renorm) — the
+    # torch-port parity suite and every zoo logit depend on it.
+    from distributed_sod_project_tpu.models.layers import resize_to
+
+    x = jax.random.normal(jax.random.key(0), shape)
+    ref = jax.image.resize(x, (shape[0],) + tuple(hw) + (shape[3],),
+                           method="bilinear")
+    got = resize_to(x, hw)
+    assert jnp.abs(ref - got).max() < 2e-6
+
+    def loss(fn, x):
+        return jnp.sum(jnp.sin(fn(x)))
+
+    g_ref = jax.grad(lambda x: loss(
+        lambda v: jax.image.resize(
+            v, (shape[0],) + tuple(hw) + (shape[3],), "bilinear"), x))(x)
+    g_got = jax.grad(lambda x: loss(lambda v: resize_to(v, hw), x))(x)
+    # Relative: an 8x up-resize cotangent sums 64 contributions, so the
+    # f32 round-off scales with |g|.
+    assert jnp.allclose(g_ref, g_got, rtol=1e-5, atol=1e-5)
