@@ -37,11 +37,20 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # Beyond this many f32 elements for the padded x tile, fall back to the
 # XLA im2col path rather than risk VMEM pressure (≈8 MB at f32, and the
 # kernel maps add T·H·W on top).
 _MAX_TILE_ELEMS = 2 * 1024 * 1024
+
+# Raise the per-kernel scoped-VMEM ceiling past the 16 MB default.
+# First real-v5e exposure (round 2): at (32,80,80,64)·bf16, XLA's
+# memory-space assignment parked the custom call's full output in VMEM
+# (S(1) layout) and the compile died against the 16 MB scoped limit
+# even though the per-grid-step windows are <2 MB.  v5e has 128 MB of
+# VMEM; 100 MB headroom compiles and runs fwd+bwd at batch 128.
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
 
 def _taps(ksize: int, dilation: int):
@@ -109,6 +118,7 @@ def _call_filter(x, kt, ksize, dilation, interpret):
             flops=2 * b * h * w * c * len(taps), transcendentals=0,
             bytes_accessed=(2 * x.size + kt.size) * 4),
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(xp, kt)
 
 
@@ -137,6 +147,7 @@ def _dlf_bwd(ksize, dilation, interpret, res, g):
         out_specs=_img_spec((h, w, c)),
         out_shape=jax.ShapeDtypeStruct((b, h, w, c), x.dtype),
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(gp, ktp)
 
     xp = _pad_hw(x, r)
@@ -147,6 +158,7 @@ def _dlf_bwd(ksize, dilation, interpret, res, g):
         out_specs=_img_spec((t, h, w)),
         out_shape=jax.ShapeDtypeStruct((b, t, h, w), jnp.float32),
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(xp, g)
     return dx, dk
 
