@@ -40,8 +40,10 @@ def main(argv=None):
     p.add_argument("--config", default="minet_r50_dp")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--batch-per-chip", type=int, default=32,
-                   help="per-chip batch (default 32: small batches "
+    p.add_argument("--batch-per-chip", type=int, default=128,
+                   help="per-chip batch (default 128: the measured v5e "
+                        "throughput optimum for the flagship config — "
+                        "batch sweep in BASELINE.md; small batches "
                         "underreport — per-step dispatch latency "
                         "dominates under ~16 imgs/chip on remote-device "
                         "transports)")

@@ -49,7 +49,13 @@ def deep_supervision_loss(
         total = total + weight * value
 
     for logit, lw in zip(logits_list, level_weights):
-        if fused and (bce_w or iou_w or cel_w):
+        if fused:
+            from ..pallas.fused_loss import fused_loss_available
+        # Availability guard, not an error: fused=True configs must
+        # keep working at off-lane eval sizes and on non-TPU backends
+        # (falling back to the numerically-identical reference terms).
+        if (fused and (bce_w or iou_w or cel_w)
+                and fused_loss_available(logit.shape)):
             from ..pallas import fused_bce_iou_cel
 
             add("bce_iou_cel",

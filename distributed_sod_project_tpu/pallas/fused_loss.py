@@ -50,6 +50,20 @@ def _sums_kernel(x_ref, t_ref, out_ref):
     out_ref[:] = out
 
 
+def fused_loss_available(shape) -> bool:
+    """True when the fused kernel can run for this logit shape here:
+    pixel count a lane multiple (padding would bias the Σσ(x) region
+    statistics, so off-lane sizes are rejected, not padded) and a
+    backend with a Pallas path (Mosaic on TPU, interpret on CPU).
+    Callers fall back to the reference losses otherwise — configs with
+    ``loss.fused_kernel=true`` must keep working at odd eval sizes and
+    on GPU backends."""
+    n = 1
+    for d in shape[1:]:
+        n *= int(d)
+    return n % _LANES == 0 and jax.default_backend() in ("cpu", "tpu")
+
+
 def pixel_region_sums(logits: jnp.ndarray, targets: jnp.ndarray,
                       interpret: bool | None = None,
                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
