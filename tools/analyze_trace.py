@@ -31,14 +31,8 @@ import sys
 
 def find_xspaces(trace_dir: str) -> list[str]:
     """All xplane.pb files under a profile dir (any nesting)."""
-    pats = [
-        os.path.join(trace_dir, "**", "*.xplane.pb"),
-        os.path.join(trace_dir, "*.xplane.pb"),
-    ]
-    found: list[str] = []
-    for p in pats:
-        found.extend(glob.glob(p, recursive=True))
-    return sorted(set(found))
+    return sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                            recursive=True))
 
 
 def convert(xspace_paths: list[str], tool: str):
@@ -65,7 +59,7 @@ def _gviz_rows(table: dict) -> tuple[list[str], list[list]]:
     return cols, rows
 
 
-def _fmt_table(cols: list[str], rows: list[list], width: int = 110) -> str:
+def _fmt_table(cols: list[str], rows: list[list]) -> str:
     if not rows:
         return "(no rows)"
     widths = [min(max(len(str(c)), *(len(str(r[i])) if i < len(r) else 0
