@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Bisect the swin_sod EVAL TPU-worker crash (round-2 session 3).
+
+``bench.py --config swin_sod --mode eval`` crashed the v5e worker
+twice ("kernel fault"; the train step is fine, and eval of every other
+zoo member is fine).  This drives the eval program's pieces one at a
+time IN SUBPROCESSES so the crashing stage is identified without
+taking down the parent, smallest first:
+
+    python tools/bisect_swin_eval.py            # all stages
+    python tools/bisect_swin_eval.py --stage fwd_b1
+
+Each stage prints CRASHED/OK plus the tail of stderr on failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+_STAGES = {}
+
+
+def _stage(name):
+    def deco(src):
+        _STAGES[name] = src
+        return src
+    return deco
+
+
+_PRELUDE = """
+import jax, jax.numpy as jnp, numpy as np
+from distributed_sod_project_tpu.configs import get_config, apply_overrides
+from distributed_sod_project_tpu.models import build_model
+from distributed_sod_project_tpu.parallel.mesh import (
+    batch_sharding, make_mesh, replicated_sharding)
+from distributed_sod_project_tpu.train import (
+    build_optimizer, create_train_state)
+from distributed_sod_project_tpu.train.state import TrainState
+
+B = {batch}
+cfg = get_config("swin_sod")
+cfg = apply_overrides(cfg, [f"global_batch_size={{B}}",
+                            "data.image_size=320,320"])
+mesh = make_mesh(cfg.mesh)
+model = build_model(cfg.model)
+rng = np.random.RandomState(0)
+batch = {{
+    "image": rng.randn(B, 320, 320, 3).astype(np.float32),
+    "mask": (rng.rand(B, 320, 320, 1) > 0.5).astype(np.float32),
+}}
+tx, _ = build_optimizer(cfg.optim, 100)
+state = create_train_state(jax.random.key(0), model, tx, batch)
+state = TrainState(step=state.step, params=state.params,
+                   batch_stats=state.batch_stats, opt_state=())
+state = jax.device_put(state, replicated_sharding(mesh))
+dev = jax.device_put(batch, batch_sharding(mesh))
+"""
+
+# Plain forward, no eval-step machinery.
+_STAGES["fwd_b1"] = _PRELUDE + """
+fn = jax.jit(lambda s, b: model.apply(
+    {"params": s.params, "batch_stats": s.batch_stats},
+    b["image"], None, train=False)[0])
+out = fn(state, dev)
+print("fwd ok", float(out.astype(jnp.float32).sum()))
+"""
+
+# The real eval step (sigmoid probs) without metric accumulation.
+_STAGES["eval_step"] = _PRELUDE + """
+from distributed_sod_project_tpu.train.step import make_eval_step
+estep = make_eval_step(model, mesh)
+probs = estep(state, dev)
+print("eval step ok", float(probs.astype(jnp.float32).sum()))
+"""
+
+# Eval step + device-side metric accumulation (what bench --mode eval
+# times, and what crashed).
+_STAGES["eval_metrics"] = _PRELUDE + """
+from distributed_sod_project_tpu.train.step import make_eval_step
+from distributed_sod_project_tpu.metrics.streaming import (
+    init_fbeta_state, update_fbeta_state)
+estep = make_eval_step(model, mesh)
+upd = jax.jit(update_fbeta_state, donate_argnums=0)
+acc = init_fbeta_state()
+for _ in range(3):
+    probs = estep(state, dev)
+    acc = upd(acc, probs, dev["mask"])
+print("eval+metrics ok", float(acc.mae_sum))
+"""
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--stage", default=None, choices=sorted(_STAGES))
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--timeout", type=int, default=900)
+    args = p.parse_args(argv)
+
+    names = [args.stage] if args.stage else list(_STAGES)
+    for name in names:
+        src = _STAGES[name].format(batch=args.batch)
+        print(f"== {name} (b={args.batch})", flush=True)
+        try:
+            r = subprocess.run([sys.executable, "-c", src],
+                               capture_output=True, text=True,
+                               timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            print("   WEDGED (timeout)")
+            continue
+        if r.returncode == 0:
+            print("   OK:", (r.stdout or "").strip().splitlines()[-1:])
+        else:
+            tail = (r.stderr or "").strip().splitlines()[-6:]
+            print(f"   CRASHED rc={r.returncode}")
+            for line in tail:
+                print("   |", line[:200])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
