@@ -18,6 +18,7 @@ import os
 import sys
 import threading
 import time
+from functools import partial
 
 # Exactly ONE result line may reach stdout (the driver parses the last
 # JSON line).  The main thread and the watchdog timer thread race for
@@ -321,17 +322,24 @@ def _run(args):
         # loop), so the number includes what eval actually does.  The
         # metric state also chains every step: eval forwards are
         # independent, so without the carry the final fetch would only
-        # prove the last dispatch drained.
-        upd = jax.jit(update_fbeta_state, donate_argnums=0)
+        # prove the last dispatch drained.  ONE jit for forward+update:
+        # two dispatches per step pay the remote-transport round-trip
+        # twice (per-dispatch latency dominates small batches there).
+        @partial(jax.jit, donate_argnums=0)
+        def eval_and_update(acc_state, s, b):
+            return update_fbeta_state(acc_state, estep(s, b), b["mask"])
+
         acc = [init_fbeta_state()]
 
         def run_step():
-            probs = estep(state, dev_batch)
-            acc[0] = upd(acc[0], probs, dev_batch["mask"])
-            return acc[0].mae_sum + acc[0].f_curve_sum.sum()
+            # Exactly ONE dispatch per step; the chained (donated) acc
+            # state is the sync token.  The reductions that prove every
+            # shard landed happen once, in sync(), after the loop.
+            acc[0] = eval_and_update(acc[0], state, dev_batch)
+            return acc[0]
 
-        def sync(token):
-            return float(token)
+        def sync(a):
+            return float(a.mae_sum + a.f_curve_sum.sum())
     else:
         step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
                                remat=cfg.model.remat,
@@ -422,10 +430,15 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
     base_path = (os.environ.get("DSOD_BENCH_BASELINE")
                  or os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json"))
-    # Batch is in the key: throughput scales with it (dispatch-latency
-    # amortisation), so baselines only compare like with like.
+    # Batch AND --set overrides are in the key: throughput scales with
+    # batch (dispatch-latency amortisation) and overrides change the
+    # compiled program (remat, kernels), so baselines only compare like
+    # with like.  (Round-2 lesson: a remat-on run seeded b64's key and
+    # every remat-off run then reported a bogus vs_baseline.)
     key = (f"{args.config}-{args.image_size}-b{args.batch_per_chip}"
            f"-{platform}")
+    if args.overrides:
+        key += "-" + ",".join(sorted(args.overrides))
     if mode != "train":
         key += f"-{mode}"
     base = {}

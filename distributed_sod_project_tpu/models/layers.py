@@ -160,11 +160,15 @@ def resize_to(x, hw: Tuple[int, int], method: str = "bilinear"):
     Bilinear integer-factor resizes — every resize the zoo performs —
     take the fused slice/lerp path above; anything else falls back to
     ``jax.image.resize`` (same numerics either way, asserted in
-    tests/test_models.py).
+    tests/test_models.py).  ``DSOD_RESIZE_IMPL=xla`` forces the generic
+    path everywhere — the measurement/debug escape hatch (the A/B knob
+    used for the v5e numbers in BASELINE.md).
     """
+    import os
+
     import jax
 
-    if method == "bilinear":
+    if method == "bilinear" and os.environ.get("DSOD_RESIZE_IMPL") != "xla":
         h = _fast_bilinear_axis(x, 1, hw[0])
         if h is not None:
             w = _fast_bilinear_axis(h, 2, hw[1])

@@ -316,6 +316,10 @@ def _prepare(q, k, v, block_q, block_kv, interpret):
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
     if q.ndim != 4:
         raise ValueError(f"expected [B, H, N, D], got {q.shape}")
+    if block_q is None:
+        block_q = _env_block("DSOD_FLASH_BLOCK_Q", 128)
+    if block_kv is None:
+        block_kv = _env_block("DSOD_FLASH_BLOCK_KV", 128)
     b, h, n, d = q.shape
     if d > _LANES and d % _LANES:
         raise ValueError(
@@ -334,7 +338,19 @@ def _prepare(q, k, v, block_q, block_kv, interpret):
     return fold(q), fold(k), fold(v), cfg, (b, h, n, d)
 
 
-def flash_attention(q, k, v, *, block_q: int = 128, block_kv: int = 128,
+def _env_block(name: str, default: int) -> int:
+    """Block-shape override for on-hardware tuning
+    (``DSOD_FLASH_BLOCK_Q`` / ``DSOD_FLASH_BLOCK_KV`` — the knob
+    ``tools/bench_flash.py`` sweeps; round-2 v5e measurement showed the
+    128/128 default leaves >2x on the table at short N)."""
+    import os
+
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def flash_attention(q, k, v, *, block_q: int | None = None,
+                    block_kv: int | None = None,
                     interpret: bool | None = None) -> jnp.ndarray:
     """Drop-in for ``ring_attention.full_attention`` (non-causal).
 
@@ -352,8 +368,8 @@ def flash_attention(q, k, v, *, block_q: int = 128, block_kv: int = 128,
     return out[:, :n].reshape(b, h, n, d)
 
 
-def flash_attention_with_lse(q, k, v, *, block_q: int = 128,
-                             block_kv: int = 128,
+def flash_attention_with_lse(q, k, v, *, block_q: int | None = None,
+                             block_kv: int | None = None,
                              interpret: bool | None = None):
     """``flash_attention`` that also returns lse ([B, H, N] f32, the
     per-row logsumexp of the scaled scores) — the statistic that makes

@@ -32,7 +32,7 @@ def parse_args(argv=None):
                    help="test-set root; repeatable as name=path",
                    action="append")
     p.add_argument("--save-dir", default=None, help="write saliency PNGs here")
-    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--no-structure", action="store_true",
                    help="skip S/E-measure (faster)")
     p.add_argument("--fast-metrics", action="store_true",

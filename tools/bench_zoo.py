@@ -108,7 +108,15 @@ def main(argv=None):
     for cfg_name in zoo:
         for mode in modes:
             print(f"… {cfg_name} [{mode}]", file=sys.stderr, flush=True)
-            results[(cfg_name, mode)] = run_one(cfg_name, mode, args)
+            r = run_one(cfg_name, mode, args)
+            results[(cfg_name, mode)] = r
+            # Emit each row the moment it lands (stderr, like the
+            # progress dots): a sweep killed by an outer timeout must
+            # not take its finished measurements with it — round 2 lost
+            # the first real-TPU zoo table exactly this way and the
+            # numbers had to be dug out of bench_baseline.json seeds.
+            print(f"  {cfg_name} [{mode}] -> {json.dumps(r)}",
+                  file=sys.stderr, flush=True)
 
     lines = [f"| config | {' | '.join(modes)} |",
              f"|---|{'---|' * len(modes)}"]
