@@ -258,3 +258,17 @@ def test_analyze_trace_summarises_profile(tmp_path, capsys):
     assert "overview_page" in out and "hlo_stats" in out
     # Missing dir is a clean rc=1, not a traceback.
     assert analyze_trace.main([str(tmp_path / "nope")]) == 1
+
+
+@pytest.mark.slow
+def test_bench_flash_sweep_runs_on_cpu(capsys):
+    # CPU smoke of the block-shape sweep harness (interpret-mode
+    # kernel): tiny shape, one block pair, fwd-only.  Validates the
+    # timing/sync plumbing so the on-hardware sweep can't die on a
+    # harness bug when the tunnel window opens.
+    import bench_flash
+
+    assert bench_flash.main(["--shape", "2,256,64", "--iters", "2",
+                             "--blocks", "128/128", "--fwd-only"]) is None
+    out = capsys.readouterr().out
+    assert "xla" in out and "flash 128/128" in out and "ms" in out
