@@ -32,6 +32,13 @@ ZOO = [
     "vit_sod_sp",
 ]
 
+# Per-config batch/chip for TPU sweeps.  bench.py's default (128) is
+# the FLAGSHIP's measured optimum; the heavier members (two-stream
+# hdfnet, 89M-param basnet, 7-output u2net) were measured at 32 and
+# b128 risks HBM OOM — keep the sweep comparable round-over-round.
+ZOO_BATCH = {"minet_r50_dp": 128}
+_DEFAULT_BATCH = 32
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
@@ -68,8 +75,9 @@ def run_one(cfg_name, mode, args):
            "--watchdog", str(child_watchdog)]
     if args.device:
         cmd += ["--device", args.device]
-    if args.batch_per_chip is not None:
-        cmd += ["--batch-per-chip", str(args.batch_per_chip)]
+    batch = (args.batch_per_chip if args.batch_per_chip is not None
+             else ZOO_BATCH.get(cfg_name, _DEFAULT_BATCH))
+    cmd += ["--batch-per-chip", str(batch)]
     for ov in args.overrides:
         cmd += ["--set", ov]
     try:
