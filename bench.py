@@ -131,7 +131,17 @@ def main(argv=None):
                     return _run(args)
                 except Exception as e:  # noqa: BLE001 — classified below
                     if not _is_unavailable(e):
-                        raise
+                        # Non-retryable (OOM, shape error, bad flag):
+                        # full traceback to stderr for the human, but
+                        # the driver STILL gets a parsed JSON line —
+                        # a bare raise is how round 1 lost its
+                        # benchmark artifact to parsed=null.
+                        import traceback
+
+                        traceback.print_exc()
+                        _report_error(
+                            args, f"{type(e).__name__}: {str(e)[:300]}")
+                        return 1
                     fail = str(e)
                     _reset_backends()
             last_err = fail

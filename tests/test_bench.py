@@ -102,12 +102,14 @@ def test_bench_retries_unavailable_then_reports_error_json(
     assert "UNAVAILABLE" in out["error"]
 
 
-def test_bench_does_not_retry_unrelated_errors(tmp_path, monkeypatch):
+def test_bench_does_not_retry_unrelated_errors(tmp_path, monkeypatch, capsys):
     """Only transport-init failures are retried; a real bug (e.g. shape
-    error in the step) must surface immediately as the exception."""
-    import bench
+    error in the step) must surface immediately — exactly once, rc=1,
+    and STILL as a parsed JSON error line (a bare traceback is how
+    round 1 lost its benchmark artifact to parsed=null)."""
+    import json
 
-    import pytest
+    import bench
 
     monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
     calls = []
@@ -117,7 +119,11 @@ def test_bench_does_not_retry_unrelated_errors(tmp_path, monkeypatch):
         raise ValueError("shapes do not match")
 
     monkeypatch.setattr(bench, "_run", boom)
-    with pytest.raises(ValueError):
-        bench.main(["--device", "cpu", "--init-retries", "3",
-                    "--init-backoff", "0", "--probe-timeout", "0"])
+    rc = bench.main(["--device", "cpu", "--init-retries", "3",
+                     "--init-backoff", "0", "--probe-timeout", "0"])
+    assert rc == 1
     assert len(calls) == 1
+    out = capsys.readouterr().out
+    line = json.loads(out.strip().splitlines()[-1])
+    assert "shapes do not match" in line["error"]
+    assert line["value"] == 0.0
