@@ -1,0 +1,38 @@
+#!/bin/bash
+# Remaining round-2 TPU agenda — run when the tunnel is back.
+# (Committed from /tmp/agenda2.sh at round-2 session end; the tunnel
+# wedged before these could run. Run top-to-bottom in the next
+# hardware window; swin bisect stays LAST — it crashes the worker.)
+cd /root/repo
+R=tpu_results2; mkdir -p $R
+run() { name=$1; shift; echo "=== $name: $*"; timeout 900 "$@" 2>$R/$name.err | tail -1; }
+
+# 1. resize A/B (single variable: DSOD_RESIZE_IMPL)
+for impl in xla fast; do
+  ENV=""; [ $impl = xla ] && export DSOD_RESIZE_IMPL=xla || unset DSOD_RESIZE_IMPL
+  run rsz_${impl}_b128r python bench.py --device tpu --steps 20 --config minet_r50_dp --batch-per-chip 128 --set model.remat=true
+  run rsz_${impl}_b128 python bench.py --device tpu --steps 20 --config minet_r50_dp --batch-per-chip 128
+  run rsz_${impl}_b32 python bench.py --device tpu --steps 20 --config minet_r50_dp --batch-per-chip 32
+done
+unset DSOD_RESIZE_IMPL
+
+# 2. eval single-dispatch win (vs 248.30 / 365.07 two-dispatch)
+run eval_b32 python bench.py --device tpu --steps 20 --config minet_r50_dp --mode eval --batch-per-chip 32
+run eval_b64 python bench.py --device tpu --steps 20 --config minet_r50_dp --mode eval --batch-per-chip 64
+
+# 3. flash block sweep (fwd+bwd then fwd-only; short and long N)
+run flash_1k python tools/bench_flash.py --shape 12,1024,64 --iters 20
+run flash_1k_fwd python tools/bench_flash.py --shape 12,1024,64 --iters 20 --fwd-only
+run flash_4k python tools/bench_flash.py --shape 12,4096,64 --iters 10 --blocks 128/128,256/1024,512/1024,512/2048
+
+# 4. u2net fused default confirm (u2net was never A/B'd)
+run u2net_fused_off python bench.py --device tpu --steps 20 --config u2net_ds --batch-per-chip 32 --set loss.fused_kernel=false
+run u2net_fused_on python bench.py --device tpu --steps 20 --config u2net_ds --batch-per-chip 32
+
+# 5. LAST: swin eval bisect (can crash the worker)
+echo "=== swin bisect"
+timeout 2400 python tools/bisect_swin_eval.py 2>&1 | tail -30
+
+# 6. profile the b64-no-remat cliff + the new best config
+run prof_b64 python bench.py --device tpu --steps 20 --config minet_r50_dp --batch-per-chip 64 --profile-dir $R/trace_b64
+run prof_b128 python bench.py --device tpu --steps 20 --config minet_r50_dp --batch-per-chip 128 --profile-dir $R/trace_b128
