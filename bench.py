@@ -26,6 +26,25 @@ from functools import partial
 _REPORT_LOCK = threading.Lock()
 _REPORT_CLAIMED = False
 
+# Per-config --batch-per-chip defaults.  128 is the flagship's measured
+# v5e throughput optimum (batch sweep in BASELINE.md); the heavier zoo
+# members (two-stream hdfnet, 89M-param basnet, 7-output u2net) were
+# measured at 32 and risk HBM OOM at 128.  tools/bench_zoo.py reuses
+# this table so sweeps and direct runs agree.
+PER_CONFIG_BATCH = {"minet_r50_dp": 128}
+DEFAULT_BATCH = 32
+
+# Env vars that change the COMPILED PROGRAM (and therefore throughput):
+# they must be part of the baseline key, or an A/B leg run with one of
+# these set seeds the canonical key with the slow variant and every
+# later run reports a bogus vs_baseline (the exact failure class the
+# round-2 remat fix documented — see _report()).
+_PROGRAM_ENV_VARS = (
+    "DSOD_RESIZE_IMPL",
+    "DSOD_FLASH_BLOCK_Q",
+    "DSOD_FLASH_BLOCK_KV",
+)
+
 
 def _claim_report() -> bool:
     global _REPORT_CLAIMED
@@ -41,11 +60,12 @@ def main(argv=None):
     p.add_argument("--config", default="minet_r50_dp")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--batch-per-chip", type=int, default=128,
-                   help="per-chip batch (default 128: the measured v5e "
-                        "throughput optimum for the flagship config — "
-                        "batch sweep in BASELINE.md; small batches "
-                        "underreport — per-step dispatch latency "
+    p.add_argument("--batch-per-chip", type=int, default=None,
+                   help="per-chip batch (default: per-config — 128 for "
+                        "the flagship, its measured v5e optimum; 32 for "
+                        "the heavier zoo members, which risk HBM OOM at "
+                        "b128 — see PER_CONFIG_BATCH.  Small batches "
+                        "underreport: per-step dispatch latency "
                         "dominates under ~16 imgs/chip on remote-device "
                         "transports)")
     p.add_argument("--image-size", type=int, default=320)
@@ -69,13 +89,24 @@ def main(argv=None):
                         "seconds (the remote-TPU transport can wedge "
                         "indefinitely; 0 disables)")
     p.add_argument("--init-retries", type=int, default=5,
-                   help="attempts at backend init / first compile when "
-                        "the device transport reports UNAVAILABLE "
-                        "(round-1 postmortem: one transient tunnel "
-                        "outage at jax.device_count() cost the round "
-                        "its benchmark artifact)")
-    p.add_argument("--init-backoff", type=float, default=60.0,
-                   help="seconds between --init-retries attempts")
+                   help="MINIMUM attempts at backend init / first "
+                        "compile when the device transport reports "
+                        "UNAVAILABLE (round-1 postmortem: one transient "
+                        "tunnel outage at jax.device_count() cost the "
+                        "round its benchmark artifact).  On top of this "
+                        "floor, retries continue until --retry-budget "
+                        "seconds have elapsed")
+    p.add_argument("--init-backoff", type=float, default=30.0,
+                   help="seconds between retry attempts")
+    p.add_argument("--retry-budget", type=float, default=None,
+                   help="keep retrying backend init until this many "
+                        "seconds have elapsed (default: watchdog - 300, "
+                        "i.e. ~25 of the 30 watchdog minutes — round-2 "
+                        "postmortem: 5 fixed attempts gave up with 15+ "
+                        "unused minutes on the clock and the tunnel's "
+                        "observed behavior is 'wedged now, back later "
+                        "in the session'; 0 = exactly --init-retries "
+                        "attempts)")
     p.add_argument("--probe-timeout", type=float, default=120.0,
                    help="per-attempt subprocess dial-probe timeout; the "
                         "transport's common failure mode is a WEDGE "
@@ -90,6 +121,13 @@ def main(argv=None):
     for flag in ("watchdog", "init_backoff", "probe_timeout"):
         if getattr(args, flag) < 0:
             p.error(f"--{flag.replace('_', '-')} must be >= 0")
+    if args.retry_budget is not None and args.retry_budget < 0:
+        p.error("--retry-budget must be >= 0")
+    if args.batch_per_chip is None:
+        args.batch_per_chip = PER_CONFIG_BATCH.get(args.config,
+                                                   DEFAULT_BATCH)
+    if args.batch_per_chip < 1:
+        p.error("--batch-per-chip must be >= 1")
     global _REPORT_CLAIMED  # in-process callers may run main() repeatedly
     _REPORT_CLAIMED = False
 
@@ -121,8 +159,17 @@ def main(argv=None):
         if args.mode == "data":
             return _run(args)  # pure host path: no device to retry
         last_err = None
-        retries = max(args.init_retries, 1)
-        for attempt in range(retries):
+        min_attempts = max(args.init_retries, 1)
+        budget = args.retry_budget
+        if budget is None:
+            # Spend (nearly) the whole watchdog window retrying: the
+            # 300 s reserve leaves room for a final attempt's compile +
+            # timed steps to finish before the watchdog fires.
+            budget = max(args.watchdog - 300.0, 0.0) if args.watchdog else 0.0
+        t_start = time.monotonic()
+        attempt = 0
+        while True:
+            attempt += 1
             fail = None
             if args.probe_timeout and _expects_accelerator(args):
                 fail = _probe_backend(args.probe_timeout)
@@ -145,16 +192,29 @@ def main(argv=None):
                     fail = str(e)
                     _reset_backends()
             last_err = fail
+            elapsed = time.monotonic() - t_start
             print(f"bench: device backend unavailable (attempt "
-                  f"{attempt + 1}/{retries}): {fail}",
-                  file=sys.stderr, flush=True)
-            if attempt + 1 < retries:
-                time.sleep(args.init_backoff)
+                  f"{attempt}, {elapsed:.0f}s/{budget:.0f}s budget): "
+                  f"{fail}", file=sys.stderr, flush=True)
+            if attempt >= min_attempts and elapsed >= budget:
+                break
+            # Don't sleep past the retry deadline — but only once the
+            # attempt floor is met: floor attempts keep their full
+            # backoff (spacing is the point of the floor; a zero-sleep
+            # hammer defeats the transient-outage retry).
+            sleep = args.init_backoff
+            if budget and attempt >= min_attempts:
+                sleep = min(sleep, max(budget - elapsed, 0.0))
+            if sleep:
+                time.sleep(sleep)
         # Out of retries: emit the standard JSON line WITH an error field
         # so the driver parses a result either way (round 1 recorded
         # parsed=null when this died with a bare traceback).
+        elapsed = time.monotonic() - t_start
         _report_error(args, f"device backend unavailable after "
-                            f"{retries} attempts: {last_err}")
+                            f"{attempt} attempts over {elapsed:.0f}s "
+                            f"(budget {budget:.0f}s): {last_err}",
+                      attempts=attempt, elapsed_s=round(elapsed, 1))
         return 0
     finally:
         if timer is not None:  # in-process callers outlive the bench
@@ -233,7 +293,7 @@ def _reset_backends() -> None:
         pass
 
 
-def _report_error(args, reason: str) -> bool:
+def _report_error(args, reason: str, **extra) -> bool:
     if not _claim_report():
         return False  # a genuine result line already won the race
     print(json.dumps({
@@ -243,6 +303,7 @@ def _report_error(args, reason: str) -> bool:
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
         "error": reason,
+        **extra,
     }), flush=True)
     return True
 
@@ -440,15 +501,21 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
     base_path = (os.environ.get("DSOD_BENCH_BASELINE")
                  or os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json"))
-    # Batch AND --set overrides are in the key: throughput scales with
-    # batch (dispatch-latency amortisation) and overrides change the
-    # compiled program (remat, kernels), so baselines only compare like
-    # with like.  (Round-2 lesson: a remat-on run seeded b64's key and
-    # every remat-off run then reported a bogus vs_baseline.)
+    # Batch, --set overrides, AND program-affecting env vars are in the
+    # key: throughput scales with batch (dispatch-latency amortisation)
+    # and the others change the compiled program (remat, kernels,
+    # resize impl, flash blocks), so baselines only compare like with
+    # like.  (Round-2 lesson: a remat-on run seeded b64's key and every
+    # remat-off run then reported a bogus vs_baseline; the same class
+    # of contamination applied to DSOD_RESIZE_IMPL=xla A/B legs.)
     key = (f"{args.config}-{args.image_size}-b{args.batch_per_chip}"
            f"-{platform}")
     if args.overrides:
         key += "-" + ",".join(sorted(args.overrides))
+    env_tags = sorted(f"{k}={os.environ[k]}" for k in _PROGRAM_ENV_VARS
+                      if os.environ.get(k))
+    if env_tags:
+        key += "-env:" + ",".join(env_tags)
     if mode != "train":
         key += f"-{mode}"
     base = {}

@@ -69,6 +69,48 @@ def test_bench_zoo_unknown_config_is_visible_error(tmp_path, monkeypatch):
     assert "ERR" in out.read_text()
 
 
+def test_bench_batch_defaults_are_per_config(monkeypatch):
+    """ADVICE r2: a bare ``bench.py --config basnet_ds`` must not
+    default into the flagship's b128 regime (HBM OOM risk on the heavy
+    zoo members) — the default is per-config via PER_CONFIG_BATCH."""
+    import bench
+
+    seen = []
+
+    def record(args):
+        seen.append(args.batch_per_chip)
+        return 0
+
+    monkeypatch.setattr(bench, "_run", record)
+    bench.main(["--device", "cpu", "--probe-timeout", "0"])  # flagship
+    bench.main(["--device", "cpu", "--probe-timeout", "0",
+                "--config", "basnet_ds"])
+    bench.main(["--device", "cpu", "--probe-timeout", "0",
+                "--config", "basnet_ds", "--batch-per-chip", "7"])
+    assert seen == [bench.PER_CONFIG_BATCH["minet_r50_dp"],
+                    bench.DEFAULT_BATCH, 7]
+
+
+def test_bench_baseline_key_includes_program_env_vars(
+        tmp_path, capsys, monkeypatch):
+    """ADVICE r2 (medium): DSOD_RESIZE_IMPL / DSOD_FLASH_BLOCK_* change
+    the compiled program; an A/B leg run with one of them set must not
+    seed the canonical baseline key (bogus vs_baseline later)."""
+    import bench
+
+    monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
+    monkeypatch.setenv("DSOD_RESIZE_IMPL", "xla")
+    rc = bench.main([
+        "--device", "cpu", "--mode", "data", "--steps", "2", "--warmup",
+        "0", "--batch-per-chip", "4", "--image-size", "32",
+        "--set", "data.synthetic_size=16", "--set", "data.num_workers=0",
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    keys = list(json.loads((tmp_path / "base.json").read_text()))
+    assert len(keys) == 1 and "env:DSOD_RESIZE_IMPL=xla" in keys[0]
+
+
 def test_bench_retries_unavailable_then_reports_error_json(
         tmp_path, capsys, monkeypatch):
     """Round-1 postmortem: a transient tunnel outage at backend init
@@ -91,8 +133,11 @@ def test_bench_retries_unavailable_then_reports_error_json(
     # --probe-timeout 0: the subprocess dial probe is exercised against
     # the real transport (it wedges when the tunnel is down — verified
     # live); in CI it would just burn 3 jax-import subprocesses.
+    # --retry-budget 0 pins exactly --init-retries attempts (the
+    # default spends the watchdog window — tested separately below).
     rc = bench.main(["--device", "tpu", "--init-retries", "3",
-                     "--init-backoff", "0", "--probe-timeout", "0"])
+                     "--init-backoff", "0", "--probe-timeout", "0",
+                     "--retry-budget", "0"])
     assert rc == 0
     assert len(calls) == 3
     line = capsys.readouterr().out.strip().splitlines()[-1]
@@ -100,6 +145,34 @@ def test_bench_retries_unavailable_then_reports_error_json(
     assert out["unit"] == "images/sec/chip"
     assert out["value"] == 0.0 and out["vs_baseline"] == 0.0
     assert "UNAVAILABLE" in out["error"]
+    assert out["attempts"] == 3
+
+
+def test_bench_retry_budget_outlasts_attempt_floor(
+        tmp_path, capsys, monkeypatch):
+    """Round-2 postmortem: 5 fixed attempts gave up with 15+ unused
+    watchdog minutes (BENCH_r02 value=0.0 while the tunnel came back
+    later in the session).  The contract now: keep retrying until
+    --retry-budget seconds elapse (default watchdog-300), and record
+    attempts + elapsed in the error line."""
+    import bench
+
+    monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
+    calls = []
+
+    def boom(args):
+        calls.append(1)
+        raise RuntimeError("UNAVAILABLE: tunnel wedged")
+
+    monkeypatch.setattr(bench, "_run", boom)
+    rc = bench.main(["--device", "tpu", "--init-retries", "1",
+                     "--init-backoff", "0.05", "--probe-timeout", "0",
+                     "--retry-budget", "0.3"])
+    assert rc == 0
+    assert len(calls) > 1  # kept going past the attempt floor
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["attempts"] == len(calls)
+    assert out["elapsed_s"] >= 0.3
 
 
 def test_bench_does_not_retry_unrelated_errors(tmp_path, monkeypatch, capsys):

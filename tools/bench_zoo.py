@@ -21,6 +21,10 @@ import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from bench import DEFAULT_BATCH as _DEFAULT_BATCH  # noqa: E402
+from bench import PER_CONFIG_BATCH as ZOO_BATCH  # noqa: E402
 
 ZOO = [
     "minet_vgg16_ref",
@@ -32,12 +36,8 @@ ZOO = [
     "vit_sod_sp",
 ]
 
-# Per-config batch/chip for TPU sweeps.  bench.py's default (128) is
-# the FLAGSHIP's measured optimum; the heavier members (two-stream
-# hdfnet, 89M-param basnet, 7-output u2net) were measured at 32 and
-# b128 risks HBM OOM — keep the sweep comparable round-over-round.
-ZOO_BATCH = {"minet_r50_dp": 128}
-_DEFAULT_BATCH = 32
+# Per-config batch/chip lives in bench.py (PER_CONFIG_BATCH) so direct
+# bench runs and zoo sweeps default identically.
 
 
 def parse_args(argv=None):
@@ -54,6 +54,15 @@ def parse_args(argv=None):
     p.add_argument("--image-size", type=int, default=320)
     p.add_argument("--timeout", type=int, default=1800,
                    help="seconds per (config, mode) subprocess")
+    p.add_argument("--retry-budget", type=float, default=None,
+                   help="forwarded to each bench.py run; pass 0 so a "
+                        "tunnel that wedges MID-SWEEP fails each cell "
+                        "fast instead of burning every remaining cell's "
+                        "full watchdog retrying a known-dead transport")
+    p.add_argument("--init-retries", type=int, default=None,
+                   help="forwarded to each bench.py run")
+    p.add_argument("--init-backoff", type=float, default=None,
+                   help="forwarded to each bench.py run")
     p.add_argument("--out", default=None, help="write the table here too")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="PATH=VALUE", help="forwarded to every run")
@@ -78,6 +87,10 @@ def run_one(cfg_name, mode, args):
     batch = (args.batch_per_chip if args.batch_per_chip is not None
              else ZOO_BATCH.get(cfg_name, _DEFAULT_BATCH))
     cmd += ["--batch-per-chip", str(batch)]
+    for flag in ("retry_budget", "init_retries", "init_backoff"):
+        val = getattr(args, flag)
+        if val is not None:
+            cmd += [f"--{flag.replace('_', '-')}", str(val)]
     for ov in args.overrides:
         cmd += ["--set", ov]
     try:
@@ -112,6 +125,24 @@ def main(argv=None):
         zoo = ([c for c in ZOO if c in wanted]
                + [c for c in wanted if c not in ZOO])
 
+    def render(results):
+        lines = [f"| config | {' | '.join(modes)} |",
+                 f"|---|{'---|' * len(modes)}"]
+        for cfg_name in zoo:
+            cells = []
+            for mode in modes:
+                r = results.get((cfg_name, mode))
+                if r is None:
+                    cells.append("…")
+                else:
+                    cells.append(f"{r['value']:g}" if "value" in r
+                                 else f"ERR: {r['error']}")
+            lines.append(f"| {cfg_name} | {' | '.join(cells)} |")
+        unit = next((r["unit"] for r in results.values() if "unit" in r),
+                    "images/sec/chip")
+        return "\n".join(lines) + f"\n\n(all numbers {unit}; " \
+            f"{args.image_size}px, steps={args.steps})\n"
+
     results = {}
     for cfg_name in zoo:
         for mode in modes:
@@ -119,26 +150,18 @@ def main(argv=None):
             r = run_one(cfg_name, mode, args)
             results[(cfg_name, mode)] = r
             # Emit each row the moment it lands (stderr, like the
-            # progress dots): a sweep killed by an outer timeout must
-            # not take its finished measurements with it — round 2 lost
-            # the first real-TPU zoo table exactly this way and the
-            # numbers had to be dug out of bench_baseline.json seeds.
+            # progress dots) AND flush the partial table to --out: a
+            # sweep killed by an outer timeout must not take its
+            # finished measurements with it — round 2 lost the first
+            # real-TPU zoo table exactly this way and the numbers had
+            # to be dug out of bench_baseline.json seeds.
             print(f"  {cfg_name} [{mode}] -> {json.dumps(r)}",
                   file=sys.stderr, flush=True)
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(render(results))
 
-    lines = [f"| config | {' | '.join(modes)} |",
-             f"|---|{'---|' * len(modes)}"]
-    for cfg_name in zoo:
-        cells = []
-        for mode in modes:
-            r = results[(cfg_name, mode)]
-            cells.append(f"{r['value']:g}" if "value" in r
-                         else f"ERR: {r['error']}")
-        lines.append(f"| {cfg_name} | {' | '.join(cells)} |")
-    unit = next((r["unit"] for r in results.values() if "unit" in r),
-                "images/sec/chip")
-    table = "\n".join(lines) + f"\n\n(all numbers {unit}; " \
-        f"{args.image_size}px, steps={args.steps})\n"
+    table = render(results)
     print(table)
     if args.out:
         with open(args.out, "w") as f:

@@ -1,0 +1,100 @@
+#!/bin/bash
+# Round-3 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh does exactly that).  Ordered by value-per-minute
+# so a short window still resolves the top open questions from
+# VERDICT.md r2 ("Next round" items 1, 3, 4, 6, 9):
+#
+#   1. canonical b128 headline (fresh driver-grade number)
+#   2. resize A/B   — isolate the fast path's share of the +61% headline
+#   3. eval single-dispatch re-measure (b32/b64)
+#   4. profiles     — b128 trace (MFU) + the b64-no-remat cliff
+#   5. b256         — the unexplored right edge of the batch curve
+#   6. flash sweep  — block shapes at N=1024 and N=4096
+#   7. u2net fused A/B
+#   8. zoo sweep    — per-item budgets, swin EVAL EXCLUDED (kills the
+#                     worker; its train row runs separately)
+#   9. LAST: swin eval bisect — known to crash the TPU worker and wedge
+#      the tunnel for hours; nothing may run after it.
+#
+# Every leg is a bounded subprocess; each JSON result is flushed to
+# $R/results.jsonl the moment it lands.  bench.py legs run with
+# --retry-budget 0 --init-retries 2: the watcher only starts us when
+# the tunnel is UP, so a wedge mid-agenda should fail fast and let
+# later (independent) legs try, not eat the window retrying.
+cd "$(dirname "$0")/.." || exit 1
+R=tpu_results3
+mkdir -p $R
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a $R/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> $R/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a $R/agenda.log
+}
+
+# -- 1. canonical headline (b128 default, fast resize, no env tags)
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2. resize A/B (single variable: DSOD_RESIZE_IMPL; baseline keys
+#       are env-tagged now, so the xla legs cannot poison canonical keys)
+export DSOD_RESIZE_IMPL=xla
+run rsz_xla_b128  900 $BENCH --config minet_r50_dp
+run rsz_xla_b128r 900 $BENCH --config minet_r50_dp --set model.remat=true
+run rsz_xla_b32   900 $BENCH --config minet_r50_dp --batch-per-chip 32
+unset DSOD_RESIZE_IMPL
+run rsz_fast_b128r 900 $BENCH --config minet_r50_dp --set model.remat=true
+run rsz_fast_b32   900 $BENCH --config minet_r50_dp --batch-per-chip 32
+
+# -- 3. eval single-dispatch re-measure (round-2 two-dispatch numbers:
+#       248.30 @ b32 / 365.07 @ b64)
+run eval_b32 900 $BENCH --config minet_r50_dp --mode eval --batch-per-chip 32
+run eval_b64 900 $BENCH --config minet_r50_dp --mode eval --batch-per-chip 64
+
+# -- 4. profiles: the b128 best (MFU push) and the b64-no-remat cliff
+run prof_b128 900 $BENCH --config minet_r50_dp --profile-dir $R/trace_b128
+run prof_b64  900 $BENCH --config minet_r50_dp --batch-per-chip 64 --profile-dir $R/trace_b64
+
+# -- 5. past-b128 exploration (round-2 b256 attempt died >900s; give it
+#       a real compile budget and record timeout-as-answer otherwise)
+run b256_remat 1600 python bench.py --device tpu --steps 20 --watchdog 1500 \
+    --retry-budget 0 --init-retries 2 --config minet_r50_dp \
+    --batch-per-chip 256 --set model.remat=true
+run b256 1600 python bench.py --device tpu --steps 20 --watchdog 1500 \
+    --retry-budget 0 --init-retries 2 --config minet_r50_dp --batch-per-chip 256
+
+# -- 6. flash block sweep (fwd+bwd then fwd-only; short and long N)
+run flash_1k     900 python tools/bench_flash.py --shape 12,1024,64 --iters 20
+run flash_1k_fwd 900 python tools/bench_flash.py --shape 12,1024,64 --iters 20 --fwd-only
+run flash_4k    1200 python tools/bench_flash.py --shape 12,4096,64 --iters 10 \
+    --blocks 128/128,256/1024,512/1024,512/2048
+run flash_4k_noxla 1200 python tools/bench_flash.py --shape 12,4096,64 --iters 10 \
+    --blocks 128/128,256/1024,512/1024,512/2048 --no-xla --fwd-only
+
+# -- 7. u2net fused-loss A/B (never A/B'd on hardware)
+run u2net_fused_off 900 $BENCH --config u2net_ds --set loss.fused_kernel=false
+run u2net_fused_on  900 $BENCH --config u2net_ds
+
+# -- 8. zoo sweep: per-item budget 600 s, partial table flushed per row.
+#       swin_sod EVAL excluded (crashes the worker — round-2 zoo.log);
+#       its train row runs via --modes train.
+run zoo_noswin 9000 python tools/bench_zoo.py --device tpu --timeout 600 \
+    --retry-budget 0 --init-retries 2 \
+    --configs minet_vgg16_ref,minet_r50_dp,hdfnet_rgbd,u2net_ds,basnet_ds,vit_sod_sp \
+    --modes train,eval --out $R/zoo_table.md
+run zoo_swin_train 1200 python tools/bench_zoo.py --device tpu --timeout 900 \
+    --retry-budget 0 --init-retries 2 \
+    --configs swin_sod --modes train --out $R/zoo_swin_train.md
+
+# -- 9. LAST: the swin eval bisect. Known to kill the TPU worker; the
+#       tunnel may be unusable for hours afterwards.
+echo "=== swin_bisect [$(date -u +%H:%M:%S)] — NOTHING runs after this" | tee -a $R/agenda.log
+timeout 2400 python tools/bisect_swin_eval.py > $R/swin_bisect.out 2> $R/swin_bisect.err
+echo "{\"step\": \"swin_bisect\", \"rc\": $?}" >> $R/results.jsonl
+tail -40 $R/swin_bisect.out | tee -a $R/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a $R/agenda.log
