@@ -43,6 +43,8 @@ _PROGRAM_ENV_VARS = (
     "DSOD_RESIZE_IMPL",
     "DSOD_FLASH_BLOCK_Q",
     "DSOD_FLASH_BLOCK_KV",
+    "DSOD_STEM_IMPL",
+    "DSOD_DLF_VMEM_MB",
 )
 
 

@@ -94,7 +94,19 @@ class ResNet(nn.Module):
             param_dtype=self.param_dtype,
         )
         feats: List[jnp.ndarray] = []
-        x = ConvBNAct(64, (7, 7), strides=2, **kw)(x, train)
+        # DSOD_STEM_IMPL=s2d: compute the stem as space-to-depth + 4×4
+        # conv (layers.SpaceToDepthStem) — same arithmetic, same param
+        # tree, TPU-friendlier tiling.  Env-knob A/B like
+        # DSOD_RESIZE_IMPL (bench.py keys baselines on it).
+        import os
+
+        if (os.environ.get("DSOD_STEM_IMPL") == "s2d"
+                and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0):
+            from ..layers import SpaceToDepthStem
+
+            x = SpaceToDepthStem(64, name="ConvBNAct_0", **kw)(x, train)
+        else:
+            x = ConvBNAct(64, (7, 7), strides=2, **kw)(x, train)
         feats.append(x)  # stride 2
         # padding (1,1), not SAME: matches torch MaxPool2d(3,2,1) so
         # ported ImageNet weights see the alignment they trained with.

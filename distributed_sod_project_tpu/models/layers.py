@@ -75,6 +75,80 @@ def max_pool(x, window: int = 2, stride: int = 2):
     return nn.max_pool(x, (window, window), strides=(stride, stride), padding="SAME")
 
 
+class _S2DConv7x7(nn.Module):
+    """7×7/stride-2 conv computed as space-to-depth + 4×4/stride-1.
+
+    The MLPerf-ResNet TPU trick: a stride-2 conv on a 3-channel
+    full-res image keeps the MXU's 128-lane input dimension 97% idle
+    and streams the largest activation in the network from HBM.
+    Re-expressing it over the 2×2-block space-to-depth input
+    ([B,H/2,W/2,12]) quadruples the contraction depth and quarters the
+    streamed rows, with IDENTICAL arithmetic: the stored parameter
+    stays the standard ``kernel`` [7,7,C,F] (checkpoint- and
+    weight-port-compatible), padded to 8×8 with a leading zero row/col
+    and regrouped at trace time so tap (u,v) lands on the s2d channel
+    of its parity.  Derivation: with torch padding 3, tap u = 2p+a−1
+    reads x[2(i+p−2)+a] = s2d row i+p−2, parity a — hence the 4-tap
+    kernel and explicit (2,1) padding.  Bit-equivalence vs the plain
+    stem is asserted in tests/test_models.py.
+    """
+
+    features: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        import jax.lax as lax
+
+        b, h, w, c = x.shape
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (7, 7, c, self.features), self.param_dtype)
+        k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        k = (k.reshape(4, 2, 4, 2, c, self.features)
+             .transpose(0, 2, 1, 3, 4, 5)
+             .reshape(4, 4, 4 * c, self.features))
+        x2 = (x.reshape(b, h // 2, 2, w // 2, 2, c)
+              .transpose(0, 1, 3, 2, 4, 5)
+              .reshape(b, h // 2, w // 2, 4 * c))
+        return lax.conv_general_dilated(
+            x2.astype(self.dtype), k.astype(self.dtype),
+            window_strides=(1, 1), padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class SpaceToDepthStem(nn.Module):
+    """Drop-in for ``ConvBNAct(F, (7,7), strides=2)`` with the conv
+    computed via :class:`_S2DConv7x7`.  Instantiate with
+    ``name="ConvBNAct_0"`` so the param tree is indistinguishable from
+    the plain stem (children ``Conv_0`` / ``BatchNorm_0``) — a
+    checkpoint trained either way restores into the other."""
+
+    features: int
+    axis_name: Optional[str] = None
+    bn_momentum: float = 0.9
+    act: Optional[Callable] = nn.relu
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _S2DConv7x7(self.features, dtype=self.dtype,
+                        param_dtype=self.param_dtype, name="Conv_0")(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            axis_name=self.axis_name if train else None,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="BatchNorm_0",
+        )(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
 def _upsample_axis(x, axis: int, s: int):
     """Integer-factor bilinear upsample along one spatial axis.
 

@@ -44,13 +44,34 @@ from jax.experimental.pallas import tpu as pltpu
 # kernel maps add T·H·W on top).
 _MAX_TILE_ELEMS = 2 * 1024 * 1024
 
-# Raise the per-kernel scoped-VMEM ceiling past the 16 MB default.
-# First real-v5e exposure (round 2): at (32,80,80,64)·bf16, XLA's
-# memory-space assignment parked the custom call's full output in VMEM
-# (S(1) layout) and the compile died against the 16 MB scoped limit
-# even though the per-grid-step windows are <2 MB.  v5e has 128 MB of
-# VMEM; 100 MB headroom compiles and runs fwd+bwd at batch 128.
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+def _compiler_params() -> pltpu.CompilerParams:
+    """Per-kernel scoped-VMEM ceiling, gated on the device generation.
+
+    First real-v5e exposure (round 2): at (32,80,80,64)·bf16, XLA's
+    memory-space assignment parked the custom call's full output in
+    VMEM (S(1) layout) and the compile died against the 16 MB scoped
+    limit even though the per-grid-step windows are <2 MB.  v5e has
+    128 MB of VMEM; 100 MB headroom compiles and runs fwd+bwd at batch
+    128.  Earlier generations (v2/v3: ~16 MB/core) would FAIL to
+    compile with a scoped limit past physical VMEM, so the raise only
+    applies where the hardware has it; ``DSOD_DLF_VMEM_MB`` overrides
+    either way (0 = compiler default).
+    """
+    import os
+
+    env = os.environ.get("DSOD_DLF_VMEM_MB")
+    if env is not None:
+        mb = int(env)
+        return (pltpu.CompilerParams() if mb <= 0
+                else pltpu.CompilerParams(vmem_limit_bytes=mb * 1024 * 1024))
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        kind = ""
+    big_vmem = any(tag in kind for tag in ("v5", "v6", "lite"))
+    if big_vmem:
+        return pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+    return pltpu.CompilerParams()
 
 
 def _taps(ksize: int, dilation: int):
@@ -118,7 +139,7 @@ def _call_filter(x, kt, ksize, dilation, interpret):
             flops=2 * b * h * w * c * len(taps), transcendentals=0,
             bytes_accessed=(2 * x.size + kt.size) * 4),
         interpret=interpret,
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )(xp, kt)
 
 
@@ -147,7 +168,7 @@ def _dlf_bwd(ksize, dilation, interpret, res, g):
         out_specs=_img_spec((h, w, c)),
         out_shape=jax.ShapeDtypeStruct((b, h, w, c), x.dtype),
         interpret=interpret,
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )(gp, ktp)
 
     xp = _pad_hw(x, r)
@@ -158,7 +179,7 @@ def _dlf_bwd(ksize, dilation, interpret, res, g):
         out_specs=_img_spec((t, h, w)),
         out_shape=jax.ShapeDtypeStruct((b, t, h, w), jnp.float32),
         interpret=interpret,
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )(xp, g)
     return dx, dk
 

@@ -39,6 +39,38 @@ def test_resnet50_pyramid_shapes():
     ]
 
 
+def test_resnet_s2d_stem_matches_plain_stem(monkeypatch):
+    """DSOD_STEM_IMPL=s2d (layers.SpaceToDepthStem) is an
+    arithmetic-identical re-tiling of the 7×7/2 stem: same param tree
+    (init AND restore interchange), same outputs to conv-reassociation
+    tolerance.  Guards the kernel-regroup/padding derivation."""
+    m = ResNet50()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 64, 64, 3),
+                    jnp.float32)
+
+    monkeypatch.delenv("DSOD_STEM_IMPL", raising=False)
+    v_plain = m.init(jax.random.key(0), x)
+    feats_plain = m.apply(v_plain, x)
+
+    monkeypatch.setenv("DSOD_STEM_IMPL", "s2d")
+    v_s2d = m.init(jax.random.key(0), x)
+    # Identical param trees — same paths, shapes, AND init values (the
+    # RNG folds over the same "ConvBNAct_0/Conv_0/kernel" path).
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        v_plain, v_s2d)
+    feats_s2d = m.apply(v_plain, x)  # plain-trained params, s2d compute
+    for fp, fs in zip(feats_plain, feats_s2d):
+        np.testing.assert_allclose(np.asarray(fp), np.asarray(fs),
+                                   rtol=1e-4, atol=1e-4)
+
+    # Odd spatial size: falls back to the plain stem (no s2d possible).
+    x_odd = jnp.zeros((1, 63, 63, 3))
+    v_odd = m.init(jax.random.key(0), x_odd)
+    assert m.apply(v_odd, x_odd)[0].shape == (1, 32, 32, 64)
+
+
 def test_resnet34_pyramid_shapes():
     m = ResNet34()
     x = jnp.zeros((1, 64, 64, 3))

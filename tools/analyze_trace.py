@@ -187,10 +187,25 @@ def main(argv=None) -> int:
         return 1
     print(f"xspace files: {[os.path.basename(x) for x in xspaces]}")
 
-    from xprof.convert import raw_to_tool_data
+    # Degrade, don't traceback (the module docstring's promise): xprof
+    # ships with the jax profiler deps and its layout has moved between
+    # releases — a missing/changed package must not crash --list-tools.
+    # Tool enumeration failing is fatal only for the flags that need
+    # it; the default overview path still runs (its extractors degrade
+    # one by one).
+    try:
+        from xprof.convert import raw_to_tool_data
 
-    names = [n.rstrip("^@")
-             for n in raw_to_tool_data.xspace_to_tool_names(xspaces)]
+        names = [n.rstrip("^@")
+                 for n in raw_to_tool_data.xspace_to_tool_names(xspaces)]
+    except Exception as e:  # noqa: BLE001 — import/layout drift
+        print(f"[xprof tool conversion unavailable "
+              f"({type(e).__name__}: {e}); install the jax profiler "
+              f"deps (xprof / tensorboard-plugin-profile)]",
+              file=sys.stderr)
+        if args.list_tools or args.tool or args.dump_json:
+            return 1
+        names = []
     if args.list_tools:
         print("\n".join(names))
         return 0

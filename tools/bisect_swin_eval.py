@@ -2,35 +2,50 @@
 """Bisect the swin_sod EVAL TPU-worker crash (round-2 session 3).
 
 ``bench.py --config swin_sod --mode eval`` crashed the v5e worker
-twice ("kernel fault"; the train step is fine, and eval of every other
-zoo member is fine).  This drives the eval program's pieces one at a
-time IN SUBPROCESSES so the crashing stage is identified without
-taking down the parent, smallest first:
+twice ("kernel fault", tpu_results/zoo.log); the train step is fine,
+and eval of every other zoo member is fine.  The train/eval program
+differences are small and enumerable, so each stage below isolates one
+of them, IN A SUBPROCESS, smallest program first:
+
+  metrics_only    the 256-bin scatter-add metric update, no model
+  backbone        SwinT forward alone (ignores train — shared by the
+                  working train step)
+  fwd_b1          full model, train=False, batch 1
+  fwd             full model, train=False, eval batch
+  fwd_trainflag   full model, train=True + mutable BN (the working
+                  train step's forward, minus grad) — isolates the
+                  running-average-BN vs batch-BN program difference
+  eval_step       make_eval_step (shard_map + sigmoid)
+  eval_xla_resize eval_step with DSOD_RESIZE_IMPL=xla — isolates the
+                  round-2 slice/lerp resize fast path
+  eval_metrics    eval_step + metric update, the reproduced crasher —
+                  LAST: a worker kill can wedge the tunnel for hours
+
+After any CRASHED/WEDGED stage the tool re-probes the backend
+out-of-process; if the tunnel is dead it STOPS and reports, rather
+than burning 900 s per remaining stage against a wedged transport.
 
     python tools/bisect_swin_eval.py            # all stages
     python tools/bisect_swin_eval.py --stage fwd_b1
-
-Each stage prints CRASHED/OK plus the tail of stderr on failure.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import subprocess
 import sys
 
-_STAGES = {}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+_PLATFORM = """
+import jax
+{platform_select}
+"""
 
-def _stage(name):
-    def deco(src):
-        _STAGES[name] = src
-        return src
-    return deco
-
-
-_PRELUDE = """
-import jax, jax.numpy as jnp, numpy as np
+_PRELUDE = _PLATFORM + """
+import jax.numpy as jnp, numpy as np
 from distributed_sod_project_tpu.configs import get_config, apply_overrides
 from distributed_sod_project_tpu.models import build_model
 from distributed_sod_project_tpu.parallel.mesh import (
@@ -39,16 +54,16 @@ from distributed_sod_project_tpu.train import (
     build_optimizer, create_train_state)
 from distributed_sod_project_tpu.train.state import TrainState
 
-B = {batch}
+B = max({batch}, jax.device_count())  # batch must shard over the mesh
 cfg = get_config("swin_sod")
 cfg = apply_overrides(cfg, [f"global_batch_size={{B}}",
-                            "data.image_size=320,320"])
+                            "data.image_size={hw},{hw}"])
 mesh = make_mesh(cfg.mesh)
 model = build_model(cfg.model)
 rng = np.random.RandomState(0)
 batch = {{
-    "image": rng.randn(B, 320, 320, 3).astype(np.float32),
-    "mask": (rng.rand(B, 320, 320, 1) > 0.5).astype(np.float32),
+    "image": rng.randn(B, {hw}, {hw}, 3).astype(np.float32),
+    "mask": (rng.rand(B, {hw}, {hw}, 1) > 0.5).astype(np.float32),
 }}
 tx, _ = build_optimizer(cfg.optim, 100)
 state = create_train_state(jax.random.key(0), model, tx, batch)
@@ -58,17 +73,59 @@ state = jax.device_put(state, replicated_sharding(mesh))
 dev = jax.device_put(batch, batch_sharding(mesh))
 """
 
-# Plain forward, no eval-step machinery.
-_STAGES["fwd_b1"] = _PRELUDE + """
+# No model at all: just the scatter-add metric kernel on random probs.
+_METRICS_ONLY = _PLATFORM + """
+import jax.numpy as jnp, numpy as np
+from distributed_sod_project_tpu.metrics.streaming import (
+    init_fbeta_state, update_fbeta_state)
+B = {batch}
+rng = np.random.RandomState(0)
+probs = jnp.asarray(rng.rand(B, {hw}, {hw}).astype(np.float32))
+gt = jnp.asarray((rng.rand(B, {hw}, {hw}, 1) > 0.5).astype(np.float32))
+upd = jax.jit(update_fbeta_state, donate_argnums=0)
+acc = init_fbeta_state()
+for _ in range(3):
+    acc = upd(acc, probs, gt)
+print("metrics ok", float(acc.mae_sum))
+"""
+
+_BACKBONE = _PLATFORM + """
+import jax.numpy as jnp, numpy as np
+from distributed_sod_project_tpu.models.backbones.swin import SwinT
+B = {batch}
+rng = np.random.RandomState(0)
+img = jnp.asarray(rng.randn(B, {hw}, {hw}, 3).astype(np.float32))
+bb = SwinT(dtype=jnp.bfloat16)
+vars_ = bb.init(jax.random.key(0), img)
+fn = jax.jit(lambda v, x: [f.astype(jnp.float32).sum()
+                           for f in bb.apply(v, x)])
+print("backbone ok", [float(s) for s in fn(vars_, img)])
+"""
+
+_FWD = _PRELUDE + """
 fn = jax.jit(lambda s, b: model.apply(
-    {"params": s.params, "batch_stats": s.batch_stats},
+    {{"params": s.params, "batch_stats": s.batch_stats}},
     b["image"], None, train=False)[0])
 out = fn(state, dev)
 print("fwd ok", float(out.astype(jnp.float32).sum()))
 """
 
-# The real eval step (sigmoid probs) without metric accumulation.
-_STAGES["eval_step"] = _PRELUDE + """
+# The working train step's forward (train=True + mutable BN), no grad:
+# if this passes where fwd crashes, the BN running-average program
+# difference is implicated.
+_FWD_TRAINFLAG = _PRELUDE + """
+def f(s, b):
+    outs, _ = model.apply(
+        {{"params": s.params, "batch_stats": s.batch_stats}},
+        b["image"], None, train=True, mutable=["batch_stats"],
+        rngs={{"dropout": jax.random.key(0)}})
+    return outs[0]
+fn = jax.jit(f)
+out = fn(state, dev)
+print("fwd trainflag ok", float(out.astype(jnp.float32).sum()))
+"""
+
+_EVAL_STEP = _PRELUDE + """
 from distributed_sod_project_tpu.train.step import make_eval_step
 estep = make_eval_step(model, mesh)
 probs = estep(state, dev)
@@ -76,8 +133,8 @@ print("eval step ok", float(probs.astype(jnp.float32).sum()))
 """
 
 # Eval step + device-side metric accumulation (what bench --mode eval
-# times, and what crashed).
-_STAGES["eval_metrics"] = _PRELUDE + """
+# timed in round 2, and what crashed).
+_EVAL_METRICS = _PRELUDE + """
 from distributed_sod_project_tpu.train.step import make_eval_step
 from distributed_sod_project_tpu.metrics.streaming import (
     init_fbeta_state, update_fbeta_state)
@@ -90,32 +147,95 @@ for _ in range(3):
 print("eval+metrics ok", float(acc.mae_sum))
 """
 
+# (name, source, extra_env, batch_override) — order = smallest program
+# first; the known crasher stays LAST.
+_STAGES = [
+    ("metrics_only", _METRICS_ONLY, {}, None),
+    ("backbone", _BACKBONE, {}, None),
+    ("fwd_b1", _FWD, {}, 1),
+    ("fwd", _FWD, {}, None),
+    ("fwd_trainflag", _FWD_TRAINFLAG, {}, None),
+    ("eval_step", _EVAL_STEP, {}, None),
+    ("eval_xla_resize", _EVAL_STEP, {"DSOD_RESIZE_IMPL": "xla"}, None),
+    ("eval_metrics", _EVAL_METRICS, {}, None),
+]
+
+
+def _probe_backend(timeout: float = 90.0) -> bool:
+    """Out-of-process dial: is the TPU still answering?"""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout, cwd=_REPO)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and ("tpu" in r.stdout.lower()
+                                  or "axon" in r.stdout.lower())
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--stage", default=None, choices=sorted(_STAGES))
-    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--stage", default=None,
+                   choices=[n for n, *_ in _STAGES])
+    p.add_argument("--batch", type=int, default=32,
+                   help="eval batch (round-2 crash was at the zoo's 32)")
+    p.add_argument("--image-size", type=int, default=320)
+    p.add_argument("--device", default="tpu", choices=["tpu", "cpu"],
+                   help="cpu = smoke-test THIS TOOL's machinery on tiny "
+                        "shapes (platform picked via config.update so a "
+                        "wedged tunnel is never dialled); the bisect "
+                        "itself is tpu")
     p.add_argument("--timeout", type=int, default=900)
+    p.add_argument("--json-out", default=None,
+                   help="write a {stage: verdict} summary here")
     args = p.parse_args(argv)
 
-    names = [args.stage] if args.stage else list(_STAGES)
-    for name in names:
-        src = _STAGES[name].format(batch=args.batch)
-        print(f"== {name} (b={args.batch})", flush=True)
+    platform_select = (
+        'jax.config.update("jax_platforms", "cpu")'
+        if args.device == "cpu" else "")
+    stages = [(n, s, e, b) for n, s, e, b in _STAGES
+              if args.stage in (None, n)]
+    verdicts = {}
+    for name, src, extra_env, b_over in stages:
+        b = b_over if b_over is not None else args.batch
+        src = src.format(batch=b, hw=args.image_size,
+                         platform_select=platform_select)
+        env = dict(os.environ, **extra_env)
+        print(f"== {name} (b={b}{', ' if extra_env else ''}"
+              f"{' '.join(f'{k}={v}' for k, v in extra_env.items())})",
+              flush=True)
         try:
             r = subprocess.run([sys.executable, "-c", src],
-                               capture_output=True, text=True,
-                               timeout=args.timeout)
+                               capture_output=True, text=True, env=env,
+                               timeout=args.timeout, cwd=_REPO)
         except subprocess.TimeoutExpired:
-            print("   WEDGED (timeout)")
-            continue
-        if r.returncode == 0:
-            print("   OK:", (r.stdout or "").strip().splitlines()[-1:])
+            verdicts[name] = "WEDGED"
+            print("   WEDGED (timeout)", flush=True)
         else:
-            tail = (r.stderr or "").strip().splitlines()[-6:]
-            print(f"   CRASHED rc={r.returncode}")
-            for line in tail:
-                print("   |", line[:200])
+            if r.returncode == 0:
+                verdicts[name] = "OK"
+                print("   OK:", (r.stdout or "").strip().splitlines()[-1:],
+                      flush=True)
+            else:
+                verdicts[name] = f"CRASHED rc={r.returncode}"
+                print(f"   CRASHED rc={r.returncode}", flush=True)
+                for line in (r.stderr or "").strip().splitlines()[-8:]:
+                    print("   |", line[:200], flush=True)
+        if (verdicts[name] != "OK" and len(stages) > 1
+                and args.device == "tpu"):
+            # A worker kill can take the whole tunnel with it; do not
+            # spend 900 s per remaining stage on a dead transport.
+            if not _probe_backend():
+                print("!! backend no longer answering — stopping bisect "
+                      "(remaining stages would only measure the wedge)",
+                      flush=True)
+                verdicts["_aborted"] = "backend dead after failure"
+                break
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(verdicts, f, indent=2)
+    print(json.dumps(verdicts), flush=True)
     return 0
 
 

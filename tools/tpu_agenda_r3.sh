@@ -59,6 +59,14 @@ run eval_b64 900 $BENCH --config minet_r50_dp --mode eval --batch-per-chip 64
 run prof_b128 900 $BENCH --config minet_r50_dp --profile-dir $R/trace_b128
 run prof_b64  900 $BENCH --config minet_r50_dp --batch-per-chip 64 --profile-dir $R/trace_b64
 
+# -- 4b. space-to-depth stem A/B (arithmetic-identical stem re-tiling;
+#        the round-2 profile put 69% of op time in HBM-bound conv
+#        fusions and the stem streams the largest activation)
+export DSOD_STEM_IMPL=s2d
+run s2d_b128 900 $BENCH --config minet_r50_dp
+run s2d_b32  900 $BENCH --config minet_r50_dp --batch-per-chip 32
+unset DSOD_STEM_IMPL
+
 # -- 5. past-b128 exploration (round-2 b256 attempt died >900s; give it
 #       a real compile budget and record timeout-as-answer otherwise)
 run b256_remat 1600 python bench.py --device tpu --steps 20 --watchdog 1500 \
@@ -93,7 +101,7 @@ run zoo_swin_train 1200 python tools/bench_zoo.py --device tpu --timeout 900 \
 # -- 9. LAST: the swin eval bisect. Known to kill the TPU worker; the
 #       tunnel may be unusable for hours afterwards.
 echo "=== swin_bisect [$(date -u +%H:%M:%S)] — NOTHING runs after this" | tee -a $R/agenda.log
-timeout 2400 python tools/bisect_swin_eval.py > $R/swin_bisect.out 2> $R/swin_bisect.err
+timeout 2400 python tools/bisect_swin_eval.py --json-out $R/swin_bisect.json > $R/swin_bisect.out 2> $R/swin_bisect.err
 echo "{\"step\": \"swin_bisect\", \"rc\": $?}" >> $R/results.jsonl
 tail -40 $R/swin_bisect.out | tee -a $R/agenda.log
 

@@ -184,12 +184,26 @@ def main(argv=None):
             json.dump(results, f, indent=2)
 
     if "zoo" not in skip:
+        # Per-item budget (round-2 lesson: one flat 4x bound let a
+        # single stage eat 80 minutes and time out the whole table);
+        # the partial table flushes to zoo_table.md after every row.
+        # swin_sod eval is EXCLUDED — it crashes the TPU worker and can
+        # wedge the tunnel for hours (tpu_results/zoo.log); its train
+        # row runs via the bisect/agenda tooling instead.
+        per_item = max(args.step_timeout // 2, 120)
+        zoo_configs = ["minet_vgg16_ref", "minet_r50_dp", "hdfnet_rgbd",
+                       "u2net_ds", "basnet_ds", "vit_sod_sp"]
+        zoo_modes = ["train", "eval"]
+        n_items = len(zoo_configs) * len(zoo_modes)
         _run("zoo", [py, "tools/bench_zoo.py", "--device", args.device,
-                     "--modes", "train,eval", "--steps", str(args.steps),
-                     "--image-size", hw,
+                     "--modes", ",".join(zoo_modes),
+                     "--steps", str(args.steps),
+                     "--image-size", hw, "--timeout", str(per_item),
+                     "--retry-budget", "0", "--init-retries", "2",
+                     "--configs", ",".join(zoo_configs),
                      *([] if not smoke else ["--batch-per-chip", "1"]),
                      "--out", os.path.join(args.out, "zoo_table.md")],
-             args.out, 4 * args.step_timeout, results)
+             args.out, n_items * per_item + 300, results)
         with open(os.path.join(args.out, "results.json"), "w") as f:
             json.dump(results, f, indent=2)
 
