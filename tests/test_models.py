@@ -45,7 +45,7 @@ def test_resnet_s2d_stem_matches_plain_stem(monkeypatch):
     (init AND restore interchange), same outputs to conv-reassociation
     tolerance.  Guards the kernel-regroup/padding derivation."""
     m = ResNet50()
-    x = jnp.asarray(np.random.RandomState(0).randn(2, 64, 64, 3),
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 48, 48, 3),
                     jnp.float32)
 
     monkeypatch.delenv("DSOD_STEM_IMPL", raising=False)
@@ -66,9 +66,10 @@ def test_resnet_s2d_stem_matches_plain_stem(monkeypatch):
                                    rtol=1e-4, atol=1e-4)
 
     # Odd spatial size: falls back to the plain stem (no s2d possible).
-    x_odd = jnp.zeros((1, 63, 63, 3))
-    v_odd = m.init(jax.random.key(0), x_odd)
-    assert m.apply(v_odd, x_odd)[0].shape == (1, 32, 32, 64)
+    # Fully-convolutional → reuse the same params, no third init.
+    x_odd = jnp.asarray(np.random.RandomState(1).randn(1, 47, 47, 3),
+                        jnp.float32)
+    assert m.apply(v_plain, x_odd)[0].shape == (1, 24, 24, 64)
 
 
 def test_resnet34_pyramid_shapes():
@@ -317,9 +318,15 @@ def test_swin_nondivisible_input_padding():
 
 @pytest.mark.parametrize("shape,hw", [
     ((2, 10, 10, 3), (20, 20)),   # 2x up (every decoder stage)
-    ((1, 5, 5, 2), (40, 40)),     # 8x up (deep-supervision heads)
-    ((2, 16, 16, 3), (8, 8)),     # 2x antialiased down (AIM below)
-    ((2, 12, 8, 3), (6, 16)),     # mixed: down2 in H, up2 in W
+    # One representative case stays in the quick gate; each extra case
+    # costs ~10 s of cold XLA compile (resize oracle + fast path) and
+    # they guard the same slice/lerp math — full suite runs them all.
+    pytest.param((1, 5, 5, 2), (40, 40),      # 8x up (deep-sup heads)
+                 marks=pytest.mark.slow),
+    pytest.param((2, 16, 16, 3), (8, 8),      # 2x antialiased down
+                 marks=pytest.mark.slow),
+    pytest.param((2, 12, 8, 3), (6, 16),      # mixed down2-H / up2-W
+                 marks=pytest.mark.slow),
     ((1, 9, 9, 1), (3, 3)),       # non-integer factor -> fallback
 ])
 def test_resize_fast_path_matches_jax_image(shape, hw):

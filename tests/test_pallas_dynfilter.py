@@ -19,7 +19,14 @@ def _xk(b=2, h=12, w=16, c=8, ksize=3, seed=0):
     return x, k
 
 
-@pytest.mark.parametrize("ksize,dilation", [(3, 1), (3, 2), (3, 4), (5, 1)])
+@pytest.mark.parametrize("ksize,dilation", [
+    (3, 1),
+    # HDFNet's other dilation branches exercise the same shifted-FMA
+    # kernel; each costs ~10 s cold compile — full suite only.
+    pytest.param(3, 2, marks=pytest.mark.slow),
+    pytest.param(3, 4, marks=pytest.mark.slow),
+    pytest.param(5, 1, marks=pytest.mark.slow),
+])
 def test_forward_and_grads_match_im2col(ksize, dilation):
     x, k = _xk(ksize=ksize)
     out = fused_dynamic_filter(x, k, ksize, dilation)

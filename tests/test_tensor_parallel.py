@@ -132,6 +132,7 @@ def test_param_specs_fall_back_on_indivisible_axes(eight_devices):
 # ---------------------------------------------------------- ZeRO-1
 
 
+@pytest.mark.slow
 def test_zero1_shards_opt_state_and_matches_oracle(eight_devices):
     """ZeRO-1 (arXiv 2004.13336 style): optimizer/EMA buffers shard
     over ``data``; the math equals the unsharded GSPMD step."""

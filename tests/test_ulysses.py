@@ -99,6 +99,7 @@ def test_sp_step_ulysses_matches_single_device(eight_devices):
                                rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_sp_eval_step_ulysses_matches_single_device(eight_devices):
     """Forward-only SP with the all-to-all strategy equals the
     single-device sigmoid forward (mirrors the ring eval test)."""
