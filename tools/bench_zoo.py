@@ -32,6 +32,7 @@ ZOO = [
     "hdfnet_rgbd",
     "u2net_ds",
     "basnet_ds",
+    "gatenet_vgg16",
     "swin_sod",
     "vit_sod_sp",
 ]

@@ -92,7 +92,7 @@ run u2net_fused_on  900 $BENCH --config u2net_ds
 #       its train row runs via --modes train.
 run zoo_noswin 9000 python tools/bench_zoo.py --device tpu --timeout 600 \
     --retry-budget 0 --init-retries 2 \
-    --configs minet_vgg16_ref,minet_r50_dp,hdfnet_rgbd,u2net_ds,basnet_ds,vit_sod_sp \
+    --configs minet_vgg16_ref,minet_r50_dp,hdfnet_rgbd,u2net_ds,basnet_ds,gatenet_vgg16,vit_sod_sp \
     --modes train,eval --out $R/zoo_table.md
 run zoo_swin_train 1200 python tools/bench_zoo.py --device tpu --timeout 900 \
     --retry-budget 0 --init-retries 2 \

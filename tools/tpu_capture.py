@@ -191,8 +191,13 @@ def main(argv=None):
         # wedge the tunnel for hours (tpu_results/zoo.log); its train
         # row runs via the bisect/agenda tooling instead.
         per_item = max(args.step_timeout // 2, 120)
-        zoo_configs = ["minet_vgg16_ref", "minet_r50_dp", "hdfnet_rgbd",
-                       "u2net_ds", "basnet_ds", "vit_sod_sp"]
+        # One source of truth for zoo membership (minus the
+        # worker-killing swin eval); tpu_agenda_r3.sh is the only
+        # remaining manual copy (shell can't import).
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        from bench_zoo import ZOO
+
+        zoo_configs = [c for c in ZOO if c != "swin_sod"]
         zoo_modes = ["train", "eval"]
         n_items = len(zoo_configs) * len(zoo_modes)
         _run("zoo", [py, "tools/bench_zoo.py", "--device", args.device,
