@@ -69,6 +69,29 @@ def test_bench_zoo_unknown_config_is_visible_error(tmp_path, monkeypatch):
     assert "ERR" in out.read_text()
 
 
+def test_zoo_sweep_covers_every_registered_config():
+    """Every registered experiment config must be in bench_zoo.ZOO or
+    in the explicit exclusion list below — GateNet sat registered but
+    silently absent from the hardware sweep for a whole round, and a
+    missing row reads as 'covered' in the zoo table."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import bench_zoo
+
+    from distributed_sod_project_tpu.configs import list_configs
+
+    excluded = {
+        # Variant of vit_sod_sp at 512px whose distinguishing knobs
+        # (flash attention, hires memory posture) are A/B'd by the
+        # dedicated flash legs in tools/tpu_capture.py / the agenda.
+        "vit_sod_hires",
+    }
+    missing = set(list_configs()) - set(bench_zoo.ZOO) - excluded
+    assert not missing, (
+        f"configs registered but absent from bench_zoo.ZOO and not "
+        f"explicitly excluded: {sorted(missing)}")
+
+
 def test_bench_batch_defaults_are_per_config(monkeypatch):
     """ADVICE r2: a bare ``bench.py --config basnet_ds`` must not
     default into the flagship's b128 regime (HBM OOM risk on the heavy

@@ -48,6 +48,12 @@ def parse_args(argv=None):
                    help="comma list of bench modes (train,eval,data)")
     p.add_argument("--configs", default=None,
                    help="comma list (default: the whole zoo)")
+    p.add_argument("--exclude", default=None,
+                   help="comma list of configs to drop from the sweep "
+                        "(e.g. swin_sod, whose eval kills the TPU "
+                        "worker) — applied after --configs, so sweeps "
+                        "can run 'the zoo minus X' without restating "
+                        "the zoo membership")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--batch-per-chip", type=int, default=None,
@@ -125,6 +131,9 @@ def main(argv=None):
         wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
         zoo = ([c for c in ZOO if c in wanted]
                + [c for c in wanted if c not in ZOO])
+    if args.exclude:
+        dropped = {c.strip() for c in args.exclude.split(",") if c.strip()}
+        zoo = [c for c in zoo if c not in dropped]
 
     def render(results):
         lines = [f"| config | {' | '.join(modes)} |",

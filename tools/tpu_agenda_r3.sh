@@ -59,6 +59,11 @@ run eval_b64 900 $BENCH --config minet_r50_dp --mode eval --batch-per-chip 64
 run prof_b128 900 $BENCH --config minet_r50_dp --profile-dir $R/trace_b128
 run prof_b64  900 $BENCH --config minet_r50_dp --batch-per-chip 64 --profile-dir $R/trace_b64
 
+# analyze the traces immediately (host-side; no tunnel needed) so the
+# MFU/top-HLO tables exist even if the session dies later
+run an_b128 600 python tools/analyze_trace.py $R/trace_b128 --top 25
+run an_b64  600 python tools/analyze_trace.py $R/trace_b64 --top 25
+
 # -- 4b. space-to-depth stem A/B (arithmetic-identical stem re-tiling;
 #        the round-2 profile put 69% of op time in HBM-bound conv
 #        fusions and the stem streams the largest activation)
@@ -90,9 +95,8 @@ run u2net_fused_on  900 $BENCH --config u2net_ds
 # -- 8. zoo sweep: per-item budget 600 s, partial table flushed per row.
 #       swin_sod EVAL excluded (crashes the worker — round-2 zoo.log);
 #       its train row runs via --modes train.
-run zoo_noswin 9000 python tools/bench_zoo.py --device tpu --timeout 600 \
-    --retry-budget 0 --init-retries 2 \
-    --configs minet_vgg16_ref,minet_r50_dp,hdfnet_rgbd,u2net_ds,basnet_ds,gatenet_vgg16,vit_sod_sp \
+run zoo_noswin 9600 python tools/bench_zoo.py --device tpu --timeout 600 \
+    --retry-budget 0 --init-retries 2 --exclude swin_sod \
     --modes train,eval --out $R/zoo_table.md
 run zoo_swin_train 1200 python tools/bench_zoo.py --device tpu --timeout 900 \
     --retry-budget 0 --init-retries 2 \
