@@ -90,3 +90,66 @@ def test_flagship_quality_band_end_to_end(tmp_path, eight_devices, capsys):
     assert missing == 0
     assert abs(off["max_fbeta"] - res["max_fbeta"]) < 0.02, (off, res)
     assert abs(off["mae"] - res["mae"]) < 0.01, (off, res)
+
+
+@pytest.mark.slow
+def test_rgbd_quality_band_end_to_end(tmp_path, eight_devices, capsys):
+    """The RGB-D family's band: HDFNet (two-stream VGG16 + dynamic
+    local filtering) on the NJU2K-layout tiny set — depth loading,
+    the depth stream, and the fusion/DLF path all sit inside this
+    band, none of which the flagship RGB test touches.  Observed at
+    this budget: max-Fβ ≈ 0.996, MAE ≈ 0.010 (scouted 2026-08-01)."""
+    from make_tiny_dataset import main as make_ds
+
+    from distributed_sod_project_tpu.configs import (apply_overrides,
+                                                     get_config)
+    from distributed_sod_project_tpu.train.loop import fit
+
+    root = str(tmp_path / "rgbd16")
+    make_ds(["--out", root, "--n", "16", "--size", "96", "--seed", "0",
+             "--rgbd"])
+    capsys.readouterr()
+
+    ckpt = str(tmp_path / "ck")
+    cfg = get_config("hdfnet_rgbd")
+    cfg = apply_overrides(cfg, [
+        f"data.root={root}",
+        "data.image_size=64,64",
+        "data.num_workers=0",
+        "data.hflip=false",
+        "model.compute_dtype=float32",
+        "global_batch_size=8",
+        "optim.lr=0.01",
+        "num_epochs=1000",
+        "log_every_steps=20",
+        "eval_every_steps=0",
+        "checkpoint_every_steps=60",
+        f"checkpoint_dir={ckpt}",
+    ])
+    out = fit(cfg, max_steps=60)
+    assert out["final_step"] == 60
+
+    import importlib
+
+    test_mod = importlib.import_module("test")
+    preds = str(tmp_path / "preds")
+    rc = test_mod.main([
+        "--ckpt-dir", ckpt, "--device", "cpu",
+        "--data-root", f"tiny={root}",
+        "--save-dir", preds, "--batch-size", "8", "--no-structure",
+    ])
+    assert rc == 0
+    res = json.loads(capsys.readouterr().out)["tiny"]
+    assert res["max_fbeta"] >= 0.85, res
+    assert res["mae"] <= 0.10, res
+    assert res["num_images"] == 16
+
+    # Offline scorer parity over the saved PNGs (GT dir is the NJU2K
+    # layout's GT/) — same leg as the flagship band.
+    from eval_preds import evaluate_pair
+
+    off, _, missing = evaluate_pair(os.path.join(preds, "tiny"),
+                                    os.path.join(root, "GT"))
+    assert missing == 0
+    assert abs(off["max_fbeta"] - res["max_fbeta"]) < 0.02, (off, res)
+    assert abs(off["mae"] - res["mae"]) < 0.01, (off, res)

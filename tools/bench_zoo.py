@@ -133,6 +133,14 @@ def main(argv=None):
                + [c for c in wanted if c not in ZOO])
     if args.exclude:
         dropped = {c.strip() for c in args.exclude.split(",") if c.strip()}
+        unknown = dropped - set(zoo)
+        if unknown:
+            # Loud, like a typo'd --configs: a silently ignored
+            # exclusion would run the very config the caller meant to
+            # keep off the hardware (swin_sod's eval kills the worker).
+            print(f"--exclude names not in the sweep: {sorted(unknown)} "
+                  f"(sweep: {zoo})", file=sys.stderr)
+            return 1
         zoo = [c for c in zoo if c not in dropped]
 
     def render(results):

@@ -59,11 +59,6 @@ run eval_b64 900 $BENCH --config minet_r50_dp --mode eval --batch-per-chip 64
 run prof_b128 900 $BENCH --config minet_r50_dp --profile-dir $R/trace_b128
 run prof_b64  900 $BENCH --config minet_r50_dp --batch-per-chip 64 --profile-dir $R/trace_b64
 
-# analyze the traces immediately (host-side; no tunnel needed) so the
-# MFU/top-HLO tables exist even if the session dies later
-run an_b128 600 python tools/analyze_trace.py $R/trace_b128 --top 25
-run an_b64  600 python tools/analyze_trace.py $R/trace_b64 --top 25
-
 # -- 4b. space-to-depth stem A/B (arithmetic-identical stem re-tiling;
 #        the round-2 profile put 69% of op time in HBM-bound conv
 #        fusions and the stem streams the largest activation)
@@ -101,6 +96,12 @@ run zoo_noswin 9600 python tools/bench_zoo.py --device tpu --timeout 600 \
 run zoo_swin_train 1200 python tools/bench_zoo.py --device tpu --timeout 900 \
     --retry-budget 0 --init-retries 2 \
     --configs swin_sod --modes train --out $R/zoo_swin_train.md
+
+# -- analyze the captured traces (HOST-side — needs no tunnel, so it
+#    runs after the last tunnel-dependent bench leg; placed before the
+#    bisect only because NOTHING may run after the bisect)
+run an_b128 600 python tools/analyze_trace.py $R/trace_b128 --top 25
+run an_b64  600 python tools/analyze_trace.py $R/trace_b64 --top 25
 
 # -- 9. LAST: the swin eval bisect. Known to kill the TPU worker; the
 #       tunnel may be unusable for hours afterwards.
