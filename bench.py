@@ -443,9 +443,54 @@ def _run(args):
         if args.profile_dir:  # a retried attempt must not find the
             jax.profiler.stop_trace()  # profiler still active
 
+    if args.mode == "eval":
+        extra = _cost_fields(eval_and_update, dt / args.steps,
+                             init_fbeta_state(), state, dev_batch)
+    else:
+        extra = _cost_fields(step, dt / args.steps, state, dev_batch)
     _report(args, batch * args.steps / dt, jax.devices()[0].platform,
-            n_chips)
+            n_chips, **extra)
     return 0
+
+
+def _cost_fields(jitted, dt_step: float, *call_args) -> dict:
+    """FLOPs/step from XLA's cost model → ``gflops_per_step_chip``
+    (cost_analysis is per-device under jit-of-shard_map, so the value
+    is already the per-chip share) and, where the peak is known,
+    ``mfu``.
+
+    ``lower().compile()`` hits the in-process executable cache (the
+    step just ran), so this is bookkeeping, not a second compile.  MFU
+    uses the per-chip dense peak for the device generation; unknown
+    kinds report FLOPs only.  Best-effort: any failure returns {} —
+    the throughput number must never die on the cost model.
+    """
+    import jax
+
+    try:
+        cost = jitted.lower(*call_args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # old jax: one dict per device
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+    except Exception:  # noqa: BLE001 — optional diagnostics only
+        return {}
+    if flops <= 0 or dt_step <= 0:
+        return {}
+    # cost_analysis is per-program; under jit-of-shard_map that is the
+    # per-device share.  Dense bf16/fp32-accum peak per chip:
+    peaks = {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+             "v4": 275e12, "v6": 918e12, "trillium": 918e12}
+    kind = ""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001
+        pass
+    out = {"gflops_per_step_chip": round(flops / 1e9, 1)}
+    for tag, peak in peaks.items():
+        if tag in kind:
+            out["mfu"] = round(flops / dt_step / peak, 4)
+            break
+    return out
 
 
 def _bench_data(cfg, batch: int, steps: int, warmup: int) -> float:
@@ -491,7 +536,7 @@ def _bench_data(cfg, batch: int, steps: int, warmup: int) -> float:
 
 
 def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
-            mode: str | None = None) -> None:
+            mode: str | None = None, **extra) -> None:
     """One JSON line + self-relative baseline tracking (the first run
     per (config, size, platform, mode) seeds ``bench_baseline.json``)."""
     # Claimed BEFORE the print: the watchdog must never append an error
@@ -536,6 +581,7 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
+        **extra,
     }), flush=True)
 
 
