@@ -38,19 +38,41 @@ import hashlib  # noqa: E402
 
 
 def _cpu_key() -> str:
+    """Key the cache dir by CPU IDENTITY, not just feature flags.
+
+    Round-3 postmortem: a stale cache with IDENTICAL cpuinfo flags
+    still aborted the suite — XLA:CPU bakes llvm host-TUNING
+    pseudo-features (+prefer-no-scatter/+prefer-no-gather, picked from
+    the CPU micro-architecture, invisible in cpuinfo flags) into AOT
+    entries, and executing a mismatched entry wedged a device thread
+    mid-collective until the rendezvous timeout aborted the process
+    (`cpu_aot_loader.cc "machine type ... doesn't match"` in stderr is
+    the tell — DELETE /tmp/jax_pytest_cache_* when you see it).  Hash
+    family/model/stepping/model-name too so same-flags different-silicon
+    hosts get distinct caches, and the jaxlib version so an image bump
+    never replays old entries.
+    """
     try:
         with open("/proc/cpuinfo") as f:
-            # x86 spells it "flags", ARM "Features"; hash every match so
-            # hosts differing in ANY ISA extension get distinct caches.
-            flags = "".join(line for line in f
-                            if line.startswith(("flags", "Features")))
-        if not flags:
-            raise OSError("no flags/Features lines")
+            # x86 spells it "flags", ARM "Features"; include the model
+            # identity lines (sorted-unique: one socket's worth).
+            keep = ("flags", "Features", "model", "cpu family",
+                    "stepping", "vendor_id",
+                    # ARM spells CPU identity differently:
+                    "CPU implementer", "CPU part", "CPU variant",
+                    "CPU architecture", "CPU revision")
+            ident = "".join(sorted({line for line in f
+                                    if line.startswith(keep)}))
+        if not ident:
+            raise OSError("no cpuinfo lines")
     except OSError:
         import platform
 
-        flags = (platform.processor() or platform.machine() or "unknown")
-    return hashlib.sha1(flags.encode()).hexdigest()[:10]
+        ident = (platform.processor() or platform.machine() or "unknown")
+    import jaxlib
+
+    ident += f"|jaxlib={getattr(jaxlib, '__version__', '?')}"
+    return hashlib.sha1(ident.encode()).hexdigest()[:10]
 
 
 jax.config.update("jax_compilation_cache_dir",
