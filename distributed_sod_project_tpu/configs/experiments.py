@@ -116,17 +116,22 @@ def swin_sod() -> ExperimentConfig:
 @register_config("vit_sod_hires")
 def vit_sod_hires() -> ExperimentConfig:
     """Long-context flagship recipe: ViT-SOD at 1024px (4096 global
-    tokens).  The two memory levers stack — image rows shard over
-    ``mesh.seq`` (ring attention; ``--set mesh.sp_strategy=ulysses``
-    for the all-to-all variant when heads divide), and each block runs
-    the Pallas flash kernel (`model.attn_impl=flash`) so N² scores
-    never touch HBM.  On fewer chips, drop ``mesh.seq`` to 1 and the
-    flash kernel alone carries the memory load."""
+    tokens).  Image rows shard over ``mesh.seq`` (ring attention;
+    ``--set mesh.sp_strategy=ulysses`` for the all-to-all variant when
+    heads divide).  Attention defaults to ``attn_impl="xla"``: at every
+    operating point measured on v5e (round 2, N=1024) the Pallas flash
+    kernel was 2.2x SLOWER than XLA's materialized attention whenever
+    the N² scores fit in HBM, and the pre-committed decision rule says
+    flash must measurably win to be a default (docs/PERFORMANCE.md).
+    ``--set model.attn_impl=flash`` remains the documented memory
+    lever — at b16/N=4096 it runs where XLA OOMs — and the round-4
+    block sweep (tools/tpu_agenda_r4.sh leg 6) re-flips this default
+    if any block shape beats XLA at this config's operating point."""
     return ExperimentConfig(
         name="vit_sod_hires",
         data=DataConfig(dataset="duts", image_size=(1024, 1024)),
         model=ModelConfig(name="vit_sod", backbone="small", sync_bn=False,
-                          attn_impl="flash", remat=True),
+                          attn_impl="xla", remat=True),
         loss=LossConfig(bce=1.0, iou=1.0, ssim=1.0),
         optim=OptimConfig(optimizer="adamw", lr=3e-4, weight_decay=0.01,
                           warmup_steps=500),

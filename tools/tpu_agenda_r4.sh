@@ -1,0 +1,136 @@
+#!/bin/bash
+# Round-4 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh fires this automatically).  The round-3 agenda
+# never got a window (0/248 watcher probes answered over 11 h), so the
+# open-question list is unchanged from VERDICT.md r3 item 1, ordered by
+# value-per-minute:
+#
+#   1. canonical b128 headline WITH self-reported MFU (bench.py now
+#      emits gflops_per_step_chip + mfu — never yet run on hardware)
+#   2. resize A/B   — isolate the fast path's share of the +61% headline
+#   3. eval single-dispatch re-measure (b32/b64)
+#   4. profiles     — b128 trace (MFU) + the b64-no-remat cliff
+#   4b. s2d stem A/B — the round-3 lever, still a hypothesis
+#   5. b256         — the unexplored right edge of the batch curve
+#   6. flash sweep  — block shapes at N=1024 and N=4096; decides the
+#      pre-committed flash decision rule (default already flipped to
+#      xla in round 4; the sweep can re-flip it)
+#   6b. vit_sod_hires full-model attn A/B (xla vs flash) — the config-
+#      level check behind the round-4 default flip
+#   7. u2net fused A/B
+#   8. zoo sweep    — per-item budgets, swin EVAL EXCLUDED (kills the
+#                     worker; its train row runs separately)
+#   9. LAST: swin eval bisect — known to crash the TPU worker and wedge
+#      the tunnel for hours; nothing may run after it.
+#
+# Every leg is a bounded subprocess; each JSON result is flushed to
+# $R/results.jsonl the moment it lands.  bench.py legs run with
+# --retry-budget 0 --init-retries 2: the watcher only starts us when
+# the tunnel is UP, so a wedge mid-agenda should fail fast and let
+# later (independent) legs try, not eat the window retrying.
+cd "$(dirname "$0")/.." || exit 1
+R=${R:-tpu_results4}
+mkdir -p "$R"
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+}
+
+# -- 1. canonical headline (b128 default, fast resize, no env tags).
+#       bench.py self-reports mfu + gflops_per_step_chip since round 3.
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2. resize A/B (single variable: DSOD_RESIZE_IMPL; baseline keys
+#       are env-tagged, so the xla legs cannot poison canonical keys)
+export DSOD_RESIZE_IMPL=xla
+run rsz_xla_b128  900 $BENCH --config minet_r50_dp
+run rsz_xla_b128r 900 $BENCH --config minet_r50_dp --set model.remat=true
+run rsz_xla_b32   900 $BENCH --config minet_r50_dp --batch-per-chip 32
+unset DSOD_RESIZE_IMPL
+run rsz_fast_b128r 900 $BENCH --config minet_r50_dp --set model.remat=true
+run rsz_fast_b32   900 $BENCH --config minet_r50_dp --batch-per-chip 32
+
+# -- 3. eval single-dispatch re-measure (round-2 two-dispatch numbers:
+#       248.30 @ b32 / 365.07 @ b64)
+run eval_b32 900 $BENCH --config minet_r50_dp --mode eval --batch-per-chip 32
+run eval_b64 900 $BENCH --config minet_r50_dp --mode eval --batch-per-chip 64
+
+# -- 4. profiles: the b128 best (MFU push) and the b64-no-remat cliff
+run prof_b128 900 $BENCH --config minet_r50_dp --profile-dir "$R"/trace_b128
+run prof_b64  900 $BENCH --config minet_r50_dp --batch-per-chip 64 --profile-dir "$R"/trace_b64
+
+# -- 4b. space-to-depth stem A/B (arithmetic-identical stem re-tiling;
+#        the round-2 profile put 69% of op time in HBM-bound conv
+#        fusions and the stem streams the largest activation).  The
+#        roofline (docs/PERFORMANCE.md, round 4) predicts the delta —
+#        this leg confirms or refutes it.
+export DSOD_STEM_IMPL=s2d
+run s2d_b128 900 $BENCH --config minet_r50_dp
+run s2d_b32  900 $BENCH --config minet_r50_dp --batch-per-chip 32
+unset DSOD_STEM_IMPL
+
+# -- 5. past-b128 exploration (round-2 b256 attempt died >900s; give it
+#       a real compile budget and record timeout-as-answer otherwise)
+run b256_remat 1600 python bench.py --device tpu --steps 20 --watchdog 1500 \
+    --retry-budget 0 --init-retries 2 --config minet_r50_dp \
+    --batch-per-chip 256 --set model.remat=true
+run b256 1600 python bench.py --device tpu --steps 20 --watchdog 1500 \
+    --retry-budget 0 --init-retries 2 --config minet_r50_dp --batch-per-chip 256
+
+# -- 6. flash block sweep (fwd+bwd then fwd-only; short and long N).
+#       Executes the pre-committed decision rule: if some block shape
+#       beats XLA at the vit_sod_hires operating point, re-flip its
+#       default back to flash and record the shape in PERFORMANCE.md.
+run flash_1k     900 python tools/bench_flash.py --shape 12,1024,64 --iters 20
+run flash_1k_fwd 900 python tools/bench_flash.py --shape 12,1024,64 --iters 20 --fwd-only
+run flash_4k    1200 python tools/bench_flash.py --shape 12,4096,64 --iters 10 \
+    --blocks 128/128,256/1024,512/1024,512/2048
+run flash_4k_noxla 1200 python tools/bench_flash.py --shape 12,4096,64 --iters 10 \
+    --blocks 128/128,256/1024,512/1024,512/2048 --no-xla --fwd-only
+
+# -- 6b. full-model attn A/B at the vit_sod_hires operating point.
+#        Both arms pin attn_impl explicitly so the comparison stays
+#        two-armed even if the config default moves between rounds
+#        (the default is xla since round 4).
+run vit_attn_xla   900 $BENCH --config vit_sod_hires --set model.attn_impl=xla
+run vit_attn_flash 900 $BENCH --config vit_sod_hires --set model.attn_impl=flash
+
+# -- 7. u2net fused-loss A/B (never A/B'd on hardware)
+run u2net_fused_off 900 $BENCH --config u2net_ds --set loss.fused_kernel=false
+run u2net_fused_on  900 $BENCH --config u2net_ds
+
+# -- 8. zoo sweep: per-item budget 600 s, partial table flushed per row.
+#       swin_sod EVAL excluded (crashes the worker — round-2 zoo.log);
+#       its train row runs via --modes train.
+run zoo_noswin 9600 python tools/bench_zoo.py --device tpu --timeout 600 \
+    --retry-budget 0 --init-retries 2 --exclude swin_sod \
+    --modes train,eval --out "$R"/zoo_table.md
+run zoo_swin_train 1200 python tools/bench_zoo.py --device tpu --timeout 900 \
+    --retry-budget 0 --init-retries 2 \
+    --configs swin_sod --modes train --out "$R"/zoo_swin_train.md
+
+# -- analyze the captured traces (HOST-side — needs no tunnel, so it
+#    runs after the last tunnel-dependent bench leg; placed before the
+#    bisect only because NOTHING may run after the bisect)
+run an_b128 600 python tools/analyze_trace.py "$R"/trace_b128 --top 25
+run an_b64  600 python tools/analyze_trace.py "$R"/trace_b64 --top 25
+
+# -- 9. LAST: the swin eval bisect. Known to kill the TPU worker; the
+#       tunnel may be unusable for hours afterwards.  (VERDICT r3
+#       item 7 — CPU-side stage exclusion — updates the bisect's stage
+#       list separately this round; this leg runs whatever the current
+#       tools/bisect_swin_eval.py stage list is.)
+echo "=== swin_bisect [$(date -u +%H:%M:%S)] — NOTHING runs after this" | tee -a "$R"/agenda.log
+timeout 2400 python tools/bisect_swin_eval.py --json-out "$R"/swin_bisect.json > "$R"/swin_bisect.out 2> "$R"/swin_bisect.err
+echo "{\"step\": \"swin_bisect\", \"rc\": $?}" >> "$R"/results.jsonl
+tail -40 "$R"/swin_bisect.out | tee -a "$R"/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log

@@ -1,16 +1,23 @@
 #!/bin/bash
 # Probe the axon TPU tunnel in a loop; the moment it answers, run the
-# round-3 agenda (tools/tpu_agenda_r3.sh) and exit.  Run in the
-# background at session start — the tunnel's observed behavior is
-# "wedged now, back later in the session" and the window can be short.
+# current round's agenda and exit.  Run in the background at session
+# start — the tunnel's observed behavior is "wedged now, back later in
+# the session" and the window can be short.
 #
-#   nohup bash tools/tpu_watch.sh > tpu_results3/watch.out 2>&1 &
+#   mkdir -p tpu_results4 && \
+#     nohup bash tools/tpu_watch.sh > tpu_results4/watch.out 2>&1 &
 #
-# The probe is a throwaway subprocess under timeout: a wedged tunnel
-# hangs PJRT client creation indefinitely and only an out-of-process
-# dial converts that into a retryable failure (see bench.py).
+# AGENDA / RDIR select the agenda script and results dir (default: the
+# current round's).  RDIR is forwarded to the agenda as R; only
+# tpu_agenda_r4.sh and later honor it — the frozen r2/r3 agendas
+# hardcode their own results dir and ignore R.  The probe is a
+# throwaway subprocess under timeout: a wedged tunnel hangs PJRT
+# client creation indefinitely and only an out-of-process dial
+# converts that into a retryable failure (see bench.py).
 cd "$(dirname "$0")/.." || exit 1
-mkdir -p tpu_results3
+AGENDA=${AGENDA:-tools/tpu_agenda_r4.sh}
+RDIR=${RDIR:-tpu_results4}
+mkdir -p "$RDIR"
 MAX_HOURS=${MAX_HOURS:-11}
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 n=0
@@ -19,16 +26,16 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
   plat=$(timeout 100 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
   case "$plat" in
     tpu|TPU|axon)
-      echo "$(date -u +%FT%TZ) probe $n: tunnel UP ($plat) — starting agenda" >> tpu_results3/watch.log
-      bash tools/tpu_agenda_r3.sh
-      echo "$(date -u +%FT%TZ) agenda finished" >> tpu_results3/watch.log
+      echo "$(date -u +%FT%TZ) probe $n: tunnel UP ($plat) — starting agenda" >> "$RDIR/watch.log"
+      R="$RDIR" bash "$AGENDA"
+      echo "$(date -u +%FT%TZ) agenda finished" >> "$RDIR/watch.log"
       exit 0
       ;;
     *)
-      echo "$(date -u +%FT%TZ) probe $n: down (got '${plat:-wedge/timeout}')" >> tpu_results3/watch.log
+      echo "$(date -u +%FT%TZ) probe $n: down (got '${plat:-wedge/timeout}')" >> "$RDIR/watch.log"
       sleep 60
       ;;
   esac
 done
-echo "$(date -u +%FT%TZ) gave up after ${MAX_HOURS}h" >> tpu_results3/watch.log
+echo "$(date -u +%FT%TZ) gave up after ${MAX_HOURS}h" >> "$RDIR/watch.log"
 exit 1
