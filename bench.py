@@ -198,15 +198,29 @@ def main(argv=None):
             print(f"bench: device backend unavailable (attempt "
                   f"{attempt}, {elapsed:.0f}s/{budget:.0f}s budget): "
                   f"{fail}", file=sys.stderr, flush=True)
-            if attempt >= min_attempts and elapsed >= budget:
+            # Admission gate (VERDICT r3 item 5): once the attempt
+            # floor is met, a new attempt is admitted only if its
+            # worst-case dial probe can still FINISH inside the
+            # budget.  The previous gate (elapsed >= budget) admitted
+            # an attempt whenever any budget remained, so the last
+            # probe could overrun by up to --probe-timeout — BENCH_r03
+            # reported elapsed 1620 s against a 1500 s budget and
+            # survived the driver watchdog only on its grace margin.
+            # With the reserve, the error path's elapsed_s <= budget
+            # whenever the budget (not the floor) ends the loop.
+            probe_reserve = (args.probe_timeout
+                             if args.probe_timeout
+                             and _expects_accelerator(args) else 0.0)
+            if attempt >= min_attempts and elapsed + probe_reserve >= budget:
                 break
-            # Don't sleep past the retry deadline — but only once the
-            # attempt floor is met: floor attempts keep their full
+            # Don't sleep past the admission deadline — but only once
+            # the attempt floor is met: floor attempts keep their full
             # backoff (spacing is the point of the floor; a zero-sleep
             # hammer defeats the transient-outage retry).
             sleep = args.init_backoff
             if budget and attempt >= min_attempts:
-                sleep = min(sleep, max(budget - elapsed, 0.0))
+                sleep = min(sleep,
+                            max(budget - elapsed - probe_reserve, 0.0))
             if sleep:
                 time.sleep(sleep)
         # Out of retries: emit the standard JSON line WITH an error field
@@ -444,8 +458,12 @@ def _run(args):
             jax.profiler.stop_trace()  # profiler still active
 
     if args.mode == "eval":
+        # ADVICE r3: lower with the ACTUAL final acc object — a fresh
+        # host-side init_fbeta_state() has different sharding/commit-
+        # ment, which can miss the executable cache and trigger a
+        # (post-timing, but slow on device backends) second compile.
         extra = _cost_fields(eval_and_update, dt / args.steps,
-                             init_fbeta_state(), state, dev_batch)
+                             acc[0], state, dev_batch)
     else:
         extra = _cost_fields(step, dt / args.steps, state, dev_batch)
     _report(args, batch * args.steps / dt, jax.devices()[0].platform,

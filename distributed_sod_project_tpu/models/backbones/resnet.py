@@ -22,6 +22,24 @@ from flax import linen as nn
 
 from ..layers import ConvBNAct
 
+_S2D_FALLBACK_WARNED: set = set()
+
+
+def _warn_s2d_fallback(shape: Tuple[int, ...]) -> None:
+    """One warning per input shape per process (the module is traced
+    under jit — a plain print would fire once per trace anyway, but
+    dedup keeps multi-config sweeps readable)."""
+    key = tuple(shape[1:3])
+    if key in _S2D_FALLBACK_WARNED:
+        return
+    _S2D_FALLBACK_WARNED.add(key)
+    from ...utils import get_logger
+
+    get_logger().warning(
+        "DSOD_STEM_IMPL=s2d requested but input H×W %s is odd — "
+        "falling back to the plain 7x7 stem.  Any benchmark tagged "
+        "stem=s2d at this size measured the PLAIN stem.", key)
+
 
 class BasicBlock(nn.Module):
     features: int
@@ -100,11 +118,19 @@ class ResNet(nn.Module):
         # DSOD_RESIZE_IMPL (bench.py keys baselines on it).
         import os
 
-        if (os.environ.get("DSOD_STEM_IMPL") == "s2d"
-                and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0):
-            from ..layers import SpaceToDepthStem
+        if os.environ.get("DSOD_STEM_IMPL") == "s2d":
+            if x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+                from ..layers import SpaceToDepthStem
 
-            x = SpaceToDepthStem(64, name="ConvBNAct_0", **kw)(x, train)
+                x = SpaceToDepthStem(64, name="ConvBNAct_0", **kw)(x, train)
+            else:
+                # ADVICE r3: odd H or W forces the plain-stem fallback,
+                # but bench.py tags the baseline key with the env var —
+                # a silent fallback would record numbers labeled s2d
+                # that actually ran the 7x7 stem.  Warn loudly so a
+                # mislabeled A/B leg is visible in its log.
+                _warn_s2d_fallback(x.shape)
+                x = ConvBNAct(64, (7, 7), strides=2, **kw)(x, train)
         else:
             x = ConvBNAct(64, (7, 7), strides=2, **kw)(x, train)
         feats.append(x)  # stride 2

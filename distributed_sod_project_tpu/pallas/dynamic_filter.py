@@ -56,8 +56,17 @@ def _compiler_params() -> pltpu.CompilerParams:
     compile with a scoped limit past physical VMEM, so the raise only
     applies where the hardware has it; ``DSOD_DLF_VMEM_MB`` overrides
     either way (0 = compiler default).
+
+    ADVICE r3: gate on a SMALL-VMEM denylist (v2/v3, ~16 MB/core)
+    rather than a big-VMEM allowlist — the old allowlist omitted v4
+    (128 MB/core, would have re-hit the round-2 compile-failure class)
+    and substring-matched fragile tags ('lite' matched 'TPU v4 lite').
+    Unknown/future generations default to the raised limit; v2/v3 are
+    the only known-small kinds and ``DSOD_DLF_VMEM_MB`` stays the
+    escape hatch for anything else.
     """
     import os
+    import re
 
     env = os.environ.get("DSOD_DLF_VMEM_MB")
     if env is not None:
@@ -68,10 +77,11 @@ def _compiler_params() -> pltpu.CompilerParams:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:
         kind = ""
-    big_vmem = any(tag in kind for tag in ("v5", "v6", "lite"))
-    if big_vmem:
-        return pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
-    return pltpu.CompilerParams()
+    # "tpu v2" / "tpu v3" (word-bounded so e.g. "v23"/"v32" never match).
+    small_vmem = re.search(r"\bv[23]\b", kind) is not None
+    if small_vmem:
+        return pltpu.CompilerParams()
+    return pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
 
 def _taps(ksize: int, dilation: int):

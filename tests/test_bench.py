@@ -4,6 +4,7 @@ real hardware; data mode is pure host and cheap enough for CI)."""
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -196,6 +197,52 @@ def test_bench_retry_budget_outlasts_attempt_floor(
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["attempts"] == len(calls)
     assert out["elapsed_s"] >= 0.3
+
+
+def test_bench_retry_budget_is_a_hard_ceiling(
+        tmp_path, capsys, monkeypatch):
+    """VERDICT r3 item 5: the budget gate must not admit an attempt
+    whose worst-case dial probe would FINISH past the budget.
+    BENCH_r03 reported elapsed 1620 s against a 1500 s budget — the
+    old gate admitted a final attempt with ~1 s of budget left and a
+    120 s probe timeout, surviving the driver watchdog only on its
+    grace margin.  Contract now: when the budget (not the attempt
+    floor) ends the loop, the error line's elapsed_s <= budget."""
+    import bench
+
+    monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
+    probes = []
+
+    def fake_probe(timeout):
+        probes.append(time.monotonic())
+        time.sleep(0.05)
+        return "UNAVAILABLE: tunnel wedged (fake probe)"
+
+    monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+
+    # Reserve larger than the remaining budget: after the floor, no
+    # further attempt may start even though raw budget remains.
+    rc = bench.main(["--device", "tpu", "--init-retries", "1",
+                     "--init-backoff", "0", "--probe-timeout", "10",
+                     "--retry-budget", "5"])
+    assert rc == 0
+    assert len(probes) == 1  # floor only: 0.05s spent + 10s reserve > 5s
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["elapsed_s"] <= 5.0
+
+    # Reserve that fits several times: retries proceed, and the loop
+    # still breaks early enough that elapsed_s <= budget invariantly.
+    probes.clear()
+    t0 = time.monotonic()
+    rc = bench.main(["--device", "tpu", "--init-retries", "1",
+                     "--init-backoff", "0.02", "--probe-timeout", "0.2",
+                     "--retry-budget", "1.0"])
+    assert rc == 0
+    assert len(probes) > 1  # budget admitted retries past the floor
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["elapsed_s"] <= 1.0
+    # No probe may START with less than its own timeout left.
+    assert all(t - t0 <= 1.0 - 0.2 + 0.05 for t in probes)
 
 
 def test_bench_does_not_retry_unrelated_errors(tmp_path, monkeypatch, capsys):

@@ -65,11 +65,17 @@ def test_resnet_s2d_stem_matches_plain_stem(monkeypatch):
         np.testing.assert_allclose(np.asarray(fp), np.asarray(fs),
                                    rtol=1e-4, atol=1e-4)
 
-    # Odd spatial size: falls back to the plain stem (no s2d possible).
+    # Odd spatial size: falls back to the plain stem (no s2d possible)
+    # — and WARNS, because bench.py tags baseline keys with the env var
+    # and a silent fallback would mislabel an A/B leg (ADVICE r3).
     # Fully-convolutional → reuse the same params, no third init.
+    from distributed_sod_project_tpu.models.backbones import resnet
+
+    resnet._S2D_FALLBACK_WARNED.clear()
     x_odd = jnp.asarray(np.random.RandomState(1).randn(1, 47, 47, 3),
                         jnp.float32)
     assert m.apply(v_plain, x_odd)[0].shape == (1, 24, 24, 64)
+    assert (47, 47) in resnet._S2D_FALLBACK_WARNED
 
 
 def test_resnet34_pyramid_shapes():

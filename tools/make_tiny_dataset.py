@@ -9,6 +9,14 @@ BASELINE.md convergence-evidence protocol.
     python tools/make_tiny_dataset.py --out /tmp/duts16 --n 16
     python tools/make_tiny_dataset.py --out /tmp/rgbd16 --n 16 --rgbd
 
+``--eval-n K`` additionally writes K HELD-OUT samples (same generator
+and layout, drawn from the rng stream *after* the train draws, so the
+two sets are disjoint by construction) into ``--eval-out`` (default
+``<out>_eval``).  Scoring the eval root with a model trained on the
+train root is the in-env generalization signal (VERDICT r3 item 2):
+a model that merely memorizes the 16 train images does not place
+ellipses it never saw.
+
 Layouts match data/folder.py:
   DUTS:  <out>/DUTS-TR-Image/*.jpg + <out>/DUTS-TR-Mask/*.png
   RGB-D: <out>/{RGB,depth,GT}/ with matching stems.
@@ -54,6 +62,12 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--rgbd", action="store_true",
                    help="NJU2K/NLPR-style RGB+depth+GT layout")
+    p.add_argument("--eval-n", type=int, default=0,
+                   help="also write this many HELD-OUT samples (drawn "
+                        "after the train draws — disjoint by "
+                        "construction) into --eval-out")
+    p.add_argument("--eval-out", default=None,
+                   help="held-out root (default: <out>_eval)")
     args = p.parse_args(argv)
 
     rng = np.random.RandomState(args.seed)
@@ -61,19 +75,27 @@ def main(argv=None):
         dirs = {"img": "RGB", "mask": "GT", "depth": "depth"}
     else:
         dirs = {"img": "DUTS-TR-Image", "mask": "DUTS-TR-Mask"}
-    for d in dirs.values():
-        os.makedirs(os.path.join(args.out, d), exist_ok=True)
 
-    for i in range(args.n):
-        img, mask, depth = make_sample(rng, args.size)
-        stem = f"tiny_{i:04d}"
-        img.save(os.path.join(args.out, dirs["img"], stem + ".jpg"),
-                 quality=95)
-        mask.save(os.path.join(args.out, dirs["mask"], stem + ".png"))
-        if args.rgbd:
-            depth.save(os.path.join(args.out, dirs["depth"], stem + ".png"))
+    def write_split(out, n, stem_fmt):
+        for d in dirs.values():
+            os.makedirs(os.path.join(out, d), exist_ok=True)
+        for i in range(n):
+            img, mask, depth = make_sample(rng, args.size)
+            stem = stem_fmt.format(i)
+            img.save(os.path.join(out, dirs["img"], stem + ".jpg"),
+                     quality=95)
+            mask.save(os.path.join(out, dirs["mask"], stem + ".png"))
+            if args.rgbd:
+                depth.save(os.path.join(out, dirs["depth"],
+                                        stem + ".png"))
+
+    write_split(args.out, args.n, "tiny_{:04d}")
     print(f"wrote {args.n} samples to {args.out} "
           f"({'RGB-D' if args.rgbd else 'DUTS'} layout)")
+    if args.eval_n:
+        eval_out = args.eval_out or args.out.rstrip("/") + "_eval"
+        write_split(eval_out, args.eval_n, "tinyeval_{:04d}")
+        print(f"wrote {args.eval_n} HELD-OUT samples to {eval_out}")
     return 0
 
 
