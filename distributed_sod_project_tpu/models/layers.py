@@ -216,13 +216,59 @@ def _downsample2_axis(x, axis: int):
     ], axis)
 
 
+def _upsample2_axis_convt(x, axis: int):
+    """Factor-2 bilinear upsample as a depthwise fractionally-strided
+    conv — the ``DSOD_RESIZE_IMPL=convt`` A/B arm.
+
+    Same numerics as :func:`_upsample_axis` (s=2): the two output
+    phases 0.25·x[i-1]+0.75·x[i] and 0.75·x[i]+0.25·x[i+1] are exactly
+    one length-4 kernel [.25,.75,.75,.25] cross-correlated over the
+    2×-lhs-dilated input; replicate-padding one row each side makes
+    the conv's implicit zero taps reproduce the edge clamping, and
+    VALID output length lands on 2n with no crop (derivation in the
+    round-4 notes, docs/PERFORMANCE.md).
+
+    Why it might win: the round-2 v5e trace shows the stack+reshape
+    interleave of ``_upsample_axis`` costing ~1.25 ms relayout copies
+    per call at b64 (data-formatting = 10% of the step) — a conv's
+    output needs no relayout.  Why it might lose: depthwise convs run
+    on the VPU with kernel overhead per channel.  Hardware A/B leg:
+    ``rsz_convt`` in tools/tpu_agenda_r4.sh.
+    """
+    import jax.lax as lax
+
+    n = x.shape[axis]
+    first = lax.slice_in_dim(x, 0, 1, axis=axis)
+    last = lax.slice_in_dim(x, n - 1, n, axis=axis)
+    xp = jnp.concatenate([first, x, last], axis=axis)
+    c = x.shape[-1]
+    k = jnp.asarray([0.25, 0.75, 0.75, 0.25], x.dtype)
+    if axis == 1:
+        kern = jnp.tile(k.reshape(4, 1, 1, 1), (1, 1, 1, c))
+        dil = (2, 1)
+        pad = ((0, 0), (0, 0))
+    else:
+        kern = jnp.tile(k.reshape(1, 4, 1, 1), (1, 1, 1, c))
+        dil = (1, 2)
+        pad = ((0, 0), (0, 0))
+    return lax.conv_general_dilated(
+        xp, kern, window_strides=(1, 1), padding=pad,
+        lhs_dilation=dil, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c)
+
+
 def _fast_bilinear_axis(x, axis: int, out_n: int):
     """One axis of ``resize_to``'s fast path; None if unsupported."""
+    import os
+
     n = x.shape[axis]
     if out_n == n:
         return x
     if out_n % n == 0:
-        return _upsample_axis(x, axis, out_n // n)
+        s = out_n // n
+        if s == 2 and os.environ.get("DSOD_RESIZE_IMPL") == "convt":
+            return _upsample2_axis_convt(x, axis)
+        return _upsample_axis(x, axis, s)
     if n == 2 * out_n and n % 2 == 0:
         return _downsample2_axis(x, axis)
     return None

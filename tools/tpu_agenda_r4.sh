@@ -57,6 +57,14 @@ run rsz_xla_b32   900 $BENCH --config minet_r50_dp --batch-per-chip 32
 unset DSOD_RESIZE_IMPL
 run rsz_fast_b128r 900 $BENCH --config minet_r50_dp --set model.remat=true
 run rsz_fast_b32   900 $BENCH --config minet_r50_dp --batch-per-chip 32
+# convt third arm (round 4): the 2x upsample as a depthwise
+# fractionally-strided conv — targets the ~1.25 ms/call interleave
+# relayout copies the roofline reconciliation found (PERFORMANCE.md
+# lever #2; numerics-identical, tests/test_models.py).
+export DSOD_RESIZE_IMPL=convt
+run rsz_convt_b128 900 $BENCH --config minet_r50_dp
+run rsz_convt_b32  900 $BENCH --config minet_r50_dp --batch-per-chip 32
+unset DSOD_RESIZE_IMPL
 
 # -- 3. eval single-dispatch re-measure (round-2 two-dispatch numbers:
 #       248.30 @ b32 / 365.07 @ b64)
