@@ -18,6 +18,9 @@ of them, IN A SUBPROCESS, smallest program first:
   eval_step       make_eval_step (shard_map + sigmoid)
   eval_xla_resize eval_step with DSOD_RESIZE_IMPL=xla — isolates the
                   round-2 slice/lerp resize fast path
+  eval_metrics_nofuse  the crasher's program with XLA fusion passes
+                  disabled — implicates/exonerates a fused kernel
+                  (the scatter-metrics fusion suspect) in one stage
   eval_metrics    eval_step + metric update, the reproduced crasher —
                   LAST: a worker kill can wedge the tunnel for hours
 
@@ -27,6 +30,18 @@ than burning 900 s per remaining stage against a wedged transport.
 
     python tools/bisect_swin_eval.py            # all stages
     python tools/bisect_swin_eval.py --stage fwd_b1
+    python tools/bisect_swin_eval.py --export-check   # no hardware
+
+``--export-check`` (VERDICT r3 item 7) serializes every stage's
+jitted program for platforms=['tpu'] via jax.export ON CPU at the
+real crash shapes.  What it can exclude: StableHLO lowering /
+cross-platform legalization failures.  What it cannot: Mosaic/XLA:TPU
+*backend* compilation and runtime faults (the export path stops at
+serialized StableHLO — no TPU codegen happens off-device).  Result of
+the round-4 run: ALL stages export clean at b32@320 (see
+docs/PERFORMANCE.md swin note), so the crash is a backend
+compile/runtime fault, not a lowering bug — consistent with the
+worker dying only on real hardware.
 """
 
 from __future__ import annotations
@@ -42,6 +57,23 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _PLATFORM = """
 import jax
 {platform_select}
+import os as _os
+
+
+def finish(label, jitted, fargs, run):
+    '''Shared stage footer: execute the stage (default), or — with
+    DSOD_BISECT_EXPORT=1 — serialize the same jitted program for the
+    TPU platform via jax.export WITHOUT running it.  The export path
+    works on the CPU backend, so it checks cross-platform (StableHLO)
+    lowering of the exact crash-shaped program with no hardware.'''
+    if _os.environ.get("DSOD_BISECT_EXPORT") == "1":
+        from jax import export as _jexport
+
+        exp = _jexport.export(jitted, platforms=["tpu"])(*fargs)
+        print(label, "EXPORT-TPU ok:",
+              len(exp.mlir_module_serialized), "bytes")
+    else:
+        print(label, "ok", run())
 """
 
 _PRELUDE = _PLATFORM + """
@@ -83,10 +115,16 @@ rng = np.random.RandomState(0)
 probs = jnp.asarray(rng.rand(B, {hw}, {hw}).astype(np.float32))
 gt = jnp.asarray((rng.rand(B, {hw}, {hw}, 1) > 0.5).astype(np.float32))
 upd = jax.jit(update_fbeta_state, donate_argnums=0)
-acc = init_fbeta_state()
-for _ in range(3):
-    acc = upd(acc, probs, gt)
-print("metrics ok", float(acc.mae_sum))
+
+
+def _run():
+    acc = init_fbeta_state()
+    for _ in range(3):
+        acc = upd(acc, probs, gt)
+    return float(acc.mae_sum)
+
+
+finish("metrics", upd, (init_fbeta_state(), probs, gt), _run)
 """
 
 _BACKBONE = _PLATFORM + """
@@ -99,15 +137,16 @@ bb = SwinT(dtype=jnp.bfloat16)
 vars_ = bb.init(jax.random.key(0), img)
 fn = jax.jit(lambda v, x: [f.astype(jnp.float32).sum()
                            for f in bb.apply(v, x)])
-print("backbone ok", [float(s) for s in fn(vars_, img)])
+finish("backbone", fn, (vars_, img),
+       lambda: [float(s) for s in fn(vars_, img)])
 """
 
 _FWD = _PRELUDE + """
 fn = jax.jit(lambda s, b: model.apply(
     {{"params": s.params, "batch_stats": s.batch_stats}},
     b["image"], None, train=False)[0])
-out = fn(state, dev)
-print("fwd ok", float(out.astype(jnp.float32).sum()))
+finish("fwd", fn, (state, dev),
+       lambda: float(fn(state, dev).astype(jnp.float32).sum()))
 """
 
 # The working train step's forward (train=True + mutable BN), no grad:
@@ -121,15 +160,15 @@ def f(s, b):
         rngs={{"dropout": jax.random.key(0)}})
     return outs[0]
 fn = jax.jit(f)
-out = fn(state, dev)
-print("fwd trainflag ok", float(out.astype(jnp.float32).sum()))
+finish("fwd_trainflag", fn, (state, dev),
+       lambda: float(fn(state, dev).astype(jnp.float32).sum()))
 """
 
 _EVAL_STEP = _PRELUDE + """
 from distributed_sod_project_tpu.train.step import make_eval_step
 estep = make_eval_step(model, mesh)
-probs = estep(state, dev)
-print("eval step ok", float(probs.astype(jnp.float32).sum()))
+finish("eval_step", estep, (state, dev),
+       lambda: float(estep(state, dev).astype(jnp.float32).sum()))
 """
 
 # Eval step + device-side metric accumulation (what bench --mode eval
@@ -140,15 +179,35 @@ from distributed_sod_project_tpu.metrics.streaming import (
     init_fbeta_state, update_fbeta_state)
 estep = make_eval_step(model, mesh)
 upd = jax.jit(update_fbeta_state, donate_argnums=0)
-acc = init_fbeta_state()
-for _ in range(3):
-    probs = estep(state, dev)
-    acc = upd(acc, probs, dev["mask"])
-print("eval+metrics ok", float(acc.mae_sum))
+
+
+def _run():
+    acc = init_fbeta_state()
+    for _ in range(3):
+        probs = estep(state, dev)
+        acc = upd(acc, probs, dev["mask"])
+    return float(acc.mae_sum)
+
+
+def _combined(acc, s, b):
+    return upd(acc, estep(s, b), b["mask"])
+
+
+finish("eval+metrics", jax.jit(_combined), (init_fbeta_state(), state, dev),
+       _run)
 """
 
 # (name, source, extra_env, batch_override) — order = smallest program
-# first; the known crasher stays LAST.
+# first; the known crasher stays LAST.  eval_metrics_nofuse (VERDICT
+# r3 item 7) runs the crasher's program with XLA's fusion passes
+# disabled: if IT survives where eval_metrics kills the worker, the
+# fault lives in a fused kernel (the scatter-metrics fusion suspect),
+# not in any single op — and vice versa.  Unknown pass names in
+# --xla_disable_hlo_passes are ignored, so the stage degrades to a
+# duplicate-of-crasher rather than an error on backends that name the
+# passes differently.
+_NOFUSE_FLAGS = ("--xla_disable_hlo_passes="
+                 "fusion,priority-fusion,multi-output-fusion")
 _STAGES = [
     ("metrics_only", _METRICS_ONLY, {}, None),
     ("backbone", _BACKBONE, {}, None),
@@ -157,6 +216,8 @@ _STAGES = [
     ("fwd_trainflag", _FWD_TRAINFLAG, {}, None),
     ("eval_step", _EVAL_STEP, {}, None),
     ("eval_xla_resize", _EVAL_STEP, {"DSOD_RESIZE_IMPL": "xla"}, None),
+    ("eval_metrics_nofuse", _EVAL_METRICS, {"XLA_FLAGS": _NOFUSE_FLAGS},
+     None),
     ("eval_metrics", _EVAL_METRICS, {}, None),
 ]
 
@@ -186,11 +247,20 @@ def main(argv=None) -> int:
                         "shapes (platform picked via config.update so a "
                         "wedged tunnel is never dialled); the bisect "
                         "itself is tpu")
+    p.add_argument("--export-check", action="store_true",
+                   help="no hardware: on the CPU backend, jax.export "
+                        "each stage's jitted program for platforms="
+                        "['tpu'] at the CRASH shapes instead of running "
+                        "it — rules lowering-level causes in or out "
+                        "(VERDICT r3 item 7); combine with the default "
+                        "--batch/--image-size for the real shapes")
     p.add_argument("--timeout", type=int, default=900)
     p.add_argument("--json-out", default=None,
                    help="write a {stage: verdict} summary here")
     args = p.parse_args(argv)
 
+    if args.export_check:
+        args.device = "cpu"
     platform_select = (
         'jax.config.update("jax_platforms", "cpu")'
         if args.device == "cpu" else "")
@@ -202,6 +272,8 @@ def main(argv=None) -> int:
         src = src.format(batch=b, hw=args.image_size,
                          platform_select=platform_select)
         env = dict(os.environ, **extra_env)
+        if args.export_check:
+            env["DSOD_BISECT_EXPORT"] = "1"
         print(f"== {name} (b={b}{', ' if extra_env else ''}"
               f"{' '.join(f'{k}={v}' for k, v in extra_env.items())})",
               flush=True)
