@@ -193,15 +193,18 @@ def minet_r50_ledger(b: int, hw: int = 320, s2d: bool = False,
     return ops
 
 
-def act_capacity_gb(b, hw=320) -> float:
-    """Rough live-activation footprint for the backward pass with NO
-    remat: every op output stays resident until its bwd consumes it
-    (upper bound — XLA frees what it can reorder around).  Against
-    v5e's 16 GB HBM this predicts where the batch curve hits the
-    capacity wall."""
+def act_capacity_gb(b, hw=320, policy: str = "none") -> float:
+    """Rough live-activation footprint for the backward pass (upper
+    bound — XLA frees what it can reorder around).  ``policy``:
+    'none' = no remat, every op output resident; 'dots' = the
+    ``remat_policy=dots`` checkpoint policy, only conv/matmul outputs
+    resident (elementwise recomputed).  Against v5e's 16 GB HBM this
+    predicts where the batch curve hits the capacity wall."""
     ops = minet_r50_ledger(b, hw=hw)
     n_out = 0.0
     for o in ops:
+        if policy == "dots" and not o.params:
+            continue
         # bytes = A*(n_in+n_out)+P*params for convs; A*n*(r+w) for
         # eltwise — recover n_out as the write half.
         writes = (o.bytes - P * o.params) / 2 if o.params else o.bytes / 2
@@ -209,16 +212,23 @@ def act_capacity_gb(b, hw=320) -> float:
     return n_out / 1e9
 
 
-def predict(b, remat=False, s2d=False, resize="fast", hw=320):
+def predict(b, remat=False, s2d=False, resize="fast", hw=320,
+            remat_policy="none"):
     ops = minet_r50_ledger(b, hw=hw, s2d=s2d, resize=resize)
     rows = {}
     tot_f = tot_b = tot_t = 0.0
     for o in ops:
         f = o.flops + o.bwd_flops
         by = o.bytes + o.bwd_bytes
-        if remat:  # policy=none: bwd re-runs the forward
-            f += o.flops
-            by += o.bytes
+        if remat:
+            if remat_policy == "dots":
+                # conv outputs saved; only elementwise recomputed
+                if not o.params:
+                    f += o.flops
+                    by += o.bytes
+            else:  # policy=none: bwd re-runs the whole forward
+                f += o.flops
+                by += o.bytes
         t = max(f / PEAK_FLOPS, by / HBM_BW)
         r = rows.setdefault(o.res, [0.0, 0.0, 0.0])
         r[0] += f
@@ -230,9 +240,12 @@ def predict(b, remat=False, s2d=False, resize="fast", hw=320):
     return rows, tot_f, tot_b, tot_t
 
 
-def fmt_pred(b, remat=False, s2d=False, resize="fast"):
-    rows, tf, tb, tt = predict(b, remat=remat, s2d=s2d, resize=resize)
-    out = [f"## predicted  b{b}  remat={'on' if remat else 'off'}  "
+def fmt_pred(b, remat=False, s2d=False, resize="fast",
+             remat_policy="none"):
+    rows, tf, tb, tt = predict(b, remat=remat, s2d=s2d, resize=resize,
+                               remat_policy=remat_policy)
+    tag = f"on[{remat_policy}]" if remat else "off"
+    out = [f"## predicted  b{b}  remat={tag}  "
            f"stem={'s2d' if s2d else 'plain'}  resize={resize}",
            "| res | GFLOPs | HBM GB | roofline ms | bound |",
            "|---|---|---|---|---|"]
@@ -248,9 +261,12 @@ def fmt_pred(b, remat=False, s2d=False, resize="fast"):
     out.append(f"roofline-ideal: {ideal:.1f} img/s/chip, MFU {mfu:.0%} "
                f"(intensity {tf / tb:.0f} FLOPs/B vs ridge "
                f"{PEAK_FLOPS / HBM_BW:.0f})")
-    if not remat:
-        out.append(f"no-remat live activations (upper bound): "
-                   f"~{act_capacity_gb(b):.1f} GB vs 16 GB v5e HBM")
+    policy = remat_policy if remat else "none"
+    if not remat or remat_policy == "dots":
+        cap = act_capacity_gb(b, policy=policy if remat else "none")
+        label = "dots-saved" if remat else "no-remat live"
+        out.append(f"{label} activations (upper bound): "
+                   f"~{cap:.1f} GB vs 16 GB v5e HBM")
     return "\n".join(out)
 
 
@@ -398,6 +414,12 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=None,
                    help="single batch size (default: the b32/64/128 sweep)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", choices=["none", "dots"],
+                   default="none",
+                   help="with --remat: the model.remat_policy knob — "
+                        "'none' re-runs the whole forward in bwd, "
+                        "'dots' keeps conv outputs (capacity cost) "
+                        "and recomputes only elementwise")
     p.add_argument("--s2d", action="store_true")
     p.add_argument("--resize", choices=["fast", "xla"], default="fast")
     p.add_argument("--trace", help="profile dir to reconcile against")
@@ -411,7 +433,8 @@ def main(argv=None) -> int:
     batches = [args.batch] if args.batch else [32, 64, 128]
     for b in batches:
         print(fmt_pred(b, remat=args.remat, s2d=args.s2d,
-                       resize=args.resize))
+                       resize=args.resize,
+                       remat_policy=args.remat_policy))
         print()
     if args.trace:
         print(f"## measured ({args.trace})")

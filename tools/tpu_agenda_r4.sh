@@ -85,6 +85,17 @@ run s2d_b128 900 $BENCH --config minet_r50_dp
 run s2d_b32  900 $BENCH --config minet_r50_dp --batch-per-chip 32
 unset DSOD_STEM_IMPL
 
+# -- 4c. remat-POLICY A/B (round 4; never measured): policy=dots keeps
+#        conv outputs and recomputes only elementwise — the roofline
+#        (docs/PERFORMANCE.md) predicts its backward adds ~25 GB/step
+#        less recompute traffic than policy=none at b64, at the cost
+#        of conv-output capacity.  b128+dots probes the capacity edge
+#        (predicted tight against 16 GB); timeout/OOM is an answer.
+run dots_b64  900 $BENCH --config minet_r50_dp --batch-per-chip 64 \
+    --set model.remat=true --set model.remat_policy=dots
+run dots_b128 900 $BENCH --config minet_r50_dp \
+    --set model.remat=true --set model.remat_policy=dots
+
 # -- 5. past-b128 exploration (round-2 b256 attempt died >900s; give it
 #       a real compile budget and record timeout-as-answer otherwise)
 run b256_remat 1600 python bench.py --device tpu --steps 20 --watchdog 1500 \
