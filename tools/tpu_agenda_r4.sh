@@ -33,8 +33,25 @@ R=${R:-tpu_results4}
 mkdir -p "$R"
 BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
 
+# A leg is DONE if a prior firing recorded rc=0 with no error field —
+# the observed tunnel serves SHORT windows, so a re-fired agenda must
+# spend them on legs that still lack numbers, not on repeats (the
+# watcher re-fires this script until every leg lands or its firing
+# budget runs out).
+done_ok() {
+  [ -f "$R"/results.jsonl ] || return 1
+  local rec
+  rec=$(grep "\"step\": \"$1\", \"rc\": 0" "$R"/results.jsonl | tail -1)
+  [ -n "$rec" ] || return 1
+  ! printf '%s' "$rec" | grep -q '"error"'
+}
+
 run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
   local name=$1 tmo=$2; shift 2
+  if done_ok "$name"; then
+    echo "[$name] skip: succeeded in a previous window" | tee -a "$R"/agenda.log
+    return 0
+  fi
   echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
   timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
   local rc=$?
@@ -147,9 +164,13 @@ run an_b64  600 python tools/analyze_trace.py "$R"/trace_b64 --top 25
 #       item 7 — CPU-side stage exclusion — updates the bisect's stage
 #       list separately this round; this leg runs whatever the current
 #       tools/bisect_swin_eval.py stage list is.)
-echo "=== swin_bisect [$(date -u +%H:%M:%S)] — NOTHING runs after this" | tee -a "$R"/agenda.log
-timeout 2400 python tools/bisect_swin_eval.py --json-out "$R"/swin_bisect.json > "$R"/swin_bisect.out 2> "$R"/swin_bisect.err
-echo "{\"step\": \"swin_bisect\", \"rc\": $?}" >> "$R"/results.jsonl
-tail -40 "$R"/swin_bisect.out | tee -a "$R"/agenda.log
+if grep -q '"step": "swin_bisect", "rc": 0' "$R"/results.jsonl 2>/dev/null; then
+  echo "[swin_bisect] skip: completed in a previous window" | tee -a "$R"/agenda.log
+else
+  echo "=== swin_bisect [$(date -u +%H:%M:%S)] — NOTHING runs after this" | tee -a "$R"/agenda.log
+  timeout 2400 python tools/bisect_swin_eval.py --json-out "$R"/swin_bisect.json > "$R"/swin_bisect.out 2> "$R"/swin_bisect.err
+  echo "{\"step\": \"swin_bisect\", \"rc\": $?}" >> "$R"/results.jsonl
+  tail -40 "$R"/swin_bisect.out | tee -a "$R"/agenda.log
+fi
 
 echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
