@@ -315,3 +315,42 @@ def test_roofline_ledger_and_buckets(capsys):
     assert roofline.main(["--batch", "64", "--remat"]) == 0
     out = capsys.readouterr().out
     assert "roofline-ideal" in out and "| 160 |" in out
+
+
+def test_make_tiny_dataset_heldout_split(tmp_path):
+    """--eval-n (round 4): the held-out split must be genuinely
+    disjoint from the train split — distinct stems (no PNG can shadow
+    a train file through the prediction-matching path) and distinct
+    image content (the rng stream continues past the train draws, so
+    an accidental reseed that replayed the same ellipses would turn
+    the 'generalization' band into a memorization test)."""
+    import numpy as np
+    from PIL import Image
+
+    from make_tiny_dataset import main as make_ds
+
+    out = str(tmp_path / "t")
+    make_ds(["--out", out, "--n", "4", "--size", "32", "--seed", "7",
+             "--eval-n", "3"])
+    tr = sorted(os.listdir(os.path.join(out, "DUTS-TR-Image")))
+    ev_root = out + "_eval"
+    ev = sorted(os.listdir(os.path.join(ev_root, "DUTS-TR-Image")))
+    assert len(tr) == 4 and len(ev) == 3
+    assert not (set(tr) & set(ev))
+    assert all(s.startswith("tinyeval_") for s in ev)
+
+    def imgs(root, names):
+        return [np.asarray(Image.open(os.path.join(root,
+                "DUTS-TR-Image", n))) for n in names]
+
+    for e in imgs(ev_root, ev):
+        assert all(not np.array_equal(e, t) for t in imgs(out, tr))
+
+    # Determinism: the same seed reproduces both splits bit-for-bit.
+    out2 = str(tmp_path / "t2")
+    make_ds(["--out", out2, "--n", "4", "--size", "32", "--seed", "7",
+             "--eval-n", "3", "--eval-out", out2 + "_ev"])
+    a = imgs(ev_root, ev)
+    b = imgs(out2 + "_ev", sorted(os.listdir(
+        os.path.join(out2 + "_ev", "DUTS-TR-Image"))))
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
