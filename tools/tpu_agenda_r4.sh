@@ -158,6 +158,11 @@ run zoo_swin_train 1200 python tools/bench_zoo.py --device tpu --timeout 900 \
 #    bisect only because NOTHING may run after the bisect)
 run an_b128 600 python tools/analyze_trace.py "$R"/trace_b128 --top 25
 run an_b64  600 python tools/analyze_trace.py "$R"/trace_b64 --top 25
+# roofline reconciliation on the FRESH traces (host-side): lands the
+# predicted-vs-measured table for docs/PERFORMANCE.md in the same
+# window the trace was captured.
+run rl_b128 600 python tools/roofline.py --batch 128 --trace "$R"/trace_b128
+run rl_b64  600 python tools/roofline.py --batch 64 --trace "$R"/trace_b64
 
 # -- 9. LAST: the swin eval bisect. Known to kill the TPU worker; the
 #       tunnel may be unusable for hours afterwards.  (VERDICT r3
