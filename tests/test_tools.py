@@ -354,3 +354,35 @@ def test_make_tiny_dataset_heldout_split(tmp_path):
     b = imgs(out2 + "_ev", sorted(os.listdir(
         os.path.join(out2 + "_ev", "DUTS-TR-Image"))))
     assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def test_window_report_renders_and_recommends(tmp_path, capsys):
+    """tools/window_report.py: latest-record-wins dedup, error/rc
+    surfacing, A/B ratios, and the pre-committed decision rules
+    (recommend-only — the tool must never edit configs)."""
+    import window_report
+
+    p = tmp_path / "results.jsonl"
+    p.write_text("\n".join([
+        '{"step": "headline_b128", "rc": 0, "result": {"value": 378.2,'
+        ' "unit": "images/sec/chip", "mfu": 0.28}}',
+        '{"step": "vit_attn_xla", "rc": 0, "result": {"value": 21.0}}',
+        '{"step": "vit_attn_flash", "rc": 0, "result": {"value": 25.0}}',
+        '{"step": "eval_b32", "rc": 0, "result": {"value": 0.0,'
+        ' "error": "UNAVAILABLE"}}',
+        '{"step": "b256_remat", "rc": 124, "result": null}',
+        # re-fired headline: the later record must win
+        '{"step": "headline_b128", "rc": 0, "result": {"value": 400.0,'
+        ' "unit": "images/sec/chip", "mfu": 0.30}}',
+    ]))
+    assert window_report.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "| headline_b128 | 400.0 |" in out          # dedup
+    assert "UNAVAILABLE" in out and "rc=124" in out    # failures visible
+    assert "1.190" in out                              # flash/xla ratio
+    assert "RE-FLIP vit_sod_hires" in out              # rule fires
+    # An error-result leg never counts as a value.
+    assert window_report.value(window_report.load(str(p)),
+                               "eval_b32") is None
+
+    assert window_report.main([str(tmp_path / "nope.jsonl")]) == 1

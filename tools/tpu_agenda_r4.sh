@@ -178,4 +178,11 @@ else
   tail -40 "$R"/swin_bisect.out | tee -a "$R"/agenda.log
 fi
 
+# Host-side window report (touches no TPU — safe after the bisect):
+# the capture rendered as BASELINE.md-ready tables + the pre-committed
+# decision rules evaluated against the numbers.
+timeout 120 python tools/window_report.py "$R"/results.jsonl \
+    > "$R"/window_report.md 2> "$R"/window_report.err || true
+tail -20 "$R"/window_report.md | tee -a "$R"/agenda.log
+
 echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
