@@ -46,6 +46,20 @@ done_ok() {
   ! printf '%s' "$rec" | grep -q '"error"'
 }
 
+# Circuit breaker: after any failed leg, verify the tunnel still runs
+# REAL compute (devices() alone is not evidence — the 2026-08-02 window
+# enumerated fine while every dispatch wedged).  If the probe wedges
+# too, abort this firing immediately: the watcher re-fires the agenda
+# in the next window and done_ok() skips what already landed.  Without
+# this, a wedge at leg k burns (N-k) x ~270-900s on a dead tunnel.
+tunnel_computes() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('computes')" 2>/dev/null | grep -q computes
+}
+
 run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
   local name=$1 tmo=$2; shift 2
   if done_ok "$name"; then
@@ -59,6 +73,12 @@ run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
   line=$(grep -E '^\{' "$R/$name.out" | tail -1)
   echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
   echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+  if { [ "$rc" -ne 0 ] || printf '%s' "$line" | grep -Eq 'wedged|unavailable'; } \
+      && ! tunnel_computes; then
+    echo "[$name] tunnel no longer computes — aborting firing (watcher will re-fire)" \
+      | tee -a "$R"/agenda.log
+    exit 2
+  fi
 }
 
 # -- 1. canonical headline (b128 default, fast resize, no env tags).

@@ -25,7 +25,17 @@ n=0
 firings=0
 while [ "$(date +%s)" -lt "$deadline" ]; do
   n=$((n + 1))
-  plat=$(timeout 100 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+  # The probe must run REAL compute, not just enumerate devices: the
+  # 2026-08-02 window showed the tunnel answering jax.devices() in <5s
+  # while every dispatched program (even a 1024x1024 matmul) wedged
+  # forever.  An enumerate-only probe would burn an agenda firing
+  # (MAX_FIRINGS budget) on a tunnel that cannot execute anything.
+  plat=$(timeout 100 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print(d.platform)" 2>/dev/null | tail -1)
   case "$plat" in
     tpu|TPU|axon)
       firings=$((firings + 1))
