@@ -11,15 +11,26 @@ and restore is sharding-aware: passing a template whose leaves carry
 One checkpoint = the whole ``TrainState`` pytree (step / params /
 batch_stats / opt_state) — exact resume, including optimizer momentum,
 matching §4's "save→restore→bitwise-state equality" test contract.
+
+Integrity (resilience/integrity.py): orbax's own ``latest_step()``
+trusts any digit-named dir, including one whose finalize was killed by
+preemption — restoring that crashes the run (reproduced on orbax
+0.7.0).  This manager validates step dirs, writes size manifests after
+saves finalize, and exposes :meth:`restore_latest_valid`, which
+quarantines corrupt dirs and falls back to the newest VALID checkpoint
+instead of raising.  docs/RESILIENCE.md has the failure-mode table.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import orbax.checkpoint as ocp
+
+from ..resilience import integrity
+from ..utils.logging import get_logger
 
 
 class CheckpointManager:
@@ -32,6 +43,10 @@ class CheckpointManager:
       "best-metric save", SURVEY.md §3.4).
     - saves are async: ``wait()`` blocks until durable (called before
       process exit and in tests).
+    - ``latest_step``/``restore_latest_valid`` skip tmp/incomplete/
+      corrupt step dirs (resilience/integrity.py) so a
+      preemption-truncated save can never be selected as the resume
+      point.
     """
 
     def __init__(
@@ -55,20 +70,48 @@ class CheckpointManager:
         )
         self._mgr = ocp.CheckpointManager(directory, options=opts)
 
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
     def save(self, step: int, state: Any, metrics: Optional[dict] = None,
              force: bool = False) -> bool:
         """Queue an async save of ``state`` at ``step``; returns whether a
         save was actually started (save_interval/keep policy may skip)."""
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
-        return self._mgr.save(
+        started = self._mgr.save(
             int(step),
             args=ocp.args.StandardSave(state),
             metrics=metrics or None,
             force=force,
         )
+        # Earlier saves have finalized by now (orbax serializes async
+        # saves); manifest them so restore can verify sizes.  THIS
+        # step's manifest lands at the next save/wait.
+        self._write_pending_manifests(exclude=int(step))
+        # Fault injection (chaos suite): truncate this step the way a
+        # mid-finalize preemption does.  No-op without DSOD_FAULTS, and
+        # the synchronous wait only happens when THIS step is scheduled
+        # for truncation — any other plan must leave save timing
+        # untouched or the chaos runs would not exercise the real async
+        # save path.
+        from ..resilience.inject import plan_from_env
+
+        plan = plan_from_env()
+        if (plan is not None and started
+                and int(step) in plan.truncate_steps):
+            self._mgr.wait_until_finished()
+            plan.maybe_truncate_ckpt(int(step), self._step_dir(step))
+        return started
+
+    def _write_pending_manifests(self, exclude: Optional[int] = None):
+        for step, path in integrity.list_step_dirs(self.directory).items():
+            if step == exclude or integrity.has_manifest(path):
+                continue
+            if os.path.isfile(os.path.join(path, "_CHECKPOINT_METADATA")):
+                integrity.write_manifest(path)
 
     def restore(self, template: Any, step: Optional[int] = None) -> Any:
-        """Restore the state saved at ``step`` (default: latest).
+        """Restore the state saved at ``step`` (default: latest valid).
 
         ``template`` is a concrete or abstract ``TrainState`` with the
         target shapes/dtypes/shardings (build it with
@@ -82,14 +125,99 @@ class CheckpointManager:
         return self._mgr.restore(
             int(step), args=ocp.args.StandardRestore(template))
 
+    def restore_raw(self, step: int) -> Any:
+        """Template-free restore: the saved pytree as host arrays (the
+        inspection/test path — no shardings, no dtype casting)."""
+        return self._mgr.restore(
+            int(step), args=ocp.args.StandardRestore())
+
+    def restore_latest_valid(self, template: Any,
+                             max_fallbacks: int = 2) -> Tuple[Any, Optional[int]]:
+        """Restore the newest checkpoint that validates AND restores.
+
+        Corrupt candidates are quarantined (moved under
+        ``_quarantine/``, never deleted) and the next-newest is tried,
+        so one truncated save costs ``checkpoint_every_steps`` of
+        recompute instead of the whole run.  Returns
+        ``(state, step)`` or ``(template, None)`` when nothing valid
+        remains.
+
+        ``max_fallbacks`` bounds the blast radius: per-dir corruption
+        is localized, so after that many restore failures in one call
+        the error is systemic (template shape mismatch, storage outage)
+        and the last one re-raises instead of serially quarantining
+        every good checkpoint and silently restarting from scratch.
+        """
+        log = get_logger()
+        self.quarantine_invalid()
+        fallbacks = 0
+        for step in sorted(self.valid_steps(), reverse=True):
+            try:
+                return self.restore(template, step), step
+            except Exception as e:  # noqa: BLE001 — quarantine + fall back
+                if fallbacks >= max_fallbacks:
+                    log.error(
+                        "checkpoint step %d is consecutive restore "
+                        "failure #%d (%r) — systemic, re-raising "
+                        "instead of quarantining further", step,
+                        fallbacks + 1, e)
+                    raise
+                fallbacks += 1
+                path = self._step_dir(step)
+                reason = f"validated but failed restore: {e!r}"
+                log.warning("checkpoint step %d %s — quarantining",
+                            step, reason)
+                integrity.quarantine_step_dir(path, reason)
+                self.reload()
+        return template, None
+
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        """Newest VALID step (tmp/incomplete/corrupt dirs skipped)."""
+        steps = self.valid_steps()
+        return max(steps) if steps else None
+
+    def valid_steps(self) -> List[int]:
+        """Steps whose dirs pass integrity validation, ascending."""
+        out = []
+        for step, path in sorted(
+                integrity.list_step_dirs(self.directory).items()):
+            ok, reason = integrity.validate_step_dir(path)
+            if ok:
+                out.append(step)
+            else:
+                get_logger().warning(
+                    "skipping checkpoint step %d: %s", step, reason)
+        return out
+
+    def quarantine_invalid(self) -> List[int]:
+        """Move every step dir that fails validation under
+        ``_quarantine/`` (evidence kept for post-mortem); returns the
+        quarantined steps."""
+        gone = []
+        for step, path in sorted(
+                integrity.list_step_dirs(self.directory).items()):
+            ok, reason = integrity.validate_step_dir(path)
+            if not ok:
+                if integrity.quarantine_step_dir(path, reason):
+                    gone.append(step)
+                    get_logger().warning(
+                        "quarantined checkpoint step %d: %s", step, reason)
+        if gone:
+            self.reload()
+        return gone
 
     def all_steps(self):
         return sorted(self._mgr.all_steps())
 
+    def reload(self):
+        """Re-scan the directory (after quarantine moved dirs aside —
+        orbax caches its step list)."""
+        if hasattr(self._mgr, "reload"):
+            self._mgr.reload()
+
     def wait(self):
         self._mgr.wait_until_finished()
+        self._write_pending_manifests()
 
     def close(self):
         self.wait()
@@ -116,12 +244,10 @@ class CheckpointManager:
 
 def restore_latest(directory: str, template: Any) -> Tuple[Any, Optional[int]]:
     """Convenience for ``--resume``: returns ``(state, step)`` from the
-    newest checkpoint, or ``(template, None)`` if none exists yet."""
+    newest VALID checkpoint (corrupt ones quarantined), or
+    ``(template, None)`` if none exists yet."""
     mgr = CheckpointManager(directory, async_save=False)
     try:
-        step = mgr.latest_step()
-        if step is None:
-            return template, None
-        return mgr.restore(template, step), step
+        return mgr.restore_latest_valid(template)
     finally:
         mgr.close()

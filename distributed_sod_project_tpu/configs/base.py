@@ -41,6 +41,17 @@ class DataConfig:
     # the resize rides the device, not the input pipeline.  Use
     # multiples of 32 (backbone strides + fused-loss lane alignment).
     multiscale: Tuple[int, ...] = ()
+    # >0: re-run the cheap non-finite batch check every N batches (the
+    # first batch is always fully validated); 0 keeps the once-only
+    # behavior.  Catches mid-run data corruption before it becomes an
+    # unexplained divergence (utils/checks.py).
+    validate_every: int = 0
+    # >0: tolerate this many corrupt samples per run — each is skipped
+    # (deterministic next-index substitution) and counted into the
+    # `data_skipped` metric instead of killing the epoch; budget
+    # exhaustion raises.  0 = fail on the first corrupt sample.
+    # See resilience/dataguard.py and docs/RESILIENCE.md.
+    skip_budget: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -167,6 +178,16 @@ class ExperimentConfig:
     best_metric: Optional[str] = None  # e.g. "max_fbeta": keep best ckpts
     best_mode: str = "max"  # "min" for lower-is-better metrics (mae)
     tensorboard: bool = True  # event files under <workdir>/tb
+    # >0: arm the step watchdog (resilience/watchdog.py): a train step
+    # exceeding this many seconds means the wedged-dispatch failure
+    # mode (device answers enumeration, programs never complete) —
+    # dump stacks + last metrics and exit with code 114 so the
+    # supervising layer re-fires and resumes.  Must exceed the slowest
+    # legitimate step.  0 = off.
+    watchdog_deadline_s: float = 0.0
+    # Grace for the FIRST step, which includes XLA compilation
+    # (minutes, legitimately).  Only read when the watchdog is armed.
+    watchdog_compile_grace_s: float = 600.0
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

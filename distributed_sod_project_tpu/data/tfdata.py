@@ -51,9 +51,17 @@ class TFDataLoader:
         rotate_degrees: float = 0.0,
         color_jitter: float = 0.0,
         num_workers: int = 4,
+        skip_budget: int = 0,
     ):
         self.rotate_degrees = float(rotate_degrees)
         self.color_jitter = float(color_jitter)
+        # Corrupt-sample degradation (resilience/dataguard.py): with a
+        # budget, decode errors are dropped inside the TF graph
+        # (ignore_errors) and the resulting epoch-end batch shortfall
+        # is charged against the budget; without one, the first decode
+        # error propagates (fail fast, the historical behavior).
+        self.skip_budget = int(skip_budget)
+        self.skipped = 0
         if global_batch_size % num_shards != 0:
             raise ValueError(
                 f"global_batch_size={global_batch_size} not divisible by "
@@ -228,21 +236,55 @@ class TFDataLoader:
             return out
 
         ds = (tf.data.Dataset.from_tensor_slices(tensors)
-              .map(decode, num_parallel_calls=max(1, self.num_workers))
-              .batch(self.local_batch_size, drop_remainder=True)
-              .prefetch(2))
+              .map(decode, num_parallel_calls=max(1, self.num_workers)))
+        if self.skip_budget > 0:
+            # Drop undecodable samples inside the graph instead of
+            # killing the epoch; the shortfall check below bounds how
+            # many may vanish before we fail anyway.
+            ds = ds.apply(tf.data.experimental.ignore_errors())
+        ds = ds.batch(self.local_batch_size, drop_remainder=True).prefetch(2)
+        got = 0
         for batch in ds.as_numpy_iterator():
             batch.pop("img_path", None)
             batch.pop("mask_path", None)
             batch.pop("depth_path", None)
+            got += 1
             yield batch
+        if self.skip_budget > 0:
+            # ignore_errors is silent; charge the observable effect —
+            # whole batches missing at epoch end — against the budget
+            # so unbounded skipping can't shrink the dataset quietly.
+            # Only a FULLY-DRAINED epoch can be charged: on an early
+            # break (total_steps reached, preemption stop) the shortfall
+            # is indistinguishable from batches the consumer never asked
+            # for, so that partial epoch goes uncounted rather than
+            # false-positively exhausting the budget.
+            lost = (steps - start - got) * self.local_batch_size
+            if lost > 0:
+                self.skipped += lost
+            if self.skipped > self.skip_budget:
+                from ..resilience.dataguard import SkipBudgetExhausted
+
+                raise SkipBudgetExhausted(
+                    f"tfdata epoch {epoch} lost ≥{lost} samples to decode "
+                    f"errors; total skipped {self.skipped} exceeds "
+                    f"skip_budget={self.skip_budget}")
 
 
 def make_loader(dataset, data_cfg, **kw):
-    """Backend dispatch: 'host' (default), 'tfdata', or 'grain'."""
+    """Backend dispatch: 'host' (default), 'tfdata', or 'grain'.
+
+    ``skip_budget`` is consumed here: the host/grain backends fetch
+    sample-by-sample through the (possibly GuardedDataset-wrapped)
+    dataset, which enforces the budget itself; only the tf.data
+    backend — which decodes inside the TF graph, bypassing
+    ``dataset[i]`` — needs the budget to drive its own
+    ignore_errors + shortfall degradation (see TFDataLoader).
+    """
     backend = getattr(data_cfg, "backend", "host")
+    skip_budget = int(kw.pop("skip_budget", 0))
     if backend == "tfdata":
-        return TFDataLoader(dataset, **kw)
+        return TFDataLoader(dataset, skip_budget=skip_budget, **kw)
     if backend == "grain":
         from .grain_pipeline import GrainLoader
 

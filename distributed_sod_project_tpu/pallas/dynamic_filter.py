@@ -39,12 +39,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax ≥ 0.6 renamed TPUCompilerParams → CompilerParams; take whichever
+# this jax ships (the utils/compat.py version-skew pattern — same
+# vmem_limit_bytes keyword either way).
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 # Beyond this many f32 elements for the padded x tile, fall back to the
 # XLA im2col path rather than risk VMEM pressure (≈8 MB at f32, and the
 # kernel maps add T·H·W on top).
 _MAX_TILE_ELEMS = 2 * 1024 * 1024
 
-def _compiler_params() -> pltpu.CompilerParams:
+def _compiler_params() -> "_CompilerParams":
     """Per-kernel scoped-VMEM ceiling, gated on the device generation.
 
     First real-v5e exposure (round 2): at (32,80,80,64)·bf16, XLA's
@@ -71,8 +77,8 @@ def _compiler_params() -> pltpu.CompilerParams:
     env = os.environ.get("DSOD_DLF_VMEM_MB")
     if env is not None:
         mb = int(env)
-        return (pltpu.CompilerParams() if mb <= 0
-                else pltpu.CompilerParams(vmem_limit_bytes=mb * 1024 * 1024))
+        return (_CompilerParams() if mb <= 0
+                else _CompilerParams(vmem_limit_bytes=mb * 1024 * 1024))
     try:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:
@@ -80,8 +86,8 @@ def _compiler_params() -> pltpu.CompilerParams:
     # "tpu v2" / "tpu v3" (word-bounded so e.g. "v23"/"v32" never match).
     small_vmem = re.search(r"\bv[23]\b", kind) is not None
     if small_vmem:
-        return pltpu.CompilerParams()
-    return pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+        return _CompilerParams()
+    return _CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
 
 def _taps(ksize: int, dilation: int):

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .ring_attention import resolve_attn_fn
+from ..utils.compat import axis_size, shard_map
 
 
 def ulysses_attention(
@@ -45,7 +46,7 @@ def ulysses_attention(
     ``ring_attention`` layout); returns the same shape/dtype.
     Requires ``H % axis_size == 0``.
     """
-    s = lax.axis_size(axis_name)
+    s = axis_size(axis_name)
     h = q.shape[1]
     if h % s:
         raise ValueError(
@@ -80,6 +81,6 @@ def make_ulysses_attention_fn(mesh, causal: bool = False,
         return ulysses_attention(q, k, v, axis_name="seq", causal=causal,
                                  attn_impl=attn_impl)
 
-    sharded = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                             out_specs=spec, check_vma=False)
     return jax.jit(sharded)

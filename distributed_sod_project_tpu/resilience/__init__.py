@@ -1,0 +1,48 @@
+"""Fault tolerance: checkpoint integrity, supervised retries, watchdog,
+data-path degradation, and deterministic fault injection.
+
+The 2026-08-02 TPU window (docs/RESILIENCE.md) showed two failure modes
+this package exists for: dispatched programs wedging indefinitely while
+``jax.devices()`` still answers, and preemption-truncated orbax step
+dirs being selected as the resume point.  Every piece here maps to a
+failure already observed or structurally possible in this stack:
+
+- :mod:`.integrity` — validate/quarantine checkpoint step dirs so
+  restore always lands on the newest *valid* checkpoint.
+- :mod:`.watchdog` — in-process step heartbeat; a wedged step becomes a
+  bounded-time exit (code 114) with stack-dump diagnostics.
+- :mod:`.supervisor` — wraps ``fit`` with rollback-and-retry on
+  divergence/restore failure, with a bounded budget and LR degradation.
+- :mod:`.inject` — deterministic, env-gated fault injection points
+  driving the chaos suite (tests/test_resilience.py).
+- :mod:`.dataguard` — bounded skip-budget for corrupt samples,
+  surfaced as a counter metric instead of an epoch-killing exception.
+"""
+
+from .dataguard import GuardedDataset
+from .inject import FaultPlan, plan_from_env, reset_plans
+from .integrity import (quarantine_step_dir, validate_step_dir,
+                        write_manifest)
+from .watchdog import WATCHDOG_EXIT_CODE, StepWatchdog
+
+__all__ = [
+    "GuardedDataset",
+    "FaultPlan",
+    "plan_from_env",
+    "reset_plans",
+    "quarantine_step_dir",
+    "validate_step_dir",
+    "write_manifest",
+    "WATCHDOG_EXIT_CODE",
+    "StepWatchdog",
+    "run_supervised",
+]
+
+
+def run_supervised(*args, **kw):
+    """Lazy alias for :func:`.supervisor.run_supervised` (the supervisor
+    imports the train loop; importing it eagerly here would cycle
+    through ckpt/manager.py's integrity import)."""
+    from .supervisor import run_supervised as _run
+
+    return _run(*args, **kw)

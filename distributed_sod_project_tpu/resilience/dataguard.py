@@ -1,0 +1,105 @@
+"""Data-path graceful degradation: a bounded skip-budget for corrupt
+samples.
+
+One truncated JPEG three hours into an epoch should not kill a
+pod-scale run — but UNBOUNDED skipping silently trains on a shrinking
+dataset, so the budget is finite and exhaustion re-raises the original
+error.  ``GuardedDataset`` wraps any map-style dataset (FolderSOD,
+SyntheticSOD, …): a fetch that raises, or returns non-finite pixels, is
+replaced by the next index (deterministic substitution — every rank
+substitutes identically, so multi-host batch composition stays in
+lockstep) and counted.  The count surfaces as the ``data_skipped``
+train metric instead of an epoch-killing exception.
+
+Backend coverage: the host loader (``_fetch``) and the grain loader
+(``_ShardView.__getitem__``) both fetch through ``dataset[i]``, so
+wrapping the dataset covers them sample-exactly.  The tf.data backend
+decodes inside the TF graph from raw paths; it degrades via
+``ignore_errors()`` + an epoch-end shortfall check against the same
+budget (data/tfdata.py).  The native C++ batch decoder already falls
+back to the (guarded) PIL path on decode errors.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+
+class SkipBudgetExhausted(RuntimeError):
+    pass
+
+
+class GuardedDataset:
+    """Map-style dataset wrapper with a bounded corrupt-sample budget.
+
+    ``skip_budget`` is the total number of substitutions allowed for
+    the lifetime of this wrapper (i.e. the run).  ``max_probe`` bounds
+    the substitution chain per fetch so a fully-corrupt directory
+    fails fast instead of walking the whole dataset.
+    """
+
+    def __init__(self, dataset, skip_budget: int = 0,
+                 fault_plan=None, max_probe: int = 4,
+                 check_finite: bool = True):
+        self._dataset = dataset
+        self.skip_budget = int(skip_budget)
+        self.max_probe = int(max_probe)
+        self.check_finite = check_finite
+        self._plan = fault_plan
+        self.skipped = 0
+        self.skipped_indices: List[int] = []
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._dataset)
+
+    def __getattr__(self, name):
+        # stems/img_paths/mean/std/image_size/load_batch… pass through,
+        # so every loader backend accepts the wrapper as-is.
+        return getattr(self._dataset, name)
+
+    def _fetch_one(self, index: int) -> Dict[str, np.ndarray]:
+        if self._plan is not None:
+            self._plan.check_sample(index)
+        sample = self._dataset[index]
+        if self.check_finite:
+            img = sample.get("image") if isinstance(sample, dict) else None
+            if img is not None and not np.all(np.isfinite(img)):
+                raise ValueError(
+                    f"non-finite pixels in sample {index} (corrupt decode)")
+        return sample
+
+    def _spend(self, index: int, err: Exception) -> None:
+        with self._lock:
+            if self.skipped >= self.skip_budget:
+                raise SkipBudgetExhausted(
+                    f"corrupt-sample skip budget ({self.skip_budget}) "
+                    f"exhausted at dataset index {index}: {err}") from err
+            self.skipped += 1
+            self.skipped_indices.append(int(index))
+        get_logger().warning(
+            "corrupt sample at index %d (%s) — substituting next index "
+            "(%d/%d budget spent)", index, err, self.skipped,
+            self.skip_budget)
+
+    def __getitem__(self, index: int) -> Dict[str, np.ndarray]:
+        index = int(index)
+        n = len(self._dataset)
+        err: Optional[Exception] = None
+        for probe in range(self.max_probe + 1):
+            j = (index + probe) % n
+            try:
+                return self._fetch_one(j)
+            except Exception as e:  # noqa: BLE001 — budget decides
+                # Every failed probe is a distinct corrupt sample:
+                # each one spends budget (and exhaustion raises here).
+                self._spend(j, e)
+                err = e
+        raise SkipBudgetExhausted(
+            f"no readable substitute within {self.max_probe} probes of "
+            f"index {index}") from err

@@ -1,0 +1,144 @@
+"""Deterministic fault injection — the chaos harness's hand on the lever.
+
+Faults are declared in the ``DSOD_FAULTS`` env var (config-free so the
+same injection reaches subprocesses and multi-host workers verbatim)
+as a comma-separated list of ``kind@where`` specs:
+
+- ``nan_grad@S`` / ``nan_grad@SxN`` — poison one pixel of the batch to
+  NaN for the N (default 1) consecutive steps starting at step S
+  (1-based, as logged), producing non-finite gradients through the real
+  backward path — the bf16-overflow / corrupt-decode divergence mode.
+- ``sigterm@S`` — deliver SIGTERM to this process after step S
+  completes (preemption arriving mid-epoch).
+- ``stall@S:SEC`` — block step S for SEC seconds before the heartbeat
+  (the wedged-dispatch mode the watchdog exists for).
+- ``corrupt_sample@I`` — dataset index I raises at fetch time
+  (truncated JPEG, bitrot) — exercised through GuardedDataset.
+- ``truncate_ckpt@S`` — right after the save of step S finalizes,
+  truncate its step dir the way a mid-finalize preemption does.
+
+Every fault fires ONCE per process: plans are cached per spec string,
+so a supervised retry (resilience/supervisor.py) re-runs clean — the
+transient-fault model the chaos suite asserts recovery under.  All
+injection points are no-ops (a dict lookup) when ``DSOD_FAULTS`` is
+unset; production pays nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..utils.logging import get_logger
+
+ENV_VAR = "DSOD_FAULTS"
+
+KINDS = ("nan_grad", "sigterm", "stall", "corrupt_sample", "truncate_ckpt")
+
+
+class InjectedSampleCorruption(RuntimeError):
+    """Raised by the data path for an injected corrupt sample."""
+
+
+class FaultPlan:
+    """A parsed, latching fault schedule."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.nan_steps: Set[int] = set()
+        self.sigterm_steps: Set[int] = set()
+        self.stall_steps: Dict[int, float] = {}
+        self.corrupt_indices: Set[int] = set()
+        self.truncate_steps: Set[int] = set()
+        self.fired: List[str] = []  # audit log, asserted in tests
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            kind, _, where = part.partition("@")
+            if kind not in KINDS or not where:
+                raise ValueError(
+                    f"bad fault spec {part!r} (kinds: {', '.join(KINDS)}; "
+                    "syntax kind@step, nan_grad@SxN, stall@S:SEC)")
+            if kind == "nan_grad":
+                s, _, n = where.partition("x")
+                for k in range(int(n or 1)):
+                    self.nan_steps.add(int(s) + k)
+            elif kind == "sigterm":
+                self.sigterm_steps.add(int(where))
+            elif kind == "stall":
+                s, _, sec = where.partition(":")
+                self.stall_steps[int(s)] = float(sec or 30.0)
+            elif kind == "corrupt_sample":
+                self.corrupt_indices.add(int(where))
+            elif kind == "truncate_ckpt":
+                self.truncate_steps.add(int(where))
+
+    def _fire(self, tag: str) -> None:
+        self.fired.append(tag)
+        get_logger().warning("FAULT INJECTED: %s", tag)
+
+    # -- injection points (each latches: one firing per plan) ---------
+
+    def maybe_poison_batch(self, step: int, batch):
+        """NaN one image pixel at a scheduled step (device-side edit;
+        works on replicated and batch-sharded global arrays)."""
+        if step not in self.nan_steps:
+            return batch
+        self.nan_steps.discard(step)
+        self._fire(f"nan_grad@{step}")
+        out = dict(batch)
+        img = out["image"]
+        zero = (0,) * img.ndim
+        out["image"] = img.at[zero].set(float("nan"))
+        return out
+
+    def maybe_stall(self, step: int) -> None:
+        sec = self.stall_steps.pop(step, None)
+        if sec is not None:
+            self._fire(f"stall@{step}:{sec}")
+            time.sleep(sec)
+
+    def maybe_sigterm(self, step: int) -> None:
+        if step in self.sigterm_steps:
+            self.sigterm_steps.discard(step)
+            self._fire(f"sigterm@{step}")
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_truncate_ckpt(self, step: int, step_dir: str) -> bool:
+        if step not in self.truncate_steps:
+            return False
+        self.truncate_steps.discard(step)
+        self._fire(f"truncate_ckpt@{step}")
+        from .integrity import truncate_step_dir
+
+        truncate_step_dir(step_dir)
+        return True
+
+    def check_sample(self, index: int) -> None:
+        """Raise for an injected corrupt sample (consulted by
+        GuardedDataset on every fetch; latches per index)."""
+        if int(index) in self.corrupt_indices:
+            self.corrupt_indices.discard(int(index))
+            self._fire(f"corrupt_sample@{index}")
+            raise InjectedSampleCorruption(
+                f"injected corruption at dataset index {index}")
+
+
+# Plans latch per PROCESS, not per fit() call: a supervised retry must
+# see the already-spent schedule, or the "transient" fault would
+# re-fire forever and no retry budget could ever converge.
+_PLANS: Dict[str, FaultPlan] = {}
+
+
+def plan_from_env(env: Optional[dict] = None) -> Optional[FaultPlan]:
+    spec = (env if env is not None else os.environ).get(ENV_VAR, "").strip()
+    if not spec:
+        return None
+    if spec not in _PLANS:
+        _PLANS[spec] = FaultPlan(spec)
+    return _PLANS[spec]
+
+
+def reset_plans() -> None:
+    """Forget all latched plans (test isolation)."""
+    _PLANS.clear()

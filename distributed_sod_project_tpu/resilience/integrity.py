@@ -1,0 +1,184 @@
+"""Checkpoint step-dir integrity: validation, manifests, quarantine.
+
+Orbax commits a step atomically by renaming the tmp dir, but the commit
+is multi-part: the rename lands before ``_CHECKPOINT_METADATA`` and the
+per-item metadata are finalized.  A process killed in that window (an
+async save under SIGKILL/preemption) leaves a step dir that
+``ocp.CheckpointManager.latest_step()`` happily reports — and restore
+then crashes with "No structure could be identified" (reproduced
+against orbax 0.7.0).  The helpers here classify such dirs so the
+manager can fall back to the newest *valid* checkpoint instead of
+raising, and move the corpse aside for post-mortem rather than
+deleting evidence.
+
+Validation is structural + (when present) manifest-based:
+
+- structural: the dir is digit-named, carries ``_CHECKPOINT_METADATA``
+  at its root, and has at least one item subdir with ``_METADATA``.
+- manifest: ``_integrity.json`` (written by our CheckpointManager after
+  a save finalizes) records every file's size; any missing/short file
+  fails validation.  Absence of the manifest is NOT a failure — the
+  writer may have been killed before ``wait()``.
+
+Stdlib-only on purpose: this module is imported by ckpt/manager.py and
+must never pull jax/orbax (or anything that could cycle back into the
+training stack).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+MANIFEST_NAME = "_integrity.json"
+QUARANTINE_DIRNAME = "_quarantine"
+
+# Files orbax itself mutates after commit (retention metadata) or that
+# we write post-hoc; their sizes are allowed to drift from the manifest.
+_MANIFEST_EXEMPT = (MANIFEST_NAME,)
+
+
+def _iter_files(step_dir: str):
+    for root, _, files in os.walk(step_dir):
+        for fn in files:
+            full = os.path.join(root, fn)
+            yield os.path.relpath(full, step_dir), full
+
+
+def write_manifest(step_dir: str) -> Optional[str]:
+    """Record every file's size under ``step_dir`` into
+    ``_integrity.json`` (atomic write).  Returns the manifest path, or
+    None when the dir is missing."""
+    if not os.path.isdir(step_dir):
+        return None
+    files: Dict[str, int] = {}
+    for rel, full in _iter_files(step_dir):
+        if rel in _MANIFEST_EXEMPT:
+            continue
+        try:
+            files[rel] = os.path.getsize(full)
+        except OSError:
+            return None  # dir is being mutated under us; don't manifest
+    payload = {
+        "version": 1,
+        "created_unix": time.time(),
+        "file_count": len(files),
+        "total_bytes": sum(files.values()),
+        "files": files,
+    }
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=0, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def has_manifest(step_dir: str) -> bool:
+    return os.path.isfile(os.path.join(step_dir, MANIFEST_NAME))
+
+
+def check_manifest(step_dir: str) -> Tuple[bool, str]:
+    """Verify manifest-recorded files exist with their recorded sizes.
+    A missing manifest passes (see module docstring)."""
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.isfile(path):
+        return True, "no manifest (pre-finalize kill or legacy save)"
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError) as e:
+        return False, f"unreadable manifest: {e!r}"
+    for rel, size in files.items():
+        full = os.path.join(step_dir, rel)
+        try:
+            actual = os.path.getsize(full)
+        except OSError:
+            return False, f"manifested file missing: {rel}"
+        if actual != int(size):
+            return False, (f"size mismatch for {rel}: "
+                           f"{actual} != {size} (truncated write)")
+    return True, "manifest ok"
+
+
+def validate_step_dir(step_dir: str) -> Tuple[bool, str]:
+    """(ok, reason) for one candidate checkpoint step directory."""
+    base = os.path.basename(os.path.normpath(step_dir))
+    if not base.isdigit():
+        # Orbax tmp dirs ("7.orbax-checkpoint-tmp-123") and anything
+        # else non-step-shaped: never a resume candidate.
+        return False, f"non-step name {base!r} (tmp/foreign dir)"
+    if not os.path.isdir(step_dir):
+        return False, "not a directory"
+    if not os.path.isfile(os.path.join(step_dir, "_CHECKPOINT_METADATA")):
+        return False, ("missing _CHECKPOINT_METADATA — save was killed "
+                       "before finalize")
+    items = [d for d in sorted(os.listdir(step_dir))
+             if os.path.isdir(os.path.join(step_dir, d))]
+    if not any(os.path.isfile(os.path.join(step_dir, d, "_METADATA"))
+               for d in items):
+        return False, "no item dir with _METADATA (partial payload)"
+    return check_manifest(step_dir)
+
+
+def quarantine_step_dir(step_dir: str, reason: str = "") -> Optional[str]:
+    """Move a corrupt step dir into ``<root>/_quarantine/`` (evidence
+    preserved, step-number scan can never pick it again).  Returns the
+    new path, or None if the move failed (cross-host race: another
+    process may quarantine first — losing that race is fine)."""
+    step_dir = os.path.normpath(step_dir)
+    root = os.path.dirname(step_dir)
+    base = os.path.basename(step_dir)
+    qdir = os.path.join(root, QUARANTINE_DIRNAME)
+    os.makedirs(qdir, exist_ok=True)
+    dest = os.path.join(qdir, base)
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = os.path.join(qdir, f"{base}.{n}")
+    try:
+        os.rename(step_dir, dest)
+    except OSError:
+        return None
+    with open(dest + ".reason", "w") as f:
+        f.write(reason or "unspecified\n")
+    return dest
+
+
+def list_step_dirs(directory: str) -> Dict[int, str]:
+    """All digit-named step dirs under a checkpoint root (no
+    validation), as {step: path}."""
+    out: Dict[int, str] = {}
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return out
+    for name in entries:
+        p = os.path.join(directory, name)
+        if name.isdigit() and os.path.isdir(p):
+            out[int(name)] = p
+    return out
+
+
+def truncate_step_dir(step_dir: str, *, drop_metadata: bool = True,
+                      truncate_bytes: int = 8) -> None:
+    """Deterministically corrupt a committed step dir the way a
+    preemption mid-finalize does (fault injection / chaos tests):
+    remove the commit marker and truncate the largest payload file."""
+    meta = os.path.join(step_dir, "_CHECKPOINT_METADATA")
+    if drop_metadata and os.path.isfile(meta):
+        os.remove(meta)
+    # Truncate the biggest file: a partially-flushed shard.
+    biggest, size = None, -1
+    for rel, full in _iter_files(step_dir):
+        if rel in _MANIFEST_EXEMPT:
+            continue
+        s = os.path.getsize(full)
+        if s > size:
+            biggest, size = full, s
+    if biggest is not None and size > truncate_bytes:
+        with open(biggest, "r+b") as f:
+            f.truncate(truncate_bytes)

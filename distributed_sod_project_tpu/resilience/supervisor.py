@@ -1,0 +1,159 @@
+"""Training supervisor: rollback-and-retry around ``fit``.
+
+TF-Replicator's framing (PAPERS.md): worker failure and restartability
+are a property of the training FRAMEWORK, not of ops runbooks.  The
+supervisor wraps ``train.loop.fit`` and converts the two recoverable
+failure classes this stack actually produces into bounded retries:
+
+- **divergence** — the loop's consecutive-non-finite-updates
+  ``RuntimeError`` (train/loop.py, ``optim.skip_nonfinite``).  No bad
+  update was applied, so the last checkpoint is sound: roll back and
+  re-run.  A transient (one poisoned batch, a bf16 overflow spike)
+  succeeds on the plain retry; a persistent divergence gets the
+  degradation policy — LR scaled down per retry after the first —
+  matching the loop's own advice string ("restart from the last
+  checkpoint with a lower lr").
+- **restore failure** — a corrupt/truncated checkpoint surfacing as
+  orbax/manager errors at resume time.  The quarantine pass moves the
+  corpse aside so the next attempt restores the newest *valid* step
+  (ckpt/manager.py); veScale's SPMD-consistency argument applies:
+  recovery must be provably identical to the uninterrupted run, which
+  rollback-to-bitwise-checkpoint + deterministic data order gives us
+  (asserted by tests/test_resilience.py).
+
+Everything else (ValueError config errors, OOM, keyboard interrupt)
+propagates immediately — retrying non-recoverable errors only burns
+the TPU window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+from ..utils.logging import get_logger
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry budget + degradation schedule.
+
+    ``degrade_after``: number of retries attempted verbatim before LR
+    degradation starts.  The default (1) gives transients one exact
+    replay — which keeps the recovered run bitwise-identical to the
+    unfaulted one — and only then starts trading reproducibility for
+    survival.
+    """
+
+    max_retries: int = 3
+    degrade_after: int = 1
+    lr_factor: float = 0.5
+    min_lr_scale: float = 1e-3  # stop degrading below this total scale
+
+    def lr_scale_for(self, attempt: int) -> float:
+        """Total LR scale for retry ``attempt`` (1-based)."""
+        n = max(0, attempt - self.degrade_after)
+        return max(self.min_lr_scale, self.lr_factor ** n)
+
+
+def is_divergence(err: BaseException) -> bool:
+    return (isinstance(err, RuntimeError)
+            and "non-finite gradient" in str(err))
+
+
+def is_restore_failure(err: BaseException) -> bool:
+    """Errors the checkpoint path raises for corrupt/unreadable step
+    dirs (orbax raises a zoo: FileNotFoundError for missing structure,
+    ValueError/KeyError for undecodable payloads)."""
+    if isinstance(err, FileNotFoundError):
+        return True
+    return (isinstance(err, (ValueError, KeyError, OSError))
+            and ("checkpoint" in str(err).lower()
+                 or "restore" in str(err).lower()))
+
+
+def is_recoverable(err: BaseException) -> bool:
+    return is_divergence(err) or is_restore_failure(err)
+
+
+def _degraded(cfg, lr_scale: float):
+    if lr_scale == 1.0:
+        return cfg
+    return cfg.replace(
+        optim=dataclasses.replace(cfg.optim, lr=cfg.optim.lr * lr_scale))
+
+
+def run_supervised(
+    cfg,
+    workdir: Optional[str] = None,
+    resume: bool = False,
+    max_steps: Optional[int] = None,
+    hooks: Optional[Dict[str, Callable]] = None,
+    policy: Optional[RetryPolicy] = None,
+    fit_fn: Optional[Callable] = None,
+) -> Dict[str, float]:
+    """Run ``fit`` under rollback-and-retry; returns its final metrics
+    plus ``supervisor_retries``/``supervisor_lr_scale``.
+
+    Requires ``cfg.checkpoint_every_steps > 0`` to have anything to
+    roll back to (a zero-checkpoint run still gets retry-from-scratch).
+    ``fit_fn`` is injectable for tests.
+    """
+    if fit_fn is None:
+        from ..train.loop import fit as fit_fn  # lazy: avoid cycles
+
+    policy = policy or RetryPolicy()
+    log = get_logger()
+    attempt = 0  # number of retries consumed
+    lr_scale = 1.0
+    while True:
+        try:
+            metrics = fit_fn(
+                _degraded(cfg, lr_scale),
+                workdir=workdir,
+                resume=resume or attempt > 0,
+                max_steps=max_steps,
+                hooks=hooks,
+            )
+            metrics["supervisor_retries"] = float(attempt)
+            metrics["supervisor_lr_scale"] = float(lr_scale)
+            return metrics
+        except BaseException as err:  # noqa: BLE001 — filtered below
+            if not is_recoverable(err):
+                raise
+            attempt += 1
+            if attempt > policy.max_retries:
+                log.error(
+                    "supervisor: retry budget (%d) exhausted, re-raising",
+                    policy.max_retries)
+                raise
+            # Quarantine anything invalid so the retry's restore lands
+            # on the newest VALID checkpoint, then degrade if due.
+            ckpt_dir = workdir or cfg.checkpoint_dir
+            last_good = _quarantine_and_latest(ckpt_dir)
+            lr_scale = policy.lr_scale_for(attempt)
+            log.warning(
+                "supervisor: attempt %d/%d after %s: %s — rolling back "
+                "to step %s, lr_scale=%g", attempt, policy.max_retries,
+                "divergence" if is_divergence(err) else "restore failure",
+                err, last_good, lr_scale)
+
+
+def _quarantine_and_latest(ckpt_dir: str):
+    """Move invalid step dirs aside; return the newest valid step (or
+    None).  Uses the integrity helpers directly — no orbax manager is
+    constructed, so a half-written dir can't wedge the scan."""
+    from .integrity import (list_step_dirs, quarantine_step_dir,
+                            validate_step_dir)
+
+    latest = None
+    for step, path in sorted(list_step_dirs(ckpt_dir).items()):
+        ok, reason = validate_step_dir(path)
+        if ok:
+            latest = step
+        else:
+            quarantine_step_dir(path, reason)
+            get_logger().warning(
+                "supervisor: quarantined checkpoint step %d (%s)",
+                step, reason)
+    return latest

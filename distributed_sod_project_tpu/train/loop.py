@@ -30,6 +30,21 @@ from .state import create_train_state, param_count
 from .step import make_eval_step, make_train_step
 
 
+def _poll_stop(guard, step: int, sync_every: int) -> bool:
+    """Graceful-stop polling cadence (one knob, unit-tested):
+    single-process reads the host-local flag every step; multi-host
+    agrees only at deterministic steps (all hosts must enter the
+    allgather together — ``sync_every`` = the logging cadence), keeping
+    the async run-ahead between agreement points."""
+    if jax.process_count() == 1:
+        return guard.should_stop
+    if step % sync_every == 0:
+        # Blocking allgather — throttled so the host keeps
+        # its async run-ahead between agreement points.
+        return guard.sync()
+    return False
+
+
 def fit(
     cfg: ExperimentConfig,
     workdir: Optional[str] = None,
@@ -44,15 +59,22 @@ def fit(
     contain ``on_metrics(step, dict)`` for test instrumentation;
     ``profile_dir`` captures a jax.profiler trace of a short post-warmup
     step window (view in TensorBoard/Perfetto).
+
+    Resilience (docs/RESILIENCE.md): restore lands on the newest VALID
+    checkpoint; ``cfg.watchdog_deadline_s`` arms the wedged-step
+    watchdog; ``cfg.data.skip_budget`` tolerates corrupt samples;
+    ``DSOD_FAULTS`` injects deterministic faults (chaos tests).
     """
     import os
 
+    from ..resilience import inject
     from ..utils.observability import (MetricWriter, PreemptionGuard,
                                        profile_window)
 
     log = get_logger()
     hooks = hooks or {}
     workdir = workdir or cfg.checkpoint_dir
+    plan = inject.plan_from_env()
 
     mesh = make_mesh(cfg.mesh)
     n_dev = mesh.devices.size
@@ -74,6 +96,18 @@ def fit(
     # same images.  Pure DP reduces to (process_index, process_count).
     shard_id, num_shards = host_batch_shard(mesh)
     dataset = resolve_dataset(cfg.data)
+    # Corrupt-sample degradation: bounded skip-budget with
+    # deterministic substitution instead of an epoch-killing exception
+    # (host/grain backends fetch through the wrapper; tfdata enforces
+    # the same budget via its shortfall check — see dataguard.py).
+    data_guard = None
+    if cfg.data.skip_budget > 0 or (plan is not None
+                                    and plan.corrupt_indices):
+        from ..resilience.dataguard import GuardedDataset
+
+        data_guard = GuardedDataset(dataset, cfg.data.skip_budget,
+                                    fault_plan=plan)
+        dataset = data_guard
     loader = make_loader(
         dataset, cfg.data,
         global_batch_size=cfg.global_batch_size,
@@ -85,6 +119,7 @@ def fit(
         rotate_degrees=cfg.data.rotate_degrees,
         color_jitter=cfg.data.color_jitter,
         num_workers=cfg.data.num_workers,
+        skip_budget=cfg.data.skip_budget,
     )
     steps_per_epoch = cfg.steps_per_epoch or loader.steps_per_epoch
     if steps_per_epoch <= 0:
@@ -125,9 +160,12 @@ def fit(
     start_step = 0
     resumed_from = -1
     if resume:
-        ck_step = mgr.latest_step()
+        # Newest VALID checkpoint: tmp/truncated/corrupt step dirs are
+        # quarantined and the next-newest is tried (ckpt/manager.py) —
+        # a preemption mid-save costs checkpoint_every_steps of
+        # recompute, never the run.
+        state, ck_step = mgr.restore_latest_valid(state)
         if ck_step is not None:
-            state = mgr.restore(state, ck_step)
             start_step = int(state.step)
             resumed_from = start_step
             log.info("resumed from checkpoint step %d", start_step)
@@ -240,7 +278,20 @@ def fit(
     eval_fn = (_make_inline_eval(cfg, model, mesh)
                if cfg.eval_every_steps else None)
 
-    timer = StepTimer()
+    # Wedged-dispatch watchdog: heartbeat fed by timer.tick() (one beat
+    # per completed step); a step past the deadline → stack dump + exit
+    # code 114 for the supervising layer to re-fire (watchdog.py).
+    watchdog = None
+    if cfg.watchdog_deadline_s > 0:
+        from ..resilience.watchdog import StepWatchdog
+
+        watchdog = StepWatchdog(
+            cfg.watchdog_deadline_s,
+            first_deadline_s=max(cfg.watchdog_compile_grace_s,
+                                 cfg.watchdog_deadline_s),
+            dump_dir=workdir,
+        ).start()
+    timer = StepTimer(on_tick=watchdog.beat if watchdog else None)
     last_metrics: Dict[str, float] = {}
     eval_metrics: Dict[str, float] = {}
     step = start_step
@@ -278,10 +329,17 @@ def fit(
             if step >= total_steps or stop:
                 break
             loader.set_epoch(epoch)
+            # Host-side periodic re-validation rides BEFORE the H2D
+            # prefetch (cheap numpy pass, no device sync); off unless
+            # cfg.data.validate_every > 0.
+            from ..utils.checks import periodic_validate
+
+            host_batches = periodic_validate(iter(loader),
+                                             cfg.data.validate_every)
             # mesh= (not sharding=): each host contributes its local
             # slice of the global batch — correct on multi-host pods.
             it = prefetch_to_device(
-                iter(loader), size=cfg.data.prefetch_batches, mesh=mesh,
+                host_batches, size=cfg.data.prefetch_batches, mesh=mesh,
                 transfer_dtype=cfg.data.transfer_dtype,
                 drop_keys=("index",),
                 spec=batch_spec_override)
@@ -289,6 +347,8 @@ def fit(
                 if step >= total_steps or stop:
                     break
                 train_step = train_step_at(step)
+                if plan is not None:
+                    batch = plan.maybe_poison_batch(step + 1, batch)
                 if step == profile_at:
                     with profile_window(profile_dir):
                         state, metrics = train_step(state, batch)
@@ -296,13 +356,14 @@ def fit(
                 else:
                     state, metrics = train_step(state, batch)
                 step += 1
+                if plan is not None:
+                    # Stall BEFORE the heartbeat: to the watchdog this
+                    # step is still in flight, like a wedged dispatch.
+                    plan.maybe_stall(step)
                 timer.tick()
-                if jax.process_count() == 1:
-                    stop = guard.should_stop
-                elif step % sync_every == 0:
-                    # Blocking allgather — throttled so the host keeps
-                    # its async run-ahead between agreement points.
-                    stop = guard.sync()
+                if plan is not None:
+                    plan.maybe_sigterm(step)
+                stop = _poll_stop(guard, step, sync_every)
                 if step % cfg.log_every_steps == 0 or step == total_steps:
                     host = {k: float(v) for k, v in metrics.items()}
                     if (cfg.optim.skip_nonfinite and
@@ -318,6 +379,14 @@ def fit(
                     host["imgs_per_sec"] = timer.images_per_sec(
                         cfg.global_batch_size)
                     host["epoch"] = epoch
+                    if cfg.data.skip_budget > 0:
+                        # Corrupt samples tolerated so far (dataguard
+                        # substitution + tfdata shortfall), surfaced as
+                        # a counter instead of an epoch-killing raise.
+                        host["data_skipped"] = float(
+                            (data_guard.skipped if data_guard is not None
+                             else 0)
+                            + int(getattr(loader, "skipped", 0)))
                     last_metrics = host
                     writer.scalars(step, host)
                     if is_primary_process():
@@ -337,6 +406,11 @@ def fit(
                         log.info("eval @ %d: %s", step,
                                  {k: round(v, 4) for k, v in
                                   eval_metrics.items()})
+                    if watchdog is not None:
+                        # Inline eval is legitimate beat-free progress;
+                        # don't let a val sweep longer than the step
+                        # deadline read as a wedged dispatch.
+                        watchdog.beat(step, eval_metrics)
                 if cfg.checkpoint_every_steps and (
                         step % cfg.checkpoint_every_steps == 0):
                     if (cfg.best_metric and eval_fn is not None
@@ -349,8 +423,14 @@ def fit(
                     # copy behind the next train steps (no device_get stall).
                     mgr.save(step, state, metrics=eval_metrics or None)
                     last_saved = step
+                    if watchdog is not None:
+                        watchdog.beat(step)
             if step >= total_steps or stop:
                 break
+        if watchdog is not None:
+            # Training is over: the final eval/force-save/close below is
+            # legitimate wind-down, not a wedged step.
+            watchdog.stop()
         if step != last_saved:
             if (cfg.best_metric and eval_fn is not None
                     and last_eval_step != step):
@@ -359,6 +439,10 @@ def fit(
                 last_eval_step = step
             mgr.save(step, state, metrics=eval_metrics or None, force=True)
     finally:
+        if watchdog is not None:
+            # Idempotent; also covers the exception paths, so the daemon
+            # can never outlive fit() and 114 a healthy caller later.
+            watchdog.stop()
         mgr.close()
         writer.close()
     last_metrics["final_step"] = step

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..losses import deep_supervision_loss
 from .state import TrainState
+from ..utils.compat import shard_map
 
 
 def resolve_remat_policy(name: str):
@@ -200,7 +201,7 @@ def make_train_step(
             metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
         return new_state, metrics
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step_fn,
         mesh=mesh,
         in_specs=(P(), P("data")),
@@ -230,7 +231,7 @@ def make_eval_step(model, mesh: Mesh) -> Callable:
         )
         return jax.nn.sigmoid(outs[0][..., 0].astype(jnp.float32))
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         eval_fn,
         mesh=mesh,
         in_specs=(P(), P("data")),

@@ -6,11 +6,16 @@ decode, images that skipped normalization.  ``validate_batch`` runs
 once on the first batch of a training run (cheap, host-side) and fails
 loudly with the actual problem instead of letting a silent bad input
 become an unexplained divergence thousands of steps later.
+
+``periodic_validate`` extends the net past the first batch: a
+non-finite-only re-check every ``cfg.data.validate_every`` batches on
+the host side of the prefetch queue (before the H2D copy, so it costs
+no device sync).  Default off — the once-only behavior stands.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Iterable, Iterator
 
 import numpy as np
 
@@ -58,3 +63,30 @@ def validate_batch(batch: Dict, image_size, use_depth: bool = False) -> None:
                              f"with image {img.shape}")
         if not np.all(np.isfinite(depth)):
             raise ValueError("non-finite values in depth batch")
+
+
+def check_finite_batch(batch: Dict, batch_index: int = -1) -> None:
+    """The cheap subset of ``validate_batch``: raise on non-finite
+    values in the float arrays (corrupt decode / poisoned cache).
+    Shape/range invariants can't drift mid-run; finiteness can."""
+    for k in ("image", "mask", "depth"):
+        v = batch.get(k)
+        if v is not None and not np.all(np.isfinite(np.asarray(v))):
+            raise ValueError(
+                f"non-finite values in {k!r} at batch {batch_index} — "
+                "mid-run data corruption (decoder bug, bitrot, or a "
+                "poisoned cache); see docs/RESILIENCE.md")
+
+
+def periodic_validate(batches: Iterable[Dict], every: int,
+                      start_index: int = 0) -> Iterator[Dict]:
+    """Yield ``batches``, re-running :func:`check_finite_batch` on every
+    ``every``-th one (host-side, pre-transfer).  ``every<=0`` passes
+    the iterator through untouched."""
+    if every <= 0:
+        yield from batches
+        return
+    for i, batch in enumerate(batches, start=start_index):
+        if i % every == 0:
+            check_finite_batch(batch, batch_index=i)
+        yield batch

@@ -1,0 +1,39 @@
+"""jax API-surface compatibility shims.
+
+The codebase targets the current public API; this module papers over
+the renames between the jax versions the images we run on actually
+ship, so a version skew degrades to a shim instead of an
+AttributeError twenty minutes into a TPU window.
+
+- ``shard_map``: public ``jax.shard_map`` (jax ≥ 0.6) vs
+  ``jax.experimental.shard_map.shard_map`` (0.4.x), including the
+  ``check_vma`` → ``check_rep`` keyword rename.
+- ``axis_size``: ``lax.axis_size`` (new) vs the ``psum(1, axis)``
+  idiom (0.4.x) — the result is the static mesh-axis extent either way.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+    def axis_size(axis_name):
+        """Static extent of a named mesh axis inside shard_map."""
+        return lax.psum(1, axis_name)
+
+_native = getattr(jax, "shard_map", None)
+
+if _native is not None:
+    shard_map = _native
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        """0.4.x fallback: same signature as ``jax.shard_map``."""
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)

@@ -9,11 +9,18 @@ from collections import deque
 
 class StepTimer:
     """Sliding-window step timer; excludes the first ``warmup`` steps so
-    XLA compilation time never pollutes throughput numbers."""
+    XLA compilation time never pollutes throughput numbers.
 
-    def __init__(self, window: int = 50, warmup: int = 2):
+    ``on_tick`` (optional) is invoked once per ``tick()`` — the train
+    loop feeds the step watchdog's heartbeat through it
+    (resilience/watchdog.py), so "a step completed" and "the throughput
+    clock advanced" are, by construction, the same event.
+    """
+
+    def __init__(self, window: int = 50, warmup: int = 2, on_tick=None):
         self.window = window
         self.warmup = warmup
+        self.on_tick = on_tick
         self._times: deque = deque(maxlen=window)
         self._last = None
         self._count = 0
@@ -24,6 +31,8 @@ class StepTimer:
         if self._last is not None and self._count > self.warmup:
             self._times.append(now - self._last)
         self._last = now
+        if self.on_tick is not None:
+            self.on_tick()
 
     @property
     def mean_step_time(self) -> float:

@@ -90,3 +90,34 @@ def eight_devices():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs[:8]
+
+
+@pytest.fixture(autouse=True)
+def _chaos_deadline(request):
+    """Per-test deadline for the chaos suite (pytest.ini `chaos`
+    marker).  Fault-injection tests stall/kill/corrupt things on
+    purpose; a recovery-path bug must surface as a bounded-time test
+    failure, not wedge the whole tier-1 run until its outer `timeout`
+    kills everything.  SIGALRM-based because the image ships no
+    pytest-timeout; default 120 s, override via
+    ``@pytest.mark.chaos(timeout=N)``."""
+    import signal
+
+    marker = request.node.get_closest_marker("chaos")
+    if marker is None or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    limit = int(marker.kwargs.get("timeout", 120))
+
+    def _expire(signum, frame):
+        raise TimeoutError(
+            f"chaos test exceeded its {limit}s deadline — a recovery "
+            "path is wedged (see docs/RESILIENCE.md)")
+
+    prev = signal.signal(signal.SIGALRM, _expire)
+    signal.alarm(limit)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev)

@@ -178,3 +178,51 @@ def test_tfdata_color_jitter_content_matches_host_loader(folder_ds):
     for tb, hb in zip(tfl, hl):
         np.testing.assert_array_equal(tb["index"], hb["index"])
         np.testing.assert_allclose(tb["image"], hb["image"], atol=2e-3)
+
+
+@pytest.fixture()
+def corrupt_folder_ds(tmp_path):
+    """12 images, one of which is undecodable garbage (truncated
+    PNG) — the tfdata degradation scenario (docs/RESILIENCE.md)."""
+    (tmp_path / "Image").mkdir()
+    (tmp_path / "Mask").mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(12):
+        Image.fromarray(rng.integers(0, 256, (24, 24, 3), np.uint8)).save(
+            tmp_path / "Image" / f"s{i}.png")
+        Image.fromarray(
+            (rng.random((24, 24)) > 0.5).astype(np.uint8) * 255).save(
+            tmp_path / "Mask" / f"s{i}.png")
+    (tmp_path / "Image" / "s3.png").write_bytes(b"\x89PNG not really")
+    return FolderSOD(str(tmp_path), image_size=(16, 16))
+
+
+def test_tfdata_zero_budget_propagates_decode_error(corrupt_folder_ds):
+    loader = TFDataLoader(corrupt_folder_ds, global_batch_size=4, seed=1)
+    with pytest.raises(Exception):  # tf.errors.InvalidArgumentError
+        list(loader)
+    assert loader.skipped == 0
+
+
+def test_tfdata_skip_budget_degrades_and_counts(corrupt_folder_ds):
+    """With a budget, the corrupt sample is dropped in-graph and the
+    epoch-end shortfall (batch-granular: one lost batch = one local
+    batch of samples) is charged against it instead of killing the
+    epoch."""
+    loader = TFDataLoader(corrupt_folder_ds, global_batch_size=4, seed=1,
+                          skip_budget=4)
+    batches = list(loader)
+    assert len(batches) == 2  # 11 decodable // 4
+    assert loader.skipped == 4  # (3 expected − 2 got) × local_batch 4
+    for b in batches:
+        assert np.all(np.isfinite(b["image"]))
+
+
+def test_tfdata_skip_budget_exhaustion_raises(corrupt_folder_ds):
+    from distributed_sod_project_tpu.resilience.dataguard import (
+        SkipBudgetExhausted)
+
+    loader = TFDataLoader(corrupt_folder_ds, global_batch_size=4, seed=1,
+                          skip_budget=3)
+    with pytest.raises(SkipBudgetExhausted):
+        list(loader)

@@ -1,11 +1,68 @@
-"""Tooling tests: HLO dump (tools/dump_hlo.py)."""
+"""Tooling tests: HLO dump (tools/dump_hlo.py), the tpu_watch probe
+contract, and friends."""
 
 import os
+import subprocess
 import sys
 
 import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+_TPU_WATCH = os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "tpu_watch.sh")
+
+
+def _watch(*args):
+    p = subprocess.run(["bash", _TPU_WATCH, *args],
+                       capture_output=True, text=True, timeout=30)
+    return p.returncode, p.stdout.strip()
+
+
+def test_tpu_watch_probe_parser_ok_and_wedged():
+    """The real-matmul probe contract: only an accelerator platform
+    that EXECUTED the matmul parses as OK; empty output (a wedged
+    tunnel hanging until the probe's timeout kills it) and a cpu
+    fallback both parse as WEDGED — an enumerate-only or fallback
+    answer must never burn an agenda firing."""
+    assert _watch("parse-probe", "tpu") == (0, "PROBE OK tpu")
+    assert _watch("parse-probe", "axon") == (0, "PROBE OK axon")
+    assert _watch("parse-probe", "TPU") == (0, "PROBE OK TPU")
+
+    rc, out = _watch("parse-probe", "")
+    assert rc == 1 and out == "PROBE WEDGED timeout"
+    rc, out = _watch("parse-probe", "cpu")
+    assert rc == 1 and out == "PROBE WEDGED cpu"
+    # Garbage (a traceback fragment reaching the tail) is not OK.
+    rc, out = _watch("parse-probe", "RuntimeError")
+    assert rc == 1 and out.startswith("PROBE WEDGED")
+
+
+def test_tpu_watch_count_results_single_line_integers(tmp_path):
+    """The decide() inputs must be scalar integers: an all-clean file
+    counts as "0 0" on ONE line (grep -c prints 0 *and* exits 1 when
+    nothing matches — a naive `|| echo 0` yields "0\\n0" and makes the
+    all-clean DONE branch unreachable), and a missing file is "0 0"."""
+    f = tmp_path / "results.jsonl"
+    f.write_text('{"leg": "a", "rc": 0}\n{"leg": "b", "rc": 0}\n')
+    assert _watch("count-results", str(f)) == (0, "0 0")
+    f.write_text('{"leg": "a", "rc": 1}\n{"leg": "b", "error": "boom"}\n')
+    assert _watch("count-results", str(f)) == (0, "2 1")
+    assert _watch("count-results", str(tmp_path / "missing.jsonl")) == \
+        (0, "0 0")
+
+
+def test_tpu_watch_circuit_breaker_decision():
+    """The post-firing policy on (firings, max, nonzero-rc, errors):
+    all-clean stops (DONE), budget exhaustion with failures remaining
+    stops (BUDGET_SPENT), anything else keeps probing (REFIRE)."""
+    assert _watch("decide", "1", "3", "0", "0") == (0, "DONE")
+    # Clean results stop the watcher even on the last allowed firing.
+    assert _watch("decide", "3", "3", "0", "0") == (0, "DONE")
+    assert _watch("decide", "3", "3", "2", "0") == (0, "BUDGET_SPENT")
+    assert _watch("decide", "3", "3", "0", "1") == (0, "BUDGET_SPENT")
+    assert _watch("decide", "1", "3", "2", "0") == (0, "REFIRE")
+    assert _watch("decide", "2", "3", "0", "4") == (0, "REFIRE")
 
 
 @pytest.mark.slow

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Tier-1 verify gate, verbatim from ROADMAP.md — run from the repo root
+# (or anywhere; this cd's home first).  Prints DOTS_PASSED=<n> at the
+# end and exits with pytest's status, so CI and humans invoke the exact
+# same command the roadmap promises.
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -14,7 +14,90 @@
 # throwaway subprocess under timeout: a wedged tunnel hangs PJRT
 # client creation indefinitely and only an out-of-process dial
 # converts that into a retryable failure (see bench.py).
+#
+# Unit-test hooks (tests/test_tools.py): the probe parser and the
+# circuit-breaker decision are pure functions, callable directly —
+#   tools/tpu_watch.sh parse-probe "<raw probe output>"
+#       -> "PROBE OK <platform>" (exit 0) | "PROBE WEDGED <raw>" (exit 1)
+#   tools/tpu_watch.sh decide <firings> <max_firings> <bad> <err>
+#       -> "DONE" | "BUDGET_SPENT" | "REFIRE"
+#   tools/tpu_watch.sh count-results <results.jsonl>
+#       -> "<bad> <err>" (single line, integers; missing file -> "0 0")
 cd "$(dirname "$0")/.." || exit 1
+
+# The probe must run REAL compute, not just enumerate devices: the
+# 2026-08-02 window showed the tunnel answering jax.devices() in <5s
+# while every dispatched program (even a 1024x1024 matmul) wedged
+# forever.  An enumerate-only probe would burn an agenda firing
+# (MAX_FIRINGS budget) on a tunnel that cannot execute anything.
+run_probe() {
+  timeout 100 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print(d.platform)" 2>/dev/null | tail -1
+}
+
+# Classify the probe's raw output into the one-line parseable contract.
+# OK requires BOTH: the matmul completed (any output at all) AND the
+# platform is an accelerator — a cpu fallback answering the probe is
+# NOT a usable window.
+probe_parse() {
+  local raw="$1"
+  case "$raw" in
+    tpu|TPU|axon)
+      echo "PROBE OK $raw"
+      return 0
+      ;;
+    *)
+      echo "PROBE WEDGED ${raw:-timeout}"
+      return 1
+      ;;
+  esac
+}
+
+# Circuit breaker after an agenda firing: stop when every leg is clean
+# (DONE) or the firing budget is spent (BUDGET_SPENT); otherwise keep
+# probing for another window (REFIRE).  Pure decision on counts so the
+# policy is unit-testable without a tunnel.
+decide() {
+  local firings="$1" max_firings="$2" bad="$3" err="$4"
+  if [ "$bad" -eq 0 ] && [ "$err" -eq 0 ]; then
+    echo "DONE"
+  elif [ "$firings" -ge "$max_firings" ]; then
+    echo "BUDGET_SPENT"
+  else
+    echo "REFIRE"
+  fi
+}
+
+# Leg-result counts for decide(), as ONE line of two integers.
+# grep -c prints "0" AND exits 1 when nothing matches, so a naive
+# `|| echo 0` yields the two-line "0\n0" and breaks decide's integer
+# tests; default only the missing-file case (grep prints nothing).
+count_results() {
+  local f="$1" bad err
+  bad=$(grep -cv '"rc": 0' "$f" 2>/dev/null); bad=${bad:-0}
+  err=$(grep -c '"error"' "$f" 2>/dev/null); err=${err:-0}
+  echo "$bad $err"
+}
+
+case "$1" in
+  parse-probe)
+    probe_parse "$2"
+    exit $?
+    ;;
+  decide)
+    decide "$2" "$3" "$4" "$5"
+    exit 0
+    ;;
+  count-results)
+    count_results "$2"
+    exit 0
+    ;;
+esac
+
 AGENDA=${AGENDA:-tools/tpu_agenda_r4.sh}
 RDIR=${RDIR:-tpu_results4}
 mkdir -p "$RDIR"
@@ -25,42 +108,33 @@ n=0
 firings=0
 while [ "$(date +%s)" -lt "$deadline" ]; do
   n=$((n + 1))
-  # The probe must run REAL compute, not just enumerate devices: the
-  # 2026-08-02 window showed the tunnel answering jax.devices() in <5s
-  # while every dispatched program (even a 1024x1024 matmul) wedged
-  # forever.  An enumerate-only probe would burn an agenda firing
-  # (MAX_FIRINGS budget) on a tunnel that cannot execute anything.
-  plat=$(timeout 100 python -c "
-import jax, jax.numpy as jnp
-d = jax.devices()[0]
-x = jnp.ones((256, 256), jnp.bfloat16)
-(x @ x).block_until_ready()
-print(d.platform)" 2>/dev/null | tail -1)
-  case "$plat" in
-    tpu|TPU|axon)
+  verdict=$(probe_parse "$(run_probe)")
+  echo "$(date -u +%FT%TZ) probe $n: $verdict" >> "$RDIR/watch.log"
+  case "$verdict" in
+    "PROBE OK"*)
       firings=$((firings + 1))
-      echo "$(date -u +%FT%TZ) probe $n: tunnel UP ($plat) — agenda firing $firings/$MAX_FIRINGS" >> "$RDIR/watch.log"
+      echo "$(date -u +%FT%TZ) tunnel UP — agenda firing $firings/$MAX_FIRINGS" >> "$RDIR/watch.log"
       R="$RDIR" bash "$AGENDA"
       # The agenda skips legs that already succeeded, so a re-fire in
       # a later window only runs what's missing.  Keep probing until
       # every leg has a clean record or the firing budget is spent —
       # the observed tunnel serves SHORT windows, and exiting after a
       # partial one (the r3 design) would waste any second window.
-      bad=$(grep -cv '"rc": 0' "$RDIR/results.jsonl" 2>/dev/null || echo 0)
-      err=$(grep -c '"error"' "$RDIR/results.jsonl" 2>/dev/null || echo 0)
+      read -r bad err <<< "$(count_results "$RDIR/results.jsonl")"
       echo "$(date -u +%FT%TZ) agenda firing $firings done (nonzero-rc: $bad, error-results: $err)" >> "$RDIR/watch.log"
-      if [ "$bad" -eq 0 ] && [ "$err" -eq 0 ]; then
-        echo "$(date -u +%FT%TZ) all legs clean — watcher done" >> "$RDIR/watch.log"
-        exit 0
-      fi
-      if [ "$firings" -ge "$MAX_FIRINGS" ]; then
-        echo "$(date -u +%FT%TZ) firing budget spent with failed legs remaining" >> "$RDIR/watch.log"
-        exit 0
-      fi
+      case "$(decide "$firings" "$MAX_FIRINGS" "$bad" "$err")" in
+        DONE)
+          echo "$(date -u +%FT%TZ) all legs clean — watcher done" >> "$RDIR/watch.log"
+          exit 0
+          ;;
+        BUDGET_SPENT)
+          echo "$(date -u +%FT%TZ) firing budget spent with failed legs remaining" >> "$RDIR/watch.log"
+          exit 0
+          ;;
+      esac
       sleep 120
       ;;
     *)
-      echo "$(date -u +%FT%TZ) probe $n: down (got '${plat:-wedge/timeout}')" >> "$RDIR/watch.log"
       sleep 60
       ;;
   esac
