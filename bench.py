@@ -86,6 +86,19 @@ def main(argv=None):
                         "(bench always times the shard_map DP step)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the timed window")
+    p.add_argument("--baseline-file", default=None,
+                   help="regression mode: JSON file of recorded "
+                        "baselines keyed like bench_baseline.json.  "
+                        "First run per key SEEDS the file; later runs "
+                        "add a vs_recorded field (this run / recorded) "
+                        "to the result line.  Unlike the implicit "
+                        "bench_baseline.json side file, this one is "
+                        "meant to be checked in (tools/bench_data.sh)")
+    p.add_argument("--fail-below", type=float, default=0.0,
+                   help="with --baseline-file: exit 3 when vs_recorded "
+                        "falls below this ratio (0 = never gate — the "
+                        "shared-CI posture; the number is still "
+                        "printed and recorded)")
     p.add_argument("--watchdog", type=int, default=1800,
                    help="hard-exit with a diagnostic after this many "
                         "seconds (the remote-TPU transport can wedge "
@@ -341,10 +354,10 @@ def _run(args):
         cfg = apply_overrides(
             cfg, [f"global_batch_size={batch}",
                   f"data.image_size={hw},{hw}"] + list(args.overrides))
-        dt = _bench_data(cfg, batch, args.steps, args.warmup)
-        _report(args, batch * args.steps / dt, "cpu", 1,
-                mode=f"data[{cfg.data.backend}]")
-        return 0
+        dt = _bench_data(cfg, batch, args.steps, args.warmup,
+                         overrides=args.overrides)
+        return _report(args, batch * args.steps / dt, "cpu", 1,
+                       mode=f"data[{cfg.data.backend}]")
 
     import jax
     import jax.numpy as jnp
@@ -466,9 +479,8 @@ def _run(args):
                              acc[0], state, dev_batch)
     else:
         extra = _cost_fields(step, dt / args.steps, state, dev_batch)
-    _report(args, batch * args.steps / dt, jax.devices()[0].platform,
-            n_chips, **extra)
-    return 0
+    return _report(args, batch * args.steps / dt,
+                   jax.devices()[0].platform, n_chips, **extra)
 
 
 def _cost_fields(jitted, dt_step: float, *call_args) -> dict:
@@ -511,7 +523,8 @@ def _cost_fields(jitted, dt_step: float, *call_args) -> dict:
     return out
 
 
-def _bench_data(cfg, batch: int, steps: int, warmup: int) -> float:
+def _bench_data(cfg, batch: int, steps: int, warmup: int,
+                overrides=()) -> float:
     """Time the host input pipeline alone: seconds to produce ``steps``
     batches (epochs cycled as needed) on the configured backend.
 
@@ -527,11 +540,23 @@ def _bench_data(cfg, batch: int, steps: int, warmup: int) -> float:
     from distributed_sod_project_tpu.data.tfdata import make_loader
 
     dataset = resolve_dataset(cfg.data)
+    # The bench consumes each batch immediately, so UNLESS the user
+    # said otherwise it runs the zero-copy posture the train loop uses
+    # on hardware: recycled ring buffers.  An explicit --set
+    # data.ring_buffers=<n> (including 0 = off, the A/B leg for the
+    # allocating path) always wins.
+    ring = cfg.data.ring_buffers
+    user_set_ring = any(o.split("=", 1)[0].strip() == "data.ring_buffers"
+                        for o in overrides)
+    if not user_set_ring and ring == 0:
+        ring = cfg.data.lookahead + 3
     loader = make_loader(
         dataset, cfg.data, global_batch_size=batch, shard_id=0,
         num_shards=1, shuffle=True, seed=cfg.seed, hflip=cfg.data.hflip,
         rotate_degrees=cfg.data.rotate_degrees,
-        num_workers=cfg.data.num_workers)
+        color_jitter=cfg.data.color_jitter,
+        num_workers=cfg.data.num_workers,
+        ring_buffers=ring)
 
     if loader.steps_per_epoch <= 0:
         raise SystemExit(
@@ -554,9 +579,11 @@ def _bench_data(cfg, batch: int, steps: int, warmup: int) -> float:
 
 
 def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
-            mode: str | None = None, **extra) -> None:
+            mode: str | None = None, **extra) -> int:
     """One JSON line + self-relative baseline tracking (the first run
-    per (config, size, platform, mode) seeds ``bench_baseline.json``)."""
+    per (config, size, platform, mode) seeds ``bench_baseline.json``).
+    Returns the process exit code: 0, or 3 when --baseline-file +
+    --fail-below flags a regression."""
     # Claimed BEFORE the print: the watchdog must never append an error
     # line after (or while) a genuine result is being written — losing a
     # real number is worse than the timer dying with the result unsent.
@@ -577,10 +604,20 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
            f"-{platform}")
     if args.overrides:
         key += "-" + ",".join(sorted(args.overrides))
-    env_tags = sorted(f"{k}={os.environ[k]}" for k in _PROGRAM_ENV_VARS
-                      if os.environ.get(k))
+    env_tags = []
+    for k in _PROGRAM_ENV_VARS:
+        v = os.environ.get(k)
+        if not v:
+            continue
+        if k == "DSOD_STEM_IMPL" and v == "s2d" and args.image_size % 2:
+            # ADVICE r3: odd H/W forces the plain-stem fallback
+            # (models/backbones/resnet.py) — tag the key with what
+            # actually ran so an s2d A/B leg at an odd size never
+            # records mislabeled numbers.
+            v = "s2d[plain-stem-fallback]"
+        env_tags.append(f"{k}={v}")
     if env_tags:
-        key += "-env:" + ",".join(env_tags)
+        key += "-env:" + ",".join(sorted(env_tags))
     if mode != "train":
         key += f"-{mode}"
     base = {}
@@ -593,6 +630,25 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
             json.dump(base, f, indent=2)
     vs = per_chip / base[key] if base[key] else 1.0
 
+    rc = 0
+    if args.baseline_file:
+        # Regression mode against a CHECKED-IN baseline: seed on first
+        # contact, compare forever after (tools/bench_data.sh).
+        recorded = {}
+        if os.path.exists(args.baseline_file):
+            with open(args.baseline_file) as f:
+                recorded = json.load(f)
+        if key in recorded and recorded[key]:
+            extra["vs_recorded"] = round(per_chip / recorded[key], 3)
+            if args.fail_below and extra["vs_recorded"] < args.fail_below:
+                rc = 3
+        else:
+            recorded[key] = round(per_chip, 2)
+            with open(args.baseline_file, "w") as f:
+                json.dump(recorded, f, indent=2, sort_keys=True)
+                f.write("\n")
+            extra["recorded"] = True
+
     print(json.dumps({
         "metric": f"{mode}_throughput[{args.config}@"
                   f"{args.image_size}px,{platform}x{n_chips}]",
@@ -601,6 +657,7 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
         "vs_baseline": round(vs, 3),
         **extra,
     }), flush=True)
+    return rc
 
 
 if __name__ == "__main__":

@@ -31,8 +31,33 @@ class DataConfig:
     #   aug); identical per-index draws on every backend
     normalize_mean: Tuple[float, float, float] = (0.485, 0.456, 0.406)
     normalize_std: Tuple[float, float, float] = (0.229, 0.224, 0.225)
-    num_workers: int = 4  # host-side prefetch threads
+    num_workers: int = 4  # host backend: parallel batch-BUILD threads
+    #   (each assembles+augments a whole batch; decode may additionally
+    #   go to processes, see decode_procs)
     prefetch_batches: int = 2
+    # Host-backend data-plane knobs (docs/PERFORMANCE.md "Host data
+    # plane").  lookahead: batches built ahead of the consumer (in
+    # flight across the build workers).
+    lookahead: int = 2
+    # >0: recycle this many preallocated batch buffers instead of
+    # allocating per step (zero-copy assembly).  CONTRACT: a yielded
+    # batch's arrays are overwritten after 2 further batches have been
+    # yielded — consumers that hold batches longer must copy.  The
+    # train/bench paths consume immediately; keep 0 (fresh arrays)
+    # when iterating by hand.
+    ring_buffers: int = 0
+    # >0: decode samples in this many worker PROCESSES writing into
+    # shared-memory ring slots — sidesteps the GIL for the PIL decode
+    # path when native/ is unbuilt (implies a ring).  0 = in-thread.
+    decode_procs: int = 0
+    # Raw-decoded-sample cache (the tf.data cache() analogue): -1 =
+    # auto (cache every sample when the whole dataset fits
+    # cache_budget_mb of host RAM), 0 = off, N = cache at most N
+    # samples.  Epochs after the first cost a row copy per sample
+    # instead of a decode; augmentation still runs per epoch, so the
+    # (seed, epoch, idx) draw contract is untouched.
+    cache_decoded: int = -1
+    cache_budget_mb: int = 1024
     transfer_dtype: str = "float32"  # bfloat16 halves H2D image bytes
     synthetic_size: int = 256  # virtual dataset length when dataset=synthetic
     # Multi-scale training (MINet-style): the cycle of square train

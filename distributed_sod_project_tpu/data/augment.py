@@ -9,11 +9,28 @@ mid-epoch resume replays the exact stream (data/pipeline.py contract).
 Rotation runs host-side on the decoded float arrays: bilinear for
 image/depth, nearest for the binary mask, constant fill — matching the
 torchvision ``rotate(expand=False)`` convention.
+
+Two implementations of the same math live here:
+
+- the SCALAR path (``augment_sample`` and the ``apply_*`` helpers) —
+  one sample at a time, rotation via ``scipy.ndimage``.  This is the
+  reference semantics, kept for per-sample callers and as the ground
+  truth the batch path is tested against.
+- the BATCH path (``augment_batch`` and the ``*_batch`` helpers) —
+  whole-batch numpy: hflip via a boolean row mask, jitter via broadcast
+  factor columns, rotation via a per-image affine coordinate map and a
+  flat bilinear/nearest gather.  Same per-``(aug_seed, idx)`` draw
+  streams (the draws themselves are shared), bitwise-identical outputs
+  for hflip/jitter and ≤1e-5 from scipy for rotation
+  (tests/test_data_plane.py).  This is what all three loader backends
+  run in production — the scalar path does per-sample Python work
+  (N ``scipy.ndimage.rotate`` calls per batch) that made the host
+  pipeline the throughput wall (docs/PERFORMANCE.md "Host data plane").
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -104,7 +121,10 @@ def augment_sample(sample: Dict[str, np.ndarray], idx: int, aug_seed: int,
                    color_jitter: float = 0.0, norm_mean=None, norm_std=None
                    ) -> Dict[str, np.ndarray]:
     """The full deterministic train-time augmentation for one sample:
-    color jitter (photometric, image only) → hflip → rotation."""
+    color jitter (photometric, image only) → hflip → rotation.
+
+    Scalar REFERENCE path — production batches go through
+    :func:`augment_batch` (same draws, vectorized application)."""
     if color_jitter:
         sample = apply_color_jitter(
             sample, jitter_draw(aug_seed, idx, color_jitter),
@@ -115,3 +135,266 @@ def augment_sample(sample: Dict[str, np.ndarray], idx: int, aug_seed: int,
         sample = apply_rotate(sample, rotate_draw(aug_seed, idx,
                                                   rotate_degrees))
     return sample
+
+
+# ---------------------------------------------------------------------------
+# Vectorized whole-batch path.
+#
+# The draws stay per-index scalar calls (one tiny SeedSequence each —
+# bit-for-bit the streams above; vectorizing THEM would change the
+# bits), while the pixel work is batch-level numpy.
+# ---------------------------------------------------------------------------
+
+
+def hflip_draw_batch(aug_seed: int, idxs: Sequence[int]) -> np.ndarray:
+    """``[hflip_draw(aug_seed, i) for i in idxs]`` as a bool column."""
+    return np.asarray([hflip_draw(aug_seed, int(i)) for i in idxs],
+                      np.bool_)
+
+
+def rotate_draw_batch(aug_seed: int, idxs: Sequence[int],
+                      degrees: float) -> np.ndarray:
+    """Per-index rotation angles, same stream as :func:`rotate_draw`."""
+    return np.asarray([rotate_draw(aug_seed, int(i), degrees)
+                       for i in idxs], np.float64)
+
+
+def jitter_draw_batch(aug_seed: int, idxs: Sequence[int],
+                      strength: float) -> np.ndarray:
+    """[len(idxs), 3] (brightness, saturation, contrast) factor matrix,
+    same streams as :func:`jitter_draw`."""
+    return np.asarray([jitter_draw(aug_seed, int(i), strength)
+                       for i in idxs], np.float64)
+
+
+def apply_color_jitter_batch(images: np.ndarray, factors: np.ndarray,
+                             mean, std,
+                             out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Whole-batch :func:`apply_color_jitter`: [B,H,W,3] images,
+    [B,3] factors, broadcast factor columns instead of per-sample
+    Python.  Bitwise-identical to the scalar path: every elementwise op
+    runs in float32 on the same values in the same order, and the
+    per-image gray mean (the one true reduction) is computed per sample
+    exactly as the scalar path computes it.
+
+    ``out`` may alias ``images`` (ring-slot reuse): the input pixels are
+    fully consumed into temporaries before the final write.
+    """
+    mean = np.asarray(mean if mean is not None else 0.0, np.float32)
+    std = np.asarray(std if std is not None else 1.0, np.float32)
+    bsc = factors.astype(np.float32)
+    if out is None:
+        out = np.empty_like(images)
+    # Per-image chunks: one image's working set is cache-resident where
+    # a whole-batch pass streams ~40 MB through DRAM per op (this box
+    # measured 4x slower batch-wide).  Same ops, same order, same
+    # values per sample as apply_color_jitter → bitwise equal.
+    for j in range(images.shape[0]):
+        b, s, c = bsc[j, 0], bsc[j, 1], bsc[j, 2]
+        img = (images[j] if images.dtype == np.float32
+               else images[j].astype(np.float32))
+        raw = img * std + mean
+        raw *= b
+        gray = (raw @ _LUMA)[..., None]
+        # tmp = gray + (raw - gray) * s, elementwise in float32 — the
+        # in-place forms round identically to the scalar path's
+        # expression.
+        raw -= gray
+        raw *= s
+        raw += gray
+        gmean = np.float32(gray.mean())
+        raw -= gmean
+        raw *= c
+        raw += gmean
+        np.clip(raw, 0.0, 1.0, out=raw)
+        raw -= mean
+        raw /= std
+        np.copyto(out[j], raw, casting="unsafe")
+    return out
+
+
+def apply_hflip_batch(batch: Dict[str, np.ndarray],
+                      flips: np.ndarray) -> None:
+    """In-place width-axis flip of the flagged rows of every spatial
+    key ([B,H,W,C] layout; the scalar path flips sample axis 1 = W,
+    which is batch axis 2)."""
+    if not flips.any():
+        return
+    for k in ("image", "mask", "depth"):
+        if k in batch:
+            batch[k][flips] = batch[k][flips][:, :, ::-1]
+
+
+_GRIDS: Dict[tuple, tuple] = {}
+
+
+def _grid(h: int, w: int, dtype=np.float64):
+    """Memoized read-only ``np.mgrid[0:h, 0:w]`` pair — shared by the
+    rotation gather (float64 coords) and SyntheticSOD's decode
+    (float32); rebuilding these per call is measurable on the hot
+    path.  Read-only: the cache hands the same arrays to every
+    caller."""
+    key = (h, w, np.dtype(dtype).str)
+    g = _GRIDS.get(key)
+    if g is None:
+        yy, xx = np.mgrid[0:h, 0:w].astype(dtype)
+        yy.setflags(write=False)
+        xx.setflags(write=False)
+        g = _GRIDS[key] = (yy, xx)
+    return g
+
+
+def _rotate_gather(plane: np.ndarray, sy, sx, valid, invalid_any: bool,
+                   order: int, out: np.ndarray) -> None:
+    """Sample one [H,W,C] plane at source coords (sy, sx) into ``out``.
+
+    order=1 bilinear / order=0 nearest, constant-0 outside [0, n-1]
+    on either axis — scipy.ndimage's ``mode='constant'`` semantics
+    (no edge/cval interpolation; verified against scipy in tests).
+    ``sy``/``sx`` arrive pre-clipped into the valid range; ``valid``
+    marks which outputs keep their sampled value.
+    """
+    h, w, c = plane.shape
+    flat = plane.reshape(h * w, c)
+    if order == 0:
+        iy = np.floor(sy + 0.5).astype(np.int32)
+        iy *= w
+        iy += np.floor(sx + 0.5).astype(np.int32)
+        # 1-channel planes (the mask): a flat 1D take is ~2x a row take.
+        if c == 1:
+            out[...] = plane.reshape(-1).take(iy.ravel()).reshape(h, w, 1)
+        else:
+            out[...] = np.take(flat, iy.ravel(), axis=0).reshape(h, w, c)
+    else:
+        y0 = np.minimum(np.floor(sy), h - 2)
+        x0 = np.minimum(np.floor(sx), w - 2)
+        wy = (sy - y0).astype(np.float32)[..., None]
+        wx = (sx - x0).astype(np.float32)[..., None]
+        i00 = y0.astype(np.int32)
+        i00 *= w
+        i00 += x0.astype(np.int32)
+        i00 = i00.ravel()
+        g00 = np.take(flat, i00, axis=0).reshape(h, w, c)
+        i00 += 1
+        g01 = np.take(flat, i00, axis=0).reshape(h, w, c)
+        i00 += w - 1
+        g10 = np.take(flat, i00, axis=0).reshape(h, w, c)
+        i00 += 1
+        g11 = np.take(flat, i00, axis=0).reshape(h, w, c)
+        g01 -= g00
+        g01 *= wx
+        g01 += g00  # top
+        g11 -= g10
+        g11 *= wx
+        g11 += g10  # bot
+        g11 -= g01
+        g11 *= wy
+        g11 += g01
+        out[...] = g11
+    if invalid_any:
+        out[~valid] = 0
+
+
+def rotate_batch(batch: Dict[str, np.ndarray], angles_deg: np.ndarray,
+                 out: Optional[Dict[str, np.ndarray]] = None
+                 ) -> Dict[str, np.ndarray]:
+    """Whole-batch :func:`apply_rotate`: one affine coordinate map per
+    image (float64, matching scipy's internal precision) shared by
+    every key, then a flat gather — bilinear for image/depth, nearest
+    for the binary mask, zero fill.  ≤1e-5 from the scipy reference for
+    bilinear, exact for nearest (tests/test_data_plane.py).
+
+    Images are processed one at a time over cached [H,W] grids — the
+    per-image working set fits cache, where one giant [B,H,W] gather
+    thrashes — but each step is pure C-speed numpy, no scipy call.
+    ``out`` buffers (ring slots) are written in place when given; keys
+    absent from the batch are ignored.  |angle| < 1e-6 rows are copied
+    through unchanged (the scalar path's identity short-circuit).
+    """
+    keys = [(k, o) for k, o in (("image", 1), ("depth", 1), ("mask", 0))
+            if k in batch]
+    if not keys:
+        return batch
+    b, h, w = batch[keys[0][0]].shape[:3]
+    if out is None:
+        out = {k: np.empty_like(batch[k]) for k, _ in keys}
+    yy, xx = _grid(h, w)
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    # scipy.ndimage.rotate's trig: degree-native cosdg/sindg are EXACT
+    # at the quadrant angles (cosdg(90) == 0.0), so the source
+    # coordinates below match scipy's to the last bit there — with
+    # np.cos(deg2rad(90)) ≈ 6e-17 boundary pixels flip validity
+    # against the scalar reference.
+    from scipy.special import cosdg, sindg
+
+    for j in range(b):
+        a = float(angles_deg[j])
+        if abs(a) < 1e-6:
+            for k, _ in keys:
+                if out[k] is not batch[k]:
+                    out[k][j] = batch[k][j]
+            continue
+        cos, sin = float(cosdg(a)), float(sindg(a))
+        # Same association as scipy's affine_transform inner loop:
+        # (M[h,0]*y + M[h,1]*x) + offset[h], offset = c_in - M @ c_out.
+        off_y = cy - (cos * cy + sin * cx)
+        off_x = cx - (-sin * cy + cos * cx)
+        sy = cos * yy
+        sy += sin * xx
+        sy += off_y
+        sx = -sin * yy
+        sx += cos * xx
+        sx += off_x
+        valid = (sy >= 0) & (sy <= h - 1) & (sx >= 0) & (sx <= w - 1)
+        invalid_any = not valid.all()
+        np.clip(sy, 0, h - 1, out=sy)
+        np.clip(sx, 0, w - 1, out=sx)
+        for k, order in keys:
+            # With out[k] aliasing batch[k] (in-place ring reuse) the
+            # gather must read the pre-rotation pixels — copy the one
+            # source image, not the whole batch.
+            arr = batch[k][j]
+            if out[k] is batch[k]:
+                arr = arr.copy()
+            _rotate_gather(arr, sy, sx, valid, invalid_any, order,
+                           out[k][j])
+    for k, _ in keys:
+        batch[k] = out[k]
+    return batch
+
+
+def augment_batch(batch: Dict[str, np.ndarray], idxs: Sequence[int],
+                  aug_seed: int, *, hflip: bool, rotate_degrees: float,
+                  color_jitter: float = 0.0, norm_mean=None, norm_std=None,
+                  skip_hflip: bool = False,
+                  reuse_buffers: bool = False) -> Dict[str, np.ndarray]:
+    """The full deterministic augmentation, whole-batch vectorized:
+    jitter → hflip → rotation, same order and same per-``(aug_seed,
+    idx)`` draw streams as :func:`augment_sample` applied per row.
+
+    Callers hand in freshly assembled buffers or ring slots, never
+    dataset-owned memory.  With ``reuse_buffers`` every stage writes
+    back into the arrays already in ``batch`` (ring-slot discipline:
+    the dict keeps its identity and its buffers); without it, stages
+    may swap in fresh arrays.  ``skip_hflip`` is for backends that
+    already flipped upstream (the C++ native decode) — the draws are
+    consumed there, not re-applied here.
+    """
+    for k in ("image", "mask", "depth"):
+        # Some execution layers (grain worker shared memory) hand back
+        # read-only arrays; the stages below mutate rows in place.
+        if k in batch and not batch[k].flags.writeable:
+            batch[k] = batch[k].copy()
+    if color_jitter:
+        batch["image"] = apply_color_jitter_batch(
+            batch["image"], jitter_draw_batch(aug_seed, idxs, color_jitter),
+            norm_mean, norm_std,
+            out=batch["image"] if reuse_buffers else None)
+    if hflip and not skip_hflip:
+        apply_hflip_batch(batch, hflip_draw_batch(aug_seed, idxs))
+    if rotate_degrees:
+        batch = rotate_batch(
+            batch, rotate_draw_batch(aug_seed, idxs, rotate_degrees),
+            out={k: batch[k] for k in ("image", "depth", "mask")
+                 if k in batch} if reuse_buffers else None)
+    return batch

@@ -22,32 +22,19 @@ import numpy as np
 
 class _ShardView:
     """Random-access view: position in this host's epoch sequence →
-    augmented sample (deterministic per-record draws shared with the
-    host backend via data/augment.py)."""
+    RAW decoded sample.  Augmentation happens on the assembled batch in
+    the parent (the shared vectorized path in data/augment.py), so
+    Grain's worker processes carry only the decode."""
 
-    def __init__(self, dataset, keys: np.ndarray, hflip: bool,
-                 aug_seed: int, rotate_degrees: float = 0.0,
-                 color_jitter: float = 0.0):
+    def __init__(self, dataset, keys: np.ndarray):
         self._dataset = dataset
         self._keys = keys
-        self._hflip = hflip
-        self._aug_seed = aug_seed
-        self._rotate = rotate_degrees
-        self._jitter = color_jitter
 
     def __len__(self) -> int:
         return len(self._keys)
 
     def __getitem__(self, i) -> Dict[str, np.ndarray]:
-        from .augment import augment_sample
-
-        idx = int(self._keys[int(i)])
-        return augment_sample(dict(self._dataset[idx]), idx,
-                              self._aug_seed, hflip=self._hflip,
-                              rotate_degrees=self._rotate,
-                              color_jitter=self._jitter,
-                              norm_mean=getattr(self._dataset, "mean", None),
-                              norm_std=getattr(self._dataset, "std", None))
+        return dict(self._dataset[int(self._keys[int(i)])])
 
 
 class GrainLoader:
@@ -133,9 +120,7 @@ class GrainLoader:
         if not len(keys):
             return iter(())
 
-        view = _ShardView(self.dataset, keys, self.hflip, aug_seed,
-                          rotate_degrees=self.rotate_degrees,
-                          color_jitter=self.color_jitter)
+        view = _ShardView(self.dataset, keys)
         sampler = grain.IndexSampler(
             num_records=len(view),
             shard_options=grain.NoSharding(),  # host sharding is in `keys`
@@ -150,4 +135,21 @@ class GrainLoader:
                                     drop_remainder=True)],
             worker_count=self.num_workers,
         )
-        return iter(loader)
+
+        def batches():
+            from .augment import augment_batch
+
+            mean = getattr(self.dataset, "mean", None)
+            std = getattr(self.dataset, "std", None)
+            for batch in loader:
+                # Grain assembled fresh arrays; the shared vectorized
+                # augment (same per-(aug_seed, idx) draws as every
+                # backend) runs batch-level in the parent.
+                yield augment_batch(
+                    dict(batch), batch["index"], aug_seed,
+                    hflip=self.hflip,
+                    rotate_degrees=self.rotate_degrees,
+                    color_jitter=self.color_jitter,
+                    norm_mean=mean, norm_std=std)
+
+        return batches()

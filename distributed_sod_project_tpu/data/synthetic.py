@@ -17,6 +17,16 @@ from typing import Dict, Tuple
 
 import numpy as np
 
+# Decode-stage hot path (data plane): the coordinate grids are a pure
+# function of the image size — rebuild per sample and they are ~10% of
+# generation time.  Values identical to np.mgrid[...].astype(f32);
+# the memoized read-only cache is shared with the rotation gather.
+from .augment import _grid as _grids_cache
+
+
+def _grids(h: int, w: int) -> Tuple[np.ndarray, np.ndarray]:
+    return _grids_cache(h, w, np.float32)
+
 
 class SyntheticSOD:
     def __init__(
@@ -45,11 +55,14 @@ class SyntheticSOD:
         rng = np.random.default_rng(
             np.random.SeedSequence([self.seed, int(index)])
         )
-        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        yy, xx = _grids(h, w)
 
         # Background: low-frequency texture from a coarse noise grid.
+        # repeat() is the block-expand np.kron(coarse, ones((16,16,1)))
+        # computes by multiplication — identical values, ~4x cheaper.
         coarse = rng.normal(0.35, 0.12, size=(h // 16 + 1, w // 16 + 1, 3))
-        bg = np.kron(coarse, np.ones((16, 16, 1)))[:h, :w, :].astype(np.float32)
+        bg = (coarse.repeat(16, axis=0).repeat(16, axis=1)
+              [:h, :w, :].astype(np.float32))
 
         mask = np.zeros((h, w), dtype=np.float32)
         img = bg.copy()

@@ -205,34 +205,11 @@ class TFDataLoader:
                         out[k] = tf.cond(
                             flip, lambda t=out[k]: tf.reverse(t, axis=[1]),
                             lambda t=out[k]: t)
-            if self.rotate_degrees:
-                # Host-side scipy rotation via py_function, the SAME
-                # per-index draws as the host/grain backends
-                # (data/augment.py) — backend choice never changes the
-                # training data.  py_function serialises on the GIL but
-                # scipy releases it for the heavy spline work.
-                deg = self.rotate_degrees
-
-                def rot(idx, img, mask, *maybe_depth):
-                    from .augment import apply_rotate, rotate_draw
-
-                    angle = rotate_draw(aug_seed, int(idx.numpy()), deg)
-                    s = {"image": img.numpy(), "mask": mask.numpy()}
-                    if maybe_depth:
-                        s["depth"] = maybe_depth[0].numpy()
-                    s = apply_rotate(s, angle)
-                    outs = [s["image"], s["mask"]]
-                    if maybe_depth:
-                        outs.append(s["depth"])
-                    return outs
-
-                keys = ["image", "mask"] + (["depth"] if use_depth else [])
-                rotated = tf.py_function(
-                    rot, inp=[out["index"]] + [out[k] for k in keys],
-                    Tout=[tf.float32] * len(keys))
-                for k, r in zip(keys, rotated):
-                    r.set_shape(out[k].shape)  # py_function drops shapes
-                    out[k] = r
+            # Rotation happens OUTSIDE the graph, on the assembled
+            # numpy batch, through the shared vectorized augment
+            # (data/augment.py rotate_batch) — same per-index draws as
+            # the host/grain backends, one gather per batch instead of
+            # a GIL-serialised py_function per sample.
             return out
 
         ds = (tf.data.Dataset.from_tensor_slices(tensors)
@@ -249,6 +226,12 @@ class TFDataLoader:
             batch.pop("mask_path", None)
             batch.pop("depth_path", None)
             got += 1
+            if self.rotate_degrees:
+                from .augment import rotate_batch, rotate_draw_batch
+
+                batch = rotate_batch(
+                    batch, rotate_draw_batch(aug_seed, batch["index"],
+                                             self.rotate_degrees))
             yield batch
         if self.skip_budget > 0:
             # ignore_errors is silent; charge the observable effect —
@@ -280,9 +263,25 @@ def make_loader(dataset, data_cfg, **kw):
     backend — which decodes inside the TF graph, bypassing
     ``dataset[i]`` — needs the budget to drive its own
     ignore_errors + shortfall degradation (see TFDataLoader).
+
+    The host-pipeline tuning knobs (``lookahead``, ``ring_buffers``,
+    ``decode_procs`` — see DataConfig) are injected from ``data_cfg``
+    here so callers only pass the cross-backend parameters; ``stats``
+    (a utils/observability.PipelineStats) is forwarded to the host
+    backend, which is the one with instrumented blocking points.
     """
     backend = getattr(data_cfg, "backend", "host")
     skip_budget = int(kw.pop("skip_budget", 0))
+    stats = kw.pop("stats", None)
+    # Host-only knobs: consumed here so tfdata/grain callers may pass
+    # them uniformly (their execution layers have their own buffering).
+    host_kw = {}
+    for knob in ("lookahead", "ring_buffers", "decode_procs",
+                 "cache_decoded", "cache_budget_mb"):
+        if knob in kw:
+            host_kw[knob] = kw.pop(knob)
+        elif hasattr(data_cfg, knob):
+            host_kw[knob] = getattr(data_cfg, knob)
     if backend == "tfdata":
         return TFDataLoader(dataset, skip_budget=skip_budget, **kw)
     if backend == "grain":
@@ -292,5 +291,5 @@ def make_loader(dataset, data_cfg, **kw):
     if backend == "host":
         from .pipeline import HostDataLoader
 
-        return HostDataLoader(dataset, **kw)
+        return HostDataLoader(dataset, stats=stats, **kw, **host_kw)
     raise ValueError(f"unknown data backend {backend!r}")

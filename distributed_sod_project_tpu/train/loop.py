@@ -108,6 +108,12 @@ def fit(
         data_guard = GuardedDataset(dataset, cfg.data.skip_budget,
                                     fault_plan=plan)
         dataset = data_guard
+    # Host-data-plane telemetry: every blocking point in the loader /
+    # prefetch stages reports here; the per-interval deltas ride the
+    # metric stream (data_starved_ms is the input-bound signal).
+    from ..utils.observability import PipelineStats
+
+    data_stats = PipelineStats()
     loader = make_loader(
         dataset, cfg.data,
         global_batch_size=cfg.global_batch_size,
@@ -120,6 +126,7 @@ def fit(
         color_jitter=cfg.data.color_jitter,
         num_workers=cfg.data.num_workers,
         skip_budget=cfg.data.skip_budget,
+        stats=data_stats,
     )
     steps_per_epoch = cfg.steps_per_epoch or loader.steps_per_epoch
     if steps_per_epoch <= 0:
@@ -342,7 +349,8 @@ def fit(
                 host_batches, size=cfg.data.prefetch_batches, mesh=mesh,
                 transfer_dtype=cfg.data.transfer_dtype,
                 drop_keys=("index",),
-                spec=batch_spec_override)
+                spec=batch_spec_override,
+                stats=data_stats)
             for batch in it:
                 if step >= total_steps or stop:
                     break
@@ -379,6 +387,10 @@ def fit(
                     host["imgs_per_sec"] = timer.images_per_sec(
                         cfg.global_batch_size)
                     host["epoch"] = epoch
+                    # Data-plane health for this logging interval:
+                    # data_starved_ms > 0 means the device waited on
+                    # the host pipeline (docs/PERFORMANCE.md).
+                    host.update(data_stats.delta())
                     if cfg.data.skip_budget > 0:
                         # Corrupt samples tolerated so far (dataguard
                         # substitution + tfdata shortfall), surfaced as

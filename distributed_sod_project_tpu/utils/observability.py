@@ -18,9 +18,79 @@ from __future__ import annotations
 
 import contextlib
 import signal
+import threading
 from typing import Dict, Optional
 
 from .logging import get_logger, is_primary_process
+
+
+class PipelineStats:
+    """Thread-safe counters/gauges for the host data plane.
+
+    Every blocking point in the input pipeline (data/pipeline.py)
+    reports here, so "the step is input-bound" is a measured number
+    instead of a guess.  Counters (cumulative):
+
+    - ``data_starved_ms``   — consumer blocked on an empty prefetch
+      queue: device idle waiting for data.  THE input-bound signal.
+    - ``data_h2d_ms``       — time inside device_put / global array
+      assembly on the H2D thread.
+    - ``data_prefetch_full_ms`` — H2D thread blocked on a full queue
+      (healthy: the step, not the input, is the bottleneck).
+    - ``data_build_wait_ms`` — loader blocked waiting for a batch
+      build worker (decode+augment stage is the bottleneck).
+    - ``data_ring_wait_ms`` — builders blocked waiting for a free
+      batch buffer (consumer holding the ring; raise ring_buffers).
+    - ``data_batches``      — batches produced.
+
+    Queue depth is tracked as a running (sum, count) pair and reported
+    as ``data_queue_depth_avg`` / ``data_queue_size``.
+
+    ``delta()`` returns metrics accumulated since the previous
+    ``delta()`` call — the train loop calls it once per logging
+    interval and hands the result to :class:`MetricWriter`, so the
+    TensorBoard curves are per-interval, not monotone totals.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, float] = {}
+        self._last: Dict[str, float] = {}
+        self._depth_sum = 0.0
+        self._depth_n = 0
+        self._depth_size = 0
+
+    def add(self, key: str, value: float) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0.0) + float(value)
+
+    def observe_depth(self, depth: int, size: int) -> None:
+        with self._lock:
+            self._depth_sum += depth
+            self._depth_n += 1
+            self._depth_size = size
+
+    def snapshot(self) -> Dict[str, float]:
+        """Cumulative totals (plus average queue depth over the run)."""
+        with self._lock:
+            out = dict(self._counts)
+            if self._depth_n:
+                out["data_queue_depth_avg"] = self._depth_sum / self._depth_n
+                out["data_queue_size"] = float(self._depth_size)
+            return out
+
+    def delta(self) -> Dict[str, float]:
+        """Counters accumulated since the last ``delta()`` call."""
+        with self._lock:
+            out = {}
+            for k, v in self._counts.items():
+                out[k] = v - self._last.get(k, 0.0)
+            self._last = dict(self._counts)
+            if self._depth_n:
+                out["data_queue_depth_avg"] = self._depth_sum / self._depth_n
+                self._depth_sum = 0.0
+                self._depth_n = 0
+            return out
 
 
 class MetricWriter:

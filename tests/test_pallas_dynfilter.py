@@ -137,3 +137,42 @@ def test_dynfilter_lowers_for_real_tpu():
         lambda x_, k_, g_: df._dlf_bwd(3, 1, False, (x_, k_), g_)),
         platforms=["tpu"])(x, kt, g)
     assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_compiler_params_vmem_gate_denylist(monkeypatch):
+    """ADVICE r3: the scoped-VMEM raise is gated on a v2/v3 SMALL-VMEM
+    denylist (word-bounded regex), not a substring allowlist — v4 and
+    unknown/future generations get the raised limit, 'lite' never
+    matches against unrelated device kinds, and DSOD_DLF_VMEM_MB stays
+    the escape hatch."""
+    from distributed_sod_project_tpu.pallas import dynamic_filter as df
+
+    class _Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    monkeypatch.delenv("DSOD_DLF_VMEM_MB", raising=False)
+    cases = {
+        "TPU v2": None,            # small VMEM: compiler default
+        "TPU v3": None,
+        "TPU v4": 100 << 20,       # the allowlist-era omission
+        "TPU v4 lite": 100 << 20,  # 'lite' substring must not matter
+        "TPU v5 lite": 100 << 20,
+        "TPU v5p": 100 << 20,
+        "TPU v6e": 100 << 20,
+        "TPU v23x": 100 << 20,     # word boundary: not v2/v3
+        "unknown-future-chip": 100 << 20,
+    }
+    for kind, want in cases.items():
+        monkeypatch.setattr(df.jax, "devices",
+                            lambda kind=kind: [_Dev(kind)])
+        got = getattr(df._compiler_params(), "vmem_limit_bytes", None)
+        assert got == want, f"{kind}: {got} != {want}"
+
+    # Escape hatch overrides the device gate in both directions.
+    monkeypatch.setattr(df.jax, "devices", lambda: [_Dev("TPU v2")])
+    monkeypatch.setenv("DSOD_DLF_VMEM_MB", "64")
+    assert df._compiler_params().vmem_limit_bytes == 64 << 20
+    monkeypatch.setenv("DSOD_DLF_VMEM_MB", "0")
+    assert getattr(df._compiler_params(), "vmem_limit_bytes",
+                   None) is None

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Host data-plane benchmark + regression record — CPU only, no TPU
+# window needed (docs/PERFORMANCE.md "Host data plane").
+#
+# Runs bench.py --mode data (host backend, rotate+jitter on — the
+# full augmentation pipeline) against the CHECKED-IN baseline
+# tools/data_baseline.json: the first run on a fresh key seeds it,
+# later runs add vs_recorded to the JSON result line.  No hard perf
+# gate on shared CI (the sandbox CPUs are noisy-neighbor machines) —
+# the number is printed and recorded; pass --fail-below 0.5 (or any
+# ratio) to turn it into a local gate.
+#
+# Knobs via env: STEPS/WARMUP/BATCH/SIZE; extra bench.py flags pass
+# through, e.g.:  tools/bench_data.sh --set data.backend=grain
+cd "$(dirname "$0")/.." || exit 1
+STEPS=${STEPS:-8}
+WARMUP=${WARMUP:-2}
+BATCH=${BATCH:-8}
+SIZE=${SIZE:-128}
+exec env JAX_PLATFORMS=cpu python bench.py --device cpu --mode data \
+  --steps "$STEPS" --warmup "$WARMUP" --batch-per-chip "$BATCH" \
+  --image-size "$SIZE" \
+  --set data.backend=host --set data.rotate_degrees=10 \
+  --set data.color_jitter=0.4 \
+  --baseline-file tools/data_baseline.json "$@"

@@ -1,7 +1,15 @@
 #!/bin/bash
-# Tier-1 verify gate, verbatim from ROADMAP.md — run from the repo root
-# (or anywhere; this cd's home first).  Prints DOTS_PASSED=<n> at the
-# end and exits with pytest's status, so CI and humans invoke the exact
-# same command the roadmap promises.
+# Tier-1 verify gate — run from the repo root (or anywhere; this cd's
+# home first).  Prints DOTS_PASSED=<n> at the end and exits with
+# pytest's status, so CI and humans invoke the exact same command the
+# roadmap promises (the pytest line below is verbatim ROADMAP.md).
+#
+# Before the suite, the host data-plane smoke (tools/bench_data.sh)
+# prints one JSON throughput line and compares it against the
+# checked-in tools/data_baseline.json — recorded, never a hard gate
+# here (shared CI boxes are noisy-neighbor machines; see
+# docs/PERFORMANCE.md "Host data plane").
 cd "$(dirname "$0")/.." || exit 1
+echo "== host data-plane smoke (recorded, non-gating) =="
+bash tools/bench_data.sh || echo "bench_data smoke failed (non-gating)"
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
