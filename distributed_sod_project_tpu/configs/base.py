@@ -108,6 +108,19 @@ class ModelConfig:
     # "pallas" = fused VMEM shifted-FMA kernel
     # (pallas/dynamic_filter.py) — no ksize²-wide patch tensor in HBM.
     dlf_impl: str = "xla"  # xla | pallas
+    # Decoder resample strategy (minet / hdfnet / gatenet / u2net —
+    # the four decoder users of the upsample+merge idiom).  Subsumes
+    # the DSOD_RESIZE_IMPL env knob (env still honored at the default
+    # for the recorded A/B legs; an explicit non-default value wins):
+    #   fast  — slice/lerp fast paths, layout-stable interleave
+    #           (default; all-XLA, jax.image.resize-exact)
+    #   xla   — force the generic jax.image.resize (A/B escape hatch)
+    #   convt — 2x upsamples as depthwise fractionally-strided convs
+    #   fused — Pallas fused resample-merge (pallas/fused_resample.py):
+    #           upsample + add/concat as ONE VMEM pass per image.
+    #           Knob-gated pending a hardware A/B win (the pre-committed
+    #           non-XLA-default rule; legs in tools/tpu_agenda_r5.sh).
+    resample_impl: str = "fast"  # fast | xla | convt | fused
     pretrained: Optional[str] = None  # .npz from tools/port_torch_weights.py
     # Structural deep supervision for models where aux heads are
     # optional add-ons (vit_sod's mid-depth head).  U²-Net/BASNet side

@@ -96,6 +96,11 @@ class GateNet(nn.Module):
     width: int = 64
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    # Decoder resample strategy (model.resample_impl): fast | xla |
+    # convt | fused.  GateNet's decoder reuses the upsampled state
+    # twice (gate input AND skip concat), so the fused arm runs the
+    # BARE single-pass upsample kernel (no merge epilogue) here.
+    resample_impl: str = "fast"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -125,11 +130,12 @@ class GateNet(nn.Module):
         def side_logit(feat):
             l = nn.Conv(1, (3, 3), padding="SAME", dtype=self.dtype,
                         param_dtype=self.param_dtype)(feat)
-            return resize_to(l, image.shape[1:3]).astype(jnp.float32)
+            return resize_to(l, image.shape[1:3],
+                             impl=self.resample_impl).astype(jnp.float32)
 
         logits.append(side_logit(d))  # coarsest
         for i in range(len(trans) - 2, -1, -1):
-            up = upsample_like(d, trans[i])
+            up = upsample_like(d, trans[i], impl=self.resample_impl)
             gated = GateUnit(**kw)(trans[i], up, train=train)
             d = ConvBNAct(self.width, (3, 3), **kw)(
                 jnp.concatenate([gated, up], axis=-1), train=train)

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from .backbones import ResNet50, VGG16
-from .layers import ConvBNAct, resize_to, upsample_like
+from .layers import ConvBNAct, resample_merge, resize_to
 
 
 def dynamic_local_filter(x: jnp.ndarray, kernels: jnp.ndarray, ksize: int,
@@ -121,6 +121,9 @@ class HDFNet(nn.Module):
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
     dlf_impl: str = "xla"  # xla (im2col+einsum) | pallas (fused VMEM)
+    # Decoder resample strategy (model.resample_impl):
+    # fast | xla | convt | fused — see layers.resample_merge.
+    resample_impl: str = "fast"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -168,12 +171,14 @@ class HDFNet(nn.Module):
         dec = filtered[-1]
         sides = []  # supervised decoder states, coarse → fine
         for skip in (filtered[1], filtered[0]):
-            dec = upsample_like(dec, skip) + skip
+            dec = resample_merge(dec, skip, mode="add",
+                                 impl=self.resample_impl)
             dec = ConvBNAct(self.width, (3, 3), **kw)(dec, train)
             sides.append(dec)
         for lvl in (1, 0):
             skip = ConvBNAct(self.width, (3, 3), **kw)(rgb_feats[lvl], train)
-            dec = upsample_like(dec, skip) + skip
+            dec = resample_merge(dec, skip, mode="add",
+                                 impl=self.resample_impl)
             dec = ConvBNAct(self.width, (3, 3), **kw)(dec, train)
 
         hw = image.shape[1:3]
@@ -183,5 +188,6 @@ class HDFNet(nn.Module):
         for s in (dec, sides[1], sides[0]):
             l = nn.Conv(1, (3, 3), padding="SAME", dtype=self.dtype,
                         param_dtype=self.param_dtype)(s)
-            logits.append(resize_to(l, hw).astype(jnp.float32))
+            logits.append(resize_to(l, hw, impl=self.resample_impl)
+                          .astype(jnp.float32))
         return logits

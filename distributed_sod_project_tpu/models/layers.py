@@ -161,7 +161,20 @@ def _upsample_axis(x, axis: int, s: int):
     ``dot_general``s whose operand layouts cost two relayout copies per
     call (measured 15% of the MINet-R50 train step on v5e;
     docs/PERFORMANCE.md).
+
+    The interleave is LAYOUT-STABLE (round 5): the phases concatenate
+    along the NEXT axis and one reshape merges the pair — by row-major
+    identity ``(…, n, s·m, …) == (…, n, s, m, …) == (…, s·n, m, …)``
+    this produces exactly the same elements as the historical
+    ``stack(axis+1) + reshape`` form, but without inserting size-1 axes
+    XLA:TPU answers with dim-shuffled relayout copies (~1.25 ms per
+    call on ``bf16[64,160,64,160]`` in the round-2 v5e trace, ~10% of
+    the flagship step in data-formatting total).  Bit-identical either
+    way; ``DSOD_RESIZE_INTERLEAVE=stack`` keeps the old form as the A/B
+    arm ``tools/hlo_guard.py`` diffs against.
     """
+    import os
+
     import jax.lax as lax
 
     n = x.shape[axis]
@@ -180,8 +193,13 @@ def _upsample_axis(x, axis: int, s: int):
             a, b, f = x, right, c
         f = jnp.asarray(f, x.dtype)
         phases.append(a * (1 - f) + b * f)
-    y = jnp.stack(phases, axis=axis + 1)
-    return y.reshape(x.shape[:axis] + (n * s,) + x.shape[axis + 1:])
+    out_shape = x.shape[:axis] + (n * s,) + x.shape[axis + 1:]
+    if (axis + 1 >= x.ndim
+            or os.environ.get("DSOD_RESIZE_INTERLEAVE") == "stack"):
+        y = jnp.stack(phases, axis=axis + 1)  # historical form
+    else:
+        y = jnp.concatenate(phases, axis=axis + 1)  # layout-stable
+    return y.reshape(out_shape)
 
 
 def _downsample2_axis(x, axis: int):
@@ -257,16 +275,38 @@ def _upsample2_axis_convt(x, axis: int):
         feature_group_count=c)
 
 
-def _fast_bilinear_axis(x, axis: int, out_n: int):
-    """One axis of ``resize_to``'s fast path; None if unsupported."""
+RESAMPLE_IMPLS = ("fast", "xla", "convt", "fused")
+
+
+def _resolve_resample_impl(impl: Optional[str]) -> str:
+    """Resolve the execution strategy for a resample call.
+
+    ``model.resample_impl`` (threaded through the decoder modules as an
+    explicit ``impl``) subsumes the ``DSOD_RESIZE_IMPL`` env knob: an
+    explicit non-default impl always wins; at the default (``None`` /
+    ``"fast"``) a set env var still selects the arm, so the recorded
+    A/B legs (``rsz_convt`` etc. in tools/tpu_agenda_r4.sh) and the
+    BASELINE.md measurement commands keep working unchanged.
+    """
     import os
 
+    if impl in (None, "fast"):
+        env = os.environ.get("DSOD_RESIZE_IMPL")
+        impl = env or "fast"
+    if impl not in RESAMPLE_IMPLS:
+        raise ValueError(
+            f"resample impl must be one of {RESAMPLE_IMPLS}, got {impl!r}")
+    return impl
+
+
+def _fast_bilinear_axis(x, axis: int, out_n: int, impl: str = "fast"):
+    """One axis of ``resize_to``'s fast path; None if unsupported."""
     n = x.shape[axis]
     if out_n == n:
         return x
     if out_n % n == 0:
         s = out_n // n
-        if s == 2 and os.environ.get("DSOD_RESIZE_IMPL") == "convt":
+        if s == 2 and impl == "convt":
             return _upsample2_axis_convt(x, axis)
         return _upsample_axis(x, axis, s)
     if n == 2 * out_n and n % 2 == 0:
@@ -274,30 +314,102 @@ def _fast_bilinear_axis(x, axis: int, out_n: int):
     return None
 
 
-def resize_to(x, hw: Tuple[int, int], method: str = "bilinear"):
+def resize_to(x, hw: Tuple[int, int], method: str = "bilinear",
+              impl: Optional[str] = None):
     """Static-shape spatial resize (the upsample path of every decoder).
 
     Bilinear integer-factor resizes — every resize the zoo performs —
     take the fused slice/lerp path above; anything else falls back to
     ``jax.image.resize`` (same numerics either way, asserted in
-    tests/test_models.py).  ``DSOD_RESIZE_IMPL=xla`` forces the generic
-    path everywhere — the measurement/debug escape hatch (the A/B knob
-    used for the v5e numbers in BASELINE.md).
-    """
-    import os
+    tests/test_models.py).  ``impl`` (default: ``DSOD_RESIZE_IMPL``,
+    else ``fast``) selects the execution strategy:
 
+    - ``fast``  — slice/lerp with the layout-stable interleave;
+    - ``xla``   — force the generic ``jax.image.resize`` everywhere
+      (the measurement/debug escape hatch behind the BASELINE.md
+      numbers);
+    - ``convt`` — 2x upsamples as depthwise fractionally-strided convs;
+    - ``fused`` — exact-2x upsamples as one Pallas VMEM pass
+      (``pallas/fused_resample.py``) where the shape/VMEM budget
+      allows, the ``fast`` path otherwise.
+
+    Every arm computes the same bilinear resample; ``fast``/``convt``
+    match bitwise, ``xla``/``fused`` to dtype round-off (the fused
+    kernel lerps in f32 in-kernel, so under bf16 compute it is the
+    MORE precise arm, not a bit-equal one).
+    """
     import jax
 
-    if method == "bilinear" and os.environ.get("DSOD_RESIZE_IMPL") != "xla":
-        h = _fast_bilinear_axis(x, 1, hw[0])
+    impl = _resolve_resample_impl(impl)
+    if method == "bilinear" and impl != "xla":
+        if impl == "fused":
+            from ..pallas.fused_resample import (fused_resample_available,
+                                                 fused_upsample2)
+
+            if fused_resample_available(x.shape, hw):
+                return fused_upsample2(x)
+        h = _fast_bilinear_axis(x, 1, hw[0], impl)
         if h is not None:
-            w = _fast_bilinear_axis(h, 2, hw[1])
+            w = _fast_bilinear_axis(h, 2, hw[1], impl)
             if w is not None:
                 return w
     out = jax.image.resize(x, (x.shape[0], hw[0], hw[1], x.shape[3]), method=method)
     return out.astype(x.dtype)
 
 
-def upsample_like(x, ref, method: str = "bilinear"):
+def upsample_like(x, ref, method: str = "bilinear",
+                  impl: Optional[str] = None):
     """Resize ``x`` to the spatial size of ``ref``."""
-    return resize_to(x, (ref.shape[1], ref.shape[2]), method=method)
+    return resize_to(x, (ref.shape[1], ref.shape[2]), method=method,
+                     impl=impl)
+
+
+def resample_merge(x, lateral, mode: str = "add", x_first: bool = True,
+                   impl: Optional[str] = None):
+    """The decoder-stage idiom: upsample ``x`` to ``lateral``'s spatial
+    size and merge — ``mode='add'`` (``up + lateral``) or
+    ``mode='concat'`` (``[up, lateral]`` channels when ``x_first``,
+    ``[lateral, up]`` otherwise).
+
+    All four decoder users (MINet AIM/SIM, HDFNet, GateNet via its
+    bare-upsample form, U²-Net) route their merges here so the
+    ``model.resample_impl`` knob selects one strategy zoo-wide.  With
+    ``impl='fused'`` and an exact-2x, VMEM-sized resample the whole
+    chain runs as ONE Pallas pass (the fine map is read from HBM once
+    — roofline lever #1, docs/PERFORMANCE.md); any other impl, or an
+    out-of-envelope shape, takes the plain resize + merge.  Every arm
+    computes the same resample (≤1e-5 in f32, asserted in
+    tests/test_pallas_resample.py); under bf16 compute the fused arm
+    lerps in f32 in-kernel where the fast arm lerps in bf16, so the
+    arms agree to bf16 round-off (~1e-3), not bitwise.
+    """
+    impl = _resolve_resample_impl(impl)
+    if impl == "fused":
+        from ..pallas.fused_resample import (fused_resample_available,
+                                             fused_upsample2_merge)
+
+        if (mode in ("add", "concat")
+                and lateral.shape[0] == x.shape[0]
+                and (mode != "add" or lateral.shape[-1] == x.shape[-1])
+                and fused_resample_available(
+                    x.shape, lateral.shape[1:3], mode, lateral.shape[-1])):
+            return fused_upsample2_merge(x, lateral, mode=mode,
+                                         x_first=x_first)
+        # Out of envelope: trace-time note so a fused A/B leg knows
+        # which sites opted out (fires once per compile, not per step),
+        # then keep the EXPLICIT 'fused' selection and let resize_to
+        # degrade it to the fast path itself — rewriting to 'fast'
+        # would re-enter env resolution and let a stray
+        # DSOD_RESIZE_IMPL hijack a site the user pinned to fused.
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "fused resample out of envelope at %s -> %s (%s): fast path",
+            x.shape, lateral.shape, mode)
+    up = resize_to(x, (lateral.shape[1], lateral.shape[2]), impl=impl)
+    if mode == "add":
+        return up + lateral
+    if mode == "concat":
+        parts = [up, lateral] if x_first else [lateral, up]
+        return jnp.concatenate(parts, axis=-1)
+    raise ValueError(f"mode must be 'add' or 'concat', got {mode!r}")

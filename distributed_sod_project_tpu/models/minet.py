@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from .backbones import ResNet50, VGG16
-from .layers import ConvBNAct, max_pool, resize_to, upsample_like
+from .layers import (ConvBNAct, max_pool, resample_merge, resize_to,
+                     upsample_like)
 
 
 class SIM(nn.Module):
@@ -33,6 +34,7 @@ class SIM(nn.Module):
 
     width: int
     axis_name: Optional[str] = None
+    resample_impl: str = "fast"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -42,16 +44,20 @@ class SIM(nn.Module):
                   param_dtype=self.param_dtype)
         h = ConvBNAct(self.width, (3, 3), **kw)(x, train)
         l = max_pool(ConvBNAct(self.width // 2, (3, 3), **kw)(x, train))
-        # Exchange: each branch receives the other, resampled.
+        # Exchange: each branch receives the other, resampled (the
+        # upsample+add / upsample+concat merges are the fused-resample
+        # decoder idiom — model.resample_impl picks the strategy).
         h2 = ConvBNAct(self.width, (3, 3), **kw)(
-            h + upsample_like(ConvBNAct(self.width, (3, 3), **kw)(l, train), h),
+            resample_merge(ConvBNAct(self.width, (3, 3), **kw)(l, train), h,
+                           mode="add", impl=self.resample_impl),
             train,
         )
         l2 = ConvBNAct(self.width // 2, (3, 3), **kw)(
             l + max_pool(ConvBNAct(self.width // 2, (3, 3), **kw)(h, train)),
             train,
         )
-        merged = jnp.concatenate([h2, upsample_like(l2, h2)], axis=-1)
+        merged = resample_merge(l2, h2, mode="concat", x_first=False,
+                                impl=self.resample_impl)
         return ConvBNAct(self.width, (3, 3), **kw)(merged, train)
 
 
@@ -60,6 +66,7 @@ class AIM(nn.Module):
 
     width: int
     axis_name: Optional[str] = None
+    resample_impl: str = "fast"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -70,10 +77,11 @@ class AIM(nn.Module):
         parts = [ConvBNAct(self.width, (3, 3), **kw)(cur, train)]
         if below is not None:  # finer level → downsample to cur's size
             b = ConvBNAct(self.width, (3, 3), **kw)(below, train)
-            parts.append(resize_to(b, cur.shape[1:3]))
+            parts.append(resize_to(b, cur.shape[1:3],
+                                   impl=self.resample_impl))
         if above is not None:  # coarser level → upsample to cur's size
             a = ConvBNAct(self.width, (3, 3), **kw)(above, train)
-            parts.append(upsample_like(a, cur))
+            parts.append(upsample_like(a, cur, impl=self.resample_impl))
         x = jnp.concatenate(parts, axis=-1)
         return ConvBNAct(self.width, (3, 3), **kw)(x, train)
 
@@ -84,6 +92,9 @@ class MINet(nn.Module):
     width: int = 64
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    # Decoder resample strategy (model.resample_impl):
+    # fast | xla | convt | fused — see layers.resample_merge.
+    resample_impl: str = "fast"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -102,24 +113,27 @@ class MINet(nn.Module):
 
         kw = dict(axis_name=self.axis_name, dtype=self.dtype,
                   param_dtype=self.param_dtype)
+        rkw = dict(resample_impl=self.resample_impl, **kw)
 
         # AIM per level.
         agg = []
         for i, f in enumerate(feats):
             below = feats[i - 1] if i > 0 else None
             above = feats[i + 1] if i < len(feats) - 1 else None
-            agg.append(AIM(self.width, **kw)(below, f, above, train=train))
+            agg.append(AIM(self.width, **rkw)(below, f, above, train=train))
 
         # Top-down decoder with SIM refinement.
         d = agg[-1]
-        d = SIM(self.width, **kw)(d, train=train)
+        d = SIM(self.width, **rkw)(d, train=train)
         for i in range(len(agg) - 2, -1, -1):
-            d = upsample_like(d, agg[i]) + agg[i]
-            d = SIM(self.width, **kw)(d, train=train)
+            d = resample_merge(d, agg[i], mode="add",
+                               impl=self.resample_impl)
+            d = SIM(self.width, **rkw)(d, train=train)
 
         # Head → full-resolution single-channel logit.
         h = ConvBNAct(32, (3, 3), **kw)(d, train=train)
         logit = nn.Conv(1, (3, 3), padding="SAME", dtype=self.dtype,
                         param_dtype=self.param_dtype)(h)
-        logit = resize_to(logit, image.shape[1:3]).astype(jnp.float32)
+        logit = resize_to(logit, image.shape[1:3],
+                          impl=self.resample_impl).astype(jnp.float32)
         return [logit]

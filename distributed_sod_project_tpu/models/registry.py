@@ -49,6 +49,15 @@ def build_model(model_cfg):
         raise ValueError(
             f"model.dlf_impl={model_cfg.dlf_impl!r} only applies to "
             f"hdfnet, not {model_cfg.name!r}")
+    resample_impl = getattr(model_cfg, "resample_impl", "fast")
+    _RESAMPLE_USERS = ("minet", "hdfnet", "gatenet", "u2net")
+    if resample_impl != "fast" and model_cfg.name not in _RESAMPLE_USERS:
+        # Loud instead of a silent no-op (same posture as attn_impl /
+        # dlf_impl above): only the four decoder users of the
+        # upsample+merge idiom route the knob.
+        raise ValueError(
+            f"model.resample_impl={resample_impl!r} only applies to "
+            f"{_RESAMPLE_USERS}, not {model_cfg.name!r}")
     dtype = jnp.dtype(model_cfg.compute_dtype)
     param_dtype = jnp.dtype(model_cfg.param_dtype)
     axis_name = "data" if model_cfg.sync_bn else None
@@ -62,6 +71,7 @@ def _build_minet(cfg, *, dtype, param_dtype, axis_name):
     from .minet import MINet
 
     return MINet(
+        resample_impl=cfg.resample_impl,
         backbone=cfg.backbone,
         backbone_bn=cfg.backbone_bn,
         axis_name=axis_name,
@@ -80,6 +90,7 @@ def _build_u2net(cfg, *, dtype, param_dtype, axis_name):
             f"u2net is self-contained: backbone must be 'none' (full) or "
             f"'small' (U²-Net†), got {cfg.backbone!r}")
     return U2Net(
+        resample_impl=cfg.resample_impl,
         small=cfg.backbone == "small",
         axis_name=axis_name,
         bn_momentum=cfg.bn_momentum,
@@ -117,6 +128,7 @@ def _build_gatenet(cfg, *, dtype, param_dtype, axis_name):
     from .gatenet import GateNet
 
     return GateNet(
+        resample_impl=cfg.resample_impl,
         backbone=cfg.backbone,
         backbone_bn=cfg.backbone_bn,
         axis_name=axis_name,
@@ -148,6 +160,7 @@ def _build_hdfnet(cfg, *, dtype, param_dtype, axis_name):
     from .hdfnet import HDFNet
 
     return HDFNet(
+        resample_impl=cfg.resample_impl,
         backbone=cfg.backbone,
         backbone_bn=cfg.backbone_bn,
         axis_name=axis_name,

@@ -24,7 +24,8 @@ from typing import Any, List, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .layers import ConvBNAct, max_pool, resize_to, upsample_like
+from .layers import (ConvBNAct, max_pool, resample_merge, resize_to,
+                     upsample_like)
 
 
 class RSU(nn.Module):
@@ -35,6 +36,7 @@ class RSU(nn.Module):
     out: int
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    resample_impl: str = "fast"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -56,7 +58,7 @@ class RSU(nn.Module):
                 self.mid if i > 0 else self.out, (3, 3), **kw
             )(jnp.concatenate([d, enc[i]], axis=-1), train)
             if i > 0:
-                d = upsample_like(d, enc[i - 1])
+                d = upsample_like(d, enc[i - 1], impl=self.resample_impl)
         return d + xin
 
 
@@ -94,6 +96,9 @@ class U2Net(nn.Module):
     small: bool = False
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    # Decoder resample strategy (model.resample_impl):
+    # fast | xla | convt | fused — see layers.resample_merge.
+    resample_impl: str = "fast"
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -103,6 +108,8 @@ class U2Net(nn.Module):
         x = image.astype(self.dtype)
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
                   dtype=self.dtype, param_dtype=self.param_dtype)
+        # RSU blocks resample internally; RSU4F is resolution-fixed.
+        rkw = dict(resample_impl=self.resample_impl, **kw)
         if self.small:
             # U²-Net†: every stage 16/64.
             enc_spec = [(7, 16, 64), (6, 16, 64), (5, 16, 64), (4, 16, 64)]
@@ -117,7 +124,7 @@ class U2Net(nn.Module):
         feats = []
         h = x
         for lv, mid, out in enc_spec:
-            h = RSU(lv, mid, out, **kw)(h, train)
+            h = RSU(lv, mid, out, **rkw)(h, train)
             feats.append(h)
             h = max_pool(h)
         h = RSU4F(f_mid, f_out, **kw)(h, train)
@@ -128,11 +135,13 @@ class U2Net(nn.Module):
         # Decoder: RSU4F then the mirrored RSU stack on concat skips.
         sides = [h]  # bottleneck side output source
         d = RSU4F(f_mid, f_out, **kw)(
-            jnp.concatenate([upsample_like(h, feats[4]), feats[4]], axis=-1), train)
+            resample_merge(h, feats[4], mode="concat",
+                           impl=self.resample_impl), train)
         sides.append(d)
         for (lv, mid, out), skip in zip(dec_spec, feats[3::-1]):
-            d = RSU(lv, mid, out, **kw)(
-                jnp.concatenate([upsample_like(d, skip), skip], axis=-1), train)
+            d = RSU(lv, mid, out, **rkw)(
+                resample_merge(d, skip, mode="concat",
+                               impl=self.resample_impl), train)
             sides.append(d)
 
         # Side heads: 3x3 conv → 1ch logit, upsampled to input resolution.
@@ -141,7 +150,8 @@ class U2Net(nn.Module):
         for s in reversed(sides):  # finest (d1) first
             l = nn.Conv(1, (3, 3), padding="SAME", dtype=self.dtype,
                         param_dtype=self.param_dtype)(s)
-            logits.append(resize_to(l, hw).astype(jnp.float32))
+            logits.append(resize_to(l, hw, impl=self.resample_impl)
+                          .astype(jnp.float32))
         # Fused head over all 6 side logits.
         fused = nn.Conv(1, (1, 1), dtype=self.dtype,
                         param_dtype=self.param_dtype)(

@@ -1,6 +1,11 @@
 from .dynamic_filter import fused_dynamic_filter
 from .flash_attention import flash_attention
 from .fused_loss import fused_bce_iou_cel, pixel_region_sums
+from .fused_resample import (
+    fused_resample_available,
+    fused_upsample2,
+    fused_upsample2_merge,
+)
 from .fused_ssim import (
     fused_ssim_available,
     fused_ssim_loss,
@@ -11,8 +16,11 @@ __all__ = [
     "flash_attention",
     "fused_dynamic_filter",
     "fused_bce_iou_cel",
+    "fused_resample_available",
     "fused_ssim_available",
     "fused_ssim_loss",
     "fused_ssim_mean",
+    "fused_upsample2",
+    "fused_upsample2_merge",
     "pixel_region_sums",
 ]

@@ -53,41 +53,15 @@ _MAX_TILE_ELEMS = 2 * 1024 * 1024
 def _compiler_params() -> "_CompilerParams":
     """Per-kernel scoped-VMEM ceiling, gated on the device generation.
 
-    First real-v5e exposure (round 2): at (32,80,80,64)·bf16, XLA's
-    memory-space assignment parked the custom call's full output in
-    VMEM (S(1) layout) and the compile died against the 16 MB scoped
-    limit even though the per-grid-step windows are <2 MB.  v5e has
-    128 MB of VMEM; 100 MB headroom compiles and runs fwd+bwd at batch
-    128.  Earlier generations (v2/v3: ~16 MB/core) would FAIL to
-    compile with a scoped limit past physical VMEM, so the raise only
-    applies where the hardware has it; ``DSOD_DLF_VMEM_MB`` overrides
-    either way (0 = compiler default).
-
-    ADVICE r3: gate on a SMALL-VMEM denylist (v2/v3, ~16 MB/core)
-    rather than a big-VMEM allowlist — the old allowlist omitted v4
-    (128 MB/core, would have re-hit the round-2 compile-failure class)
-    and substring-matched fragile tags ('lite' matched 'TPU v4 lite').
-    Unknown/future generations default to the raised limit; v2/v3 are
-    the only known-small kinds and ``DSOD_DLF_VMEM_MB`` stays the
-    escape hatch for anything else.
+    The round-2 compile-failure history and the ADVICE-r3 v2/v3
+    small-VMEM denylist rule now live in the shared helper
+    (pallas/vmem_budget.py) so every kernel applies the same policy;
+    ``DSOD_DLF_VMEM_MB`` stays this kernel's escape hatch (0 =
+    compiler default).
     """
-    import os
-    import re
+    from .vmem_budget import scoped_vmem_params
 
-    env = os.environ.get("DSOD_DLF_VMEM_MB")
-    if env is not None:
-        mb = int(env)
-        return (_CompilerParams() if mb <= 0
-                else _CompilerParams(vmem_limit_bytes=mb * 1024 * 1024))
-    try:
-        kind = jax.devices()[0].device_kind.lower()
-    except Exception:
-        kind = ""
-    # "tpu v2" / "tpu v3" (word-bounded so e.g. "v23"/"v32" never match).
-    small_vmem = re.search(r"\bv[23]\b", kind) is not None
-    if small_vmem:
-        return _CompilerParams()
-    return _CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+    return scoped_vmem_params("DSOD_DLF_VMEM_MB")
 
 
 def _taps(ksize: int, dilation: int):

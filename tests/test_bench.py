@@ -176,9 +176,11 @@ def test_bench_retry_budget_outlasts_attempt_floor(
         tmp_path, capsys, monkeypatch):
     """Round-2 postmortem: 5 fixed attempts gave up with 15+ unused
     watchdog minutes (BENCH_r02 value=0.0 while the tunnel came back
-    later in the session).  The contract now: keep retrying until
-    --retry-budget seconds elapse (default watchdog-300), and record
-    attempts + elapsed in the error line."""
+    later in the session).  The contract now: keep retrying until the
+    --retry-budget can no longer afford one more worst-case attempt
+    (its full backoff + probe reserve), and record attempts + elapsed
+    in the error line.  Elapsed therefore lands within one worst-case
+    attempt charge of the budget — never past it."""
     import bench
 
     monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
@@ -196,7 +198,8 @@ def test_bench_retry_budget_outlasts_attempt_floor(
     assert len(calls) > 1  # kept going past the attempt floor
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["attempts"] == len(calls)
-    assert out["elapsed_s"] >= 0.3
+    # Budget spent up to (not past) one worst-case backoff charge.
+    assert 0.3 - 0.05 <= out["elapsed_s"] <= 0.3 + 0.1
 
 
 def test_bench_retry_budget_is_a_hard_ceiling(
@@ -243,6 +246,54 @@ def test_bench_retry_budget_is_a_hard_ceiling(
     assert out["elapsed_s"] <= 1.0
     # No probe may START with less than its own timeout left.
     assert all(t - t0 <= 1.0 - 0.2 + 0.05 for t in probes)
+
+
+def test_bench_admission_charges_probe_plus_sleep_r03(
+        tmp_path, capsys, monkeypatch):
+    """BENCH_r03 replay, scaled: every dial probe a full wedge against
+    a budget that doesn't divide evenly by the per-attempt cost — the
+    recorded run (probe 120 s + sleep 30 s vs budget 1500 s) admitted
+    an 11th attempt with ~30 s of budget left and overran to 1620 s.
+    The round-5 admission gate charges each attempt its worst-case
+    probe timeout PLUS its retry sleep before admitting, so the replay
+    must (a) stay within budget, (b) start every probe early enough
+    that its worst case still finishes inside the budget, and (c) stop
+    one attempt short of where the r03-era gate would have overrun.
+    Scaled shape: probe timeout 0.2 + sleep 0.05 vs budget 1.5 — the
+    old elapsed<budget gate admits an 8th attempt at ~1.4 s elapsed
+    and overruns to ~1.6 s; the charged gate must stop at 7."""
+    import bench
+
+    monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
+    t0 = time.monotonic()
+    probes = []
+
+    def wedged_probe(timeout):
+        probes.append(time.monotonic() - t0)
+        # A real wedge burns the full timeout before the subprocess is
+        # killed; sleep slightly under it so scheduler noise on a
+        # loaded CI box cannot push a legitimately-admitted attempt
+        # past the budget.
+        time.sleep(timeout - 0.05)
+        return f"dial probe wedged (>{timeout:.0f}s, no response)"
+
+    monkeypatch.setattr(bench, "_probe_backend", wedged_probe)
+    rc = bench.main(["--device", "tpu", "--init-retries", "5",
+                     "--init-backoff", "0.05", "--probe-timeout", "0.2",
+                     "--retry-budget", "1.5"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 0.0 and "wedged" in out["error"]
+    # (a) the hard ceiling BENCH_r03 violated (1620 > 1500, scaled).
+    assert out["elapsed_s"] <= 1.5
+    # (b) every admitted probe could finish its worst case in budget
+    # (small tolerance: the probe start is recorded after the loop's
+    # own bookkeeping, a few ms past the admission check).
+    assert all(t + 0.2 <= 1.5 + 0.02 for t in probes)
+    # (c) the charged admission stops one short of the old gate's
+    # overrunning attempt (noise only makes attempts FEWER: sleeps
+    # never undershoot).  The floor still ran in full.
+    assert 5 <= out["attempts"] == len(probes) <= 7
 
 
 def test_bench_does_not_retry_unrelated_errors(tmp_path, monkeypatch, capsys):

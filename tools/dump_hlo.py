@@ -28,8 +28,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def dump(config_name: str, out_dir: str, n_devices: int = 8,
-         batch_per_device: int = 1, image_size: int = 64) -> dict:
-    """Lower the config's train step; returns {'stablehlo': path, ...}."""
+         batch_per_device: int = 1, image_size: int = 64,
+         compile_cost: bool = True, overrides=()) -> dict:
+    """Lower the config's train step; returns {'stablehlo': path, ...}.
+
+    ``compile_cost=False`` skips the (slow) compile that only feeds the
+    cost-analysis sidecar — tools/hlo_guard.py lowers the step several
+    times per run and needs just the StableHLO text.  ``overrides`` are
+    extra ``section.field=value`` config overrides applied on top of
+    the standard virtual-mesh shrink — e.g. pin an execution-strategy
+    arm (``model.resample_impl=convt``) to dump/diff arm-specific
+    programs.  (The ``fast`` resample arm cannot be pinned this way:
+    it is the env-subsumed default, so hlo_guard pins its arms via the
+    env vars instead.)
+    """
     os.environ.setdefault(
         "XLA_FLAGS",
         f"--xla_force_host_platform_device_count={n_devices}")
@@ -54,7 +66,7 @@ def dump(config_name: str, out_dir: str, n_devices: int = 8,
         f"global_batch_size={batch_per_device * n_devices}",
         f"data.image_size={image_size},{image_size}",
         "mesh.data=-1", "mesh.model=1", "mesh.seq=1",
-    ])
+    ] + list(overrides))
     mesh = make_mesh(cfg.mesh, jax.devices()[:n_devices])
     model = build_model(cfg.model)
     tx, sched = build_optimizer(cfg.optim, 100)
@@ -82,6 +94,8 @@ def dump(config_name: str, out_dir: str, n_devices: int = 8,
         f.write(lowered.as_text())
     paths["stablehlo"] = shlo
 
+    if not compile_cost:
+        return paths
     try:
         compiled = lowered.compile()
         cost = compiled.cost_analysis() or {}

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""HLO relayout guard — catch data-formatting regressions at t1 time.
+
+The round-2 v5e trace put ~10% of the flagship step in data-formatting
+relayout copies, and the round-4 roofline named the upsample
+interleave's ``stack+reshape`` form as the biggest single source
+(~1.25 ms dim-shuffled ``bf16[64,160,64,160]`` copies per call).  The
+layout-stable interleave (models/layers.py::_upsample_axis, round 5)
+removes the size-1-axis insertions that force those copies — but
+nothing stops a future change from quietly re-introducing them, and a
+TPU window is needed to SEE them in a trace.
+
+This tool makes the regression visible on CPU, per PR: it lowers the
+flagship train step (reusing tools/dump_hlo.py, lowering only — no
+compile) and counts the data-formatting ops in the pre-optimization
+StableHLO — ``reshape``, ``transpose`` and ``broadcast_in_dim`` — for
+two arms of the interleave:
+
+- ``fast``        — the layout-stable concat-in-next-axis form
+                    (the default path);
+- ``fast_stack``  — the historical stack+reshape form
+                    (``DSOD_RESIZE_INTERLEAVE=stack``).
+
+Pre-optimization StableHLO is stable across machines (the same reason
+dump_hlo.py diffs it), so the counts are checked into
+``tools/hlo_copy_baseline.json`` and every run prints a ONE-LINE JSON
+delta against that baseline — recorded, non-gating in tools/t1.sh
+(pass ``--fail-on-increase`` to gate locally).  Invariants the tool
+itself asserts (exit 1):
+
+- the layout-stable arm counts strictly FEWER formatting ops than the
+  stack arm (the guard's reason to exist);
+
+Counting in pre-opt StableHLO is deliberate: the TPU relayout copies
+appear only after XLA:TPU's layout assignment, which CPU cannot run —
+but every one of them is *caused by* a reshape/transpose pattern that
+is already visible (and countable) before optimization.  Fewer
+formatting ops in ≈ fewer relayout copies out; the exact ms stays a
+TPU-window measurement (tools/tpu_agenda_r5.sh leg ``ilv_stack``).
+
+Usage:
+    python tools/hlo_guard.py                      # print delta line
+    python tools/hlo_guard.py --update-baseline    # re-seed the file
+    python tools/hlo_guard.py --fail-on-increase   # gate (local use)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "hlo_copy_baseline.json")
+
+# What counts as a data-formatting op in pre-opt StableHLO.  reshape +
+# transpose are the relayout-copy feeders; broadcast_in_dim is counted
+# too because jnp.stack may lower its size-1-axis insertion either way.
+_FORMATTING = ("reshape", "transpose", "broadcast_in_dim")
+
+# The two interleave arms of the SAME default resample path.  Each arm
+# pins EVERY resample-affecting env var (None = must be unset): the
+# agenda scripts export DSOD_RESIZE_INTERLEAVE / DSOD_RESIZE_IMPL for
+# their own A/B legs, and an inherited value would silently lower the
+# same arm twice and trip the fast<stack invariant with a false alarm.
+ARMS = {
+    "fast": {"DSOD_RESIZE_INTERLEAVE": None, "DSOD_RESIZE_IMPL": None},
+    "fast_stack": {"DSOD_RESIZE_INTERLEAVE": "stack",
+                   "DSOD_RESIZE_IMPL": None},
+}
+
+
+def count_formatting_ops(stablehlo_text: str) -> dict:
+    """Count stablehlo data-formatting ops by kind (+ 'total')."""
+    counts = {}
+    for kind in _FORMATTING:
+        counts[kind] = len(
+            re.findall(rf"stablehlo\.{kind}\b", stablehlo_text))
+    counts["total"] = sum(counts.values())
+    return counts
+
+
+def dump_arm_counts(config: str, out_dir: str, n_devices: int,
+                    image_size: int) -> dict:
+    """Lower the config's train step once per arm; return
+    {arm: counts}."""
+    from dump_hlo import dump  # tools/ sibling (path set above)
+
+    results = {}
+    for arm, env in ARMS.items():
+        saved = {k: os.environ.get(k) for k in env}
+        for k, v in env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            # NOTE: the env pinning above is the ONLY effective guard
+            # for the 'fast' arm — 'fast' is the env-subsumed default,
+            # so a config override `model.resample_impl=fast` cannot
+            # out-pin an exported DSOD_RESIZE_IMPL (by design:
+            # layers._resolve_resample_impl).  Do not trim ARMS on the
+            # strength of a config override.
+            paths = dump(config, os.path.join(out_dir, arm),
+                         n_devices=n_devices, image_size=image_size,
+                         compile_cost=False)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        with open(paths["stablehlo"]) as f:
+            results[arm] = count_formatting_ops(f.read())
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="minet_r50_dp",
+                   help="flagship by default — the config the roofline "
+                        "levers were derived on")
+    p.add_argument("--image-size", type=int, default=64,
+                   help="small-but-even lowering size: every decoder "
+                        "resample stays an exact factor-2, so the "
+                        "interleave op pattern matches 320px")
+    p.add_argument("--devices", type=int, default=2,
+                   help="virtual CPU mesh size (lowering only; 2 keeps "
+                        "the guard fast while exercising the sharded "
+                        "step)")
+    p.add_argument("--out", default=None,
+                   help="dump dir (default: a temp dir)")
+    p.add_argument("--baseline", default=_BASELINE)
+    p.add_argument("--update-baseline", action="store_true")
+    p.add_argument("--fail-on-increase", action="store_true",
+                   help="exit 2 when any arm's total exceeds the "
+                        "baseline (off in shared CI: recorded, not "
+                        "gating — the t1.sh posture)")
+    args = p.parse_args(argv)
+
+    tmp = None
+    out_dir = args.out
+    if out_dir is None:
+        import tempfile
+
+        # Cleaned up on exit: each arm's flagship StableHLO dump is
+        # multi-MB and t1.sh runs this on every pass.
+        tmp = tempfile.TemporaryDirectory(prefix="hlo_guard_")
+        out_dir = tmp.name
+    try:
+        arm_counts = dump_arm_counts(args.config, out_dir, args.devices,
+                                     args.image_size)
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+    rc = 0
+    fast, stack = arm_counts["fast"], arm_counts["fast_stack"]
+    if fast["total"] >= stack["total"]:
+        # The guard's core invariant: the layout-stable interleave must
+        # emit strictly fewer formatting ops than the stack form.
+        print(f"hlo_guard: layout-stable arm NOT fewer formatting ops "
+              f"({fast['total']} vs {stack['total']})", file=sys.stderr)
+        rc = 1
+
+    baseline = None
+    if os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    key = f"{args.config}@{args.image_size}px"
+    if rc != 0:
+        # Never persist counts from a run whose own invariant failed —
+        # a corrupt seed would make every later comparison report
+        # delta 0 against garbage, permanently masking the regression.
+        print(f"hlo_guard: invariant failed — NOT seeding/updating "
+              f"baseline for {key}", file=sys.stderr)
+        print(json.dumps({
+            "metric": f"hlo_formatting_ops[{key}]",
+            "arms": {arm: c["total"] for arm, c in arm_counts.items()},
+            "invariant_failed": True,
+        }), flush=True)
+        return rc
+    if args.update_baseline or baseline is None or key not in baseline:
+        baseline = baseline or {}
+        baseline[key] = arm_counts
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        recorded = True
+        delta = {arm: 0 for arm in arm_counts}
+    else:
+        recorded = False
+        delta = {arm: arm_counts[arm]["total"]
+                 - baseline[key].get(arm, {}).get("total", 0)
+                 for arm in arm_counts}
+        if args.fail_on_increase and any(d > 0 for d in delta.values()):
+            rc = rc or 2
+
+    # The one-line JSON delta window reports track per PR.
+    print(json.dumps({
+        "metric": f"hlo_formatting_ops[{key}]",
+        "arms": {arm: c["total"] for arm, c in arm_counts.items()},
+        "detail": arm_counts,
+        "delta_vs_baseline": delta,
+        "stack_minus_fast": stack["total"] - fast["total"],
+        **({"recorded": True} if recorded else {}),
+    }), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -105,11 +105,47 @@ def eltwise(name, b, h, c, reads=1, writes=1, res=None) -> Op:
               0.0, A * n * (reads + writes))
 
 
+# Decoder upsample/merge sites of the flagship (the fused-resample
+# kernel's targets).  Populated by minet_r50_ledger as a side list so
+# the per-arm ledger (fmt_fused_ledger) and the predictions price the
+# SAME sites.  Each fused site replaces "read the fine map, write the
+# fine map" with "read the COARSE map (a quarter of the bytes), write
+# the fine map" — the merge operand reads are unchanged — so every
+# site saves 0.75 * n_fine * A bytes of HBM traffic, fwd and bwd (the
+# transposed-resample backward reads fine / writes coarse the same
+# way).
+
+
+def _up_site(ops, sites, name, b, res, c, reads=1, fused=False):
+    """An upsample(+merge) decoder site: ``reads`` counts the fine-res
+    operand reads on the XLA path (1 = bare upsample, 2 = upsample +
+    add/concat merge).  ``fused=True`` prices the Pallas fused arm."""
+    n = b * res * res * c
+    plain = eltwise(name, b, res, c, reads=reads)
+    if not fused:
+        op = plain
+    else:
+        bytes_ = plain.bytes - 0.75 * A * n  # coarse read, fine write
+        op = Op(name, res, 0.0, bytes_, 0.0, bytes_)
+    ops.append(op)
+    sites.append((name, res, plain.bytes - op.bytes))
+    return op
+
+
 def minet_r50_ledger(b: int, hw: int = 320, s2d: bool = False,
-                     resize: str = "fast") -> list:
+                     resize: str = "fast",
+                     fused_sites: list | None = None) -> list:
     """Every op in one MINet-R50 train step (fwd reference: the module
-    graph in models/minet.py + models/backbones/resnet.py)."""
+    graph in models/minet.py + models/backbones/resnet.py).
+
+    ``resize``: 'fast'/'xla' as before; 'fused' prices the decoder
+    upsample+merge sites as the Pallas fused-resample kernel
+    (model.resample_impl=fused) — ``fused_sites`` (when passed a list)
+    collects (site, res, bytes saved/step) for the per-arm ledger.
+    """
     ops: list[Op] = []
+    sites = fused_sites if fused_sites is not None else []
+    fused = resize == "fused"
     r = hw // 2  # 160 for 320
 
     # ---- backbone stem ----------------------------------------------
@@ -158,7 +194,7 @@ def minet_r50_ledger(b: int, hw: int = 320, s2d: bool = False,
         if i < 4:
             ra, ca = feats[i + 1]
             ops.append(conv(f"aim{i}.above", b, ra, ca, 64))
-            ops.append(eltwise(f"aim{i}.up", b, res_, 64))
+            _up_site(ops, sites, f"aim{i}.up", b, res_, 64, fused=fused)
         ops.append(conv(f"aim{i}.merge", b, res_, 64 * n_parts, 64))
 
     # ---- SIM decoder (one per level, coarsest first) ----------------
@@ -168,20 +204,24 @@ def minet_r50_ledger(b: int, hw: int = 320, s2d: bool = False,
         ops.append(conv(f"{p}.l0", b, res_, 64, 32))
         ops.append(eltwise(f"{p}.lpool", b, res_ // 2, 32))
         ops.append(conv(f"{p}.l2h", b, res_ // 2, 32, 64))
-        ops.append(eltwise(f"{p}.hup", b, res_, 64))
+        _up_site(ops, sites, f"{p}.hup", b, res_, 64, fused=fused)
         ops.append(conv(f"{p}.h2", b, res_, 64, 64))
         ops.append(conv(f"{p}.h2l", b, res_, 64, 32))
         ops.append(conv(f"{p}.l2", b, res_ // 2, 32, 32))
         ops.append(conv(f"{p}.merge", b, res_, 96, 64))
         if i < 4:  # decoder hop up to the next (finer) level
-            ops.append(eltwise(f"{p}.declift", b, res_ * 2, 64, reads=2))
+            _up_site(ops, sites, f"{p}.declift", b, res_ * 2, 64,
+                     reads=2, fused=fused)
 
     # ---- head + full-res logit --------------------------------------
     ops.append(conv("head.c1", b, hw // 2, 64, 32))
     ops.append(conv("head.logit", b, hw // 2, 32, 1, bn=False))
-    k_resize = 3.0 if resize == "xla" else 1.0  # dot_general + 2 relayouts
-    ops.append(eltwise("head.resize", b, hw, 1,
-                       reads=k_resize, writes=k_resize))
+    if fused:  # the head's 2x logit upsample rides the kernel too
+        _up_site(ops, sites, "head.resize", b, hw, 1, fused=True)
+    else:
+        k_resize = 3.0 if resize == "xla" else 1.0  # dot_general + 2 relayouts
+        ops.append(eltwise("head.resize", b, hw, 1,
+                           reads=k_resize, writes=k_resize))
 
     # ---- loss @ full res (BCE+IoU+SSIM+CEL, f32) --------------------
     n = b * hw * hw
@@ -267,6 +307,41 @@ def fmt_pred(b, remat=False, s2d=False, resize="fast",
         label = "dots-saved" if remat else "no-remat live"
         out.append(f"{label} activations (upper bound): "
                    f"~{cap:.1f} GB vs 16 GB v5e HBM")
+    return "\n".join(out)
+
+
+def fmt_fused_ledger(b: int, hw: int = 320) -> str:
+    """Per-site HBM ledger for the fused-resample arm
+    (``model.resample_impl=fused``): what each decoder upsample/merge
+    stage saves per step vs the fast XLA path, and the falsifiable
+    total the tools/tpu_agenda_r5.sh A/B legs are queued against.
+
+    Conservative by construction: only sites the base ledger already
+    prices are counted (SIM's concat-merge upsample is idealized away
+    there and so claims no savings here), and the relayout copies the
+    layout-stable interleave removes (tools/hlo_guard.py) are NOT
+    priced — both make the prediction a lower bound.
+    """
+    sites: list = []
+    minet_r50_ledger(b, hw=hw, resize="fused", fused_sites=sites)
+    out = [f"## fused-resample ledger  b{b}@{hw}px  "
+           f"(model.resample_impl=fused vs fast)",
+           "| site | res | HBM bytes saved/step | ms saved (fwd+bwd) |",
+           "|---|---|---|---|"]
+    tot = 0.0
+    for name, res, saved in sites:
+        tot += saved
+        out.append(f"| {name} | {res} | {saved / 1e6:.2f} MB | "
+                   f"{2 * saved / HBM_BW * 1e3:.3f} |")
+    out.append(f"| **total** | | **{tot / 1e6:.2f} MB** | "
+               f"**{2 * tot / HBM_BW * 1e3:.3f}** |")
+    _, _, _, t_fast = predict(b, hw=hw, resize="fast")
+    _, _, _, t_fused = predict(b, hw=hw, resize="fused")
+    out.append(f"prediction: step roofline {t_fast * 1e3:.2f} -> "
+               f"{t_fused * 1e3:.2f} ms "
+               f"({(1 - t_fused / t_fast):.1%} of the ideal step) — "
+               f"the A/B leg must beat noise on THIS number to flip "
+               f"any default")
     return "\n".join(out)
 
 
@@ -421,7 +496,12 @@ def main(argv=None) -> int:
                         "'dots' keeps conv outputs (capacity cost) "
                         "and recomputes only elementwise")
     p.add_argument("--s2d", action="store_true")
-    p.add_argument("--resize", choices=["fast", "xla"], default="fast")
+    p.add_argument("--resize", choices=["fast", "xla", "fused"],
+                   default="fast",
+                   help="price the resample arm: fast (slice/lerp), "
+                        "xla (generic jax.image.resize), fused (the "
+                        "Pallas resample-merge kernel; also prints the "
+                        "per-site bytes-saved ledger)")
     p.add_argument("--trace", help="profile dir to reconcile against")
     p.add_argument("--xla-check", action="store_true")
     args = p.parse_args(argv)
@@ -436,6 +516,9 @@ def main(argv=None) -> int:
                        resize=args.resize,
                        remat_policy=args.remat_policy))
         print()
+        if args.resize == "fused":
+            print(fmt_fused_ledger(b))
+            print()
     if args.trace:
         print(f"## measured ({args.trace})")
         print(measured_table(args.trace))

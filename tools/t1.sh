@@ -9,7 +9,19 @@
 # checked-in tools/data_baseline.json — recorded, never a hard gate
 # here (shared CI boxes are noisy-neighbor machines; see
 # docs/PERFORMANCE.md "Host data plane").
+# Two more recorded, non-gating smokes ride along (same posture):
+# the HLO relayout guard (tools/hlo_guard.py vs the checked-in
+# tools/hlo_copy_baseline.json — prints a one-line JSON delta of
+# data-formatting op counts per interleave arm) and the roofline
+# ledger's --xla-check self-test (hand-math vs XLA's cost model on the
+# real jitted step; drift past ±25% exits non-zero and is echoed).
 cd "$(dirname "$0")/.." || exit 1
 echo "== host data-plane smoke (recorded, non-gating) =="
 bash tools/bench_data.sh || echo "bench_data smoke failed (non-gating)"
+echo "== HLO relayout guard (recorded, non-gating) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/hlo_guard.py \
+  || echo "hlo_guard smoke failed (non-gating)"
+echo "== roofline --xla-check (recorded, non-gating) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/roofline.py --xla-check \
+  || echo "roofline xla-check smoke failed (non-gating)"
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,136 @@
+#!/bin/bash
+# Round-5 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh fires this automatically).  Round 5 landed the
+# fine-resolution decoder work (docs/PERFORMANCE.md ranked levers #1
+# and #2): the Pallas fused resample-merge kernel behind
+# model.resample_impl=fused, the layout-stable upsample interleave
+# (default; DSOD_RESIZE_INTERLEAVE=stack is the old arm), and the
+# per-site roofline ledger (tools/roofline.py --resize fused) every
+# fused leg here is queued against.  Pre-committed rule: the fused arm
+# becomes a default ONLY if its A/B beats the fast arm beyond noise at
+# the canonical operating point; the interleave default already
+# flipped (bit-identical, strictly fewer formatting ops per
+# tools/hlo_guard.py) and the stack leg here quantifies the win.
+#
+# Ordered by value-per-minute; every leg is a bounded subprocess whose
+# JSON lands in $R/results.jsonl the moment it finishes.  Any r4 legs
+# still lacking numbers (tools/tpu_agenda_r4.sh) can be re-fired after
+# this agenda drains — this one carries ONLY the round-5 questions:
+#
+#   1. canonical b128 headline refresh (the comparison anchor)
+#   2. fused resample A/B  — flagship b128/b64(+remat)/b32, the
+#      roofline ledger's falsifiable total (~1.6 ms ideal at b64,
+#      more if the 160/80 conv-fusion pressure drops as lever #1
+#      predicts)
+#   3. interleave A/B      — layout-stable (default) vs stack form:
+#      isolates the relayout-copy win (~10-27 ms/step predicted from
+#      the round-2 trace's data-formatting bucket)
+#   4. convt cross-check   — the r4 third arm under the NEW knob
+#      (model.resample_impl=convt), so all three arms share one key
+#      scheme
+#   5. zoo fused legs      — u2net / gatenet / hdfnet decoder users
+#   6. profile of the best fused arm for the roofline reconciliation
+cd "$(dirname "$0")/.." || exit 1
+R=${R:-tpu_results5}
+mkdir -p "$R"
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+done_ok() {
+  [ -f "$R"/results.jsonl ] || return 1
+  local rec
+  rec=$(grep "\"step\": \"$1\", \"rc\": 0" "$R"/results.jsonl | tail -1)
+  [ -n "$rec" ] || return 1
+  ! printf '%s' "$rec" | grep -q '"error"'
+}
+
+# Circuit breaker (r4 pattern): after any failed leg, verify the
+# tunnel still runs REAL compute; abort the firing if not (the
+# watcher re-fires in the next window and done_ok() skips landed legs).
+tunnel_computes() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('computes')" 2>/dev/null | grep -q computes
+}
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  if done_ok "$name"; then
+    echo "[$name] skip: succeeded in a previous window" | tee -a "$R"/agenda.log
+    return 0
+  fi
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+  if { [ "$rc" -ne 0 ] || printf '%s' "$line" | grep -Eq 'wedged|unavailable'; } \
+      && ! tunnel_computes; then
+    echo "[$name] tunnel no longer computes — aborting firing (watcher will re-fire)" \
+      | tee -a "$R"/agenda.log
+    exit 2
+  fi
+}
+
+# -- 1. canonical headline refresh (this round's comparison anchor;
+#       fresh key, self-reported mfu).  NOTE: the layout-stable
+#       interleave is now the default, so this number already contains
+#       lever #2 — leg 3 isolates it.
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2. fused resample-merge A/B (model.resample_impl is a --set
+#       override, so bench keys the arms apart automatically).  The
+#       ledger prediction to beat is printed by
+#       `python tools/roofline.py --batch <b> --resize fused`.
+run rsmpl_fused_b128  900 $BENCH --config minet_r50_dp --set model.resample_impl=fused
+run rsmpl_fused_b64r  900 $BENCH --config minet_r50_dp --batch-per-chip 64 \
+    --set model.resample_impl=fused --set model.remat=true
+run rsmpl_fused_b32   900 $BENCH --config minet_r50_dp --batch-per-chip 32 \
+    --set model.resample_impl=fused
+# fast-arm twins for the non-canonical operating points (b128 fast is
+# the headline leg above)
+run rsmpl_fast_b64r   900 $BENCH --config minet_r50_dp --batch-per-chip 64 \
+    --set model.remat=true
+run rsmpl_fast_b32    900 $BENCH --config minet_r50_dp --batch-per-chip 32
+
+# -- 3. interleave A/B: the stack+reshape arm (env-tagged key via
+#       DSOD_RESIZE_INTERLEAVE in bench's _PROGRAM_ENV_VARS).  The
+#       delta vs headline_b128 is lever #2 in milliseconds.
+export DSOD_RESIZE_INTERLEAVE=stack
+run ilv_stack_b128 900 $BENCH --config minet_r50_dp
+run ilv_stack_b64r 900 $BENCH --config minet_r50_dp --batch-per-chip 64 \
+    --set model.remat=true
+unset DSOD_RESIZE_INTERLEAVE
+
+# -- 4. convt cross-check under the knob (replaces the r4 env-arm
+#       spelling; numerics-identical, key differs only in the --set)
+run rsmpl_convt_b128 900 $BENCH --config minet_r50_dp --set model.resample_impl=convt
+
+# -- 5. zoo decoder users: fused vs default at their standard batches.
+run u2net_fused    900 $BENCH --config u2net_ds   --set model.resample_impl=fused
+run u2net_fast     900 $BENCH --config u2net_ds
+run gatenet_fused  900 $BENCH --config gatenet_vgg16 --set model.resample_impl=fused
+run gatenet_fast   900 $BENCH --config gatenet_vgg16
+run hdfnet_fused   900 $BENCH --config hdfnet_rgbd --set model.resample_impl=fused
+run hdfnet_fast    900 $BENCH --config hdfnet_rgbd
+
+# -- 6. profile the fused flagship for the roofline reconciliation
+#       (did the 160/80 buckets move toward streaming bandwidth?)
+run prof_fused_b128 900 $BENCH --config minet_r50_dp \
+    --set model.resample_impl=fused --profile-dir "$R"/trace_fused_b128
+
+# Host-side analysis (no tunnel needed): trace buckets + the
+# prediction-vs-measured table for docs/PERFORMANCE.md.
+run an_fused  600 python tools/analyze_trace.py "$R"/trace_fused_b128 --top 25
+run rl_fused  600 python tools/roofline.py --batch 128 --resize fused \
+    --trace "$R"/trace_fused_b128
+
+# Host-side window report (touches no TPU).
+timeout 120 python tools/window_report.py "$R"/results.jsonl \
+    > "$R"/window_report.md 2> "$R"/window_report.err || true
+tail -20 "$R"/window_report.md | tee -a "$R"/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
