@@ -86,6 +86,15 @@ def main(argv=None):
                    help="dotted config override, e.g. --set "
                         "loss.fused_kernel=true --set model.remat=true "
                         "(bench always times the shard_map DP step)")
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="device-side step chunking sweep arm (train "
+                        "mode only): fold k train steps into one "
+                        "lax.scan dispatch (train.steps_per_dispatch); "
+                        "--steps then counts DISPATCHES, each k steps "
+                        "on a k-stacked resident batch.  Folded into "
+                        "the vs_baseline key as a --set override, so "
+                        "A/B legs never contaminate the canonical "
+                        "k=1 baselines")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of the timed window")
     p.add_argument("--baseline-file", default=None,
@@ -145,6 +154,16 @@ def main(argv=None):
                                                    DEFAULT_BATCH)
     if args.batch_per_chip < 1:
         p.error("--batch-per-chip must be >= 1")
+    if args.steps_per_dispatch < 1:
+        p.error("--steps-per-dispatch must be >= 1")
+    if args.steps_per_dispatch > 1:
+        if args.mode != "train":
+            p.error("--steps-per-dispatch only applies to --mode train")
+        # Route through the config override machinery so the compiled
+        # program AND the vs_baseline key both carry the knob (the
+        # same contamination-proofing --set and _PROGRAM_ENV_VARS get).
+        args.overrides = list(args.overrides) + [
+            f"steps_per_dispatch={args.steps_per_dispatch}"]
     global _REPORT_CLAIMED  # in-process callers may run main() repeatedly
     _REPORT_CLAIMED = False
 
@@ -358,6 +377,7 @@ def _run(args):
         cfg = apply_overrides(
             cfg, [f"global_batch_size={batch}",
                   f"data.image_size={hw},{hw}"] + list(args.overrides))
+        _reject_non_train_chunking(args, cfg)
         dt = _bench_data(cfg, batch, args.steps, args.warmup,
                          overrides=args.overrides)
         return _report(args, batch * args.steps / dt, "cpu", 1,
@@ -385,6 +405,7 @@ def _run(args):
     cfg = get_config(args.config)
     cfg = apply_overrides(cfg, [f"global_batch_size={batch}"]
                           + list(args.overrides))
+    _reject_non_train_chunking(args, cfg)
 
     mesh = make_mesh(cfg.mesh)
     model = build_model(cfg.model)
@@ -445,9 +466,32 @@ def _run(args):
         def sync(a):
             return float(a.mae_sum + a.f_curve_sum.sum())
     else:
+        # From the RESOLVED config, not the flag: --set
+        # steps_per_dispatch=k (or a config default) must count images
+        # and skip the cost model exactly like --steps-per-dispatch k.
+        k_spd = cfg.steps_per_dispatch
         step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
                                remat=cfg.model.remat,
-                               remat_policy=cfg.model.remat_policy)
+                               remat_policy=cfg.model.remat_policy,
+                               steps_per_dispatch=k_spd)
+        if k_spd > 1:
+            # One resident k-stacked batch; each timed "step" below is
+            # one dispatch = k train steps (the A/B isolates dispatch
+            # overhead: device work per image is identical).  The spec
+            # comes from the builders' single source of truth so the
+            # bench can never place chunks differently than fit does.
+            from jax.sharding import NamedSharding
+
+            from distributed_sod_project_tpu.parallel.mesh import (
+                batch_spec)
+            from distributed_sod_project_tpu.train.step import (
+                chunk_batch_spec)
+
+            chunk_host = {key: np.stack([v] * k_spd)
+                          for key, v in host_batch.items()}
+            dev_batch = jax.device_put(
+                chunk_host,
+                NamedSharding(mesh, chunk_batch_spec(batch_spec())))
         carry = [state]
 
         def run_step():
@@ -455,7 +499,9 @@ def _run(args):
             return metrics["total"]
 
         def sync(total):
-            return float(total)
+            # Chunked: (k,) per-step losses — reduce so the fetch
+            # depends on every step; scalar at k=1 as before.
+            return float(np.asarray(jax.device_get(total)).sum())
 
     for _ in range(args.warmup):  # compile + stabilise
         token = run_step()
@@ -481,9 +527,14 @@ def _run(args):
         # (post-timing, but slow on device backends) second compile.
         extra = _cost_fields(eval_and_update, dt / args.steps,
                              acc[0], state, dev_batch)
+        k_spd = 1
+    elif k_spd > 1:
+        # XLA's cost model is ambiguous about while-loop trip counts —
+        # a mislabeled per-step GFLOPs/MFU is worse than none.
+        extra = {"steps_per_dispatch": k_spd}
     else:
         extra = _cost_fields(step, dt / args.steps, state, dev_batch)
-    return _report(args, batch * args.steps / dt,
+    return _report(args, batch * args.steps * k_spd / dt,
                    jax.devices()[0].platform, n_chips, **extra)
 
 
@@ -525,6 +576,20 @@ def _cost_fields(jitted, dt_step: float, *call_args) -> dict:
             out["mfu"] = round(flops / dt_step / peak, 4)
             break
     return out
+
+
+def _reject_non_train_chunking(args, cfg) -> None:
+    """Mirror of the --steps-per-dispatch flag guard for the --set
+    spelling: a non-train mode never builds the chunked program, so a
+    steps_per_dispatch override there would record an "A/B leg" under
+    a distinct baseline key that measured the ordinary program —
+    exactly the key contamination the tagging exists to prevent."""
+    if args.mode != "train" and cfg.steps_per_dispatch > 1:
+        raise SystemExit(
+            f"--set steps_per_dispatch={cfg.steps_per_dispatch} only "
+            f"applies to --mode train (mode {args.mode!r} runs the "
+            "ordinary program; the override would tag a baseline key "
+            "without changing what was measured)")
 
 
 def _bench_data(cfg, batch: int, steps: int, warmup: int,

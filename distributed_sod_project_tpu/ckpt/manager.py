@@ -77,6 +77,38 @@ class CheckpointManager:
              force: bool = False) -> bool:
         """Queue an async save of ``state`` at ``step``; returns whether a
         save was actually started (save_interval/keep policy may skip)."""
+        import jax
+
+        will_save = force
+        if not will_save:
+            try:
+                will_save = bool(self._mgr.should_save(int(step)))
+            except Exception:  # noqa: BLE001 — older orbax: assume yes
+                will_save = True
+        if will_save and jax.default_backend() == "cpu":
+            # CPU backend: device arrays ALIAS host memory, so orbax's
+            # async write can read buffers the next (donated) train
+            # step has already updated in place — a torn checkpoint
+            # whose step dir lies about its contents (observed: a
+            # step-2 dir holding step-3 state).  Snapshot first; real
+            # accelerators do a genuine D2H copy inside save(), so they
+            # keep the zero-copy async path.  Fully-addressable leaves
+            # snapshot to host numpy; multi-process global arrays
+            # (spanning hosts) take an on-device copy instead — a fresh
+            # buffer nothing ever donates, same-sharding, and every
+            # process reaches save() together so the collective copy is
+            # well-formed.
+            import jax.numpy as _jnp
+            import numpy as _np
+
+            def _snap(x):
+                if not hasattr(x, "dtype"):
+                    return x
+                if getattr(x, "is_fully_addressable", True):
+                    return _np.array(x)
+                return _jnp.copy(x)
+
+            state = jax.tree_util.tree_map(_snap, state)
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         started = self._mgr.save(
             int(step),

@@ -10,6 +10,7 @@ from .base import (
     get_config,
     list_configs,
     register_config,
+    validate_steps_per_dispatch,
 )
 from . import experiments  # noqa: F401  (populates the registry)
 
@@ -25,4 +26,5 @@ __all__ = [
     "get_config",
     "list_configs",
     "register_config",
+    "validate_steps_per_dispatch",
 ]

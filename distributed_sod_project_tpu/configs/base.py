@@ -208,6 +208,16 @@ class ExperimentConfig:
     num_epochs: int = 50
     steps_per_epoch: Optional[int] = None  # None → derived from dataset size
     seed: int = 0
+    # Device-side step chunking (docs/PERFORMANCE.md): fold this many
+    # train steps into ONE compiled dispatch (a lax.scan over stacked
+    # batches inside the step program).  Amortises the per-step host
+    # tax — Python loop, dispatch latency, fault-plan checks, metric
+    # readback — over k steps; the loop then observes the run only at
+    # chunk boundaries, so every cadence knob (log/eval/checkpoint/
+    # stop-polling) must be divisible by k (validate_steps_per_dispatch
+    # raises otherwise).  1 = the historical per-step path, unchanged.
+    # DSOD_FAULTS forces 1 (per-step poison/stall/SIGTERM semantics).
+    steps_per_dispatch: int = 1
     log_every_steps: int = 20
     checkpoint_every_steps: int = 500
     checkpoint_dir: str = "checkpoints"
@@ -229,6 +239,43 @@ class ExperimentConfig:
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)
+
+
+def validate_steps_per_dispatch(cfg: ExperimentConfig,
+                                loader_steps_per_epoch: Optional[int] = None,
+                                ) -> None:
+    """Chunk-boundary divisibility contract for ``steps_per_dispatch``.
+
+    With k steps folded into one dispatch the train loop only observes
+    the run at chunk boundaries, so every step-cadence knob must be a
+    multiple of k or its events would fall mid-chunk and silently never
+    fire.  Raises ``ValueError`` naming the offending (knob, value)
+    pair.  ``loader_steps_per_epoch`` lets ``fit()`` also check the
+    loader's actual epoch period (a partial trailing chunk per epoch
+    would drop steps and skew the epoch accounting).
+    """
+    k = cfg.steps_per_dispatch
+    if k < 1:
+        raise ValueError(
+            f"steps_per_dispatch must be >= 1, got {k}")
+    if k == 1:
+        return
+    pairs = [
+        ("log_every_steps", cfg.log_every_steps),
+        ("eval_every_steps", cfg.eval_every_steps),
+        ("checkpoint_every_steps", cfg.checkpoint_every_steps),
+        ("steps_per_epoch", cfg.steps_per_epoch or 0),
+        ("loader steps_per_epoch", loader_steps_per_epoch or 0),
+    ]
+    for name, value in pairs:
+        if value and value % k:
+            raise ValueError(
+                f"steps_per_dispatch={k} does not divide {name}={value}"
+                " — the chunked loop only observes chunk boundaries, so"
+                f" a {name} event would fall mid-chunk and never fire."
+                f"  Pick k dividing every cadence knob or change {name}"
+                " to a multiple of k (docs/PERFORMANCE.md"
+                " \"Device-side step chunking\")")
 
 
 _REGISTRY: Dict[str, Callable[[], ExperimentConfig]] = {}

@@ -1,11 +1,12 @@
 from .synthetic import SyntheticSOD
 from .folder import FolderSOD, resolve_dataset
-from .pipeline import HostDataLoader, prefetch_to_device
+from .pipeline import HostDataLoader, chunk_batches, prefetch_to_device
 
 __all__ = [
     "SyntheticSOD",
     "FolderSOD",
     "resolve_dataset",
     "HostDataLoader",
+    "chunk_batches",
     "prefetch_to_device",
 ]

@@ -515,6 +515,70 @@ class HostDataLoader:
             pool.shutdown(wait=False)
 
 
+def chunk_batches(iterator, steps_per_dispatch: int, stats=None):
+    """Stack ``steps_per_dispatch`` consecutive host batches along a new
+    leading axis — the chunk-assembly stage feeding the scanned train
+    step (``train.steps_per_dispatch``; docs/PERFORMANCE.md).
+
+    Sits BETWEEN the loader and ``prefetch_to_device`` so one H2D
+    transfer ships a whole chunk.  Ring-buffer-aware: each incoming
+    batch is copied into the chunk buffer the moment it is yielded, so
+    the loader's ``_RING_KEEP``-yield validity window is honored for
+    any k (the assembler never holds a loader batch across a yield).
+
+    Chunk buffers rotate as a pair, mirroring ``prefetch_to_device``'s
+    cast buffers and inheriting the same safety argument: a yielded
+    chunk is consumed by the H2D thread, which blocks until the (async)
+    transfer lands before pulling the next chunk, so buffer i is only
+    rewritten after chunk i's copy completed (on the CPU backend the
+    prefetch worker snapshots host arrays instead — ``device_put`` may
+    alias — so reuse is safe there too).
+
+    A trailing partial chunk (epoch length not divisible by k — fit()
+    validates this never happens) is dropped, counted into the
+    ``data_partial_chunks_dropped`` stat rather than silently shipped
+    with stale rows.
+    """
+    k = int(steps_per_dispatch)
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+    if k == 1:
+        yield from iterator
+        return
+    bufs: list = [None, None]
+    flip = 0
+    filled = 0
+    t_asm = 0.0
+    for batch in iterator:
+        if filled == 0:
+            t_asm = 0.0
+            buf = bufs[flip]
+            stale = (buf is None or set(buf) != set(batch) or any(
+                buf[key].shape[1:] != np.asarray(v).shape
+                or buf[key].dtype != np.asarray(v).dtype
+                for key, v in batch.items()))
+            if stale:
+                bufs[flip] = {
+                    key: np.empty((k,) + np.asarray(v).shape,
+                                  np.asarray(v).dtype)
+                    for key, v in batch.items()}
+        t0 = time.perf_counter()
+        for key, v in batch.items():
+            bufs[flip][key][filled] = v
+        t_asm += time.perf_counter() - t0
+        filled += 1
+        if filled == k:
+            if stats is not None:
+                stats.add("data_chunk_assemble_ms", t_asm * 1000.0)
+                stats.add("data_chunks", 1.0)
+            out = bufs[flip]
+            flip ^= 1
+            filled = 0
+            yield out
+    if filled and stats is not None:
+        stats.add("data_partial_chunks_dropped", 1.0)
+
+
 def prefetch_to_device(iterator, size: int = 2, sharding=None, mesh=None,
                        transfer_dtype=None, drop_keys=(), spec=None,
                        stats=None):

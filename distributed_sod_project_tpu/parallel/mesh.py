@@ -198,23 +198,29 @@ def global_batch_array(batch, mesh: Mesh, spec: Optional[P] = None):
     sharding = (NamedSharding(mesh, spec) if spec is not None
                 else batch_sharding(mesh))
     sp = spec if spec is not None else batch_spec()
+    # Which dim (if any) rows shard over ``seq`` — dim 1 for the plain
+    # SP spec P('data', 'seq'), dim 2 for the step-chunked spec
+    # P(None, 'data', 'seq') (stacked batches, leading k axis).
     row_slice = None
-    if len(sp) > 1 and sp[1] == "seq":
+    seq_dim = next((i for i, names in enumerate(sp) if names == "seq"), None)
+    if seq_dim is not None:
         seq_ids = host_axis_blocks(mesh).get("seq") or [0]
         seq_size = mesh.shape.get("seq", 1)
         if len(seq_ids) < seq_size:
-            row_slice = (seq_ids[0], len(seq_ids), seq_size)
+            row_slice = (seq_dim, seq_ids[0], len(seq_ids), seq_size)
 
     def place(x):
         x = np.asarray(x)
         if row_slice is not None:
-            first, n, total = row_slice
-            if x.shape[1] % total:
+            dim, first, n, total = row_slice
+            if x.shape[dim] % total:
                 raise ValueError(
-                    f"dim 1 ({x.shape[1]}) not divisible by the seq "
-                    f"axis ({total})")
-            blk = x.shape[1] // total
-            x = x[:, first * blk:(first + n) * blk]
+                    f"dim {dim} ({x.shape[dim]}) not divisible by the "
+                    f"seq axis ({total})")
+            blk = x.shape[dim] // total
+            idx = [slice(None)] * x.ndim
+            idx[dim] = slice(first * blk, (first + n) * blk)
+            x = x[tuple(idx)]
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree_util.tree_map(place, batch)

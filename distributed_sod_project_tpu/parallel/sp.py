@@ -257,6 +257,8 @@ def make_sp_train_step(
     sp_strategy: str = "ring",
     remat: bool = False,
     remat_policy: str = "none",
+    steps_per_dispatch: int = 1,
+    _always_scan: bool = False,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
               Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the sequence-parallel ``(state, batch) -> (state, metrics)``.
@@ -266,6 +268,11 @@ def make_sp_train_step(
     model must be halo-free over rows with an injectable attention
     core (``vit_sod``).  ``sp_strategy`` picks ring vs ulysses —
     see ``_sp_apply``.
+
+    ``steps_per_dispatch=k > 1`` scans k steps in one dispatch over
+    batches stacked on a new leading axis (leaves ``P(None, 'data',
+    'seq')``), stacked per-step metrics out — see
+    ``train.step.chunked_step_fn``.  k == 1 is unchanged.
     """
     if getattr(loss_cfg, "fused_kernel", False):
         import logging
@@ -343,10 +350,16 @@ def make_sp_train_step(
             metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
         return new_state, metrics
 
+    from ..train.step import chunk_batch_spec, chunked_step_fn
+
+    body = chunked_step_fn(step_fn, steps_per_dispatch,
+                           always_scan=_always_scan)
+    batch_in = (P("data", "seq") if body is step_fn
+                else chunk_batch_spec(P("data", "seq")))
     sharded = shard_map(
-        step_fn,
+        body,
         mesh=mesh,
-        in_specs=(P(), P("data", "seq")),
+        in_specs=(P(), batch_in),
         out_specs=(P(), P()),
         check_vma=False,
     )

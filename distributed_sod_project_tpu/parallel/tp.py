@@ -215,7 +215,9 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
                        ema_decay: float = 0.0,
                        scale_hw: Optional[Tuple[int, int]] = None,
                        donate_batch: bool = False,
-                       remat: bool = False, remat_policy: str = "none"):
+                       remat: bool = False, remat_policy: str = "none",
+                       steps_per_dispatch: int = 1,
+                       _always_scan: bool = False):
     """Build the GSPMD train step: ``(state, batch) -> (state, metrics)``.
 
     Unlike the shard_map DP step there is no explicit ``pmean`` and no
@@ -225,15 +227,22 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
     alone.  Requires ``model_cfg.sync_bn=False`` models (the
     transformer zoo); BN stats here are computed over the global batch
     by construction, which is strictly stronger than SyncBN.
+
+    ``steps_per_dispatch=k > 1`` scans k steps inside the one program
+    over batches stacked on a new leading axis (leaves sharded
+    ``P(None, 'data')``), per-step metrics stacked on the way out —
+    see ``train.step.chunked_step_fn``.  k == 1 is the historical
+    per-step program, unchanged.
     """
     import jax.numpy as jnp
     import optax
 
     from ..losses import deep_supervision_loss
-    from ..train.step import (_loss_kwargs, apply_update, maybe_remat,
+    from ..train.step import (_loss_kwargs, apply_update, chunk_batch_spec,
+                              chunked_step_fn, maybe_remat,
                               notfinite_count, rescale_batch,
                               resolve_remat_policy)
-    from .mesh import batch_sharding
+    from .mesh import batch_sharding, batch_spec
 
     resolve_remat_policy(remat_policy)  # fail fast on typos, remat or not
     lkw = _loss_kwargs(loss_cfg)
@@ -271,13 +280,17 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
             metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
         return new_state, metrics
 
+    body = chunked_step_fn(step_fn, steps_per_dispatch,
+                           always_scan=_always_scan)
+    batch_in = (batch_sharding(mesh) if body is step_fn
+                else NamedSharding(mesh, chunk_batch_spec(batch_spec())))
     replicated = NamedSharding(mesh, P())
     donated = (0,) if donate else ()
     if donate_batch:  # see make_train_step: fit feeds each batch once
         donated = donated + (1,)
     return jax.jit(
-        step_fn,
-        in_shardings=(state_shardings, batch_sharding(mesh)),
+        body,
+        in_shardings=(state_shardings, batch_in),
         out_shardings=(state_shardings, replicated),
         donate_argnums=donated,
     )

@@ -56,9 +56,17 @@ def fit(
     """Run the full training loop; returns final scalar metrics.
 
     ``max_steps`` truncates (smoke tests / benchmarks); ``hooks`` may
-    contain ``on_metrics(step, dict)`` for test instrumentation;
+    contain ``on_metrics(step, dict)`` and — under step chunking —
+    ``on_chunk_metrics(step, stacked_dict)`` for test instrumentation;
     ``profile_dir`` captures a jax.profiler trace of a short post-warmup
     step window (view in TensorBoard/Perfetto).
+
+    ``cfg.steps_per_dispatch=k > 1`` folds k steps into one
+    ``lax.scan`` dispatch: the loop advances chunk-by-chunk (every
+    cadence knob must divide by k — validate_steps_per_dispatch), k
+    host batches stack into one H2D transfer, and the steady state
+    does exactly ONE host↔device sync per chunk (the stacked-metrics
+    readback).  See docs/PERFORMANCE.md "Device-side step chunking".
 
     Resilience (docs/RESILIENCE.md): restore lands on the newest VALID
     checkpoint; ``cfg.watchdog_deadline_s`` arms the wedged-step
@@ -75,6 +83,18 @@ def fit(
     hooks = hooks or {}
     workdir = workdir or cfg.checkpoint_dir
     plan = inject.plan_from_env()
+
+    # Device-side step chunking (docs/PERFORMANCE.md): k steps fold
+    # into one lax.scan dispatch and the loop advances chunk-by-chunk.
+    # Fault plans force k=1 — poison/stall/SIGTERM are PER-STEP
+    # semantics the chaos suite asserts exactly, and a scanned chunk
+    # has no host boundary between its steps to inject at.
+    k = int(cfg.steps_per_dispatch)
+    if plan is not None and k > 1:
+        log.warning(
+            "DSOD_FAULTS is set: forcing steps_per_dispatch=1 (was %d) "
+            "so per-step poison/stall/SIGTERM semantics stay exact", k)
+        k = 1
 
     mesh = make_mesh(cfg.mesh)
     n_dev = mesh.devices.size
@@ -133,9 +153,22 @@ def fit(
         raise ValueError(
             f"dataset of {len(dataset)} samples yields zero steps at "
             f"global_batch_size={cfg.global_batch_size}")
+    # Chunk-boundary contract: every cadence knob AND the loader's
+    # actual epoch period must be multiples of k (loud ValueError
+    # naming the offending pair — configs/base.py).
+    from ..configs.base import validate_steps_per_dispatch
+
+    validate_steps_per_dispatch(cfg.replace(steps_per_dispatch=k),
+                                loader.steps_per_epoch)
     total_steps = steps_per_epoch * cfg.num_epochs
     if max_steps is not None:
         total_steps = min(total_steps, max_steps)
+        if k > 1 and total_steps % k:
+            raise ValueError(
+                f"max_steps={max_steps} truncates the run to "
+                f"{total_steps} steps, not a multiple of "
+                f"steps_per_dispatch={k} — the loop would overshoot "
+                "mid-chunk; pass a max_steps that is a multiple of k")
 
     model = build_model(cfg.model)
     tx, schedule = build_optimizer(cfg.optim, total_steps)
@@ -176,6 +209,17 @@ def fit(
             start_step = int(state.step)
             resumed_from = start_step
             log.info("resumed from checkpoint step %d", start_step)
+            if k > 1 and start_step % k:
+                # checkpoint_every_steps % k == 0 guarantees chunk-
+                # aligned saves, so a misaligned resume means the
+                # checkpoint came from a run with a different k (e.g. a
+                # k=1 final force-save mid-cycle).
+                raise ValueError(
+                    f"resumed checkpoint step {start_step} is not a "
+                    f"multiple of steps_per_dispatch={k} — the chunked "
+                    "loop must re-enter on a chunk boundary.  Resume "
+                    "with steps_per_dispatch=1 (or a k dividing "
+                    f"{start_step}) until the next aligned checkpoint")
 
     # Step builder: shard_map DP step for the CNN zoo (named-axis
     # SyncBN), the GSPMD step when the mesh has a tensor-parallel axis
@@ -216,7 +260,8 @@ def fit(
                 ema_decay=cfg.optim.ema_decay, donate_batch=True,
                 sp_strategy=cfg.mesh.sp_strategy,
                 remat=cfg.model.remat,
-                remat_policy=cfg.model.remat_policy)
+                remat_policy=cfg.model.remat_policy,
+                steps_per_dispatch=k)
     elif use_gspmd:
         from ..parallel.tp import make_tp_train_step, shard_state
 
@@ -249,7 +294,8 @@ def fit(
                 schedule=schedule, ema_decay=cfg.optim.ema_decay,
                 scale_hw=scale_hw, donate_batch=True,
                 remat=cfg.model.remat,
-                remat_policy=cfg.model.remat_policy)
+                remat_policy=cfg.model.remat_policy,
+                steps_per_dispatch=k)
     else:
         state = jax.device_put(state, replicated_sharding(mesh))
 
@@ -258,7 +304,8 @@ def fit(
                 model, cfg.loss, tx, mesh, schedule=schedule,
                 remat=cfg.model.remat, ema_decay=cfg.optim.ema_decay,
                 scale_hw=scale_hw, donate_batch=True,
-                remat_policy=cfg.model.remat_policy)
+                remat_policy=cfg.model.remat_policy,
+                steps_per_dispatch=k)
 
     # Multi-scale training: one compiled step per size in the cycle
     # (each is a distinct static-shape XLA program; the resize happens
@@ -270,15 +317,20 @@ def fit(
         hw: step_factory(None if hw == tuple(cfg.data.image_size) else hw)
         for hw in dict.fromkeys(ms_cycle)
     }
-    train_step_at = lambda i: step_for_size[ms_cycle[i % len(ms_cycle)]]  # noqa: E731
+    # Multi-scale cycles per CHUNK (all k steps of a dispatch share one
+    # static-shape program; each size stays its own compiled program).
+    # At k=1 this reduces exactly to the historical per-step cycling.
+    train_step_at = lambda i: step_for_size[ms_cycle[(i // k) % len(ms_cycle)]]  # noqa: E731
 
     # SP shards image rows over ``seq`` in addition to batch over
     # ``data``; every other path uses the default batch-only sharding.
+    # Chunked batches carry a new leading k axis, unsharded.
     batch_spec_override = None
-    if use_sp:
+    if use_sp or k > 1:
         from jax.sharding import PartitionSpec as P
 
-        batch_spec_override = P("data", "seq")
+        sp_dims = ("data", "seq") if use_sp else ("data",)
+        batch_spec_override = P(*(((None,) + sp_dims) if k > 1 else sp_dims))
 
     writer = MetricWriter(os.path.join(workdir, "tb")
                           if cfg.tensorboard else None)
@@ -286,16 +338,17 @@ def fit(
                if cfg.eval_every_steps else None)
 
     # Wedged-dispatch watchdog: heartbeat fed by timer.tick() (one beat
-    # per completed step); a step past the deadline → stack dump + exit
-    # code 114 for the supervising layer to re-fire (watchdog.py).
+    # per completed CHUNK — a dispatch is k steps, so the deadline
+    # scales by k); a chunk past the deadline → stack dump + exit code
+    # 114 for the supervising layer to re-fire (watchdog.py).
     watchdog = None
     if cfg.watchdog_deadline_s > 0:
         from ..resilience.watchdog import StepWatchdog
 
         watchdog = StepWatchdog(
-            cfg.watchdog_deadline_s,
+            cfg.watchdog_deadline_s * k,
             first_deadline_s=max(cfg.watchdog_compile_grace_s,
-                                 cfg.watchdog_deadline_s),
+                                 cfg.watchdog_deadline_s * k),
             dump_dir=workdir,
         ).start()
     timer = StepTimer(on_tick=watchdog.beat if watchdog else None)
@@ -313,6 +366,9 @@ def fit(
     profile_at = -1
     if profile_dir:
         profile_at = max(start_step, min(start_step + 10, total_steps - 1))
+        # The loop only visits chunk-start steps; snap the profile
+        # window onto one (exact historical value at k=1).
+        profile_at -= (profile_at - start_step) % k
     # Resume position in LOADER coordinates: the loader always yields
     # loader.steps_per_epoch batches per epoch regardless of any
     # cfg.steps_per_epoch accounting override, so epoch/offset math must
@@ -330,6 +386,126 @@ def fit(
     # than cfg.num_epochs.
     import itertools
 
+    def _process_log(at_step, metrics_host, at_epoch):
+        """The log-boundary block, shared by the k=1 inline path and the
+        chunked flush.  Chunked metrics leaves are (k,)-stacked; the log
+        line reports the chunk's LAST step — exactly the step a k=1 loop
+        would log at this boundary."""
+        nonlocal last_metrics
+        host = {name: float(np.asarray(v).reshape(-1)[-1])
+                for name, v in metrics_host.items()}
+        if (cfg.optim.skip_nonfinite and
+                host.get("notfinite_count", 0.0)
+                >= cfg.optim.skip_nonfinite):
+            raise RuntimeError(
+                f"{int(host['notfinite_count'])} consecutive "
+                "non-finite gradient updates (≥ optim."
+                f"skip_nonfinite={cfg.optim.skip_nonfinite}) — "
+                "training has diverged; no bad update was "
+                "applied, restart from the last checkpoint "
+                "with a lower lr / higher loss scale")
+        host["imgs_per_sec"] = timer.images_per_sec(
+            cfg.global_batch_size)
+        host["epoch"] = at_epoch
+        # Data-plane health for this logging interval:
+        # data_starved_ms > 0 means the device waited on
+        # the host pipeline (docs/PERFORMANCE.md).
+        host.update(data_stats.delta())
+        if cfg.data.skip_budget > 0:
+            # Corrupt samples tolerated so far (dataguard
+            # substitution + tfdata shortfall), surfaced as
+            # a counter instead of an epoch-killing raise.
+            host["data_skipped"] = float(
+                (data_guard.skipped if data_guard is not None
+                 else 0)
+                + int(getattr(loader, "skipped", 0)))
+        last_metrics = host
+        writer.scalars(at_step, host)
+        if is_primary_process():
+            log.info(
+                "step %d/%d  loss=%.4f  lr=%.2e  %.1f imgs/s",
+                at_step, total_steps, host.get("total", float("nan")),
+                host.get("lr", float("nan")),
+                host["imgs_per_sec"])
+        if "on_metrics" in hooks:
+            hooks["on_metrics"](at_step, host)
+
+    # One source for the "does this boundary read state?" predicates:
+    # _run_state_events acts on them, _state_event_at (the chunked
+    # loop's flush-ordering decision) ORs them — adding a state-reading
+    # event means adding a predicate here, and both sides follow.
+    def _eval_due(at_step) -> bool:
+        return eval_fn is not None and at_step % cfg.eval_every_steps == 0
+
+    def _ckpt_due(at_step) -> bool:
+        return bool(cfg.checkpoint_every_steps
+                    and at_step % cfg.checkpoint_every_steps == 0)
+
+    def _run_state_events(at_step):
+        """Eval/checkpoint at a boundary — these read the CURRENT state,
+        so under chunking they may only run while ``state`` still is the
+        state at ``at_step`` (before the next chunk's donated dispatch
+        replaces it)."""
+        nonlocal eval_metrics, last_eval_step, last_saved
+        if _eval_due(at_step):
+            eval_metrics = eval_fn(state)
+            last_eval_step = at_step
+            writer.scalars(at_step, {f"eval/{k}": v
+                                     for k, v in eval_metrics.items()})
+            if is_primary_process():
+                log.info("eval @ %d: %s", at_step,
+                         {k: round(v, 4) for k, v in
+                          eval_metrics.items()})
+            if watchdog is not None:
+                # Inline eval is legitimate beat-free progress;
+                # don't let a val sweep longer than the step
+                # deadline read as a wedged dispatch.
+                watchdog.beat(at_step, eval_metrics)
+        if _ckpt_due(at_step):
+            if (cfg.best_metric and eval_fn is not None
+                    and last_eval_step != at_step):
+                # best-k ranking must reflect THIS state, not a
+                # stale measurement from an earlier step.
+                eval_metrics = eval_fn(state)
+                last_eval_step = at_step
+            # state passed as-is: orbax's async save does the D2H
+            # copy behind the next train steps (no device_get stall).
+            mgr.save(at_step, state, metrics=eval_metrics or None)
+            last_saved = at_step
+            if watchdog is not None:
+                watchdog.beat(at_step)
+
+    def _state_event_at(at_step) -> bool:
+        return _eval_due(at_step) or _ckpt_due(at_step)
+
+    # Chunked (k>1) bookkeeping: the dispatched-but-not-yet-observed
+    # chunk.  Its metrics fetch — the chunk's ONE host↔device sync — is
+    # LAGGED one iteration: chunk n is flushed after chunk n+1 has been
+    # dispatched, so the device always has work queued (run-ahead
+    # preserved; through high-latency transports the dispatch gap would
+    # otherwise idle the device once per chunk).  Boundaries that need
+    # the post-chunk STATE (eval/checkpoint) flush synchronously before
+    # the next dispatch instead — donation replaces the state.
+    pending = None  # (end_step, metrics_device, epoch)
+
+    def _flush_chunk(with_state: bool):
+        nonlocal pending, stop
+        at_step, metrics_dev, at_epoch = pending
+        pending = None
+        # The fetch cannot return before chunk `at_step` completed, so
+        # it doubles as the completed-work signal — the timer/watchdog
+        # beat is fed by finished device work, not by dispatch
+        # (utils/timing.py).
+        metrics_host = jax.device_get(metrics_dev)
+        timer.tick(steps=k)
+        if "on_chunk_metrics" in hooks:
+            hooks["on_chunk_metrics"](at_step, metrics_host)
+        stop = _poll_stop(guard, at_step, sync_every) or stop
+        if at_step % cfg.log_every_steps == 0 or at_step == total_steps:
+            _process_log(at_step, metrics_host, at_epoch)
+        if with_state:
+            _run_state_events(at_step)
+
     try:
       with PreemptionGuard() as guard:
         for epoch in itertools.count(start_epoch):
@@ -343,6 +519,15 @@ def fit(
 
             host_batches = periodic_validate(iter(loader),
                                              cfg.data.validate_every)
+            if k > 1:
+                # Chunk assembly: stack k host batches along a new
+                # leading axis BEFORE the H2D stage, so one transfer
+                # ships a whole dispatch's worth (ring-buffer-aware —
+                # see data/pipeline.py::chunk_batches).
+                from ..data import chunk_batches
+
+                host_batches = chunk_batches(host_batches, k,
+                                             stats=data_stats)
             # mesh= (not sharding=): each host contributes its local
             # slice of the global batch — correct on multi-host pods.
             it = prefetch_to_device(
@@ -354,6 +539,13 @@ def fit(
             for batch in it:
                 if step >= total_steps or stop:
                     break
+                if pending is not None and _state_event_at(pending[0]):
+                    # Chunk n's eval/checkpoint must observe the state
+                    # AT its boundary — flush before chunk n+1's
+                    # donated dispatch replaces it.
+                    _flush_chunk(with_state=True)
+                    if stop:
+                        break
                 train_step = train_step_at(step)
                 if plan is not None:
                     batch = plan.maybe_poison_batch(step + 1, batch)
@@ -363,7 +555,17 @@ def fit(
                         jax.block_until_ready(metrics["total"])
                 else:
                     state, metrics = train_step(state, batch)
-                step += 1
+                step += k
+                if k > 1:
+                    # Lagged flush: observe chunk n only after chunk
+                    # n+1 is in flight, so the device never sits idle
+                    # across the host's fetch + bookkeeping + dispatch
+                    # gap (see _flush_chunk).
+                    if pending is not None:
+                        _flush_chunk(with_state=False)
+                    pending = (step, metrics, epoch)
+                    continue
+                # ---- k == 1: the historical per-step path, unchanged.
                 if plan is not None:
                     # Stall BEFORE the heartbeat: to the watchdog this
                     # step is still in flight, like a wedged dispatch.
@@ -373,72 +575,18 @@ def fit(
                     plan.maybe_sigterm(step)
                 stop = _poll_stop(guard, step, sync_every)
                 if step % cfg.log_every_steps == 0 or step == total_steps:
-                    host = {k: float(v) for k, v in metrics.items()}
-                    if (cfg.optim.skip_nonfinite and
-                            host.get("notfinite_count", 0.0)
-                            >= cfg.optim.skip_nonfinite):
-                        raise RuntimeError(
-                            f"{int(host['notfinite_count'])} consecutive "
-                            "non-finite gradient updates (≥ optim."
-                            f"skip_nonfinite={cfg.optim.skip_nonfinite}) — "
-                            "training has diverged; no bad update was "
-                            "applied, restart from the last checkpoint "
-                            "with a lower lr / higher loss scale")
-                    host["imgs_per_sec"] = timer.images_per_sec(
-                        cfg.global_batch_size)
-                    host["epoch"] = epoch
-                    # Data-plane health for this logging interval:
-                    # data_starved_ms > 0 means the device waited on
-                    # the host pipeline (docs/PERFORMANCE.md).
-                    host.update(data_stats.delta())
-                    if cfg.data.skip_budget > 0:
-                        # Corrupt samples tolerated so far (dataguard
-                        # substitution + tfdata shortfall), surfaced as
-                        # a counter instead of an epoch-killing raise.
-                        host["data_skipped"] = float(
-                            (data_guard.skipped if data_guard is not None
-                             else 0)
-                            + int(getattr(loader, "skipped", 0)))
-                    last_metrics = host
-                    writer.scalars(step, host)
-                    if is_primary_process():
-                        log.info(
-                            "step %d/%d  loss=%.4f  lr=%.2e  %.1f imgs/s",
-                            step, total_steps, host.get("total", float("nan")),
-                            host.get("lr", float("nan")),
-                            host["imgs_per_sec"])
-                    if "on_metrics" in hooks:
-                        hooks["on_metrics"](step, host)
-                if eval_fn is not None and step % cfg.eval_every_steps == 0:
-                    eval_metrics = eval_fn(state)
-                    last_eval_step = step
-                    writer.scalars(step, {f"eval/{k}": v
-                                          for k, v in eval_metrics.items()})
-                    if is_primary_process():
-                        log.info("eval @ %d: %s", step,
-                                 {k: round(v, 4) for k, v in
-                                  eval_metrics.items()})
-                    if watchdog is not None:
-                        # Inline eval is legitimate beat-free progress;
-                        # don't let a val sweep longer than the step
-                        # deadline read as a wedged dispatch.
-                        watchdog.beat(step, eval_metrics)
-                if cfg.checkpoint_every_steps and (
-                        step % cfg.checkpoint_every_steps == 0):
-                    if (cfg.best_metric and eval_fn is not None
-                            and last_eval_step != step):
-                        # best-k ranking must reflect THIS state, not a
-                        # stale measurement from an earlier step.
-                        eval_metrics = eval_fn(state)
-                        last_eval_step = step
-                    # state passed as-is: orbax's async save does the D2H
-                    # copy behind the next train steps (no device_get stall).
-                    mgr.save(step, state, metrics=eval_metrics or None)
-                    last_saved = step
-                    if watchdog is not None:
-                        watchdog.beat(step)
+                    # ONE batched device_get for the whole metric dict —
+                    # not a blocking float(v) per scalar (each paid a
+                    # full host↔device round trip on remote transports).
+                    _process_log(step, jax.device_get(metrics), epoch)
+                _run_state_events(step)
             if step >= total_steps or stop:
                 break
+        if pending is not None:
+            # The run's last chunk: nothing was dispatched after it, so
+            # ``state`` is still its boundary state — flush with state
+            # events before wind-down.
+            _flush_chunk(with_state=True)
         if watchdog is not None:
             # Training is over: the final eval/force-save/close below is
             # legitimate wind-down, not a wedged step.

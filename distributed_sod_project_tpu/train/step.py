@@ -109,6 +109,48 @@ def notfinite_count(opt_state) -> Optional[jnp.ndarray]:
     return None
 
 
+def chunked_step_fn(step_fn, steps_per_dispatch: int, *,
+                    always_scan: bool = False):
+    """Fold ``steps_per_dispatch`` train steps into ONE program body:
+    a ``lax.scan`` of ``step_fn`` over batches stacked along a new
+    leading axis, returning the final carry and the per-step metrics
+    stacked along that same axis.
+
+    Shared by all three step builders (DP shard_map, GSPMD TP, SP) so
+    the chunking transform cannot diverge between them.  With k == 1
+    the step function is returned UNTOUCHED (no scan wrapper) — the
+    historical per-step program replays bit-identically — unless
+    ``always_scan`` asks for the degenerate 1-step scan, which exists
+    for the bitwise k-equivalence suite: scan(k) vs k dispatches of
+    scan(1) is the comparison XLA:CPU keeps bitwise (the plain-vs-scan
+    residual is a while-body conv-canonicalization layout artifact,
+    quantified in tests/test_step_chunking.py).
+
+    Because the per-step RNG folds on ``state.step`` INSIDE ``step_fn``
+    and the carry threads the real TrainState, each scan iteration is
+    the exact computation the sequential dispatch would run — per-step
+    dropout draws, LR schedule reads, EMA gating and the
+    ``apply_if_finite`` failure counter all advance identically.
+    """
+    k = int(steps_per_dispatch)
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+    if k == 1 and not always_scan:
+        return step_fn
+
+    def chunk_fn(state, batches):
+        return lax.scan(step_fn, state, batches, length=k)
+
+    return chunk_fn
+
+
+def chunk_batch_spec(base_spec: P) -> P:
+    """Batch PartitionSpec for a stacked chunk: the new leading k axis
+    is unsharded (every device runs all k steps), the original batch
+    dims keep their sharding shifted one dim right."""
+    return P(None, *base_spec)
+
+
 def rescale_batch(batch, scale_hw):
     """On-device multi-scale resize (image/mask/depth → ``scale_hw``);
     shared by the shard_map and GSPMD steps."""
@@ -136,11 +178,20 @@ def make_train_step(
     scale_hw: Optional[Tuple[int, int]] = None,
     donate_batch: bool = False,
     remat_policy: str = "none",
+    steps_per_dispatch: int = 1,
+    _always_scan: bool = False,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build ``(state, batch) -> (state, metrics)``.
 
     Sharding contract: ``state`` replicated (P()), every ``batch`` leaf
     batch-sharded (P('data')); metrics come back replicated scalars.
+
+    ``steps_per_dispatch=k > 1`` (cfg.steps_per_dispatch) instead takes
+    batches stacked along a NEW leading k axis (leaves ``P(None,
+    'data')``-sharded) and runs k full train steps as one ``lax.scan``
+    inside the compiled program (``chunked_step_fn``); metrics come
+    back stacked per-step along that axis.  k == 1 is the historical
+    per-step program, byte-for-byte (no scan wrapper).
 
     ``remat=True`` rematerialises the forward during backward
     (``jax.checkpoint``): activations are recomputed instead of stored,
@@ -201,10 +252,14 @@ def make_train_step(
             metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
         return new_state, metrics
 
+    body = chunked_step_fn(step_fn, steps_per_dispatch,
+                           always_scan=_always_scan)
+    batch_in = (P("data") if body is step_fn
+                else chunk_batch_spec(P("data")))
     sharded = shard_map(
-        step_fn,
+        body,
         mesh=mesh,
-        in_specs=(P(), P("data")),
+        in_specs=(P(), batch_in),
         out_specs=(P(), P()),
         check_vma=False,
     )

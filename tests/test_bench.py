@@ -321,3 +321,53 @@ def test_bench_does_not_retry_unrelated_errors(tmp_path, monkeypatch, capsys):
     line = json.loads(out.strip().splitlines()[-1])
     assert "shapes do not match" in line["error"]
     assert line["value"] == 0.0
+
+
+def test_bench_steps_per_dispatch_folds_into_override_key(monkeypatch):
+    """--steps-per-dispatch rides the --set override machinery, so the
+    compiled program gets cfg.steps_per_dispatch AND the vs_baseline
+    key is tagged apart from the canonical k=1 baselines."""
+    import bench
+
+    captured = {}
+
+    def fake_run(args):
+        captured["overrides"] = list(args.overrides)
+        return 0
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    rc = bench.main(["--device", "cpu", "--mode", "train",
+                     "--steps-per-dispatch", "4", "--watchdog", "0",
+                     "--probe-timeout", "0"])
+    assert rc == 0
+    assert "steps_per_dispatch=4" in captured["overrides"]
+
+
+def test_bench_steps_per_dispatch_rejects_non_train_modes():
+    import pytest
+
+    import bench
+
+    with pytest.raises(SystemExit):
+        bench.main(["--mode", "data", "--steps-per-dispatch", "2"])
+    with pytest.raises(SystemExit):
+        bench.main(["--mode", "train", "--steps-per-dispatch", "0"])
+
+
+def test_bench_set_override_chunking_rejected_off_train(tmp_path,
+                                                        monkeypatch):
+    """The --set spelling gets the same non-train guard as the flag —
+    otherwise the override tags a baseline key without changing the
+    measured program."""
+    import pytest
+
+    import bench
+
+    monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
+    with pytest.raises(SystemExit, match="only "):
+        bench.main([
+            "--device", "cpu", "--mode", "data", "--steps", "2",
+            "--warmup", "0", "--batch-per-chip", "4",
+            "--image-size", "32", "--set", "data.synthetic_size=16",
+            "--set", "steps_per_dispatch=2",
+        ])

@@ -24,4 +24,8 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/hlo_guard.py \
 echo "== roofline --xla-check (recorded, non-gating) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/roofline.py --xla-check \
   || echo "roofline xla-check smoke failed (non-gating)"
+echo "== step-chunking k-equivalence smoke (recorded; the full suite below gates it) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_step_chunking.py -q -k bitwise_smoke -p no:cacheprovider \
+  || echo "step-chunking smoke failed (the main suite below still gates it)"
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

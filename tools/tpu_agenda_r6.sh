@@ -1,0 +1,101 @@
+#!/bin/bash
+# Round-6 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh fires this automatically; default agenda since
+# round 6).  Round 6 landed device-side step chunking
+# (train.steps_per_dispatch=k: k train steps folded into one lax.scan
+# dispatch, one stacked H2D and one metrics readback per chunk —
+# docs/PERFORMANCE.md "Device-side step chunking").  The questions this
+# agenda answers:
+#
+#   1. canonical b128 headline refresh (comparison anchor; k=1 key is
+#      untouched by the chunking knob, so this replays the r5 key)
+#   2. chunking sweep at the flagship operating point — k in {2,4,8}
+#      at b128.  Prediction: per-dispatch overhead on the axon
+#      transport was measured in the tens of ms (dispatch-latency
+#      dominates under ~16 imgs/chip; BASELINE.md round-1 notes), but
+#      at b128 the step itself is ~155 ms, so the b128 win is bounded
+#      at a few percent — the sweep prices the overhead exactly:
+#      (1/img_s_k1 - 1/img_s_k) * b = ms/step saved.
+#   3. chunking sweep at b16 — the dispatch-bound regime.  Here
+#      per-step time is ~20 ms and the same absolute overhead is a
+#      10-30% tax; if chunking does NOT move b16 markedly, loop
+#      overhead was already hidden by async run-ahead and the lever's
+#      value is the multi-host sync story, not raw throughput.
+#   4. k=4 with remat at b64 — chunking composes with the memory lever
+#      (stacked batches cost k x input HBM; remat frees activations).
+#
+# The A/B legs carry steps_per_dispatch as a --set-style override, so
+# bench.py keys them apart from the canonical baselines automatically.
+cd "$(dirname "$0")/.." || exit 1
+R=${R:-tpu_results6}
+mkdir -p "$R"
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+done_ok() {
+  [ -f "$R"/results.jsonl ] || return 1
+  local rec
+  rec=$(grep "\"step\": \"$1\", \"rc\": 0" "$R"/results.jsonl | tail -1)
+  [ -n "$rec" ] || return 1
+  ! printf '%s' "$rec" | grep -q '"error"'
+}
+
+# Circuit breaker (r4 pattern): after any failed leg, verify the
+# tunnel still runs REAL compute; abort the firing if not (the
+# watcher re-fires in the next window and done_ok() skips landed legs).
+tunnel_computes() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('computes')" 2>/dev/null | grep -q computes
+}
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  if done_ok "$name"; then
+    echo "[$name] skip: succeeded in a previous window" | tee -a "$R"/agenda.log
+    return 0
+  fi
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+  if { [ "$rc" -ne 0 ] || printf '%s' "$line" | grep -Eq 'wedged|unavailable'; } \
+      && ! tunnel_computes; then
+    echo "[$name] tunnel no longer computes — aborting firing (watcher will re-fire)" \
+      | tee -a "$R"/agenda.log
+    exit 2
+  fi
+}
+
+# -- 1. canonical headline refresh (k=1; replays the canonical key)
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2. chunking sweep at the flagship point.  --steps counts
+#       DISPATCHES, so scale it down to keep wall time ~constant
+#       (20/k dispatches x k steps = 20 steps of device work).
+run spd2_b128 900 $BENCH --config minet_r50_dp --steps 10 --steps-per-dispatch 2
+run spd4_b128 900 $BENCH --config minet_r50_dp --steps 5  --steps-per-dispatch 4
+run spd8_b128 900 $BENCH --config minet_r50_dp --steps 3  --steps-per-dispatch 8
+
+# -- 3. the dispatch-bound regime: small per-chip batch, where the
+#       per-dispatch tax is a double-digit percentage of the step.
+run b16_k1  900 $BENCH --config minet_r50_dp --batch-per-chip 16 --steps 40
+run b16_k4  900 $BENCH --config minet_r50_dp --batch-per-chip 16 --steps 10 --steps-per-dispatch 4
+run b16_k8  900 $BENCH --config minet_r50_dp --batch-per-chip 16 --steps 5  --steps-per-dispatch 8
+
+# -- 4. composition with remat at b64 (stacked inputs cost k x input
+#       HBM; remat frees the activation side).
+run b64r_k1 900 $BENCH --config minet_r50_dp --batch-per-chip 64 --set model.remat=true
+run b64r_k4 900 $BENCH --config minet_r50_dp --batch-per-chip 64 --steps 5 \
+    --steps-per-dispatch 4 --set model.remat=true
+
+# Host-side window report (touches no TPU).
+timeout 120 python tools/window_report.py "$R"/results.jsonl \
+    > "$R"/window_report.md 2> "$R"/window_report.err || true
+tail -20 "$R"/window_report.md | tee -a "$R"/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
