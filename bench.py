@@ -75,12 +75,18 @@ def main(argv=None):
     p.add_argument("--image-size", type=int, default=320)
     p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
     p.add_argument("--mode", default="train",
-                   choices=["train", "eval", "data"],
+                   choices=["train", "eval", "data", "serve"],
                    help="train: full DP step (default); eval: forward-only "
                         "sigmoid inference (the test.py hot loop); data: "
                         "host input pipeline only — no device work, batch "
                         "is --batch-per-chip as-is (select the backend "
-                        "with --set data.backend=host|tfdata|grain)")
+                        "with --set data.backend=host|tfdata|grain); "
+                        "serve: end-to-end HTTP serving latency — an "
+                        "in-process server (random-init weights) driven "
+                        "by the closed-loop load generator, --steps "
+                        "requests total; reports imgs/sec plus "
+                        "p50/p95/p99 ms so serving latency joins the "
+                        "recorded perf trajectory (docs/SERVING.md)")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="PATH=VALUE",
                    help="dotted config override, e.g. --set "
@@ -407,6 +413,9 @@ def _run(args):
                           + list(args.overrides))
     _reject_non_train_chunking(args, cfg)
 
+    if args.mode == "serve":
+        return _bench_serve(args, cfg)
+
     mesh = make_mesh(cfg.mesh)
     model = build_model(cfg.model)
     tx, sched = build_optimizer(cfg.optim, 1000)
@@ -590,6 +599,55 @@ def _reject_non_train_chunking(args, cfg) -> None:
             f"applies to --mode train (mode {args.mode!r} runs the "
             "ordinary program; the override would tag a baseline key "
             "without changing what was measured)")
+
+
+def _bench_serve(args, cfg) -> int:
+    """--mode serve: stand up the real HTTP serving stack in-process
+    (random-init weights — the bench measures the serving machinery,
+    not a particular checkpoint) and drive it with the closed-loop
+    generator.  The headline value is served imgs/sec; p50/p95/p99 ride
+    along so --baseline-file regression-tracks the latency tail too.
+
+    Single-device on purpose: the engine dispatches to the default
+    device, so per-chip == total and the baseline key's platform tag
+    still distinguishes cpu/tpu runs.
+    """
+    import threading
+
+    import jax
+
+    from distributed_sod_project_tpu.configs import apply_overrides
+    from distributed_sod_project_tpu.serve.engine import InferenceEngine
+    from distributed_sod_project_tpu.serve.loadgen import run_loadgen
+    from distributed_sod_project_tpu.serve.server import make_server
+
+    hw = args.image_size
+    cfg = apply_overrides(cfg, [f"data.image_size={hw},{hw}"])
+    engine = InferenceEngine.from_random_init(cfg).start()
+    srv = make_server(engine, "127.0.0.1", 0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    concurrency = max(cfg.serve.batch_buckets)
+    try:
+        if args.warmup:  # engine.start() AOT-warmed the programs; this
+            run_loadgen(url, mode="closed", concurrency=1,  # warms HTTP
+                        requests=args.warmup, sizes=((hw, hw),), seed=0)
+        res = run_loadgen(url, mode="closed", concurrency=concurrency,
+                          requests=args.steps, sizes=((hw, hw),), seed=1)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        engine.stop()
+    if not res["ok"]:
+        _report_error(args, f"serve bench completed 0/{args.steps} "
+                            "requests")
+        return 1
+    extra = {k: res[k] for k in ("p50_ms", "p95_ms", "p99_ms")}
+    extra.update(shed=res["shed"], expired=res["expired"],
+                 concurrency=concurrency)
+    return _report(args, res["ok"] / res["elapsed_s"],
+                   jax.devices()[0].platform, 1, mode="serve", **extra)
 
 
 def _bench_data(cfg, batch: int, steps: int, warmup: int,

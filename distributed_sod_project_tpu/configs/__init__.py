@@ -5,6 +5,7 @@ from .base import (
     MeshConfig,
     ModelConfig,
     OptimConfig,
+    ServeConfig,
     apply_overrides,
     config_from_dict,
     get_config,
@@ -21,6 +22,7 @@ __all__ = [
     "MeshConfig",
     "ModelConfig",
     "OptimConfig",
+    "ServeConfig",
     "apply_overrides",
     "config_from_dict",
     "get_config",

@@ -197,6 +197,60 @@ class MeshConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Online serving (serve/ subsystem — docs/SERVING.md).
+
+    The engine coalesces arbitrary-time, arbitrary-size requests into
+    the fixed-shape compiled programs evaluation already uses: one AOT-
+    compiled forward per (resolution bucket, batch bucket), requests
+    grouped per resolution bucket and padded up to the smallest batch
+    bucket that fits.  All knobs here are request-plane policy; nothing
+    below changes a compiled program's math.
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 8080  # tools/serve.py --port 0 binds an ephemeral port
+    # Static batch shapes compiled at startup (ascending).  A dispatch
+    # takes the smallest bucket >= the coalesced group, zero-padding
+    # the remainder (eval/inference.py::pad_to_batch).
+    batch_buckets: Tuple[int, ...] = (1, 4, 8)
+    # Static square resolutions compiled at startup.  Empty = one
+    # bucket at max(data.image_size).  A request resizes to the
+    # smallest bucket >= its longest side (largest bucket otherwise);
+    # degraded mode forces the smallest.
+    resolution_buckets: Tuple[int, ...] = ()
+    # How long the oldest queued request may wait for co-riders before
+    # its batch dispatches anyway (the latency/occupancy trade).
+    max_wait_ms: float = 5.0
+    max_queue: int = 64  # admission bound; beyond it requests shed (429)
+    max_inflight: int = 2  # device batches dispatched but not fetched
+    post_workers: int = 2  # host pool for original-resolution resize-back
+    # Default per-request deadline (0 = none; X-SLO-MS overrides).  A
+    # request that can no longer meet its deadline — now + the res
+    # bucket's EWMA device time exceeds it — is shed BEFORE the forward.
+    slo_ms: float = 0.0
+    request_timeout_s: float = 30.0  # HTTP handler wait on the future
+    tta: bool = False  # horizontal-flip TTA (2x forward; off when degraded)
+    # >0: watch the checkpoint directory and hot-swap weights between
+    # dispatches when a newer VALID step appears (restore-latest-VALID
+    # via the integrity layer; swaps are atomic w.r.t. /predict).
+    reload_poll_s: float = 0.0
+    # Dispatch-loop heartbeat deadline feeding /healthz (resilience/
+    # watchdog.py).  A wedged device dispatch stops the beat; /healthz
+    # flips 503 so the fronting LB drains this replica.  0 = off.
+    watchdog_deadline_s: float = 60.0
+    # Degraded-mode hysteresis: engage after queue depth has stayed
+    # >= degraded_high * max_queue for degraded_engage_s; disengage
+    # after it has stayed <= degraded_low * max_queue for
+    # degraded_disengage_s.  Degraded serves the smallest resolution
+    # bucket with TTA off and reports itself (X-Degraded: 1).
+    degraded_high: float = 0.75
+    degraded_low: float = 0.25
+    degraded_engage_s: float = 2.0
+    degraded_disengage_s: float = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "default"
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
@@ -204,6 +258,7 @@ class ExperimentConfig:
     loss: LossConfig = dataclasses.field(default_factory=LossConfig)
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     global_batch_size: int = 8
     num_epochs: int = 50
     steps_per_epoch: Optional[int] = None  # None → derived from dataset size

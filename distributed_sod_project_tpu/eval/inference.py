@@ -259,8 +259,16 @@ def run_inference(
     all_idxs = (list(range(len(dataset))) if shard is None
                 else list(range(shard[0], len(dataset), shard[1])))
     n = len(all_idxs)
+    # With no host consumer AND no device metric carry, NOTHING in this
+    # loop ever syncs: every forward is an async dispatch and a sweep
+    # would queue the entire dataset onto the device (a warmup/
+    # throughput pass with compute_metrics=False did exactly that).
+    # Bound in-flight dispatches by blocking on a batch every few steps.
+    free_running = not need_host and dev_update is None
+    sync_every = 4
+    probs = None
     try:
-        for lo in range(0, n, batch_size):
+        for bi, lo in enumerate(range(0, n, batch_size)):
             if errors:
                 break
             idxs = all_idxs[lo:lo + batch_size]
@@ -271,6 +279,15 @@ def run_inference(
                 batch["depth"] = np.stack([s["depth"] for s in samples])
             if pad:
                 batch = pad_to_batch(batch, batch_size)
+            # The batch build above is the loop's slow host section
+            # (dataset decode); a worker error that landed during it
+            # used to surface only at the NEXT loop top — after this
+            # batch was already dispatched and enqueued for a worker
+            # that will never drain it.  Re-check at both seams: before
+            # the dispatch, and right after the (possibly blocking)
+            # enqueue below.
+            if errors:
+                break
             probs = forward(batch)  # async dispatch — no host sync here
             if dev_update is not None:
                 gts = np.stack([s["mask"] for s in samples])
@@ -283,6 +300,12 @@ def run_inference(
                 dev_state = dev_update(dev_state, probs, gts, valid=valid)
             if need_host:
                 work_q.put((probs, idxs, samples))
+                if errors:  # the put may have blocked across a failure
+                    break
+            elif free_running and bi % sync_every == sync_every - 1:
+                jax.block_until_ready(probs)
+        if free_running and probs is not None:
+            jax.block_until_ready(probs)
     finally:
         if worker is not None:
             work_q.put(None)

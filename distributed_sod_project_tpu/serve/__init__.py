@@ -1,0 +1,31 @@
+"""Online serving subsystem (docs/SERVING.md).
+
+Turns the repo's offline eval path into a request-facing service: a
+dynamic micro-batching engine that coalesces arbitrary-time,
+arbitrary-size requests into the fixed-shape compiled programs
+evaluation already uses, behind a stdlib HTTP front end with admission
+control, SLO deadline shedding, hot weight reload, and Prometheus
+telemetry.
+"""
+
+from .admission import (
+    AdmissionController,
+    DeadlineExpired,
+    EngineStopped,
+    QueueFull,
+)
+from .batcher import DynamicBatcher, Request
+from .engine import InferenceEngine, preprocess_image
+from .server import make_server
+
+__all__ = [
+    "AdmissionController",
+    "DeadlineExpired",
+    "DynamicBatcher",
+    "EngineStopped",
+    "InferenceEngine",
+    "QueueFull",
+    "Request",
+    "make_server",
+    "preprocess_image",
+]

@@ -1,0 +1,122 @@
+"""Admission control: bounded-queue backpressure, SLO deadline expiry,
+and hysteretic degraded mode (docs/SERVING.md "SLO semantics").
+
+Philosophy — shed early, shed loudly: a request the service cannot
+finish in time is cheapest to reject at the door (QueueFull, before any
+host work) and second-cheapest to drop at the dispatch gate (expired,
+before a device forward is wasted on an answer nobody is waiting for).
+An overloaded service that queues unboundedly fails *every* request
+late; one that sheds keeps its p99 for the requests it accepts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class QueueFull(Exception):
+    """Admission rejected the request: the bounded queue is at capacity.
+    HTTP surface: 429."""
+
+
+class DeadlineExpired(Exception):
+    """The request could no longer meet its SLO deadline and was shed
+    before the forward.  HTTP surface: 504."""
+
+
+class EngineStopped(Exception):
+    """The engine is not accepting work (stopped or unhealthy).
+    HTTP surface: 503."""
+
+
+class AdmissionController:
+    """Queue-bound + degraded-mode policy for the serving engine.
+
+    Degraded mode is a hysteresis state machine over observed queue
+    depth so a single burst can't flap the service between quality
+    levels: it engages only after depth has stayed at or above
+    ``high * max_queue`` for ``engage_s`` seconds, and disengages only
+    after depth has stayed at or below ``low * max_queue`` for
+    ``disengage_s`` seconds.  In between (the dead band) the current
+    state holds.  ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        max_queue: int,
+        *,
+        high: float = 0.75,
+        low: float = 0.25,
+        engage_s: float = 2.0,
+        disengage_s: float = 5.0,
+        clock=time.monotonic,
+    ):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if not 0.0 <= low <= high <= 1.0:
+            raise ValueError(
+                f"need 0 <= low <= high <= 1, got low={low} high={high}")
+        self.max_queue = int(max_queue)
+        self._high = float(high) * self.max_queue
+        self._low = float(low) * self.max_queue
+        self._engage_s = float(engage_s)
+        self._disengage_s = float(disengage_s)
+        self._clock = clock
+        self._degraded = False
+        # Time the depth first crossed into the (high / low) region it
+        # is currently in; None = not in that region.
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+
+    # -- backpressure --------------------------------------------------
+
+    def try_admit(self, queue_depth: int) -> None:
+        """Raise :class:`QueueFull` when the bounded queue is full.
+        Called at submit time, before any per-request host work."""
+        if queue_depth >= self.max_queue:
+            raise QueueFull(
+                f"queue at capacity ({queue_depth}/{self.max_queue})")
+
+    # -- SLO expiry ----------------------------------------------------
+
+    @staticmethod
+    def expired(deadline: Optional[float], est_device_s: float,
+                now: float) -> bool:
+        """True when a request with monotonic ``deadline`` can no longer
+        meet it: even dispatching right now, the res bucket's estimated
+        device time lands past the deadline.  ``deadline=None`` never
+        expires."""
+        if deadline is None:
+            return False
+        return now + max(est_device_s, 0.0) > deadline
+
+    # -- degraded mode -------------------------------------------------
+
+    def observe(self, queue_depth: int, now: Optional[float] = None) -> bool:
+        """Feed one queue-depth observation; returns the (possibly
+        updated) degraded flag.  Call periodically — the engine's
+        dispatch loop does, including when idle."""
+        now = self._clock() if now is None else now
+        if queue_depth >= self._high:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            if (not self._degraded
+                    and now - self._above_since >= self._engage_s):
+                self._degraded = True
+        elif queue_depth <= self._low:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if (self._degraded
+                    and now - self._below_since >= self._disengage_s):
+                self._degraded = False
+        else:  # dead band: hold state, reset both region timers
+            self._above_since = None
+            self._below_since = None
+        return self._degraded
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded

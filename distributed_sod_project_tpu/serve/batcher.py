@@ -1,0 +1,149 @@
+"""Dynamic micro-batching: coalesce concurrent requests into the static
+batch buckets the compiled programs expect (docs/SERVING.md).
+
+The TPU-shaped constraint (same as eval/inference.py): the compiled
+forward only ever sees ONE static shape per (resolution, batch) bucket,
+so the request plane's job is to group same-resolution requests and pad
+up to a bucket — never to hand XLA a new shape.  The coalescing rule
+balances occupancy against latency: a batch dispatches the moment the
+largest bucket fills, or when its oldest request has waited
+``max_wait``, whichever comes first.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .admission import QueueFull
+
+
+@dataclass
+class Request:
+    """One in-flight prediction request.
+
+    ``tensor`` is the preprocessed (res, res, 3) float32 input — resize
+    + normalize happen in the submitting thread (the HTTP handler pool)
+    so the dispatch loop never does per-request host work.  ``deadline``
+    is monotonic-clock absolute (None = no SLO).  The result —
+    ``(pred, meta)`` with pred the float32 (H, W) saliency map at the
+    request's ORIGINAL resolution — or a shed/expiry exception is
+    delivered through ``future``.
+    """
+
+    tensor: np.ndarray
+    orig_hw: Tuple[int, int]
+    res_bucket: int
+    arrival: float
+    deadline: Optional[float] = None
+    degraded: bool = False
+    future: Future = field(default_factory=Future)
+    dispatch_t: float = 0.0
+
+
+class DynamicBatcher:
+    """Thread-safe coalescing queue over per-resolution-bucket deques.
+
+    ``get_batch`` (the dispatch loop's pull) blocks until it can return
+    ``(res_bucket, requests)`` where the group is FIFO within its
+    resolution bucket, never exceeds the largest batch bucket, and is
+    released early once the oldest member has waited ``max_wait_s``
+    (the max-wait deadline holds even when no further requests ever
+    arrive — a stalled queue still drains).  Resolution buckets are
+    served oldest-head-first so no bucket starves.
+    """
+
+    def __init__(self, batch_buckets, max_wait_s: float,
+                 max_queue: Optional[int] = None, clock=time.monotonic):
+        buckets = sorted(int(b) for b in batch_buckets)
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"bad batch_buckets {batch_buckets!r}")
+        self.batch_buckets = tuple(buckets)
+        self.max_batch = buckets[-1]
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = max_queue
+        self._clock = clock
+        self._queues: Dict[int, deque] = {}
+        self._cv = threading.Condition()
+        self._closed = False
+
+    # -- producer side -------------------------------------------------
+
+    def put(self, req: Request) -> None:
+        """Enqueue, or raise :class:`QueueFull`.  The depth check and
+        the append share the lock — N concurrent producers can never
+        overshoot ``max_queue`` the way a check-then-put from outside
+        would (each would read the same depth and all pass)."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self.max_queue is not None:
+                depth = sum(len(q) for q in self._queues.values())
+                if depth >= self.max_queue:
+                    raise QueueFull(
+                        f"queue at capacity ({depth}/{self.max_queue})")
+            self._queues.setdefault(req.res_bucket, deque()).append(req)
+            self._cv.notify_all()
+
+    def pending(self) -> int:
+        with self._cv:
+            return sum(len(q) for q in self._queues.values())
+
+    # -- consumer side -------------------------------------------------
+
+    def _oldest_head(self) -> Optional[Request]:
+        head = None
+        for q in self._queues.values():
+            if q and (head is None or q[0].arrival < head.arrival):
+                head = q[0]
+        return head
+
+    def get_batch(self, idle_timeout_s: float
+                  ) -> Optional[Tuple[int, List[Request]]]:
+        """Next coalesced group, or None after ``idle_timeout_s`` with
+        an empty queue (so the caller's loop can heartbeat)."""
+        idle_deadline = self._clock() + idle_timeout_s
+        with self._cv:
+            while True:
+                if self._closed:
+                    return None
+                head = self._oldest_head()
+                now = self._clock()
+                if head is None:
+                    if now >= idle_deadline:
+                        return None
+                    self._cv.wait(min(idle_deadline - now, 0.05))
+                    continue
+                q = self._queues[head.res_bucket]
+                wait_left = (head.arrival + self.max_wait_s) - now
+                if len(q) >= self.max_batch or wait_left <= 0:
+                    n = min(len(q), self.max_batch)
+                    return head.res_bucket, [q.popleft() for _ in range(n)]
+                self._cv.wait(min(wait_left, 0.05))
+
+    def pick_batch_bucket(self, n: int) -> int:
+        """Smallest static batch bucket that fits ``n`` requests (the
+        largest bucket when none does — callers never hand us more than
+        ``max_batch``, but stay total anyway)."""
+        for b in self.batch_buckets:
+            if n <= b:
+                return b
+        return self.max_batch
+
+    # -- shutdown ------------------------------------------------------
+
+    def close(self) -> List[Request]:
+        """Stop accepting work; returns every still-queued request so
+        the engine can fail their futures instead of leaking waiters."""
+        with self._cv:
+            self._closed = True
+            drained = [r for q in self._queues.values() for r in q]
+            self._queues.clear()
+            self._cv.notify_all()
+        return drained

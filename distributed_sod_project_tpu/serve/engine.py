@@ -1,0 +1,492 @@
+"""The serving engine: compiled-program cache + dynamic batching +
+hot weight reload (docs/SERVING.md).
+
+TF-Replicator's thesis (PAPERS.md) applied to serving: the user-facing
+abstraction is thin — ``submit(image) -> Future`` — and everything
+underneath maps onto the fixed-shape compiled programs the eval path
+already owns.  Three device-facing invariants:
+
+- **No request-time compilation.**  Every (resolution bucket, batch
+  bucket) program is AOT-compiled at startup via
+  ``jax.jit(...).lower().compile()`` from the SAME ``make_forward`` the
+  offline eval uses, so a served prediction is bitwise what ``test.py``
+  would produce for the same bucket shapes.
+- **Atomic weight swaps.**  The checkpoint watcher restores the newest
+  VALID step (resilience integrity layer) off-thread, then swaps the
+  whole variables pytree under a lock read once per dispatch — a
+  concurrent /predict sees entirely-old or entirely-new weights, never
+  a mix.
+- **Bounded device run-ahead.**  At most ``max_inflight`` dispatched-
+  but-unfetched batches; the host completion pool (the
+  ``run_inference`` overlap pattern, generalised to out-of-order
+  completion) fetches, resizes back to each request's original
+  resolution, and resolves futures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..eval.inference import (_resize_pred, flip_tta, make_forward,
+                              pad_to_batch)
+from ..utils.logging import get_logger
+from ..utils.observability import ServeStats
+from .admission import (AdmissionController, DeadlineExpired, EngineStopped,
+                        QueueFull)
+from .batcher import DynamicBatcher, Request
+
+
+def preprocess_image(image: np.ndarray, res: int, mean, std) -> np.ndarray:
+    """Request image → the compiled forward's input row: resize to the
+    (res, res) bucket (PIL bilinear, the eval-path convention), scale to
+    [0, 1], normalize.  uint8 in; float32 [0,1] arrays are accepted and
+    quantized through uint8 so the server and any offline comparator
+    see bit-identical inputs for the same source image."""
+    arr = np.asarray(image)
+    if arr.ndim != 3 or arr.shape[2] != 3:
+        raise ValueError(
+            f"expected an (H, W, 3) image, got shape {arr.shape}")
+    if arr.dtype != np.uint8:
+        arr = (np.clip(arr, 0.0, 1.0) * 255.0).round().astype(np.uint8)
+    from PIL import Image
+
+    im = Image.fromarray(arr)
+    if im.size != (res, res):
+        im = im.resize((res, res), Image.BILINEAR)
+    x = np.asarray(im, np.float32) / 255.0
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    return ((x - mean) / std).astype(np.float32)
+
+
+class InferenceEngine:
+    """Dynamic-batching inference engine over one model.
+
+    ``state`` is a restored ``TrainState`` (its ``eval_variables()`` —
+    EMA weights when tracked — are served) or a bare variables dict.
+    ``ckpt_dir`` plus ``cfg.serve.reload_poll_s > 0`` arms the hot
+    weight reload watcher (requires a TrainState for the restore
+    template).  Request lifecycle and knobs: docs/SERVING.md.
+    """
+
+    def __init__(self, cfg, model, state, *, ckpt_dir: Optional[str] = None,
+                 stats: Optional[ServeStats] = None, clock=time.monotonic):
+        if cfg.data.use_depth:
+            raise ValueError(
+                "serving the RGB-D (use_depth) configs is not wired up —"
+                " the /predict surface is RGB-only for now")
+        self.cfg = cfg
+        self.model = model
+        self.ckpt_dir = ckpt_dir
+        self.stats = stats or ServeStats()
+        self._clock = clock
+        self._log = get_logger()
+
+        sc = cfg.serve
+        self.res_buckets = tuple(sorted(
+            sc.resolution_buckets or (max(cfg.data.image_size),)))
+        self.batch_buckets = tuple(sorted(sc.batch_buckets))
+        self._mean = np.asarray(cfg.data.normalize_mean, np.float32)
+        self._std = np.asarray(cfg.data.normalize_std, np.float32)
+
+        self._template = state if hasattr(state, "eval_variables") else None
+        variables = (state.eval_variables()
+                     if self._template is not None else state)
+        self._var_lock = threading.Lock()
+        self._variables = jax.device_put(variables)
+        # Seed the reload watermark from the state's own step so the
+        # watcher doesn't "reload" the checkpoint we just restored.
+        self._loaded_step: Optional[int] = (
+            int(jax.device_get(state.step))
+            if self._template is not None else None)
+
+        self._fwd = make_forward(model)
+        # Compiled-program cache, AOT-warmed in start().  The key spells
+        # out everything that selects a distinct executable: model,
+        # static shapes, and the decoder resample implementation (a
+        # different compiled program per configs/base.py knob).
+        self.programs: Dict[Tuple[str, int, int, str], object] = {}
+
+        self.batcher = DynamicBatcher(
+            self.batch_buckets, sc.max_wait_ms / 1000.0,
+            max_queue=sc.max_queue, clock=clock)
+        self.admission = AdmissionController(
+            sc.max_queue, high=sc.degraded_high, low=sc.degraded_low,
+            engage_s=sc.degraded_engage_s,
+            disengage_s=sc.degraded_disengage_s, clock=clock)
+
+        self._est_lock = threading.Lock()
+        self._est_s: Dict[int, float] = {}  # res bucket → EWMA device s
+
+        self._stop = threading.Event()
+        self._running = False
+        self._inflight_sem = threading.Semaphore(sc.max_inflight)
+        self._inflight_lock = threading.Lock()
+        self._inflight_n = 0
+        self._dispatch_thread: Optional[threading.Thread] = None
+        self._reload_thread: Optional[threading.Thread] = None
+        self._watchdog = None
+        self._fetch_pool = None
+        self._post_pool = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "InferenceEngine":
+        if self._running:
+            return self
+        from concurrent.futures import ThreadPoolExecutor
+
+        sc = self.cfg.serve
+        self.warm()
+        self._stop.clear()
+        self._fetch_pool = ThreadPoolExecutor(
+            max_workers=max(sc.max_inflight, 1),
+            thread_name_prefix="serve-fetch")
+        self._post_pool = ThreadPoolExecutor(
+            max_workers=max(sc.post_workers, 1),
+            thread_name_prefix="serve-post")
+        if sc.watchdog_deadline_s > 0:
+            from ..resilience.watchdog import StepWatchdog
+
+            self._watchdog = StepWatchdog(
+                deadline_s=sc.watchdog_deadline_s,
+                on_stall=lambda msg: self.stats.set_health(False, msg))
+            self._watchdog.start()
+        if self.ckpt_dir and sc.reload_poll_s > 0:
+            if self._template is None:
+                raise ValueError(
+                    "hot weight reload needs a TrainState restore "
+                    "template — construct the engine from a TrainState "
+                    "(from_checkpoint does)")
+            self._reload_thread = threading.Thread(
+                target=self._reload_loop, name="serve-reload", daemon=True)
+            self._reload_thread.start()
+        self._running = True
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name="serve-dispatch", daemon=True)
+        self._dispatch_thread.start()
+        return self
+
+    def warm(self) -> int:
+        """AOT-compile every (resolution, batch) bucket program so no
+        request ever pays a compile; returns the program count."""
+        name = self.cfg.model.name
+        impl = self.cfg.model.resample_impl
+        with self._var_lock:
+            variables = self._variables
+        for res in self.res_buckets:
+            for bb in self.batch_buckets:
+                key = (name, res, bb, impl)
+                if key in self.programs:
+                    continue
+                batch = {"image": np.zeros((bb, res, res, 3), np.float32)}
+                t0 = time.perf_counter()
+                self.programs[key] = self._fwd.lower(
+                    variables, batch).compile()
+                self._log.info(
+                    "serve: warmed program %s in %.1fs", key,
+                    time.perf_counter() - t0)
+        return len(self.programs)
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        self._stop.set()
+        for r in self.batcher.close():
+            self.stats.inc("errors")
+            self._fail(r, EngineStopped("engine stopped"))
+        if self._dispatch_thread is not None:
+            self._dispatch_thread.join(timeout=10.0)
+            self._dispatch_thread = None
+        if self._reload_thread is not None:
+            self._reload_thread.join(timeout=10.0)
+            self._reload_thread = None
+        if self._fetch_pool is not None:
+            self._fetch_pool.shutdown(wait=True)
+            self._fetch_pool = None
+        if self._post_pool is not None:
+            self._post_pool.shutdown(wait=True)
+            self._post_pool = None
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+
+    @classmethod
+    def from_checkpoint(cls, ckpt_dir: str, config_name: Optional[str] = None,
+                        overrides=(), step: Optional[int] = None,
+                        **kw) -> "InferenceEngine":
+        """Checkpoint directory → ready-to-start engine (config sidecar
+        aware, via the shared ``restore_for_eval``)."""
+        from ..eval.inference import restore_for_eval
+
+        cfg, model, state = restore_for_eval(
+            ckpt_dir, config_name=config_name, overrides=overrides,
+            step=step)
+        return cls(cfg, model, state, ckpt_dir=ckpt_dir, **kw)
+
+    @classmethod
+    def from_random_init(cls, cfg, **kw) -> "InferenceEngine":
+        """Randomly-initialised engine for a config — the
+        smoke/bench/loadgen posture where the serving machinery, not a
+        particular checkpoint, is under test.  The single bring-up used
+        by tools/serve.py --init-random AND bench.py --mode serve, so
+        the two can't drift apart."""
+        from ..models import build_model
+        from ..train import build_optimizer, create_train_state
+
+        model = build_model(cfg.model)
+        tx, _ = build_optimizer(cfg.optim, 1)
+        h, w = cfg.data.image_size
+        probe = {"image": np.zeros((1, h, w, 3), np.float32)}
+        if cfg.data.use_depth:
+            probe["depth"] = np.zeros((1, h, w, 1), np.float32)
+        state = create_train_state(jax.random.key(cfg.seed), model, tx,
+                                   probe, ema=cfg.optim.ema_decay > 0)
+        return cls(cfg, model, state, **kw)
+
+    # -- request plane -------------------------------------------------
+
+    def choose_res_bucket(self, h: int, w: int, degraded: bool) -> int:
+        if degraded:
+            return self.res_buckets[0]
+        side = max(h, w)
+        for r in self.res_buckets:
+            if side <= r:
+                return r
+        return self.res_buckets[-1]
+
+    def submit(self, image: np.ndarray,
+               slo_ms: Optional[float] = None):
+        """Enqueue one prediction; returns a ``concurrent.futures.Future``
+        resolving to ``(pred, meta)`` — pred float32 (H, W) at the
+        request's original resolution.  Raises :class:`QueueFull` /
+        :class:`EngineStopped` at the door (nothing enqueued)."""
+        if not self._running:
+            raise EngineStopped("engine not running")
+        if not self.stats.healthy:
+            raise EngineStopped(
+                f"engine unhealthy: {self.stats.health_reason}")
+        self.stats.inc("submitted")
+        try:
+            self.admission.try_admit(self.batcher.pending())
+        except QueueFull:
+            self.stats.inc("shed")
+            raise
+        degraded = self.admission.degraded
+        try:
+            arr = np.asarray(image)
+            res = self.choose_res_bucket(arr.shape[0], arr.shape[1],
+                                         degraded)
+            tensor = preprocess_image(arr, res, self._mean, self._std)
+        except Exception:
+            # Malformed input: terminate the request in the accounting
+            # (the engine owns ALL terminal counters, so the
+            # served+shed+expired+errors == submitted invariant holds
+            # for 400s too) and let the front end surface it.
+            self.stats.inc("errors")
+            raise
+        now = self._clock()
+        slo = self.cfg.serve.slo_ms if slo_ms is None else slo_ms
+        req = Request(
+            tensor=tensor, orig_hw=(int(arr.shape[0]), int(arr.shape[1])),
+            res_bucket=res, arrival=now,
+            deadline=(now + slo / 1000.0) if slo and slo > 0 else None,
+            degraded=degraded)
+        try:
+            # The batcher re-checks the bound under ITS lock (the
+            # try_admit above is the cheap pre-preprocess gate; N
+            # concurrent submitters could all have passed it).
+            self.batcher.put(req)
+        except QueueFull:
+            self.stats.inc("shed")
+            raise
+        except RuntimeError as e:  # closed: stop() raced this submit
+            self.stats.inc("errors")
+            raise EngineStopped(str(e)) from e
+        self.stats.set_queue_depth(self.batcher.pending())
+        return req.future
+
+    def predict(self, image: np.ndarray, slo_ms: Optional[float] = None,
+                timeout: Optional[float] = None):
+        """Blocking convenience wrapper over :meth:`submit`."""
+        return self.submit(image, slo_ms=slo_ms).result(
+            timeout=timeout or self.cfg.serve.request_timeout_s)
+
+    # -- dispatch loop -------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            if self._watchdog is not None:
+                self._watchdog.beat()
+            got = self.batcher.get_batch(idle_timeout_s=0.1)
+            depth = self.batcher.pending()
+            self.stats.set_queue_depth(depth)
+            self.stats.set_degraded(self.admission.observe(depth))
+            if got is None:
+                continue
+            res, reqs = got
+            with self._est_lock:
+                est = self._est_s.get(res, 0.0)
+            now = self._clock()
+            live = []
+            for r in reqs:
+                if AdmissionController.expired(r.deadline, est, now):
+                    self.stats.inc("expired")
+                    self._fail(r, DeadlineExpired(
+                        f"deadline missed before dispatch (est device "
+                        f"{est * 1000:.1f}ms)"))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            bb = self.batcher.pick_batch_bucket(len(live))
+            batch = pad_to_batch(
+                {"image": np.stack([r.tensor for r in live])}, bb)
+            with self._var_lock:
+                variables = self._variables
+                step = self._loaded_step
+            tta = self.cfg.serve.tta and not self.admission.degraded
+            # Bound run-ahead WITHOUT beating the watchdog while we
+            # wait: a wedged device keeps this semaphore drained, the
+            # beats stop, and /healthz flips — the intended signal.
+            acquired = False
+            while not self._stop.is_set():
+                if self._inflight_sem.acquire(timeout=0.25):
+                    acquired = True
+                    break
+            if not acquired:
+                for r in live:
+                    self.stats.inc("errors")
+                    self._fail(r, EngineStopped("engine stopped"))
+                continue
+            t0 = self._clock()
+            for r in live:
+                r.dispatch_t = t0
+                self.stats.queue_ms.observe((t0 - r.arrival) * 1000.0)
+            # Count the in-flight slot the moment the semaphore is held
+            # so the error path's _release_inflight always undoes a
+            # matching increment (the gauge must never go negative-ish
+            # while OTHER batches are genuinely in flight).
+            with self._inflight_lock:
+                self._inflight_n += 1
+                self.stats.set_inflight(self._inflight_n)
+            try:
+                probs = self._forward(res, bb, variables, batch, tta)
+            except Exception as e:  # noqa: BLE001 — per-request surface
+                self._release_inflight()
+                self._log.exception("serve: dispatch failed")
+                for r in live:
+                    self.stats.inc("errors")
+                    self._fail(r, e)
+                continue
+            self.stats.observe_batch(len(live), bb)
+            meta = {"res_bucket": res, "batch_bucket": bb, "tta": tta,
+                    "step": step}
+            self._fetch_pool.submit(self._complete, probs, live, meta, t0)
+
+    def _forward(self, res: int, bb: int, variables, batch, tta: bool):
+        key = (self.cfg.model.name, res, bb, self.cfg.model.resample_impl)
+        call = self.programs.get(key, self._fwd)
+
+        def fn(b):
+            return call(variables, b)
+
+        # Same wrapper the offline eval uses — serving TTA can never
+        # drift from test.py's convention.
+        return (flip_tta(fn) if tta else fn)(batch)
+
+    # -- completion (host) ---------------------------------------------
+
+    def _release_inflight(self) -> None:
+        self._inflight_sem.release()
+        with self._inflight_lock:
+            self._inflight_n = max(self._inflight_n - 1, 0)
+            self.stats.set_inflight(self._inflight_n)
+
+    def _complete(self, probs, live, meta, t0: float) -> None:
+        try:
+            arr = np.asarray(probs)[: len(live)]  # the blocking fetch
+            dev_ms = (self._clock() - t0) * 1000.0
+            res = meta["res_bucket"]
+            with self._est_lock:
+                old = self._est_s.get(res)
+                now_s = dev_ms / 1000.0
+                self._est_s[res] = (now_s if old is None
+                                    else 0.8 * old + 0.2 * now_s)
+            for _ in live:
+                self.stats.device_ms.observe(dev_ms)
+            for j, r in enumerate(live):
+                self._post_pool.submit(
+                    self._finish, r, arr[j], dict(meta, device_ms=dev_ms))
+        except Exception as e:  # noqa: BLE001 — per-request surface
+            self._log.exception("serve: completion failed")
+            for r in live:
+                self.stats.inc("errors")
+                self._fail(r, e)
+        finally:
+            self._release_inflight()
+
+    def _finish(self, r: Request, row: np.ndarray, meta: dict) -> None:
+        try:
+            pred = _resize_pred(row, r.orig_hw)
+            e2e = (self._clock() - r.arrival) * 1000.0
+            meta.update(
+                degraded=r.degraded,
+                queue_ms=round((r.dispatch_t - r.arrival) * 1000.0, 3),
+                e2e_ms=round(e2e, 3))
+            self.stats.e2e_ms.observe(e2e)
+            self.stats.inc("served")
+            self._set_result(r, (pred, meta))
+        except Exception as e:  # noqa: BLE001 — per-request surface
+            self.stats.inc("errors")
+            self._fail(r, e)
+
+    @staticmethod
+    def _set_result(r: Request, value) -> None:
+        try:
+            r.future.set_result(value)
+        except Exception:  # noqa: BLE001 — abandoned/cancelled future
+            pass
+
+    @staticmethod
+    def _fail(r: Request, exc: Exception) -> None:
+        try:
+            r.future.set_exception(exc)
+        except Exception:  # noqa: BLE001 — abandoned/cancelled future
+            pass
+
+    # -- hot weight reload ---------------------------------------------
+
+    def _reload_loop(self) -> None:
+        from ..ckpt import CheckpointManager
+
+        mgr = CheckpointManager(self.ckpt_dir, async_save=False)
+        try:
+            while not self._stop.wait(self.cfg.serve.reload_poll_s):
+                try:
+                    self._maybe_reload(mgr)
+                except Exception:  # noqa: BLE001 — keep serving old weights
+                    self._log.exception(
+                        "serve: weight reload failed; keeping current "
+                        "weights")
+        finally:
+            mgr.close()
+
+    def _maybe_reload(self, mgr) -> None:
+        step = mgr.latest_step()  # newest VALID (integrity-gated)
+        if step is None or step == self._loaded_step:
+            return
+        mgr.reload()  # the step landed after the manager's last scan
+        state = mgr.restore(self._template, step)
+        variables = jax.device_put(state.eval_variables())
+        with self._var_lock:
+            self._variables = variables
+            self._loaded_step = step
+        self.stats.inc("reloads")
+        self._log.info("serve: hot-reloaded weights from step %d", step)

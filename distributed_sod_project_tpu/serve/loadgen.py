@@ -1,0 +1,188 @@
+"""Open/closed-loop load generator for the serving engine
+(docs/SERVING.md "Measuring throughput vs p99").
+
+Stdlib-only (urllib + threads) so it runs anywhere the server does.
+Two disciplines, because they answer different questions:
+
+- **closed** loop — N workers, each sending back-to-back.  Measures
+  capacity: the throughput the service sustains at a given concurrency
+  and the latency it costs.  Latency under closed load is flattering
+  (the generator slows down with the server — coordinated omission).
+- **open** loop — requests fired on a fixed schedule at ``rps``
+  regardless of completions, the arrival process real traffic has.
+  Measures SLO behavior: p99 and shed rate at an offered rate, which is
+  what the throughput-vs-p99 curve in tools/tpu_agenda_r7.sh sweeps.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def encode_image(rng: np.random.RandomState, h: int, w: int) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, rng.randint(0, 256, size=(h, w, 3), dtype=np.uint8))
+    return buf.getvalue()
+
+
+def wait_ready(base_url: str, timeout_s: float = 60.0,
+               poll_s: float = 0.25) -> bool:
+    """Poll /healthz until it answers 200 (engine warmed and serving)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(base_url + "/healthz",
+                                        timeout=5.0) as r:
+                if r.status == 200:
+                    return True
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(poll_s)
+    return False
+
+
+def _one(base_url: str, body: bytes, slo_ms: Optional[float],
+         timeout_s: float) -> Tuple[str, float]:
+    """One /predict round-trip → (outcome, latency_ms).  Outcomes:
+    ok | shed | expired | unhealthy | error."""
+    headers = {"Content-Type": "application/x-npy"}
+    if slo_ms:
+        headers["X-SLO-MS"] = str(slo_ms)
+    req = urllib.request.Request(base_url + "/predict", data=body,
+                                 headers=headers, method="POST")
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            r.read()
+            out = "ok" if r.status == 200 else "error"
+    except urllib.error.HTTPError as e:
+        e.read()
+        out = {429: "shed", 504: "expired", 503: "unhealthy"}.get(
+            e.code, "error")
+    except (urllib.error.URLError, OSError):
+        out = "error"
+    return out, (time.monotonic() - t0) * 1000.0
+
+
+def _percentile(sorted_ms: List[float], p: float) -> float:
+    if not sorted_ms:
+        return 0.0
+    i = min(int(p * len(sorted_ms)), len(sorted_ms) - 1)
+    return sorted_ms[i]
+
+
+def run_loadgen(
+    base_url: str,
+    mode: str = "closed",
+    concurrency: int = 4,
+    requests: int = 50,
+    rps: float = 10.0,
+    duration_s: float = 5.0,
+    sizes: Tuple[Tuple[int, int], ...] = ((320, 320),),
+    seed: int = 0,
+    slo_ms: float = 0.0,
+    timeout_s: float = 60.0,
+) -> Dict[str, float]:
+    """Drive ``base_url`` and return a summary dict (see module doc for
+    the open/closed semantics).  Closed loop sends exactly ``requests``
+    total across ``concurrency`` workers; open loop offers ``rps`` for
+    ``duration_s``.  Latency percentiles are exact over OK responses
+    (client-side e2e, including HTTP)."""
+    if mode not in ("open", "closed"):
+        raise ValueError(f"mode must be open|closed, got {mode!r}")
+    rng = np.random.RandomState(seed)
+    # Pre-encode a body pool: the generator must never bottleneck on
+    # numpy/npy encoding while it is supposed to be offering load.
+    pool = [encode_image(rng, h, w)
+            for h, w in (sizes * ((16 // max(len(sizes), 1)) + 1))[:16]]
+    lock = threading.Lock()
+    outcomes: Dict[str, int] = {"ok": 0, "shed": 0, "expired": 0,
+                                "unhealthy": 0, "error": 0}
+    ok_ms: List[float] = []
+
+    def record(out: str, ms: float) -> None:
+        with lock:
+            outcomes[out] += 1
+            if out == "ok":
+                ok_ms.append(ms)
+
+    t_start = time.monotonic()
+    if mode == "closed":
+        remaining = [int(requests)]
+
+        def worker(widx: int) -> None:
+            i = widx
+            while True:
+                with lock:
+                    if remaining[0] <= 0:
+                        return
+                    remaining[0] -= 1
+                record(*_one(base_url, pool[i % len(pool)],
+                             slo_ms or None, timeout_s))
+                i += concurrency
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(max(int(concurrency), 1))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sent = int(requests)
+    else:
+        # Fixed worker pool, not thread-per-request: at a few hundred
+        # rps the spawn cost inflates the very p99 the sweep measures,
+        # and thread exhaustion kills the leg.  The pool bounds
+        # client-side concurrency; a scheduled arrival that finds every
+        # worker blocked queues in the executor and its lateness shows
+        # up in latency — the open-loop signal, not a generator stall.
+        from concurrent.futures import ThreadPoolExecutor
+
+        interval = 1.0 / max(float(rps), 1e-6)
+        n = max(int(float(duration_s) * float(rps)), 1)
+        workers = min(256, max(8, int(float(rps) * min(timeout_s, 10.0))))
+        futures = []
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            for i in range(n):
+                delay = (t_start + i * interval) - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                futures.append(ex.submit(
+                    lambda i=i: record(*_one(
+                        base_url, pool[i % len(pool)], slo_ms or None,
+                        timeout_s))))
+            for f in futures:
+                f.result()
+        sent = n
+    elapsed = time.monotonic() - t_start
+
+    ok_ms.sort()
+    done = sum(outcomes.values())
+    out = {
+        "mode": mode,
+        "sent": sent,
+        "done": done,
+        "elapsed_s": round(elapsed, 3),
+        "throughput_rps": round(outcomes["ok"] / elapsed, 2) if elapsed
+        else 0.0,
+        "p50_ms": round(_percentile(ok_ms, 0.50), 2),
+        "p95_ms": round(_percentile(ok_ms, 0.95), 2),
+        "p99_ms": round(_percentile(ok_ms, 0.99), 2),
+        "mean_ms": round(sum(ok_ms) / len(ok_ms), 2) if ok_ms else 0.0,
+        **outcomes,
+    }
+    if mode == "open":
+        out["offered_rps"] = round(float(rps), 2)
+    return out
+
+
+def fetch_stats(base_url: str, timeout_s: float = 10.0) -> Dict[str, float]:
+    with urllib.request.urlopen(base_url + "/stats", timeout=timeout_s) as r:
+        return json.loads(r.read().decode())

@@ -1,0 +1,202 @@
+"""Stdlib-only HTTP front end for the serving engine (docs/SERVING.md).
+
+Endpoints:
+
+- ``POST /predict`` — body: ``.npy`` bytes of an (H, W, 3) uint8 image
+  (float32 in [0,1] accepted, quantized through uint8).  Optional
+  ``X-SLO-MS`` header sets a per-request deadline.  200 responds with
+  ``.npy`` float32 (H, W) saliency at the ORIGINAL resolution plus
+  ``X-Degraded`` / ``X-Res-Bucket`` / ``X-Batch-Bucket`` /
+  ``X-Queue-MS`` / ``X-Device-MS`` / ``X-E2E-MS`` headers.  Overload
+  sheds with 429, a missed SLO with 504, an unhealthy engine with 503.
+- ``GET /healthz``  — 200 while the dispatch loop's resilience-watchdog
+  heartbeat is live, 503 once it stalls (or the engine stopped).
+- ``GET /metrics``  — Prometheus text (ServeStats: latency histograms,
+  shed/expired counters, batch occupancy, degraded/health gauges).
+- ``GET /stats``    — the same telemetry as one JSON object.
+
+No framework on purpose: the serving story must not add dependencies
+the training image doesn't have (stdlib ``http.server`` + threads).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import signal
+import threading
+from concurrent.futures import TimeoutError as FutTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from .admission import DeadlineExpired, EngineStopped, QueueFull
+
+MAX_BODY_BYTES = 64 * 1024 * 1024  # reject absurd uploads before np.load
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "dsod-serve/1.0"
+
+    @property
+    def engine(self):
+        return self.server.engine
+
+    def log_message(self, fmt, *args):  # route access logs to our logger
+        get_logger().debug("http: " + fmt, *args)
+
+    # -- helpers -------------------------------------------------------
+
+    def _send(self, code: int, body: bytes, content_type: str,
+              headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json")
+
+    # -- GET -----------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path == "/healthz":
+            stats = self.engine.stats
+            if stats.healthy and self.engine._running:
+                self._send_json(200, {"status": "ok"})
+            else:
+                self._send_json(503, {
+                    "status": "unhealthy",
+                    "reason": stats.health_reason or "engine stopped"})
+        elif self.path == "/metrics":
+            self._send(200, self.engine.stats.render_prometheus().encode(),
+                       "text/plain; version=0.0.4")
+        elif self.path == "/stats":
+            self._send_json(200, self.engine.stats.snapshot())
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    # -- POST ----------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        if self.path != "/predict":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if not 0 < length <= MAX_BODY_BYTES:
+                # The body was never read: a keep-alive client's next
+                # request would otherwise be parsed out of the unread
+                # image bytes.  Drop the connection with the rejection.
+                self.close_connection = True
+                self._send_json(400, {
+                    "error": f"Content-Length {length} outside "
+                             f"(0, {MAX_BODY_BYTES}]"})
+                return
+            body = self.rfile.read(length)
+            try:
+                image = np.load(io.BytesIO(body), allow_pickle=False)
+            except Exception as e:  # noqa: BLE001 — client error surface
+                self._send_json(400, {"error": f"body is not .npy: {e}"})
+                return
+            slo = self.headers.get("X-SLO-MS")
+            fut = self.engine.submit(
+                image, slo_ms=float(slo) if slo is not None else None)
+            pred, meta = fut.result(
+                timeout=self.engine.cfg.serve.request_timeout_s)
+            buf = io.BytesIO()
+            np.save(buf, pred)
+            self._send(200, buf.getvalue(), "application/x-npy", headers=[
+                ("X-Degraded", "1" if meta.get("degraded") else "0"),
+                ("X-Res-Bucket", str(meta.get("res_bucket"))),
+                ("X-Batch-Bucket", str(meta.get("batch_bucket"))),
+                ("X-Queue-MS", f"{meta.get('queue_ms', 0):.3f}"),
+                ("X-Device-MS", f"{meta.get('device_ms', 0):.3f}"),
+                ("X-E2E-MS", f"{meta.get('e2e_ms', 0):.3f}"),
+            ])
+        except QueueFull as e:
+            self._send_json(429, {"error": str(e), "kind": "shed"})
+        except DeadlineExpired as e:
+            self._send_json(504, {"error": str(e), "kind": "expired"})
+        except EngineStopped as e:
+            self._send_json(503, {"error": str(e), "kind": "stopped"})
+        except ValueError as e:
+            self._send_json(400, {"error": str(e)})
+        except FutTimeout:
+            # The ENGINE owns the terminal counters; this request is
+            # still live and will be counted (served/errors) when its
+            # batch completes — counting it here too would terminate
+            # one request in two counters.
+            self._send_json(504, {
+                "error": "response not ready within "
+                         f"{self.engine.cfg.serve.request_timeout_s}s",
+                "kind": "timeout"})
+        except Exception as e:  # noqa: BLE001 — last-resort 500
+            # No counter here either: every exception a future relays
+            # was already terminal-counted by the engine when it failed
+            # the request.
+            get_logger().exception("predict handler failed")
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class SODServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, engine):
+        self.engine = engine
+        super().__init__(addr, ServeHandler)
+
+
+def make_server(engine, host: str, port: int) -> SODServer:
+    """Bind (``port=0`` → ephemeral; read ``server_address[1]``)."""
+    return SODServer((host, port), engine)
+
+
+def serve_forever(engine, host: str, port: int,
+                  port_file: str = None) -> int:
+    """Start the engine + HTTP server and block until SIGTERM/SIGINT;
+    returns 0 on a clean drain (the contract tools/t1.sh smokes)."""
+    log = get_logger()
+    engine.start()
+    srv = make_server(engine, host, port)
+    bound = srv.server_address[1]
+    if port_file:
+        # Atomic publish: pollers watch for the file's existence and
+        # read immediately, so it must never be visible half-written.
+        import os
+
+        tmp = port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(bound))
+        os.replace(tmp, port_file)
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        log.info("serve: signal %s — draining", signum)
+        stop.set()
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(s, _sig)
+        except ValueError:  # non-main thread (tests drive stop directly)
+            pass
+    t = threading.Thread(target=srv.serve_forever, name="serve-http",
+                         daemon=True)
+    t.start()
+    log.info("serve: listening on http://%s:%d (buckets res=%s batch=%s)",
+             host, bound, engine.res_buckets, engine.batch_buckets)
+    try:
+        while not stop.wait(0.2):
+            pass
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        engine.stop()
+        log.info("serve: shut down cleanly")
+    return 0

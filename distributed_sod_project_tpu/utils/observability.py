@@ -93,6 +93,217 @@ class PipelineStats:
             return out
 
 
+class LatencyHistogram:
+    """Fixed-bucket latency histogram (milliseconds) with Prometheus
+    rendering and bucket-interpolated percentiles.
+
+    Prometheus-shaped on purpose: cumulative ``le`` buckets plus
+    ``_sum``/``_count``, so ``render_prometheus`` is a straight dump and
+    any scrape-side histogram_quantile() agrees with the in-process
+    ``percentile()`` (both interpolate linearly inside a bucket).
+    """
+
+    BOUNDS_MS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+                 1000.0, 2000.0, 5000.0, 10000.0)
+
+    def __init__(self, bounds=BOUNDS_MS):
+        self._bounds = tuple(float(b) for b in bounds)
+        self._counts = [0] * (len(self._bounds) + 1)  # +1: overflow
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, ms: float) -> None:
+        ms = float(ms)
+        with self._lock:
+            self._sum += ms
+            self._n += 1
+            for i, b in enumerate(self._bounds):
+                if ms <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 1] → estimated latency ms (linear interpolation
+        inside the bucket; the overflow bucket reports its lower
+        bound — an honest floor, not an invented tail)."""
+        with self._lock:
+            if not self._n:
+                return 0.0
+            target = p * self._n
+            cum = 0
+            lo = 0.0
+            for i, b in enumerate(self._bounds):
+                c = self._counts[i]
+                if cum + c >= target and c:
+                    frac = (target - cum) / c
+                    return lo + (b - lo) * min(max(frac, 0.0), 1.0)
+                cum += c
+                lo = b
+            return self._bounds[-1]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            n, s = self._n, self._sum
+        return {
+            "count": float(n),
+            "sum_ms": round(s, 3),
+            "p50_ms": round(self.percentile(0.50), 3),
+            "p95_ms": round(self.percentile(0.95), 3),
+            "p99_ms": round(self.percentile(0.99), 3),
+        }
+
+    def prom_lines(self, name: str) -> list:
+        with self._lock:
+            counts = list(self._counts)
+            s, n = self._sum, self._n
+        lines = [f"# TYPE {name} histogram"]
+        cum = 0
+        for b, c in zip(self._bounds, counts):
+            cum += c
+            lines.append(f'{name}_bucket{{le="{b:g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {n}')
+        lines.append(f"{name}_sum {s:g}")
+        lines.append(f"{name}_count {n}")
+        return lines
+
+
+class ServeStats:
+    """Thread-safe serving telemetry (serve/ subsystem; docs/SERVING.md).
+
+    Request accounting invariant — checked by tests/test_serving.py and
+    worth checking on any live deployment's /metrics:
+
+        served + shed + expired + errors == submitted   (eventually)
+
+    every submitted request terminates in exactly one of the four.
+    Latency histograms split the end-to-end path at its two seams:
+    ``queue_ms`` (arrival → dispatch: coalescing wait + backlog),
+    ``device_ms`` (dispatch → device fetch complete), ``e2e_ms``
+    (arrival → response ready).  Batch occupancy records how full the
+    static batch buckets run (occupancy_sum / occupancy_batches — the
+    padding tax is 1 minus that ratio over the bucket sizes).
+    """
+
+    COUNTERS = ("submitted", "served", "shed", "expired", "errors",
+                "batches", "reloads", "degraded_entered", "degraded_exited")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {k: 0 for k in self.COUNTERS}
+        self.queue_ms = LatencyHistogram()
+        self.device_ms = LatencyHistogram()
+        self.e2e_ms = LatencyHistogram()
+        self._occ_sum = 0
+        self._occ_slots = 0
+        self._queue_depth = 0
+        self._inflight = 0
+        self._degraded = False
+        self._healthy = True
+        self._health_reason = ""
+
+    def inc(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] += n
+
+    def observe_batch(self, occupancy: int, bucket: int) -> None:
+        with self._lock:
+            self._counts["batches"] += 1
+            self._occ_sum += int(occupancy)
+            self._occ_slots += int(bucket)
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._queue_depth = int(depth)
+
+    def set_inflight(self, n: int) -> None:
+        with self._lock:
+            self._inflight = int(n)
+
+    def set_degraded(self, degraded: bool) -> None:
+        with self._lock:
+            if degraded and not self._degraded:
+                self._counts["degraded_entered"] += 1
+            elif not degraded and self._degraded:
+                self._counts["degraded_exited"] += 1
+            self._degraded = bool(degraded)
+
+    def set_health(self, healthy: bool, reason: str = "") -> None:
+        with self._lock:
+            self._healthy = bool(healthy)
+            self._health_reason = reason
+
+    @property
+    def healthy(self) -> bool:
+        with self._lock:
+            return self._healthy
+
+    @property
+    def health_reason(self) -> str:
+        with self._lock:
+            return self._health_reason
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+    def counter(self, key: str) -> int:
+        with self._lock:
+            return self._counts[key]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = {k: float(v) for k, v in self._counts.items()}
+            out["queue_depth"] = float(self._queue_depth)
+            out["inflight"] = float(self._inflight)
+            out["degraded"] = float(self._degraded)
+            out["healthy"] = float(self._healthy)
+            if self._occ_slots:
+                out["batch_occupancy"] = round(
+                    self._occ_sum / self._occ_slots, 4)
+        for name, h in (("queue", self.queue_ms),
+                        ("device", self.device_ms),
+                        ("e2e", self.e2e_ms)):
+            for k, v in h.snapshot().items():
+                out[f"{name}_{k}"] = v
+        return out
+
+    def render_prometheus(self) -> str:
+        """The /metrics payload (Prometheus text exposition format)."""
+        with self._lock:
+            counts = dict(self._counts)
+            gauges = {
+                "dsod_serve_queue_depth": self._queue_depth,
+                "dsod_serve_inflight": self._inflight,
+                "dsod_serve_degraded": int(self._degraded),
+                "dsod_serve_healthy": int(self._healthy),
+            }
+            occ = (self._occ_sum, self._occ_slots)
+        lines = []
+        for k, v in sorted(counts.items()):
+            name = f"dsod_serve_{k}_total"
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {v}")
+        for name, v in sorted(gauges.items()):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {v}")
+        lines.append("# TYPE dsod_serve_batch_occupancy_sum counter")
+        lines.append(f"dsod_serve_batch_occupancy_sum {occ[0]}")
+        lines.append("# TYPE dsod_serve_batch_slots_sum counter")
+        lines.append(f"dsod_serve_batch_slots_sum {occ[1]}")
+        lines += self.queue_ms.prom_lines("dsod_serve_queue_latency_ms")
+        lines += self.device_ms.prom_lines("dsod_serve_device_latency_ms")
+        lines += self.e2e_ms.prom_lines("dsod_serve_e2e_latency_ms")
+        return "\n".join(lines) + "\n"
+
+
 class MetricWriter:
     """Rank-0-gated scalar writer over clu.metric_writers."""
 

@@ -371,3 +371,44 @@ def test_bench_set_override_chunking_rejected_off_train(tmp_path,
             "--image-size", "32", "--set", "data.synthetic_size=16",
             "--set", "steps_per_dispatch=2",
         ])
+
+
+def test_bench_serve_mode_rejects_step_chunking():
+    """serve never builds the chunked train program; the generic
+    non-train guard must cover the new mode too."""
+    import pytest
+
+    import bench
+
+    with pytest.raises(SystemExit):
+        bench.main(["--mode", "serve", "--steps-per-dispatch", "2"])
+
+
+def test_bench_serve_mode_reports_latency_fields(tmp_path, capsys,
+                                                 monkeypatch):
+    """--mode serve routes the loadgen summary through _report: one
+    JSON line with imgs/sec plus the latency-tail extras, keyed -serve
+    so serving baselines never contaminate train/eval keys."""
+    import bench
+
+    monkeypatch.setenv("DSOD_BENCH_BASELINE", str(tmp_path / "base.json"))
+
+    def fake_bench_serve(args, cfg):
+        assert cfg.serve.max_queue == 5  # --set reached the serve section
+        return bench._report(args, 12.0, "cpu", 1, mode="serve",
+                             p50_ms=1.0, p95_ms=2.0, p99_ms=3.0)
+
+    monkeypatch.setattr(bench, "_bench_serve", fake_bench_serve)
+    rc = bench.main([
+        "--device", "cpu", "--mode", "serve", "--steps", "4",
+        "--watchdog", "0", "--probe-timeout", "0",
+        "--set", "serve.max_queue=5",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["unit"] == "images/sec/chip"
+    assert out["value"] == 12.0
+    assert out["p99_ms"] == 3.0
+    assert "serve_throughput" in out["metric"]
+    key = json.loads((tmp_path / "base.json").read_text())
+    assert all(k.endswith("-serve") for k in key)

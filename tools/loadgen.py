@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Open/closed-loop load generator against a running serve instance —
+prints ONE JSON summary line (docs/SERVING.md "Measuring throughput vs
+p99").  No jax import: runs anywhere, including next to a TPU-bound
+server.
+
+    # capacity probe: 8 closed-loop workers, 200 requests
+    python tools/loadgen.py --url http://127.0.0.1:8080 \
+        --mode closed --concurrency 8 --requests 200
+
+    # SLO probe: offer 50 rps for 30 s with a 200 ms deadline
+    python tools/loadgen.py --url http://127.0.0.1:8080 \
+        --mode open --rps 50 --duration 30 --slo-ms 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_sod_project_tpu.serve.loadgen import (  # noqa: E402
+    fetch_stats, run_loadgen, wait_ready)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--url", required=True,
+                   help="base URL, e.g. http://127.0.0.1:8080")
+    p.add_argument("--mode", default="closed", choices=["closed", "open"])
+    p.add_argument("--concurrency", type=int, default=4,
+                   help="closed loop: parallel workers")
+    p.add_argument("--requests", type=int, default=50,
+                   help="closed loop: total requests")
+    p.add_argument("--rps", type=float, default=10.0,
+                   help="open loop: offered requests/sec")
+    p.add_argument("--duration", type=float, default=5.0,
+                   help="open loop: seconds of offered load")
+    p.add_argument("--size", type=int, action="append", default=[],
+                   help="square request image side (repeatable; "
+                        "default 320)")
+    p.add_argument("--slo-ms", type=float, default=0.0,
+                   help="per-request deadline sent as X-SLO-MS (0=none)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeout", type=float, default=60.0,
+                   help="per-request client timeout seconds")
+    p.add_argument("--wait-ready", type=float, default=0.0,
+                   help="poll /healthz up to this many seconds before "
+                        "generating load (0 = don't wait)")
+    p.add_argument("--server-stats", action="store_true",
+                   help="append the server's /stats snapshot to the "
+                        "summary line")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    url = args.url.rstrip("/")
+    if args.wait_ready and not wait_ready(url, timeout_s=args.wait_ready):
+        print(json.dumps({"error": f"server at {url} not ready after "
+                                   f"{args.wait_ready}s"}), flush=True)
+        return 1
+    sizes = tuple((s, s) for s in (args.size or [320]))
+    summary = run_loadgen(
+        url, mode=args.mode, concurrency=args.concurrency,
+        requests=args.requests, rps=args.rps, duration_s=args.duration,
+        sizes=sizes, seed=args.seed, slo_ms=args.slo_ms,
+        timeout_s=args.timeout)
+    if args.server_stats:
+        try:
+            summary["server"] = fetch_stats(url)
+        except Exception as e:  # noqa: BLE001 — summary still prints
+            summary["server_error"] = str(e)
+    print(json.dumps(summary), flush=True)
+    return 0 if summary.get("ok", 0) > 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

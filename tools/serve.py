@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Run the online serving engine over HTTP (docs/SERVING.md).
+
+    # Serve a trained checkpoint (config sidecar aware), hot-reloading
+    # whenever training writes a newer VALID checkpoint:
+    python tools/serve.py --ckpt-dir runs/minet --port 8080 \
+        --set serve.reload_poll_s=5
+
+    # Smoke/e2e posture: serve a randomly-initialised model (no
+    # checkpoint needed; what tools/t1.sh and the agenda legs use):
+    python tools/serve.py --config minet_vgg16_ref --init-random \
+        --port 0 --port-file /tmp/serve.port
+
+``--port 0`` binds an ephemeral port; ``--port-file`` writes the bound
+port for scripts.  SIGTERM/SIGINT drain cleanly (exit 0).  Knobs live
+under the ``serve.*`` config section (``--set serve.max_wait_ms=10``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint directory written by train.py")
+    p.add_argument("--config", default=None,
+                   help="registered config name (default: the "
+                        "checkpoint's config.json sidecar)")
+    p.add_argument("--init-random", action="store_true",
+                   help="serve a randomly-initialised model instead of "
+                        "a checkpoint (requires --config; smoke/bench "
+                        "posture)")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step (default: newest VALID)")
+    p.add_argument("--host", default=None,
+                   help="bind host (default: serve.host)")
+    p.add_argument("--port", type=int, default=None,
+                   help="bind port, 0 = ephemeral (default: serve.port)")
+    p.add_argument("--port-file", default=None,
+                   help="write the bound port here once listening")
+    p.add_argument("--device", default=None, choices=["tpu", "cpu", None])
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="PATH=VALUE", help="dotted config override")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if not args.ckpt_dir and not (args.init_random and args.config):
+        raise SystemExit(
+            "need --ckpt-dir, or --init-random with --config")
+
+    from distributed_sod_project_tpu.utils.platform import select_platform
+
+    select_platform(args.device)
+
+    from distributed_sod_project_tpu.serve.engine import InferenceEngine
+    from distributed_sod_project_tpu.serve.server import serve_forever
+
+    if args.ckpt_dir:
+        engine = InferenceEngine.from_checkpoint(
+            args.ckpt_dir, config_name=args.config,
+            overrides=args.overrides, step=args.step)
+    else:
+        from distributed_sod_project_tpu.configs import (apply_overrides,
+                                                         get_config)
+
+        cfg = apply_overrides(get_config(args.config), args.overrides)
+        engine = InferenceEngine.from_random_init(cfg)
+
+    host = args.host if args.host is not None else engine.cfg.serve.host
+    port = args.port if args.port is not None else engine.cfg.serve.port
+    return serve_forever(engine, host, port, port_file=args.port_file)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Serve smoke for tools/t1.sh: start tools/serve.py as a real
+subprocess on an ephemeral port, push one request round-trip through
+tools/loadgen.py's machinery, then SIGTERM and assert a CLEAN shutdown
+(exit 0).  Prints one JSON line; exits non-zero on any broken link.
+
+Budget contract: the internal deadlines (120 s bind incl. AOT warm +
+60 s healthz + 60 s requests + 60 s drain) sum under t1.sh's 420 s
+wrapper, so a stall always reports its OWN JSON diagnostic instead of
+dying to the outer timeout mid-wait.
+
+Deliberately out-of-process: the smoke must exercise the same process
+lifecycle a deployment does (signal handling, drain, port-file), not an
+in-process thread server (tests/test_serving.py covers that side).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_sod_project_tpu.serve.loadgen import (  # noqa: E402
+    run_loadgen, wait_ready)
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> int:
+    port_file = tempfile.mktemp(prefix="dsod_serve_port_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(TOOLS, "serve.py"),
+         "--config", "minet_vgg16_ref", "--init-random", "--device", "cpu",
+         "--port", "0", "--port-file", port_file,
+         "--set", "data.image_size=64,64",
+         "--set", "serve.resolution_buckets=64",
+         "--set", "serve.batch_buckets=1,2"],
+        env=env)
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                print(json.dumps({"error": "server died before binding",
+                                  "rc": proc.returncode}), flush=True)
+                return 1
+            if time.monotonic() > deadline:
+                print(json.dumps({"error": "server never bound a port"}),
+                      flush=True)
+                return 1
+            time.sleep(0.25)
+        with open(port_file) as f:
+            url = f"http://127.0.0.1:{int(f.read().strip())}"
+        if not wait_ready(url, timeout_s=60):
+            print(json.dumps({"error": "server never became healthy"}),
+                  flush=True)
+            return 1
+        summary = run_loadgen(url, mode="closed", concurrency=1,
+                              requests=2, sizes=((48, 56),), seed=0,
+                              timeout_s=60)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        summary["server_rc"] = rc
+        print(json.dumps(summary), flush=True)
+        return 0 if summary.get("ok", 0) == 2 and rc == 0 else 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if os.path.exists(port_file):
+            os.unlink(port_file)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

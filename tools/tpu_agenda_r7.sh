@@ -1,0 +1,110 @@
+#!/bin/bash
+# Round-7 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh fires this automatically; default agenda since
+# round 7).  Round 7 landed the online serving subsystem (serve/:
+# dynamic micro-batching over AOT-compiled bucket programs, admission
+# control, SLO shedding, hot weight reload — docs/SERVING.md).  The
+# questions this agenda answers:
+#
+#   1. canonical b128 headline refresh (comparison anchor; untouched
+#      by the serving work, so any drift is environmental)
+#   2. bench --mode serve: serving throughput + latency tail through
+#      the full HTTP stack, joining the recorded perf trajectory
+#   3. the throughput-vs-p99 curve: a long-lived server (flagship
+#      model, 320px) swept with the CLOSED-loop generator at rising
+#      concurrency — each leg records (throughput, p99) so the curve's
+#      knee (where added concurrency buys latency, not throughput)
+#      prices the static batch buckets
+#   4. SLO behavior at the knee: OPEN-loop legs at fixed offered rates
+#      with a 500 ms deadline — shed/expired counts tell whether
+#      admission control holds p99 by rejecting, not by queueing
+#
+# Serve legs talk to ONE server process started here (ephemeral port,
+# --port-file); loadgen itself never imports jax, so only the server
+# occupies the TPU.
+cd "$(dirname "$0")/.." || exit 1
+R=${R:-tpu_results7}
+mkdir -p "$R"
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+done_ok() {
+  [ -f "$R"/results.jsonl ] || return 1
+  local rec
+  rec=$(grep "\"step\": \"$1\", \"rc\": 0" "$R"/results.jsonl | tail -1)
+  [ -n "$rec" ] || return 1
+  ! printf '%s' "$rec" | grep -q '"error"'
+}
+
+# Circuit breaker (r4 pattern): after any failed leg, verify the
+# tunnel still runs REAL compute; abort the firing if not (the
+# watcher re-fires in the next window and done_ok() skips landed legs).
+tunnel_computes() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('computes')" 2>/dev/null | grep -q computes
+}
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  if done_ok "$name"; then
+    echo "[$name] skip: succeeded in a previous window" | tee -a "$R"/agenda.log
+    return 0
+  fi
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+  if { [ "$rc" -ne 0 ] || printf '%s' "$line" | grep -Eq 'wedged|unavailable'; } \
+      && ! tunnel_computes; then
+    echo "[$name] tunnel no longer computes — aborting firing (watcher will re-fire)" \
+      | tee -a "$R"/agenda.log
+    exit 2
+  fi
+}
+
+# -- 1. canonical headline refresh (the r5/r6 key replays unchanged)
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2. serving throughput joins the recorded trajectory
+run serve_bench 900 $BENCH --mode serve --config minet_r50_dp --steps 200 --warmup 8
+
+# -- 3+4. throughput-vs-p99 curve against ONE long-lived server.
+SERVE_PORT_FILE="$R/serve.port"
+rm -f "$SERVE_PORT_FILE"
+python tools/serve.py --config minet_r50_dp --init-random --device tpu \
+  --port 0 --port-file "$SERVE_PORT_FILE" \
+  --set "serve.batch_buckets=1,4,8,16" \
+  > "$R"/serve_server.out 2> "$R"/serve_server.err &
+SERVE_PID=$!
+for _ in $(seq 1 120); do [ -f "$SERVE_PORT_FILE" ] && break; sleep 2; done
+if [ -f "$SERVE_PORT_FILE" ]; then
+  URL="http://127.0.0.1:$(cat "$SERVE_PORT_FILE")"
+  LG="python tools/loadgen.py --url $URL --wait-ready 600 --size 320"
+  # closed-loop concurrency sweep: the (throughput, p99) curve
+  for c in 1 4 8 16 32; do
+    run "serve_closed_c$c" 900 $LG --mode closed --concurrency "$c" --requests 200
+  done
+  # open-loop SLO probes at fixed offered rates with a 500 ms deadline
+  for rps in 20 60 120; do
+    run "serve_open_rps$rps" 900 $LG --mode open --rps "$rps" --duration 20 \
+        --slo-ms 500 --server-stats
+  done
+  kill -TERM "$SERVE_PID" 2>/dev/null
+  wait "$SERVE_PID"
+  echo "{\"step\": \"serve_server_drain\", \"rc\": $?, \"result\": null}" >> "$R"/results.jsonl
+else
+  echo "serve server never bound a port — skipping curve legs" | tee -a "$R"/agenda.log
+  kill -9 "$SERVE_PID" 2>/dev/null
+fi
+
+# Host-side window report (touches no TPU).
+timeout 120 python tools/window_report.py "$R"/results.jsonl \
+    > "$R"/window_report.md 2> "$R"/window_report.err || true
+tail -20 "$R"/window_report.md | tee -a "$R"/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
