@@ -645,7 +645,8 @@ def _bench_serve(args, cfg) -> int:
         return 1
     extra = {k: res[k] for k in ("p50_ms", "p95_ms", "p99_ms")}
     extra.update(shed=res["shed"], expired=res["expired"],
-                 concurrency=concurrency)
+                 concurrency=concurrency,
+                 precision=cfg.serve.precision)
     return _report(args, res["ok"] / res["elapsed_s"],
                    jax.devices()[0].platform, 1, mode="serve", **extra)
 

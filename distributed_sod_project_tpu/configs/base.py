@@ -219,6 +219,20 @@ class ServeConfig:
     # smallest bucket >= its longest side (largest bucket otherwise);
     # degraded mode forces the smallest.
     resolution_buckets: Tuple[int, ...] = ()
+    # Precision arms (serve/precision.py; docs/SERVING.md "Precision
+    # arms").  Every arm in precision_arms gets its own cast-on-load
+    # weight view and its own AOT-compiled program per (res, batch)
+    # bucket at startup; `precision` picks the arm requests serve at by
+    # default (X-Precision overrides per request, within the enabled
+    # set).  Arms: f32 (identity — bitwise the offline eval path),
+    # bf16 (weights cast to bfloat16: half the weight HBM), int8 / fp8
+    # (8-bit weight-only per-channel quantization, dequantized inside
+    # the compiled program; fp8 only where jaxlib has float8_e4m3fn).
+    # The degraded ladder steps DOWN through the enabled arms before it
+    # touches resolution; quality deltas per arm are measured and
+    # budgeted by tools/precision_gate.py.
+    precision: str = "f32"
+    precision_arms: Tuple[str, ...] = ("f32", "bf16")
     # How long the oldest queued request may wait for co-riders before
     # its batch dispatches anyway (the latency/occupancy trade).
     max_wait_ms: float = 5.0
@@ -239,11 +253,14 @@ class ServeConfig:
     # watchdog.py).  A wedged device dispatch stops the beat; /healthz
     # flips 503 so the fronting LB drains this replica.  0 = off.
     watchdog_deadline_s: float = 60.0
-    # Degraded-mode hysteresis: engage after queue depth has stayed
-    # >= degraded_high * max_queue for degraded_engage_s; disengage
-    # after it has stayed <= degraded_low * max_queue for
-    # degraded_disengage_s.  Degraded serves the smallest resolution
-    # bucket with TTA off and reports itself (X-Degraded: 1).
+    # Degraded-mode hysteresis LADDER: each rung engages after queue
+    # depth has stayed >= degraded_high * max_queue for
+    # degraded_engage_s, and unwinds (one rung at a time, reverse
+    # order) after it has stayed <= degraded_low * max_queue for
+    # degraded_disengage_s.  Rungs step PRECISION down through the
+    # enabled precision_arms first (TTA off from rung 1), and only the
+    # final rung forces the smallest resolution bucket; responses
+    # self-report the rung (X-Degraded: <level>).
     degraded_high: float = 0.75
     degraded_low: float = 0.25
     degraded_engage_s: float = 2.0

@@ -16,6 +16,14 @@ from .admission import (
 )
 from .batcher import DynamicBatcher, Request
 from .engine import InferenceEngine, preprocess_image
+from .precision import (
+    PRECISION_ORDER,
+    cast_variables,
+    make_precision_forward,
+    step_down,
+    supported_arms,
+    validate_arms,
+)
 from .server import make_server
 
 __all__ = [
@@ -24,8 +32,14 @@ __all__ = [
     "DynamicBatcher",
     "EngineStopped",
     "InferenceEngine",
+    "PRECISION_ORDER",
     "QueueFull",
     "Request",
+    "cast_variables",
+    "make_precision_forward",
     "make_server",
     "preprocess_image",
+    "step_down",
+    "supported_arms",
+    "validate_arms",
 ]

@@ -33,13 +33,18 @@ class EngineStopped(Exception):
 class AdmissionController:
     """Queue-bound + degraded-mode policy for the serving engine.
 
-    Degraded mode is a hysteresis state machine over observed queue
-    depth so a single burst can't flap the service between quality
-    levels: it engages only after depth has stayed at or above
-    ``high * max_queue`` for ``engage_s`` seconds, and disengages only
-    after depth has stayed at or below ``low * max_queue`` for
-    ``disengage_s`` seconds.  In between (the dead band) the current
-    state holds.  ``clock`` is injectable for deterministic tests.
+    Degraded mode is a hysteretic **ladder** over observed queue depth:
+    ``level`` runs 0 (full quality) .. ``max_level``, and each step —
+    up or down — must EARN itself: the depth has to stay at or above
+    ``high * max_queue`` for ``engage_s`` seconds to climb one level,
+    and at or below ``low * max_queue`` for ``disengage_s`` seconds to
+    descend one.  The timers reset at every transition, so a sustained
+    overload walks the ladder one rung per ``engage_s`` (precision
+    steps first, resolution last — the engine maps levels to actions)
+    and recovery unwinds in reverse order, one rung per
+    ``disengage_s``.  In between (the dead band) the current level
+    holds.  ``max_level=1`` is the historical binary degraded mode.
+    ``clock`` is injectable for deterministic tests.
     """
 
     def __init__(
@@ -50,6 +55,7 @@ class AdmissionController:
         low: float = 0.25,
         engage_s: float = 2.0,
         disengage_s: float = 5.0,
+        max_level: int = 1,
         clock=time.monotonic,
     ):
         if max_queue < 1:
@@ -57,15 +63,19 @@ class AdmissionController:
         if not 0.0 <= low <= high <= 1.0:
             raise ValueError(
                 f"need 0 <= low <= high <= 1, got low={low} high={high}")
+        if max_level < 1:
+            raise ValueError(f"max_level must be >= 1, got {max_level}")
         self.max_queue = int(max_queue)
+        self.max_level = int(max_level)
         self._high = float(high) * self.max_queue
         self._low = float(low) * self.max_queue
         self._engage_s = float(engage_s)
         self._disengage_s = float(disengage_s)
         self._clock = clock
-        self._degraded = False
+        self._level = 0
         # Time the depth first crossed into the (high / low) region it
-        # is currently in; None = not in that region.
+        # is currently in; None = not in that region.  Reset on every
+        # ladder transition: each further rung needs its own dwell.
         self._above_since: Optional[float] = None
         self._below_since: Optional[float] = None
 
@@ -95,28 +105,36 @@ class AdmissionController:
 
     def observe(self, queue_depth: int, now: Optional[float] = None) -> bool:
         """Feed one queue-depth observation; returns the (possibly
-        updated) degraded flag.  Call periodically — the engine's
-        dispatch loop does, including when idle."""
+        updated) degraded flag (``level > 0`` — read :attr:`level` for
+        the ladder rung).  Call periodically — the engine's dispatch
+        loop does, including when idle."""
         now = self._clock() if now is None else now
         if queue_depth >= self._high:
             self._below_since = None
             if self._above_since is None:
                 self._above_since = now
-            if (not self._degraded
+            if (self._level < self.max_level
                     and now - self._above_since >= self._engage_s):
-                self._degraded = True
+                self._level += 1
+                self._above_since = now  # the next rung needs its own dwell
         elif queue_depth <= self._low:
             self._above_since = None
             if self._below_since is None:
                 self._below_since = now
-            if (self._degraded
+            if (self._level > 0
                     and now - self._below_since >= self._disengage_s):
-                self._degraded = False
+                self._level -= 1
+                self._below_since = now
         else:  # dead band: hold state, reset both region timers
             self._above_since = None
             self._below_since = None
-        return self._degraded
+        return self._level > 0
+
+    @property
+    def level(self) -> int:
+        """Current ladder rung: 0 = full quality .. ``max_level``."""
+        return self._level
 
     @property
     def degraded(self) -> bool:
-        return self._degraded
+        return self._level > 0

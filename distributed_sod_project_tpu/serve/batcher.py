@@ -30,33 +30,45 @@ class Request:
 
     ``tensor`` is the preprocessed (res, res, 3) float32 input — resize
     + normalize happen in the submitting thread (the HTTP handler pool)
-    so the dispatch loop never does per-request host work.  ``deadline``
-    is monotonic-clock absolute (None = no SLO).  The result —
-    ``(pred, meta)`` with pred the float32 (H, W) saliency map at the
-    request's ORIGINAL resolution — or a shed/expiry exception is
-    delivered through ``future``.
+    so the dispatch loop never does per-request host work.
+    ``precision`` is the arm the request will be served at (already
+    ladder-adjusted at submit) — it is part of the coalescing key,
+    because a batch runs through exactly ONE compiled program.
+    ``deadline`` is monotonic-clock absolute (None = no SLO).  The
+    result — ``(pred, meta)`` with pred the float32 (H, W) saliency map
+    at the request's ORIGINAL resolution — or a shed/expiry exception
+    is delivered through ``future``.
     """
 
     tensor: np.ndarray
     orig_hw: Tuple[int, int]
     res_bucket: int
     arrival: float
+    precision: str = "f32"
     deadline: Optional[float] = None
     degraded: bool = False
+    level: int = 0
     future: Future = field(default_factory=Future)
     dispatch_t: float = 0.0
 
+    @property
+    def bucket_key(self) -> Tuple[int, str]:
+        """The coalescing key: same resolution AND same precision arm
+        (one compiled program per group)."""
+        return (self.res_bucket, self.precision)
+
 
 class DynamicBatcher:
-    """Thread-safe coalescing queue over per-resolution-bucket deques.
+    """Thread-safe coalescing queue over per-(resolution, precision)
+    bucket deques.
 
     ``get_batch`` (the dispatch loop's pull) blocks until it can return
-    ``(res_bucket, requests)`` where the group is FIFO within its
-    resolution bucket, never exceeds the largest batch bucket, and is
-    released early once the oldest member has waited ``max_wait_s``
+    ``((res_bucket, precision), requests)`` where the group is FIFO
+    within its bucket key, never exceeds the largest batch bucket, and
+    is released early once the oldest member has waited ``max_wait_s``
     (the max-wait deadline holds even when no further requests ever
-    arrive — a stalled queue still drains).  Resolution buckets are
-    served oldest-head-first so no bucket starves.
+    arrive — a stalled queue still drains).  Bucket keys are served
+    oldest-head-first so no bucket starves.
     """
 
     def __init__(self, batch_buckets, max_wait_s: float,
@@ -88,7 +100,7 @@ class DynamicBatcher:
                 if depth >= self.max_queue:
                     raise QueueFull(
                         f"queue at capacity ({depth}/{self.max_queue})")
-            self._queues.setdefault(req.res_bucket, deque()).append(req)
+            self._queues.setdefault(req.bucket_key, deque()).append(req)
             self._cv.notify_all()
 
     def pending(self) -> int:
@@ -105,9 +117,10 @@ class DynamicBatcher:
         return head
 
     def get_batch(self, idle_timeout_s: float
-                  ) -> Optional[Tuple[int, List[Request]]]:
-        """Next coalesced group, or None after ``idle_timeout_s`` with
-        an empty queue (so the caller's loop can heartbeat)."""
+                  ) -> Optional[Tuple[Tuple[int, str], List[Request]]]:
+        """Next coalesced group as ``((res_bucket, precision), reqs)``,
+        or None after ``idle_timeout_s`` with an empty queue (so the
+        caller's loop can heartbeat)."""
         idle_deadline = self._clock() + idle_timeout_s
         with self._cv:
             while True:
@@ -120,11 +133,11 @@ class DynamicBatcher:
                         return None
                     self._cv.wait(min(idle_deadline - now, 0.05))
                     continue
-                q = self._queues[head.res_bucket]
+                q = self._queues[head.bucket_key]
                 wait_left = (head.arrival + self.max_wait_s) - now
                 if len(q) >= self.max_batch or wait_left <= 0:
                     n = min(len(q), self.max_batch)
-                    return head.res_bucket, [q.popleft() for _ in range(n)]
+                    return head.bucket_key, [q.popleft() for _ in range(n)]
                 self._cv.wait(min(wait_left, 0.05))
 
     def pick_batch_bucket(self, n: int) -> int:

@@ -7,15 +7,18 @@ underneath maps onto the fixed-shape compiled programs the eval path
 already owns.  Three device-facing invariants:
 
 - **No request-time compilation.**  Every (resolution bucket, batch
-  bucket) program is AOT-compiled at startup via
+  bucket, precision arm) program is AOT-compiled at startup via
   ``jax.jit(...).lower().compile()`` from the SAME ``make_forward`` the
-  offline eval uses, so a served prediction is bitwise what ``test.py``
-  would produce for the same bucket shapes.
+  offline eval uses (quantized arms route through
+  ``serve/precision.py``'s dequantizing forward), so a served
+  prediction is bitwise what a direct call at the same bucket shapes
+  and arm would produce.
 - **Atomic weight swaps.**  The checkpoint watcher restores the newest
-  VALID step (resilience integrity layer) off-thread, then swaps the
-  whole variables pytree under a lock read once per dispatch — a
+  VALID step (resilience integrity layer) off-thread, re-derives every
+  precision arm's cast-on-load weight view, then swaps the whole
+  arm→variables dict under a lock read once per dispatch — a
   concurrent /predict sees entirely-old or entirely-new weights, never
-  a mix.
+  a mix (across arms too).
 - **Bounded device run-ahead.**  At most ``max_inflight`` dispatched-
   but-unfetched batches; the host completion pool (the
   ``run_inference`` overlap pattern, generalised to out-of-order
@@ -32,13 +35,14 @@ from typing import Dict, Optional, Tuple
 import jax
 import numpy as np
 
-from ..eval.inference import (_resize_pred, flip_tta, make_forward,
-                              pad_to_batch)
+from ..eval.inference import _resize_pred, flip_tta, pad_to_batch
 from ..utils.logging import get_logger
 from ..utils.observability import ServeStats
 from .admission import (AdmissionController, DeadlineExpired, EngineStopped,
                         QueueFull)
 from .batcher import DynamicBatcher, Request
+from .precision import (cast_variables, make_precision_forward, step_down,
+                        validate_arms)
 
 
 def preprocess_image(image: np.ndarray, res: int, mean, std) -> np.ndarray:
@@ -94,34 +98,50 @@ class InferenceEngine:
         self._mean = np.asarray(cfg.data.normalize_mean, np.float32)
         self._std = np.asarray(cfg.data.normalize_std, np.float32)
 
+        # Precision arms (serve/precision.py): every enabled arm gets a
+        # cast-on-load weight view and its own AOT programs; requests
+        # pick an arm (serve.precision default, X-Precision override),
+        # possibly stepped down by the degraded ladder.
+        self.precision_arms = validate_arms(sc.precision_arms, sc.precision)
+        self.default_precision = sc.precision
+
         self._template = state if hasattr(state, "eval_variables") else None
         variables = (state.eval_variables()
                      if self._template is not None else state)
         self._var_lock = threading.Lock()
-        self._variables = jax.device_put(variables)
+        self._arm_vars = self._derive_arm_vars(variables)
         # Seed the reload watermark from the state's own step so the
         # watcher doesn't "reload" the checkpoint we just restored.
         self._loaded_step: Optional[int] = (
             int(jax.device_get(state.step))
             if self._template is not None else None)
 
-        self._fwd = make_forward(model)
+        self._fwds = {arm: make_precision_forward(model, arm)
+                      for arm in self.precision_arms}
         # Compiled-program cache, AOT-warmed in start().  The key spells
         # out everything that selects a distinct executable: model,
-        # static shapes, and the decoder resample implementation (a
-        # different compiled program per configs/base.py knob).
-        self.programs: Dict[Tuple[str, int, int, str], object] = {}
+        # static shapes, the decoder resample implementation, and the
+        # precision arm (each a different compiled program).
+        self.programs: Dict[Tuple[str, int, int, str, str], object] = {}
 
         self.batcher = DynamicBatcher(
             self.batch_buckets, sc.max_wait_ms / 1000.0,
             max_queue=sc.max_queue, clock=clock)
+        # Ladder depth: one rung per precision downshift available from
+        # the enabled arms, plus the final resolution rung (the
+        # historical binary mode when only one arm is enabled).
+        self._n_precision_rungs = len(self.precision_arms) - 1
         self.admission = AdmissionController(
             sc.max_queue, high=sc.degraded_high, low=sc.degraded_low,
             engage_s=sc.degraded_engage_s,
-            disengage_s=sc.degraded_disengage_s, clock=clock)
+            disengage_s=sc.degraded_disengage_s,
+            max_level=self._n_precision_rungs + 1, clock=clock)
 
         self._est_lock = threading.Lock()
-        self._est_s: Dict[int, float] = {}  # res bucket → EWMA device s
+        # (res bucket, arm) → EWMA device s: the arms are different
+        # programs with different device costs, so the SLO-expiry
+        # estimate must not blend them.
+        self._est_s: Dict[Tuple[int, str], float] = {}
 
         self._stop = threading.Event()
         self._running = False
@@ -133,6 +153,25 @@ class InferenceEngine:
         self._watchdog = None
         self._fetch_pool = None
         self._post_pool = None
+
+    # -- precision arms ------------------------------------------------
+
+    def _derive_arm_vars(self, variables) -> Dict[str, object]:
+        """Every enabled arm's weight view of ``variables`` (the f32
+        source of truth), device-resident.  Called at construction and
+        on every hot reload — the views are RE-DERIVED from the freshly
+        restored f32 state, then swapped in as one dict under the swap
+        lock so no arm ever serves a different step than its siblings."""
+        return {arm: jax.device_put(cast_variables(variables, arm))
+                for arm in self.precision_arms}
+
+    def _effective_arm(self, requested: str, level: int) -> str:
+        """The arm a request actually serves at: the requested arm
+        pushed down the enabled-arm ladder by the degraded level
+        (resolution only degrades once every precision rung is spent —
+        see :meth:`choose_res_bucket`)."""
+        return step_down(requested, self.precision_arms,
+                         min(level, self._n_precision_rungs))
 
     # -- lifecycle -----------------------------------------------------
 
@@ -173,24 +212,27 @@ class InferenceEngine:
         return self
 
     def warm(self) -> int:
-        """AOT-compile every (resolution, batch) bucket program so no
-        request ever pays a compile; returns the program count."""
+        """AOT-compile every (resolution, batch, precision-arm) bucket
+        program so no request ever pays a compile; returns the program
+        count."""
         name = self.cfg.model.name
         impl = self.cfg.model.resample_impl
         with self._var_lock:
-            variables = self._variables
-        for res in self.res_buckets:
-            for bb in self.batch_buckets:
-                key = (name, res, bb, impl)
-                if key in self.programs:
-                    continue
-                batch = {"image": np.zeros((bb, res, res, 3), np.float32)}
-                t0 = time.perf_counter()
-                self.programs[key] = self._fwd.lower(
-                    variables, batch).compile()
-                self._log.info(
-                    "serve: warmed program %s in %.1fs", key,
-                    time.perf_counter() - t0)
+            arm_vars = self._arm_vars
+        for arm in self.precision_arms:
+            for res in self.res_buckets:
+                for bb in self.batch_buckets:
+                    key = (name, res, bb, impl, arm)
+                    if key in self.programs:
+                        continue
+                    batch = {"image": np.zeros((bb, res, res, 3),
+                                               np.float32)}
+                    t0 = time.perf_counter()
+                    self.programs[key] = self._fwds[arm].lower(
+                        arm_vars[arm], batch).compile()
+                    self._log.info(
+                        "serve: warmed program %s in %.1fs", key,
+                        time.perf_counter() - t0)
         return len(self.programs)
 
     def stop(self) -> None:
@@ -262,11 +304,15 @@ class InferenceEngine:
         return self.res_buckets[-1]
 
     def submit(self, image: np.ndarray,
-               slo_ms: Optional[float] = None):
+               slo_ms: Optional[float] = None,
+               precision: Optional[str] = None):
         """Enqueue one prediction; returns a ``concurrent.futures.Future``
         resolving to ``(pred, meta)`` — pred float32 (H, W) at the
-        request's original resolution.  Raises :class:`QueueFull` /
-        :class:`EngineStopped` at the door (nothing enqueued)."""
+        request's original resolution.  ``precision`` selects the arm
+        (default ``serve.precision``; must be an enabled arm — the
+        degraded ladder may still step it further down).  Raises
+        :class:`QueueFull` / :class:`EngineStopped` at the door
+        (nothing enqueued)."""
         if not self._running:
             raise EngineStopped("engine not running")
         if not self.stats.healthy:
@@ -278,26 +324,35 @@ class InferenceEngine:
         except QueueFull:
             self.stats.inc("shed")
             raise
-        degraded = self.admission.degraded
+        level = self.admission.level
         try:
+            requested = (self.default_precision if precision is None
+                         else str(precision))
+            if requested not in self.precision_arms:
+                raise ValueError(
+                    f"unknown precision {requested!r}; enabled arms: "
+                    f"{list(self.precision_arms)}")
+            arm = self._effective_arm(requested, level)
             arr = np.asarray(image)
+            # Resolution degrades only once every precision rung is
+            # spent — precision steps down BEFORE resolution.
             res = self.choose_res_bucket(arr.shape[0], arr.shape[1],
-                                         degraded)
+                                         level > self._n_precision_rungs)
             tensor = preprocess_image(arr, res, self._mean, self._std)
         except Exception:
-            # Malformed input: terminate the request in the accounting
-            # (the engine owns ALL terminal counters, so the
-            # served+shed+expired+errors == submitted invariant holds
-            # for 400s too) and let the front end surface it.
+            # Malformed input / unknown arm: terminate the request in
+            # the accounting (the engine owns ALL terminal counters, so
+            # the served+shed+expired+errors == submitted invariant
+            # holds for 400s too) and let the front end surface it.
             self.stats.inc("errors")
             raise
         now = self._clock()
         slo = self.cfg.serve.slo_ms if slo_ms is None else slo_ms
         req = Request(
             tensor=tensor, orig_hw=(int(arr.shape[0]), int(arr.shape[1])),
-            res_bucket=res, arrival=now,
+            res_bucket=res, arrival=now, precision=arm,
             deadline=(now + slo / 1000.0) if slo and slo > 0 else None,
-            degraded=degraded)
+            degraded=level > 0, level=level)
         try:
             # The batcher re-checks the bound under ITS lock (the
             # try_admit above is the cheap pre-preprocess gate; N
@@ -313,9 +368,10 @@ class InferenceEngine:
         return req.future
 
     def predict(self, image: np.ndarray, slo_ms: Optional[float] = None,
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None,
+                precision: Optional[str] = None):
         """Blocking convenience wrapper over :meth:`submit`."""
-        return self.submit(image, slo_ms=slo_ms).result(
+        return self.submit(image, slo_ms=slo_ms, precision=precision).result(
             timeout=timeout or self.cfg.serve.request_timeout_s)
 
     # -- dispatch loop -------------------------------------------------
@@ -327,12 +383,13 @@ class InferenceEngine:
             got = self.batcher.get_batch(idle_timeout_s=0.1)
             depth = self.batcher.pending()
             self.stats.set_queue_depth(depth)
-            self.stats.set_degraded(self.admission.observe(depth))
+            self.admission.observe(depth)
+            self.stats.set_degraded(self.admission.level)
             if got is None:
                 continue
-            res, reqs = got
+            (res, arm), reqs = got
             with self._est_lock:
-                est = self._est_s.get(res, 0.0)
+                est = self._est_s.get((res, arm), 0.0)
             now = self._clock()
             live = []
             for r in reqs:
@@ -349,7 +406,7 @@ class InferenceEngine:
             batch = pad_to_batch(
                 {"image": np.stack([r.tensor for r in live])}, bb)
             with self._var_lock:
-                variables = self._variables
+                variables = self._arm_vars[arm]
                 step = self._loaded_step
             tta = self.cfg.serve.tta and not self.admission.degraded
             # Bound run-ahead WITHOUT beating the watchdog while we
@@ -377,7 +434,7 @@ class InferenceEngine:
                 self._inflight_n += 1
                 self.stats.set_inflight(self._inflight_n)
             try:
-                probs = self._forward(res, bb, variables, batch, tta)
+                probs = self._forward(res, bb, arm, variables, batch, tta)
             except Exception as e:  # noqa: BLE001 — per-request surface
                 self._release_inflight()
                 self._log.exception("serve: dispatch failed")
@@ -385,14 +442,16 @@ class InferenceEngine:
                     self.stats.inc("errors")
                     self._fail(r, e)
                 continue
-            self.stats.observe_batch(len(live), bb)
+            self.stats.observe_batch(len(live), bb, arm=arm)
             meta = {"res_bucket": res, "batch_bucket": bb, "tta": tta,
-                    "step": step}
+                    "step": step, "precision": arm}
             self._fetch_pool.submit(self._complete, probs, live, meta, t0)
 
-    def _forward(self, res: int, bb: int, variables, batch, tta: bool):
-        key = (self.cfg.model.name, res, bb, self.cfg.model.resample_impl)
-        call = self.programs.get(key, self._fwd)
+    def _forward(self, res: int, bb: int, arm: str, variables, batch,
+                 tta: bool):
+        key = (self.cfg.model.name, res, bb, self.cfg.model.resample_impl,
+               arm)
+        call = self.programs.get(key, self._fwds[arm])
 
         def fn(b):
             return call(variables, b)
@@ -413,14 +472,16 @@ class InferenceEngine:
         try:
             arr = np.asarray(probs)[: len(live)]  # the blocking fetch
             dev_ms = (self._clock() - t0) * 1000.0
-            res = meta["res_bucket"]
+            est_key = (meta["res_bucket"], meta["precision"])
             with self._est_lock:
-                old = self._est_s.get(res)
+                old = self._est_s.get(est_key)
                 now_s = dev_ms / 1000.0
-                self._est_s[res] = (now_s if old is None
-                                    else 0.8 * old + 0.2 * now_s)
+                self._est_s[est_key] = (now_s if old is None
+                                        else 0.8 * old + 0.2 * now_s)
+            arm_stats = self.stats.arm(meta["precision"])
             for _ in live:
                 self.stats.device_ms.observe(dev_ms)
+                arm_stats.device_ms.observe(dev_ms)
             for j, r in enumerate(live):
                 self._post_pool.submit(
                     self._finish, r, arr[j], dict(meta, device_ms=dev_ms))
@@ -437,10 +498,13 @@ class InferenceEngine:
             pred = _resize_pred(row, r.orig_hw)
             e2e = (self._clock() - r.arrival) * 1000.0
             meta.update(
-                degraded=r.degraded,
+                degraded=r.degraded, degraded_level=r.level,
                 queue_ms=round((r.dispatch_t - r.arrival) * 1000.0, 3),
                 e2e_ms=round(e2e, 3))
             self.stats.e2e_ms.observe(e2e)
+            arm_stats = self.stats.arm(r.precision)
+            arm_stats.e2e_ms.observe(e2e)
+            arm_stats.inc_served()
             self.stats.inc("served")
             self._set_result(r, (pred, meta))
         except Exception as e:  # noqa: BLE001 — per-request surface
@@ -484,9 +548,13 @@ class InferenceEngine:
             return
         mgr.reload()  # the step landed after the manager's last scan
         state = mgr.restore(self._template, step)
-        variables = jax.device_put(state.eval_variables())
+        # Re-derive EVERY arm's weight view off-lock (cast + quantize
+        # are the slow part), then swap the whole dict in one motion —
+        # a concurrent dispatch sees either the old step's views or the
+        # new step's views, never a mix across arms.
+        arm_vars = self._derive_arm_vars(state.eval_variables())
         with self._var_lock:
-            self._variables = variables
+            self._arm_vars = arm_vars
             self._loaded_step = step
         self.stats.inc("reloads")
         self._log.info("serve: hot-reloaded weights from step %d", step)

@@ -50,26 +50,34 @@ def wait_ready(base_url: str, timeout_s: float = 60.0,
 
 
 def _one(base_url: str, body: bytes, slo_ms: Optional[float],
-         timeout_s: float) -> Tuple[str, float]:
-    """One /predict round-trip → (outcome, latency_ms).  Outcomes:
-    ok | shed | expired | unhealthy | error."""
+         timeout_s: float, precision: Optional[str] = None
+         ) -> Tuple[str, float, Optional[str]]:
+    """One /predict round-trip → (outcome, latency_ms, served_arm).
+    Outcomes: ok | shed | expired | unhealthy | error.  ``served_arm``
+    is the response's X-Precision header (the arm the server actually
+    used — ladder-adjusted), None on non-200s."""
     headers = {"Content-Type": "application/x-npy"}
     if slo_ms:
         headers["X-SLO-MS"] = str(slo_ms)
+    if precision:
+        headers["X-Precision"] = str(precision)
     req = urllib.request.Request(base_url + "/predict", data=body,
                                  headers=headers, method="POST")
     t0 = time.monotonic()
+    arm = None
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             r.read()
             out = "ok" if r.status == 200 else "error"
+            if out == "ok":
+                arm = r.headers.get("X-Precision")
     except urllib.error.HTTPError as e:
         e.read()
         out = {429: "shed", 504: "expired", 503: "unhealthy"}.get(
             e.code, "error")
     except (urllib.error.URLError, OSError):
         out = "error"
-    return out, (time.monotonic() - t0) * 1000.0
+    return out, (time.monotonic() - t0) * 1000.0, arm
 
 
 def _percentile(sorted_ms: List[float], p: float) -> float:
@@ -90,12 +98,16 @@ def run_loadgen(
     seed: int = 0,
     slo_ms: float = 0.0,
     timeout_s: float = 60.0,
+    precision: Optional[str] = None,
 ) -> Dict[str, float]:
     """Drive ``base_url`` and return a summary dict (see module doc for
     the open/closed semantics).  Closed loop sends exactly ``requests``
     total across ``concurrency`` workers; open loop offers ``rps`` for
-    ``duration_s``.  Latency percentiles are exact over OK responses
-    (client-side e2e, including HTTP)."""
+    ``duration_s``.  ``precision`` rides every request as X-Precision.
+    Latency percentiles are exact over OK responses (client-side e2e,
+    including HTTP); the summary additionally breaks p50/p95/p99 down
+    per SERVED arm (the response's X-Precision — ladder-adjusted), so
+    the throughput-vs-p99 curve exists per precision arm."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     rng = np.random.RandomState(seed)
@@ -107,12 +119,15 @@ def run_loadgen(
     outcomes: Dict[str, int] = {"ok": 0, "shed": 0, "expired": 0,
                                 "unhealthy": 0, "error": 0}
     ok_ms: List[float] = []
+    arm_ms: Dict[str, List[float]] = {}
 
-    def record(out: str, ms: float) -> None:
+    def record(out: str, ms: float, arm: Optional[str] = None) -> None:
         with lock:
             outcomes[out] += 1
             if out == "ok":
                 ok_ms.append(ms)
+                if arm:
+                    arm_ms.setdefault(arm, []).append(ms)
 
     t_start = time.monotonic()
     if mode == "closed":
@@ -126,7 +141,8 @@ def run_loadgen(
                         return
                     remaining[0] -= 1
                 record(*_one(base_url, pool[i % len(pool)],
-                             slo_ms or None, timeout_s))
+                             slo_ms or None, timeout_s,
+                             precision=precision))
                 i += concurrency
 
         threads = [threading.Thread(target=worker, args=(i,), daemon=True)
@@ -157,7 +173,7 @@ def run_loadgen(
                 futures.append(ex.submit(
                     lambda i=i: record(*_one(
                         base_url, pool[i % len(pool)], slo_ms or None,
-                        timeout_s))))
+                        timeout_s, precision=precision))))
             for f in futures:
                 f.result()
         sent = n
@@ -178,6 +194,21 @@ def run_loadgen(
         "mean_ms": round(sum(ok_ms) / len(ok_ms), 2) if ok_ms else 0.0,
         **outcomes,
     }
+    if precision:
+        out["precision"] = precision
+    if arm_ms:
+        # Per-SERVED-arm latency breakdown: under the degraded ladder a
+        # single offered arm can come back as several served arms, and
+        # the curve per arm is the number the r8 agenda sweeps.
+        out["arms"] = {}
+        for arm in sorted(arm_ms):
+            ms = sorted(arm_ms[arm])
+            out["arms"][arm] = {
+                "ok": len(ms),
+                "p50_ms": round(_percentile(ms, 0.50), 2),
+                "p95_ms": round(_percentile(ms, 0.95), 2),
+                "p99_ms": round(_percentile(ms, 0.99), 2),
+            }
     if mode == "open":
         out["offered_rps"] = round(float(rps), 2)
     return out

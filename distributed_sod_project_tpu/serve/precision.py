@@ -1,0 +1,202 @@
+"""Precision arms for the serving fast path (docs/SERVING.md
+"Precision arms").
+
+TF-Replicator's thin-abstraction thesis, extended one axis: the serve
+engine already owns a *family* of compiled programs keyed on static
+shape (resolution bucket × batch bucket); this module adds a precision
+axis to that family.  Each arm is a **cast-on-load weight view** of the
+one f32 variables pytree the checkpoint owns — the request plane picks
+an arm per request, the program cache holds one AOT-compiled executable
+per (shape bucket, arm), and nothing about the f32 source of truth
+changes (hot reload re-derives every view from the freshly restored
+state).
+
+Arms, best quality first (``PRECISION_ORDER``):
+
+- ``f32``  — the identity view; bitwise the offline eval path.
+- ``bf16`` — every floating leaf cast to bfloat16: half the weight
+  bytes in HBM and no per-dispatch f32→bf16 weight cast inside the
+  program (the zoo's ``compute_dtype`` is bf16 already, so the math
+  was rounding there anyway — this arm moves the rounding to load
+  time and halves the weight traffic).
+- ``int8`` — weight-only symmetric per-output-channel quantization of
+  every ≥2-D floating leaf (conv kernels, dense matrices); biases and
+  BN stats stay f32.  The compiled program dequantizes on the fly
+  (``q·scale``), so weights ship and live at 1/4 the bytes.
+- ``fp8``  — same per-channel scaling, stored as ``float8_e4m3fn``
+  (only offered when this jaxlib build has the dtype —
+  ``supported_arms()`` gates it).
+
+Quality is not assumed: ``tools/precision_gate.py`` scores every
+enabled arm against f32 on a fixed eval set (max-Fβ / MAE) and fails
+loudly when an arm drifts past its checked-in budget.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Best → worst expected quality; the degraded ladder steps DOWN this
+# order (within the enabled set) before it touches resolution.
+PRECISION_ORDER: Tuple[str, ...] = ("f32", "bf16", "int8", "fp8")
+
+# Arms whose weight view is a (quantized leaves, scales) bundle rather
+# than a plain cast of the variables pytree.
+QUANT_ARMS: Tuple[str, ...] = ("int8", "fp8")
+
+# Largest representable magnitudes the per-channel scale maps amax to.
+_QMAX = {"int8": 127.0, "fp8": 448.0}  # e4m3fn max normal = 448
+
+
+def supported_arms() -> Tuple[str, ...]:
+    """Arms this jaxlib build can serve (fp8 needs the float8 dtype)."""
+    arms = ["f32", "bf16", "int8"]
+    if hasattr(jnp, "float8_e4m3fn"):
+        arms.append("fp8")
+    return tuple(arms)
+
+
+def validate_arms(arms: Sequence[str], default: str) -> Tuple[str, ...]:
+    """Normalize a config's enabled-arm set: known, supported, deduped,
+    ordered best-quality-first, and containing the default arm.
+    Raises ``ValueError`` naming the offending knob."""
+    sup = supported_arms()
+    seen = []
+    for a in arms:
+        if a not in PRECISION_ORDER:
+            raise ValueError(
+                f"unknown precision arm {a!r} in serve.precision_arms; "
+                f"known: {list(PRECISION_ORDER)}")
+        if a not in sup:
+            raise ValueError(
+                f"precision arm {a!r} is not supported by this jaxlib "
+                f"build (supported: {list(sup)})")
+        if a not in seen:
+            seen.append(a)
+    if not seen:
+        raise ValueError("serve.precision_arms must enable at least one arm")
+    if default not in seen:
+        raise ValueError(
+            f"serve.precision={default!r} is not among the enabled "
+            f"serve.precision_arms {list(seen)}")
+    return tuple(sorted(seen, key=PRECISION_ORDER.index))
+
+
+def step_down(arm: str, enabled: Sequence[str], steps: int = 1) -> str:
+    """``steps`` quality notches below ``arm`` within ``enabled``
+    (clamped at the lowest enabled arm; 0 steps is the identity).
+    ``enabled`` must be ordered best-first (``validate_arms`` output)."""
+    if arm not in enabled:
+        raise ValueError(f"arm {arm!r} not in enabled set {list(enabled)}")
+    i = list(enabled).index(arm)
+    return enabled[min(i + max(int(steps), 0), len(enabled) - 1)]
+
+
+# -- weight views ------------------------------------------------------
+
+
+def _is_weight(x) -> bool:
+    """Quantization targets: ≥2-D floating leaves (conv kernels, dense
+    matrices).  1-D floats (biases, BN scale/offset/stats) stay f32 —
+    they are byte-trivial and quality-critical."""
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) and \
+        np.ndim(x) >= 2
+
+
+def quantize_variables(variables, arm: str) -> Dict[str, Any]:
+    """f32 variables → ``{"q": leaves, "s": scales}`` bundle (same
+    treedef twice).  Weight leaves are stored at 8 bits with a
+    per-output-channel (last axis) symmetric scale; every other leaf
+    rides along unchanged in ``q`` (its ``s`` slot is a placeholder the
+    dequantizer never reads)."""
+    if arm not in QUANT_ARMS:
+        raise ValueError(f"{arm!r} is not a quantized arm ({QUANT_ARMS})")
+    qmax = _QMAX[arm]
+    one = np.ones((), np.float32)  # placeholder scale for pass-through
+
+    def split(leaf):
+        if not _is_weight(leaf):
+            return np.asarray(jax.device_get(leaf)), one
+        x = np.asarray(jax.device_get(leaf), np.float32)
+        axes = tuple(range(x.ndim - 1))
+        amax = np.max(np.abs(x), axis=axes, keepdims=True)
+        scale = np.where(amax > 0, amax / qmax, 1.0).astype(np.float32)
+        if arm == "int8":
+            q = np.clip(np.round(x / scale), -qmax, qmax).astype(np.int8)
+        else:  # fp8: the cast itself rounds to e4m3's grid
+            q = (x / scale).astype(jnp.float8_e4m3fn)
+        return q, scale
+
+    flat = jax.tree_util.tree_map(split, variables)
+    return {
+        "q": jax.tree_util.tree_map(lambda p: p[0], flat,
+                                    is_leaf=lambda p: isinstance(p, tuple)),
+        "s": jax.tree_util.tree_map(lambda p: p[1], flat,
+                                    is_leaf=lambda p: isinstance(p, tuple)),
+    }
+
+
+def dequantize_variables(qvars: Dict[str, Any]):
+    """Bundle → dense f32-ish variables (runs inside the compiled
+    forward; the dtype check is static at trace time)."""
+    qdtypes = tuple(jnp.dtype(d) for d in ("int8",)
+                    ) + ((jnp.dtype(jnp.float8_e4m3fn),)
+                         if hasattr(jnp, "float8_e4m3fn") else ())
+
+    def deq(q, s):
+        if jnp.asarray(q).dtype in qdtypes:
+            return q.astype(jnp.float32) * s
+        return q
+
+    return jax.tree_util.tree_map(deq, qvars["q"], qvars["s"])
+
+
+def cast_variables(variables, arm: str):
+    """The arm's weight view of an f32 variables pytree.
+
+    - ``f32``: the identity (same object — no copy).
+    - ``bf16``: every floating leaf cast to bfloat16.
+    - ``int8``/``fp8``: the quantized ``{"q", "s"}`` bundle
+      (:func:`quantize_variables`).
+    """
+    if arm == "f32":
+        return variables
+    if arm == "bf16":
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            variables)
+    if arm in QUANT_ARMS:
+        return quantize_variables(variables, arm)
+    raise ValueError(f"unknown precision arm {arm!r}")
+
+
+# -- forwards ----------------------------------------------------------
+
+
+def make_precision_forward(model, arm: str):
+    """The canonical serving forward for one arm:
+    ``(arm_variables, batch) -> probs`` (sigmoid on the primary logit,
+    f32, [B,H,W]) — the same contract as ``eval/inference.make_forward``
+    so a served map is bitwise what a direct call at the same arm
+    produces.  f32/bf16 arms run ``make_forward`` itself (plain
+    variables); quantized arms dequantize in-program first."""
+    from ..eval.inference import make_forward
+
+    base = make_forward(model)
+    if arm in ("f32", "bf16"):
+        return base
+    if arm not in QUANT_ARMS:
+        raise ValueError(f"unknown precision arm {arm!r}")
+
+    # Delegate to the ONE canonical forward (inlined at trace time):
+    # the quantized arms can never drift from the eval-path contract.
+    @jax.jit
+    def forward(qvars, batch):
+        return base(dequantize_variables(qvars), batch)
+
+    return forward

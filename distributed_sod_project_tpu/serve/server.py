@@ -4,11 +4,15 @@ Endpoints:
 
 - ``POST /predict`` — body: ``.npy`` bytes of an (H, W, 3) uint8 image
   (float32 in [0,1] accepted, quantized through uint8).  Optional
-  ``X-SLO-MS`` header sets a per-request deadline.  200 responds with
-  ``.npy`` float32 (H, W) saliency at the ORIGINAL resolution plus
-  ``X-Degraded`` / ``X-Res-Bucket`` / ``X-Batch-Bucket`` /
-  ``X-Queue-MS`` / ``X-Device-MS`` / ``X-E2E-MS`` headers.  Overload
-  sheds with 429, a missed SLO with 504, an unhealthy engine with 503.
+  ``X-SLO-MS`` header sets a per-request deadline; optional
+  ``X-Precision`` selects a precision arm (must be enabled — 400 on an
+  unknown arm; the degraded ladder may still step it down).  200
+  responds with ``.npy`` float32 (H, W) saliency at the ORIGINAL
+  resolution plus ``X-Degraded`` (the ladder level, "0" when clean) /
+  ``X-Precision`` (the arm actually served) / ``X-Res-Bucket`` /
+  ``X-Batch-Bucket`` / ``X-Queue-MS`` / ``X-Device-MS`` / ``X-E2E-MS``
+  headers.  Overload sheds with 429, a missed SLO with 504, an
+  unhealthy engine with 503.
 - ``GET /healthz``  — 200 while the dispatch loop's resilience-watchdog
   heartbeat is live, 503 once it stalls (or the engine stopped).
 - ``GET /metrics``  — Prometheus text (ServeStats: latency histograms,
@@ -104,15 +108,33 @@ class ServeHandler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001 — client error surface
                 self._send_json(400, {"error": f"body is not .npy: {e}"})
                 return
+            precision = self.headers.get("X-Precision")
+            if precision is not None:
+                precision = precision.strip().lower()
+                if precision not in self.engine.precision_arms:
+                    # Rejected before submit(): never entered the
+                    # engine's accounting (nothing was submitted).
+                    self._send_json(400, {
+                        "error": f"unknown precision {precision!r}; "
+                                 "enabled arms: "
+                                 f"{list(self.engine.precision_arms)}"})
+                    return
             slo = self.headers.get("X-SLO-MS")
             fut = self.engine.submit(
-                image, slo_ms=float(slo) if slo is not None else None)
+                image, slo_ms=float(slo) if slo is not None else None,
+                precision=precision)
             pred, meta = fut.result(
                 timeout=self.engine.cfg.serve.request_timeout_s)
             buf = io.BytesIO()
             np.save(buf, pred)
             self._send(200, buf.getvalue(), "application/x-npy", headers=[
-                ("X-Degraded", "1" if meta.get("degraded") else "0"),
+                # The ladder rung the request was admitted at ("0" stays
+                # falsy for the historical binary readers).
+                ("X-Degraded", str(meta.get("degraded_level",
+                                            int(bool(meta.get("degraded")))))),
+                # The arm actually served (ladder-adjusted) — loadgen
+                # splits its latency curves on this.
+                ("X-Precision", str(meta.get("precision"))),
                 ("X-Res-Bucket", str(meta.get("res_bucket"))),
                 ("X-Batch-Bucket", str(meta.get("batch_bucket"))),
                 ("X-Queue-MS", f"{meta.get('queue_ms', 0):.3f}"),

@@ -159,19 +159,63 @@ class LatencyHistogram:
             "p99_ms": round(self.percentile(0.99), 3),
         }
 
-    def prom_lines(self, name: str) -> list:
+    def prom_lines(self, name: str, labels: str = "",
+                   include_type: bool = True) -> list:
+        """Prometheus exposition lines; ``labels`` is a pre-rendered
+        label set (e.g. ``arm="bf16"``) merged into every sample so
+        per-arm histograms share one metric family (pass
+        ``include_type=False`` for every family member after the first
+        — TYPE may appear only once per family)."""
         with self._lock:
             counts = list(self._counts)
             s, n = self._sum, self._n
-        lines = [f"# TYPE {name} histogram"]
+        pre = f"{labels}," if labels else ""
+        suf = f"{{{labels}}}" if labels else ""
+        lines = [f"# TYPE {name} histogram"] if include_type else []
         cum = 0
         for b, c in zip(self._bounds, counts):
             cum += c
-            lines.append(f'{name}_bucket{{le="{b:g}"}} {cum}')
-        lines.append(f'{name}_bucket{{le="+Inf"}} {n}')
-        lines.append(f"{name}_sum {s:g}")
-        lines.append(f"{name}_count {n}")
+            lines.append(f'{name}_bucket{{{pre}le="{b:g}"}} {cum}')
+        lines.append(f'{name}_bucket{{{pre}le="+Inf"}} {n}')
+        lines.append(f"{name}_sum{suf} {s:g}")
+        lines.append(f"{name}_count{suf} {n}")
         return lines
+
+
+class ArmStats:
+    """Per-precision-arm serving telemetry (one instance per arm,
+    created lazily by :meth:`ServeStats.arm`): the latency tail and the
+    padding tax are only actionable split per compiled-program family,
+    because the arms are different programs with different device
+    costs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.device_ms = LatencyHistogram()
+        self.e2e_ms = LatencyHistogram()
+        self._served = 0
+        self._occ_sum = 0
+        self._occ_slots = 0
+
+    def inc_served(self, n: int = 1) -> None:
+        with self._lock:
+            self._served += n
+
+    def observe_batch(self, occupancy: int, bucket: int) -> None:
+        with self._lock:
+            self._occ_sum += int(occupancy)
+            self._occ_slots += int(bucket)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = {"served": float(self._served)}
+            if self._occ_slots:
+                out["batch_occupancy"] = round(
+                    self._occ_sum / self._occ_slots, 4)
+        for name, h in (("device", self.device_ms), ("e2e", self.e2e_ms)):
+            for k, v in h.snapshot().items():
+                out[f"{name}_{k}"] = v
+        return out
 
 
 class ServeStats:
@@ -188,7 +232,12 @@ class ServeStats:
     ``device_ms`` (dispatch → device fetch complete), ``e2e_ms``
     (arrival → response ready).  Batch occupancy records how full the
     static batch buckets run (occupancy_sum / occupancy_batches — the
-    padding tax is 1 minus that ratio over the bucket sizes).
+    padding tax is 1 minus that ratio over the bucket sizes).  Each
+    precision arm additionally owns an :class:`ArmStats` (device/e2e
+    histograms, served count, occupancy) exposed under ``arm=`` labels
+    in /metrics, so loadgen curves and dashboards split per arm.
+    ``degraded`` is the ladder level (0 = full quality); the
+    entered/exited counters tick on the 0 ↔ >0 boundary.
     """
 
     COUNTERS = ("submitted", "served", "shed", "expired", "errors",
@@ -200,11 +249,12 @@ class ServeStats:
         self.queue_ms = LatencyHistogram()
         self.device_ms = LatencyHistogram()
         self.e2e_ms = LatencyHistogram()
+        self._arms: Dict[str, ArmStats] = {}
         self._occ_sum = 0
         self._occ_slots = 0
         self._queue_depth = 0
         self._inflight = 0
-        self._degraded = False
+        self._degraded_level = 0
         self._healthy = True
         self._health_reason = ""
 
@@ -212,11 +262,23 @@ class ServeStats:
         with self._lock:
             self._counts[key] += n
 
-    def observe_batch(self, occupancy: int, bucket: int) -> None:
+    def arm(self, name: str) -> ArmStats:
+        """The named arm's stats, created on first touch (lazy so the
+        metric surface only shows arms that actually served)."""
+        with self._lock:
+            st = self._arms.get(name)
+            if st is None:
+                st = self._arms[name] = ArmStats()
+            return st
+
+    def observe_batch(self, occupancy: int, bucket: int,
+                      arm: Optional[str] = None) -> None:
         with self._lock:
             self._counts["batches"] += 1
             self._occ_sum += int(occupancy)
             self._occ_slots += int(bucket)
+        if arm is not None:
+            self.arm(arm).observe_batch(occupancy, bucket)
 
     def set_queue_depth(self, depth: int) -> None:
         with self._lock:
@@ -226,13 +288,16 @@ class ServeStats:
         with self._lock:
             self._inflight = int(n)
 
-    def set_degraded(self, degraded: bool) -> None:
+    def set_degraded(self, level) -> None:
+        """Feed the current ladder level (bool accepted for the binary
+        callers: True == 1)."""
+        level = int(level)
         with self._lock:
-            if degraded and not self._degraded:
+            if level > 0 and self._degraded_level == 0:
                 self._counts["degraded_entered"] += 1
-            elif not degraded and self._degraded:
+            elif level == 0 and self._degraded_level > 0:
                 self._counts["degraded_exited"] += 1
-            self._degraded = bool(degraded)
+            self._degraded_level = level
 
     def set_health(self, healthy: bool, reason: str = "") -> None:
         with self._lock:
@@ -252,7 +317,12 @@ class ServeStats:
     @property
     def degraded(self) -> bool:
         with self._lock:
-            return self._degraded
+            return self._degraded_level > 0
+
+    @property
+    def degraded_level(self) -> int:
+        with self._lock:
+            return self._degraded_level
 
     def counter(self, key: str) -> int:
         with self._lock:
@@ -263,16 +333,20 @@ class ServeStats:
             out = {k: float(v) for k, v in self._counts.items()}
             out["queue_depth"] = float(self._queue_depth)
             out["inflight"] = float(self._inflight)
-            out["degraded"] = float(self._degraded)
+            out["degraded"] = float(self._degraded_level > 0)
+            out["degraded_level"] = float(self._degraded_level)
             out["healthy"] = float(self._healthy)
             if self._occ_slots:
                 out["batch_occupancy"] = round(
                     self._occ_sum / self._occ_slots, 4)
+            arms = dict(self._arms)
         for name, h in (("queue", self.queue_ms),
                         ("device", self.device_ms),
                         ("e2e", self.e2e_ms)):
             for k, v in h.snapshot().items():
                 out[f"{name}_{k}"] = v
+        if arms:
+            out["arms"] = {a: st.snapshot() for a, st in sorted(arms.items())}
         return out
 
     def render_prometheus(self) -> str:
@@ -282,10 +356,12 @@ class ServeStats:
             gauges = {
                 "dsod_serve_queue_depth": self._queue_depth,
                 "dsod_serve_inflight": self._inflight,
-                "dsod_serve_degraded": int(self._degraded),
+                "dsod_serve_degraded": int(self._degraded_level > 0),
+                "dsod_serve_degraded_level": self._degraded_level,
                 "dsod_serve_healthy": int(self._healthy),
             }
             occ = (self._occ_sum, self._occ_slots)
+            arms = sorted(self._arms.items())
         lines = []
         for k, v in sorted(counts.items()):
             name = f"dsod_serve_{k}_total"
@@ -301,6 +377,37 @@ class ServeStats:
         lines += self.queue_ms.prom_lines("dsod_serve_queue_latency_ms")
         lines += self.device_ms.prom_lines("dsod_serve_device_latency_ms")
         lines += self.e2e_ms.prom_lines("dsod_serve_e2e_latency_ms")
+        # Per-arm families: each family ONE contiguous group (TYPE line
+        # first, then every arm's sample under an arm= label) — the
+        # text-format rule parsers enforce; interleaving families
+        # breaks promtool/OpenMetrics scrapes.
+        counters = []
+        for a, st in arms:
+            with st._lock:
+                counters.append((a, st._served, st._occ_sum, st._occ_slots))
+        if counters:
+            lines.append("# TYPE dsod_serve_arm_served_total counter")
+            for a, served, _o, _s in counters:
+                lines.append(
+                    f'dsod_serve_arm_served_total{{arm="{a}"}} {served}')
+            lines.append("# TYPE dsod_serve_arm_batch_occupancy_sum counter")
+            for a, _served, occ_sum, _s in counters:
+                lines.append(
+                    f'dsod_serve_arm_batch_occupancy_sum{{arm="{a}"}} '
+                    f'{occ_sum}')
+            lines.append("# TYPE dsod_serve_arm_batch_slots_sum counter")
+            for a, _served, _o, occ_slots in counters:
+                lines.append(
+                    f'dsod_serve_arm_batch_slots_sum{{arm="{a}"}} '
+                    f'{occ_slots}')
+        for i, (a, st) in enumerate(arms):
+            lines += st.device_ms.prom_lines(
+                "dsod_serve_arm_device_latency_ms", labels=f'arm="{a}"',
+                include_type=(i == 0))
+        for i, (a, st) in enumerate(arms):
+            lines += st.e2e_ms.prom_lines(
+                "dsod_serve_arm_e2e_latency_ms", labels=f'arm="{a}"',
+                include_type=(i == 0))
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,534 @@
+"""Low-precision serving fast path (serve/precision.py + the degraded
+ladder — docs/SERVING.md "Precision arms").
+
+Invariants proven here:
+
+- cast-on-load weight views: bf16 casts every floating leaf, int8/fp8
+  quantize exactly the ≥2-D weight leaves with bounded round-trip
+  error, and the quantized forward tracks the f32 forward;
+- the degraded ladder engages PRECISION before RESOLUTION and
+  disengages in reverse order, one hysteretic rung at a time
+  (fake-clock, no device);
+- end-to-end over live HTTP: an ``X-Precision`` request serves at that
+  arm, echoes it, and the response is BITWISE what a direct
+  ``make_precision_forward`` call at the same buckets and arm
+  produces; unknown arms 400 without touching the accounting, and the
+  served+shed+expired+errors == submitted identity closes across
+  mixed-arm traffic;
+- the loadgen summary splits latency per SERVED arm;
+- /metrics exposes per-arm histograms/occupancy and the ladder level;
+- the quality-gate ledger logic (tools/precision_gate.py): seeding,
+  budget comparison, --fail-on-increase, and the never-seed-from-a-
+  failed-run rule.
+"""
+
+import io
+import threading
+import urllib.error
+import urllib.request
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sod_project_tpu.configs import (DataConfig,
+                                                 ExperimentConfig,
+                                                 ServeConfig)
+from distributed_sod_project_tpu.eval.inference import (_resize_pred,
+                                                        pad_to_batch)
+from distributed_sod_project_tpu.serve import precision as P
+from distributed_sod_project_tpu.serve.admission import AdmissionController
+from distributed_sod_project_tpu.serve.engine import (InferenceEngine,
+                                                      preprocess_image)
+from distributed_sod_project_tpu.serve.loadgen import run_loadgen
+from distributed_sod_project_tpu.serve.server import make_server
+from distributed_sod_project_tpu.utils.observability import ServeStats
+
+
+class TinySOD(nn.Module):
+    @nn.compact
+    def __call__(self, image, depth=None, train=False):
+        x = nn.Conv(4, (3, 3), name="c1")(image)
+        x = nn.relu(x)
+        return (nn.Conv(1, (1, 1), name="head")(x),)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = TinySOD()
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 16, 16, 3), np.float32), None,
+                           train=False)
+    return model, variables
+
+
+def _cfg(**serve_kw):
+    serve_kw.setdefault("batch_buckets", (1, 2))
+    serve_kw.setdefault("resolution_buckets", (16, 24))
+    serve_kw.setdefault("max_wait_ms", 5.0)
+    serve_kw.setdefault("watchdog_deadline_s", 30.0)
+    serve_kw.setdefault("precision_arms", ("f32", "bf16", "int8"))
+    return ExperimentConfig(data=DataConfig(image_size=(16, 16)),
+                            serve=ServeConfig(**serve_kw))
+
+
+def _engine(tiny, **serve_kw):
+    model, variables = tiny
+    return InferenceEngine(_cfg(**serve_kw), model, variables)
+
+
+def _img(seed, h, w):
+    return np.random.RandomState(seed).randint(0, 256, (h, w, 3), np.uint8)
+
+
+# ------------------------------------------------------- weight views
+
+
+def test_supported_and_validate_arms():
+    sup = P.supported_arms()
+    assert sup[:3] == ("f32", "bf16", "int8")
+    assert P.validate_arms(("bf16", "f32"), "f32") == ("f32", "bf16")
+    with pytest.raises(ValueError, match="unknown precision arm"):
+        P.validate_arms(("f32", "f16"), "f32")
+    with pytest.raises(ValueError, match="not among the enabled"):
+        P.validate_arms(("f32",), "bf16")
+    with pytest.raises(ValueError, match="at least one arm"):
+        P.validate_arms((), "f32")
+
+
+def test_step_down_walks_enabled_arms_and_clamps():
+    enabled = ("f32", "bf16", "int8")
+    assert P.step_down("f32", enabled, 0) == "f32"
+    assert P.step_down("f32", enabled, 1) == "bf16"
+    assert P.step_down("f32", enabled, 2) == "int8"
+    assert P.step_down("f32", enabled, 9) == "int8"  # clamped
+    assert P.step_down("bf16", enabled, 1) == "int8"
+    assert P.step_down("int8", enabled, 1) == "int8"
+    with pytest.raises(ValueError):
+        P.step_down("fp8", enabled, 1)
+
+
+def test_cast_variables_bf16_casts_float_leaves(tiny):
+    _model, variables = tiny
+    bv = P.cast_variables(variables, "bf16")
+    assert jax.tree_util.tree_structure(bv) \
+        == jax.tree_util.tree_structure(variables)
+    for leaf in jax.tree_util.tree_leaves(bv):
+        assert leaf.dtype == jnp.bfloat16
+    # f32 is the identity view — same object, no copy.
+    assert P.cast_variables(variables, "f32") is variables
+
+
+@pytest.mark.parametrize("arm", ["int8", "fp8"])
+def test_quantize_roundtrip_error_bounded(tiny, arm):
+    if arm not in P.supported_arms():
+        pytest.skip(f"{arm} not supported by this jaxlib")
+    _model, variables = tiny
+    qv = P.cast_variables(variables, arm)
+    assert set(qv) == {"q", "s"}
+    # Weight leaves (ndim >= 2) are stored at 8 bits; 1-D leaves ride
+    # through untouched.
+    for path, leaf in jax.tree_util.tree_leaves_with_path(qv["q"]):
+        if np.ndim(leaf) >= 2:
+            assert leaf.dtype in (jnp.int8, getattr(jnp, "float8_e4m3fn",
+                                                    jnp.int8))
+        else:
+            assert leaf.dtype == jnp.float32
+    dq = P.dequantize_variables(qv)
+    for orig, back in zip(jax.tree_util.tree_leaves(variables),
+                          jax.tree_util.tree_leaves(dq)):
+        orig = np.asarray(orig, np.float32)
+        back = np.asarray(back, np.float32)
+        if orig.ndim < 2:
+            assert np.array_equal(orig, back)  # never quantized
+        else:
+            amax = np.max(np.abs(orig), axis=tuple(range(orig.ndim - 1)),
+                          keepdims=True)
+            if arm == "int8":
+                # Uniform grid: error ≤ one quantization step.
+                bound = amax / 127.0
+            else:
+                # e4m3 is floating: RELATIVE half-ulp (2^-4) for normal
+                # values plus the subnormal floor near zero.
+                bound = np.abs(orig) * 2.0 ** -4 + amax / 448.0
+            assert np.all(np.abs(orig - back) <= bound + 1e-7)
+
+
+def test_quant_forward_tracks_f32(tiny):
+    model, variables = tiny
+    batch = {"image": np.random.RandomState(1).rand(2, 16, 16, 3)
+             .astype(np.float32)}
+    ref = np.asarray(P.make_precision_forward(model, "f32")(
+        variables, batch))
+    out = np.asarray(P.make_precision_forward(model, "int8")(
+        P.cast_variables(variables, "int8"), batch))
+    assert out.shape == ref.shape and out.dtype == np.float32
+    assert np.max(np.abs(out - ref)) < 0.05  # sigmoid-space, tiny net
+
+
+# ------------------------------------------------------------- ladder
+
+
+def test_ladder_engages_one_rung_at_a_time_with_hysteresis():
+    """The satellite contract: under sustained overload the ladder
+    climbs rung by rung (precision first — the engine maps rung 1 to a
+    precision step, only the LAST rung to resolution), each rung
+    earning its own engage_s dwell; recovery unwinds in reverse order,
+    each step earning disengage_s."""
+    clk = [0.0]
+    a = AdmissionController(10, high=0.8, low=0.2, engage_s=1.0,
+                            disengage_s=2.0, max_level=2,
+                            clock=lambda: clk[0])
+    assert a.observe(9) is False and a.level == 0
+    clk[0] = 0.9
+    assert a.level == 0 or not a.observe(9)  # dwell not met
+    clk[0] = 1.1
+    a.observe(9)
+    assert a.level == 1  # precision rung first
+    clk[0] = 1.9  # the NEXT rung needs its own dwell from the transition
+    a.observe(9)
+    assert a.level == 1
+    clk[0] = 2.2
+    a.observe(9)
+    assert a.level == 2  # resolution rung only after another dwell
+    clk[0] = 3.4
+    a.observe(9)
+    assert a.level == 2  # clamped at max_level
+    # Recovery: reverse order, one rung per disengage_s.
+    clk[0] = 4.0
+    a.observe(1)
+    assert a.level == 2
+    clk[0] = 6.1
+    a.observe(1)
+    assert a.level == 1  # resolution restored first
+    clk[0] = 7.0
+    a.observe(5)  # dead band resets the below-timer
+    assert a.level == 1
+    clk[0] = 8.0
+    a.observe(1)
+    clk[0] = 9.9  # only 1.9s below since the dead-band reset
+    a.observe(1)
+    assert a.level == 1
+    clk[0] = 10.1
+    assert a.observe(1) is False and a.level == 0
+
+
+def test_engine_ladder_steps_precision_before_resolution(tiny):
+    """Engine-level ordering, fake-forced levels on a live engine:
+    rung 1 = bf16 at FULL resolution, rung 2 = bf16 + int8... the last
+    precision rung, final rung = smallest res bucket; unwinding in
+    reverse restores resolution before precision."""
+    eng = _engine(tiny)  # arms (f32, bf16, int8) -> max_level 3
+    assert eng.admission.max_level == 3
+    eng.start()
+    try:
+        img = _img(0, 40, 40)
+        expect = [
+            (0, "f32", max(eng.res_buckets)),
+            (1, "bf16", max(eng.res_buckets)),   # precision first...
+            (2, "int8", max(eng.res_buckets)),   # ...all rungs of it...
+            (3, "int8", min(eng.res_buckets)),   # ...resolution LAST
+            (2, "int8", max(eng.res_buckets)),   # reverse: res restored
+            (1, "bf16", max(eng.res_buckets)),
+            (0, "f32", max(eng.res_buckets)),
+        ]
+        for level, arm, res in expect:
+            eng.admission._level = level
+            _, meta = eng.predict(img, timeout=30)
+            assert (meta["precision"], meta["res_bucket"]) == (arm, res), \
+                f"level {level}: got ({meta['precision']}, " \
+                f"{meta['res_bucket']}), want ({arm}, {res})"
+            assert meta["degraded"] is (level > 0)
+            assert meta["degraded_level"] == level
+    finally:
+        eng.stop()
+
+
+def test_engine_requested_arm_still_steps_down_when_degraded(tiny):
+    eng = _engine(tiny)
+    eng.start()
+    try:
+        eng.admission._level = 1
+        _, meta = eng.predict(_img(0, 16, 16), timeout=30,
+                              precision="bf16")
+        assert meta["precision"] == "int8"  # one rung below the request
+        eng.admission._level = 0
+        _, meta = eng.predict(_img(0, 16, 16), timeout=30,
+                              precision="bf16")
+        assert meta["precision"] == "bf16"
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------- live-HTTP e2e
+
+
+def _start_http(eng):
+    srv = make_server(eng, "127.0.0.1", 0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _post_predict(url, img, precision=None, timeout=60.0):
+    buf = io.BytesIO()
+    np.save(buf, img)
+    headers = {"Content-Type": "application/x-npy"}
+    if precision:
+        headers["X-Precision"] = precision
+    req = urllib.request.Request(url + "/predict", data=buf.getvalue(),
+                                 headers=headers, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return np.load(io.BytesIO(r.read()), allow_pickle=False), \
+            dict(r.headers)
+
+
+def test_e2e_per_arm_bitwise_vs_direct_forward_and_accounting(tiny):
+    """The acceptance run: X-Precision requests serve at that arm, echo
+    it, and each response is BITWISE the direct make_precision_forward
+    at the same (res, batch) buckets and arm; the accounting identity
+    closes over the mixed-arm traffic."""
+    model, variables = tiny
+    eng = _engine(tiny, max_wait_ms=20.0)
+    eng.start()
+    srv, url = _start_http(eng)
+    try:
+        arms = list(eng.precision_arms)
+        warmed = set(eng.programs)
+        assert len(warmed) == 2 * 2 * len(arms)  # res x batch x arms
+        sizes = [(16, 16), (20, 28), (24, 24), (40, 40)]
+        n = 8
+        out = [None] * n
+        errs = []
+
+        def one(i):
+            try:
+                out[i] = _post_predict(url, _img(i, *sizes[i % len(sizes)]),
+                                       precision=arms[i % len(arms)])
+            except Exception as e:  # pragma: no cover — surfaces below
+                errs.append((i, e))
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, f"request failures: {errs}"
+
+        cfg = eng.cfg
+        fwds = {a: P.make_precision_forward(model, a) for a in arms}
+        views = {a: P.cast_variables(variables, a) for a in arms}
+        for i in range(n):
+            pred, headers = out[i]
+            arm = arms[i % len(arms)]
+            assert headers["X-Precision"] == arm  # echoed, served as asked
+            assert headers["X-Degraded"] == "0"
+            img = _img(i, *sizes[i % len(sizes)])
+            res = int(headers["X-Res-Bucket"])
+            bb = int(headers["X-Batch-Bucket"])
+            x = preprocess_image(img, res, cfg.data.normalize_mean,
+                                 cfg.data.normalize_std)
+            ref = np.asarray(fwds[arm](
+                views[arm], pad_to_batch({"image": x[None]}, bb)))[0]
+            ref = _resize_pred(ref, img.shape[:2])
+            assert np.array_equal(pred, ref), \
+                f"request {i}: served map not bitwise-identical to the " \
+                f"direct {arm} forward at buckets (res={res}, batch={bb})"
+
+        s = eng.stats
+        assert s.counter("submitted") == n
+        assert (s.counter("served") + s.counter("shed")
+                + s.counter("expired") + s.counter("errors")) == n
+        assert s.counter("errors") == 0
+        # Every arm was AOT-warmed at startup: serving mixed-arm
+        # traffic compiled NOTHING new.
+        assert set(eng.programs) == warmed
+        # Per-arm serving telemetry reached /metrics.
+        prom = urllib.request.urlopen(url + "/metrics", timeout=10
+                                      ).read().decode()
+        for arm in arms:
+            assert f'dsod_serve_arm_served_total{{arm="{arm}"}}' in prom
+            assert (f'dsod_serve_arm_e2e_latency_ms_bucket{{arm="{arm}"'
+                    in prom)
+        assert "dsod_serve_degraded_level 0" in prom
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.stop()
+
+
+def test_e2e_unknown_precision_400s_without_touching_accounting(tiny):
+    eng = _engine(tiny)
+    eng.start()
+    srv, url = _start_http(eng)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_predict(url, _img(0, 16, 16), precision="f16")
+        assert exc.value.code == 400
+        assert "enabled arms" in exc.value.read().decode()
+        # Rejected before submit(): the engine never saw it.
+        assert eng.stats.counter("submitted") == 0
+        # ...and a well-formed request still flows.
+        _, headers = _post_predict(url, _img(0, 16, 16))
+        assert headers["X-Precision"] == "f32"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.stop()
+
+
+def test_e2e_default_arm_comes_from_config(tiny):
+    eng = _engine(tiny, precision="bf16")
+    eng.start()
+    srv, url = _start_http(eng)
+    try:
+        _, headers = _post_predict(url, _img(0, 16, 16))  # no header
+        assert headers["X-Precision"] == "bf16"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.stop()
+
+
+def test_loadgen_reports_per_served_arm_breakdown(tiny):
+    eng = _engine(tiny, max_wait_ms=2.0)
+    eng.start()
+    srv, url = _start_http(eng)
+    try:
+        summary = run_loadgen(url, mode="closed", concurrency=2,
+                              requests=6, sizes=((16, 16),), seed=0,
+                              precision="bf16", timeout_s=60)
+        assert summary["ok"] == 6
+        assert summary["precision"] == "bf16"
+        assert summary["arms"]["bf16"]["ok"] == 6
+        for k in ("p50_ms", "p95_ms", "p99_ms"):
+            assert summary["arms"]["bf16"][k] >= 0.0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.stop()
+
+
+def test_engine_rejects_misconfigured_arms(tiny):
+    model, variables = tiny
+    with pytest.raises(ValueError, match="not among the enabled"):
+        InferenceEngine(_cfg(precision="fp8",
+                             precision_arms=("f32", "bf16")),
+                        model, variables)
+    with pytest.raises(ValueError, match="unknown precision arm"):
+        InferenceEngine(_cfg(precision_arms=("f32", "f64")),
+                        model, variables)
+
+
+# ---------------------------------------------------------- ServeStats
+
+
+def test_serve_stats_degraded_level_counts_0_boundary_only():
+    s = ServeStats()
+    s.set_degraded(1)
+    s.set_degraded(2)  # deeper rung: NOT another "entered"
+    s.set_degraded(3)
+    s.set_degraded(1)
+    s.set_degraded(0)
+    snap = s.snapshot()
+    assert snap["degraded_entered"] == 1 and snap["degraded_exited"] == 1
+    assert snap["degraded_level"] == 0.0
+    s.set_degraded(True)  # binary callers still work
+    assert s.degraded_level == 1 and s.degraded is True
+
+
+# ------------------------------------------------- quality-gate ledger
+
+
+def _report(d_fbeta=0.0, d_mae=0.0):
+    return {"arms": {
+        "f32": {"max_fbeta": 0.8, "delta_max_fbeta": 0.0,
+                "mae": 0.1, "delta_mae": 0.0},
+        "bf16": {"max_fbeta": 0.8 - d_fbeta,
+                 "delta_max_fbeta": d_fbeta,
+                 "mae": 0.1 + d_mae, "delta_mae": d_mae},
+    }, "invariant_failed": False, "reasons": []}
+
+
+def test_gate_build_report_deltas_and_invariants(tiny):
+    import sys as _sys
+    _sys.path.insert(0, "tools")
+    from precision_gate import build_report
+
+    rep = build_report({"f32": {"max_fbeta": 0.8, "mae": 0.1,
+                                "num_images": 4},
+                        "bf16": {"max_fbeta": 0.78, "mae": 0.12,
+                                 "num_images": 4}}, expected_images=4)
+    assert not rep["invariant_failed"]
+    assert rep["arms"]["bf16"]["delta_max_fbeta"] == pytest.approx(0.02)
+    assert rep["arms"]["bf16"]["delta_mae"] == pytest.approx(0.02)
+    # Short eval set / non-finite metrics poison the run.
+    bad = build_report({"f32": {"max_fbeta": 0.8, "mae": 0.1,
+                                "num_images": 3}}, expected_images=4)
+    assert bad["invariant_failed"]
+    nan = build_report({"f32": {"max_fbeta": float("nan"), "mae": 0.1,
+                                "num_images": 4}}, expected_images=4)
+    assert nan["invariant_failed"]
+
+
+def test_gate_apply_baseline_seed_compare_and_gate():
+    import sys as _sys
+    _sys.path.insert(0, "tools")
+    from precision_gate import apply_baseline
+
+    key = "cfg@64px-n12-s0"
+    # First contact seeds.
+    rc, base, summary = apply_baseline(_report(0.01, 0.002), {}, key)
+    assert rc == 0 and summary.get("recorded") and key in base
+    # Within budget: rc 0, zero delta-vs-recorded.
+    rc, base2, summary = apply_baseline(_report(0.01, 0.002), base, key,
+                                        fail_on_increase=True)
+    assert rc == 0 and base2 is base
+    assert summary["delta_vs_recorded"]["bf16"]["delta_max_fbeta"] == 0.0
+    # Over budget + --fail-on-increase: rc 2, the breach named.
+    rc, _b, summary = apply_baseline(_report(0.05, 0.002), base, key,
+                                     fail_on_increase=True,
+                                     tolerance=0.003)
+    assert rc == 2 and "bf16.delta_max_fbeta" in summary["over_budget"]
+    # Same breach without the gate flag: recorded, not failed.
+    rc, _b, summary = apply_baseline(_report(0.05, 0.002), base, key,
+                                     fail_on_increase=False)
+    assert rc == 0 and "over_budget" in summary
+    # A failed run NEVER seeds or updates — even with update=True.
+    failed = dict(_report(), invariant_failed=True,
+                  reasons=["bf16.mae is not finite"])
+    rc, b3, summary = apply_baseline(failed, {}, key, update=True)
+    assert rc == 1 and b3 == {} and summary["invariant_failed"]
+    # Checkpoint runs (seed_if_missing=False) never auto-seed the
+    # checked-in ledger: an unseen key reports, but writes nothing.
+    rc, b4, summary = apply_baseline(_report(0.01, 0.002), {}, key,
+                                     seed_if_missing=False)
+    assert rc == 0 and b4 == {} and summary["unrecorded"]
+    # ...unless deliberately recorded.
+    rc, b5, _s = apply_baseline(_report(0.01, 0.002), {}, key,
+                                update=True, seed_if_missing=False)
+    assert rc == 0 and key in b5
+
+
+def test_gate_arm_metrics_end_to_end_tiny(tiny):
+    """The measurement path itself on a minimal model + dataset: the
+    f32 arm scores identically through the gate helper and the bf16 arm
+    yields finite, near-f32 numbers."""
+    import sys as _sys
+    _sys.path.insert(0, "tools")
+    from precision_gate import arm_metrics, build_report
+
+    from distributed_sod_project_tpu.data.synthetic import SyntheticSOD
+
+    model, variables = tiny
+    ds = SyntheticSOD(size=4, image_size=(16, 16))
+    metrics = {arm: arm_metrics(model, variables, ds, arm, batch_size=2)
+               for arm in ("f32", "bf16")}
+    rep = build_report(metrics, expected_images=4)
+    assert not rep["invariant_failed"]
+    assert rep["arms"]["f32"]["delta_max_fbeta"] == 0.0
+    assert abs(rep["arms"]["bf16"]["delta_max_fbeta"]) < 0.05
+    assert abs(rep["arms"]["bf16"]["delta_mae"]) < 0.05

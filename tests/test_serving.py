@@ -142,8 +142,8 @@ def test_batcher_coalescing_never_exceeds_largest_bucket():
     clk[0] = 1.0  # every head is past max-wait
     sizes = []
     while b.pending():
-        res, group = b.get_batch(idle_timeout_s=0.0)
-        assert res == 16
+        (res, arm), group = b.get_batch(idle_timeout_s=0.0)
+        assert (res, arm) == (16, "f32")
         sizes.append(len(group))
     assert all(n <= 4 for n in sizes)
     assert sizes == [4, 4, 2]
@@ -172,7 +172,7 @@ def test_batcher_full_bucket_releases_before_max_wait():
     for _ in range(4):
         b.put(Request(tensor=np.zeros((4, 4, 3), np.float32),
                       orig_hw=(4, 4), res_bucket=24, arrival=clk[0]))
-    res, group = b.get_batch(idle_timeout_s=0.0)
+    (res, _arm), group = b.get_batch(idle_timeout_s=0.0)
     assert (res, len(group)) == (24, 4)  # full bucket: no wait at all
 
 
@@ -186,7 +186,27 @@ def test_batcher_groups_are_per_resolution_bucket():
     groups = []
     while b.pending():
         groups.append(b.get_batch(idle_timeout_s=0.0))
-    assert [(res, len(g)) for res, g in groups] == [(16, 3), (24, 2)]
+    assert [(key, len(g)) for key, g in groups] \
+        == [((16, "f32"), 3), ((24, "f32"), 2)]
+
+
+def test_batcher_groups_are_per_precision_arm():
+    """Same resolution, different precision arms: NEVER coalesced —
+    a batch runs through exactly one compiled program."""
+    clk = [0.0]
+    b = DynamicBatcher((1, 2, 4), max_wait_s=0.1, clock=lambda: clk[0])
+    for i, arm in enumerate(["f32", "bf16", "f32", "bf16", "bf16"]):
+        b.put(Request(tensor=np.zeros((4, 4, 3), np.float32),
+                      orig_hw=(4, 4), res_bucket=16, precision=arm,
+                      arrival=float(i)))
+    clk[0] = 100.0
+    groups = []
+    while b.pending():
+        groups.append(b.get_batch(idle_timeout_s=0.0))
+    assert [(key, len(g)) for key, g in groups] \
+        == [((16, "f32"), 2), ((16, "bf16"), 3)]
+    for key, g in groups:
+        assert all(r.precision == key[1] for r in g)
 
 
 # ----------------------------------------------------------- admission
@@ -250,7 +270,8 @@ def test_engine_warms_every_bucket_program_and_reuses_them(tiny):
     eng = _engine(tiny)
     eng.start()
     try:
-        assert len(eng.programs) == 2 * 3  # res buckets x batch buckets
+        # res buckets x batch buckets x precision arms (default f32+bf16)
+        assert len(eng.programs) == 2 * 3 * 2
         warmed = set(eng.programs)
         for seed, (h, w) in enumerate([(16, 16), (20, 28), (40, 40)]):
             eng.predict(_img(seed, h, w), timeout=30)
@@ -285,15 +306,19 @@ def test_engine_degraded_uses_smallest_res_bucket_and_reports(tiny):
     eng = _engine(tiny)
     eng.start()
     try:
-        eng.admission._degraded = True  # force; hysteresis tested above
+        # Force the FINAL ladder rung; hysteresis is tested above and
+        # the precision-before-resolution ordering in test_precision.py.
+        eng.admission._level = eng.admission.max_level
         pred, meta = eng.predict(_img(0, 40, 40), timeout=30)
         assert meta["degraded"] is True
         assert meta["res_bucket"] == min(eng.res_buckets)
+        assert meta["precision"] == eng.precision_arms[-1]  # fully stepped
         assert pred.shape == (40, 40)
-        eng.admission._degraded = False
+        eng.admission._level = 0
         _, meta2 = eng.predict(_img(0, 40, 40), timeout=30)
         assert meta2["degraded"] is False
         assert meta2["res_bucket"] == max(eng.res_buckets)
+        assert meta2["precision"] == "f32"
     finally:
         eng.stop()
 

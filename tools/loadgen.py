@@ -44,6 +44,12 @@ def parse_args(argv=None):
                         "default 320)")
     p.add_argument("--slo-ms", type=float, default=0.0,
                    help="per-request deadline sent as X-SLO-MS (0=none)")
+    p.add_argument("--precision", default=None,
+                   help="precision arm sent as X-Precision on every "
+                        "request (must be enabled server-side; the "
+                        "summary's per-arm breakdown reports what was "
+                        "actually SERVED — the degraded ladder may "
+                        "step it down)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=60.0,
                    help="per-request client timeout seconds")
@@ -68,7 +74,7 @@ def main(argv=None) -> int:
         url, mode=args.mode, concurrency=args.concurrency,
         requests=args.requests, rps=args.rps, duration_s=args.duration,
         sizes=sizes, seed=args.seed, slo_ms=args.slo_ms,
-        timeout_s=args.timeout)
+        timeout_s=args.timeout, precision=args.precision)
     if args.server_stats:
         try:
             summary["server"] = fetch_stats(url)

@@ -16,6 +16,7 @@ in-process thread server (tests/test_serving.py covers that side).
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import signal
@@ -32,17 +33,27 @@ from distributed_sod_project_tpu.serve.loadgen import (  # noqa: E402
 TOOLS = os.path.dirname(os.path.abspath(__file__))
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--precision", default=None,
+                   help="serve at this precision arm: sets "
+                        "serve.precision on the server AND sends "
+                        "X-Precision on every request, then asserts "
+                        "the per-arm breakdown shows every response "
+                        "was served at that arm (t1.sh runs the bf16 "
+                        "leg)")
+    args = p.parse_args(argv)
     port_file = tempfile.mktemp(prefix="dsod_serve_port_")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.Popen(
-        [sys.executable, os.path.join(TOOLS, "serve.py"),
-         "--config", "minet_vgg16_ref", "--init-random", "--device", "cpu",
-         "--port", "0", "--port-file", port_file,
-         "--set", "data.image_size=64,64",
-         "--set", "serve.resolution_buckets=64",
-         "--set", "serve.batch_buckets=1,2"],
-        env=env)
+    cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
+           "--config", "minet_vgg16_ref", "--init-random", "--device", "cpu",
+           "--port", "0", "--port-file", port_file,
+           "--set", "data.image_size=64,64",
+           "--set", "serve.resolution_buckets=64",
+           "--set", "serve.batch_buckets=1,2"]
+    if args.precision:
+        cmd += ["--set", f"serve.precision={args.precision}"]
+    proc = subprocess.Popen(cmd, env=env)
     try:
         deadline = time.monotonic() + 120
         while not os.path.exists(port_file):
@@ -63,12 +74,18 @@ def main() -> int:
             return 1
         summary = run_loadgen(url, mode="closed", concurrency=1,
                               requests=2, sizes=((48, 56),), seed=0,
-                              timeout_s=60)
+                              timeout_s=60, precision=args.precision)
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=60)
         summary["server_rc"] = rc
         print(json.dumps(summary), flush=True)
-        return 0 if summary.get("ok", 0) == 2 and rc == 0 else 1
+        ok = summary.get("ok", 0) == 2 and rc == 0
+        if args.precision:
+            # Both responses must have been SERVED at the asked arm
+            # (echoed in X-Precision; no ladder pressure at 2 requests).
+            served = summary.get("arms", {}).get(args.precision, {})
+            ok = ok and served.get("ok", 0) == 2
+        return 0 if ok else 1
     finally:
         if proc.poll() is None:
             proc.kill()

@@ -28,7 +28,10 @@ echo "== step-chunking k-equivalence smoke (recorded; the full suite below gates
 timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_step_chunking.py -q -k bitwise_smoke -p no:cacheprovider \
   || echo "step-chunking smoke failed (the main suite below still gates it)"
-echo "== serve smoke: real-process server, one loadgen round-trip, clean SIGTERM drain (recorded, non-gating) =="
-timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/serve_smoke.py \
+echo "== serve smoke: real-process server @ bf16 arm, one loadgen round-trip, clean SIGTERM drain (recorded, non-gating) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/serve_smoke.py --precision bf16 \
   || echo "serve smoke failed (non-gating; tests/test_serving.py below gates the in-process side)"
+echo "== precision quality gate: per-arm max-Fbeta/MAE deltas vs f32 on the tiny synthetic set (recorded, non-gating) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/precision_gate.py \
+  || echo "precision gate smoke failed (non-gating; --fail-on-increase gates locally)"
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

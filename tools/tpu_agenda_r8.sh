@@ -1,0 +1,140 @@
+#!/bin/bash
+# Round-8 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh fires this automatically; default agenda since
+# round 8).  Round 8 landed the low-precision serving fast path
+# (serve/precision.py: bf16/int8 cast-on-load weight views, per-arm AOT
+# programs in the serve cache, a precision-first degraded ladder —
+# docs/SERVING.md "Precision arms").  Quality is already gated on CPU
+# (tools/precision_gate.py ledger); what only hardware can answer:
+#
+#   1. canonical b128 headline refresh (comparison anchor; untouched by
+#      the serving work, so any drift is environmental)
+#   2. per-arm serve bench: bench --mode serve once per precision arm —
+#      the per-chip img/s lever ROADMAP item #3 priced.  Each leg's
+#      --set serve.precision tag keys its own baseline, so arms never
+#      contaminate each other's vs_baseline
+#   3. the per-arm throughput-vs-p99 curve: ONE long-lived server with
+#      all arms warmed, swept closed-loop per arm at rising concurrency
+#      (loadgen --precision splits the curve), to read where the bf16/
+#      int8 knee sits vs f32 — the measured answer to "what does a
+#      precision rung buy before the ladder trades resolution"
+#   4. SLO behavior under pressure: OPEN-loop legs at fixed offered
+#      rates with a 500 ms deadline, per arm — shed/expired counts + the
+#      served-arm breakdown tell whether the ladder actually converts
+#      overload into precision downshifts before resolution downshifts
+#
+# Predictions on record (docs/PERFORMANCE.md "Precision arms"): bf16
+# serve throughput +10-25% over f32 at the b8-bucket operating point
+# (weight HBM halves but activations dominate a 320px conv net); int8
+# within ±10% of bf16 on v5e (no native int8 conv path — the win is
+# weight residency, the cost is the dequant epilogue).  If int8 LOSES
+# to bf16 by >10%, drop it from the default ladder; the knob structure
+# survives either outcome.
+#
+# Serve legs talk to ONE server process started here (ephemeral port,
+# --port-file); loadgen itself never imports jax, so only the server
+# occupies the TPU.
+cd "$(dirname "$0")/.." || exit 1
+R=${R:-tpu_results8}
+mkdir -p "$R"
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+done_ok() {
+  [ -f "$R"/results.jsonl ] || return 1
+  local rec
+  rec=$(grep "\"step\": \"$1\", \"rc\": 0" "$R"/results.jsonl | tail -1)
+  [ -n "$rec" ] || return 1
+  ! printf '%s' "$rec" | grep -q '"error"'
+}
+
+# Circuit breaker (r4 pattern): after any failed leg, verify the
+# tunnel still runs REAL compute; abort the firing if not (the
+# watcher re-fires in the next window and done_ok() skips landed legs).
+tunnel_computes() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('computes')" 2>/dev/null | grep -q computes
+}
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  if done_ok "$name"; then
+    echo "[$name] skip: succeeded in a previous window" | tee -a "$R"/agenda.log
+    return 0
+  fi
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+  if { [ "$rc" -ne 0 ] || printf '%s' "$line" | grep -Eq 'wedged|unavailable'; } \
+      && ! tunnel_computes; then
+    echo "[$name] tunnel no longer computes — aborting firing (watcher will re-fire)" \
+      | tee -a "$R"/agenda.log
+    exit 2
+  fi
+}
+
+# -- 1. canonical headline refresh (the r5-r7 key replays unchanged)
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2. per-arm serve bench: each --set serve.precision tag keys its
+#       own baseline (bench folds overrides into the vs_baseline key).
+for arm in f32 bf16 int8; do
+  run "serve_bench_$arm" 900 $BENCH --mode serve --config minet_r50_dp \
+      --steps 200 --warmup 8 \
+      --set "serve.precision=$arm" \
+      --set "serve.precision_arms=f32,bf16,int8"
+done
+
+# -- 3+4. per-arm throughput-vs-p99 curve against ONE long-lived
+#         server with every arm AOT-warmed.
+SERVE_PORT_FILE="$R/serve.port"
+rm -f "$SERVE_PORT_FILE"
+python tools/serve.py --config minet_r50_dp --init-random --device tpu \
+  --port 0 --port-file "$SERVE_PORT_FILE" \
+  --set "serve.batch_buckets=1,4,8,16" \
+  --set "serve.precision_arms=f32,bf16,int8" \
+  > "$R"/serve_server.out 2> "$R"/serve_server.err &
+SERVE_PID=$!
+for _ in $(seq 1 120); do [ -f "$SERVE_PORT_FILE" ] && break; sleep 2; done
+if [ -f "$SERVE_PORT_FILE" ]; then
+  URL="http://127.0.0.1:$(cat "$SERVE_PORT_FILE")"
+  LG="python tools/loadgen.py --url $URL --wait-ready 600 --size 320"
+  # closed-loop concurrency sweep per arm: the (throughput, p99) curve,
+  # split by precision — smaller c-grid than r7 so three arms still fit
+  # a short tunnel window (the r7 f32 curve anchors the fine grid).
+  for arm in f32 bf16 int8; do
+    for c in 1 8 32; do
+      run "serve_closed_${arm}_c$c" 900 $LG --mode closed \
+          --precision "$arm" --concurrency "$c" --requests 200
+    done
+  done
+  # open-loop SLO probes at fixed offered rates with a 500 ms deadline,
+  # per arm — the served-arm breakdown in the summary shows whether the
+  # ladder stepped precision down under pressure.
+  for arm in f32 bf16; do
+    for rps in 60 120; do
+      run "serve_open_${arm}_rps$rps" 900 $LG --mode open \
+          --precision "$arm" --rps "$rps" --duration 20 \
+          --slo-ms 500 --server-stats
+    done
+  done
+  kill -TERM "$SERVE_PID" 2>/dev/null
+  wait "$SERVE_PID"
+  echo "{\"step\": \"serve_server_drain\", \"rc\": $?, \"result\": null}" >> "$R"/results.jsonl
+else
+  echo "serve server never bound a port — skipping curve legs" | tee -a "$R"/agenda.log
+  kill -9 "$SERVE_PID" 2>/dev/null
+fi
+
+# Host-side window report (touches no TPU).
+timeout 120 python tools/window_report.py "$R"/results.jsonl \
+    > "$R"/window_report.md 2> "$R"/window_report.err || true
+tail -20 "$R"/window_report.md | tee -a "$R"/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
