@@ -268,6 +268,160 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetTenantConfig:
+    """One tenant class for multi-tenant fleet admission (serve/router.py).
+
+    ``priority`` orders tenants into shed classes under backlog: when a
+    target replica's queue is past a class's backlog fraction, that
+    class sheds at the ROUTER (429) while higher classes still admit —
+    the fraction for a class is ``(rank+1) / n_classes`` over the
+    distinct priorities in the fleet (the highest class never priority-
+    sheds before the engine's own queue bound).  ``rate_rps``/``burst``
+    arm a token-bucket budget (requests/s sustained, ``burst`` capacity
+    — defaults to ``rate_rps`` when 0); ``rate_rps=0`` means unlimited.
+    Budgets are enforced at the router door, BEFORE a request ever
+    reaches an engine queue.
+    """
+
+    name: str = "default"
+    priority: int = 0
+    rate_rps: float = 0.0
+    burst: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetModelConfig:
+    """One fleet member: a routing key plus exactly one backend source.
+
+    - ``config`` (registered experiment name) → in-process engine with
+      randomly-initialised weights (smoke/bench posture);
+    - ``ckpt_dir`` → in-process engine serving that checkpoint
+      (``config`` optionally overrides the sidecar config name);
+    - ``url`` → remote serve process proxied as-is (its own engine owns
+      admission and accounting; the router adds tenancy + aggregation).
+
+    ``overrides`` are dotted ``section.field=value`` strings applied to
+    the member's ExperimentConfig (in-process members only).
+    """
+
+    name: str = ""
+    config: Optional[str] = None
+    ckpt_dir: Optional[str] = None
+    url: Optional[str] = None
+    overrides: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Multi-model, multi-tenant serving fleet (serve/fleet.py +
+    serve/router.py; docs/SERVING.md "Fleet").
+
+    A router tier fronting N engine replicas: requests name a model
+    (``X-Model`` header / ``model=`` query field) and a tenant
+    (``X-Tenant``); the router resolves the replica (404 on unknown),
+    enforces the tenant's token-bucket budget and priority class, then
+    forwards.  Co-resident in-process engines share one device through
+    a single interleaved dispatch loop (round-robin over per-model
+    batchers, so a hot model cannot starve a cold one).
+    """
+
+    models: Tuple[FleetModelConfig, ...] = ()
+    tenants: Tuple[FleetTenantConfig, ...] = ()
+    # Tenant class used when a request carries no X-Tenant header (or
+    # an unknown one, unless strict_tenants).  Auto-registered with
+    # unlimited budget + the lowest configured priority when absent
+    # from ``tenants``.
+    default_tenant: str = "default"
+    # True: an unknown X-Tenant is rejected 403 at the door (never
+    # counted — the request does not enter the fleet accounting).
+    # False (default): unknown tenants ride the default tenant's class.
+    strict_tenants: bool = False
+    host: str = "127.0.0.1"
+    port: int = 8080
+    # Router-side wait on an in-process engine future / remote response.
+    request_timeout_s: float = 30.0
+    # Seconds between remote-replica /healthz probes feeding the
+    # aggregated health view (in-process engines are read directly).
+    health_poll_s: float = 2.0
+
+
+def fleet_config_from_dict(d: Dict) -> FleetConfig:
+    """Build + validate a FleetConfig from its JSON dict (the
+    ``tools/serve.py --fleet-config`` file format).  Loud ValueError on
+    an unknown key, a duplicate model/tenant name, or a member without
+    exactly one backend source."""
+    d = dict(d)
+    models = []
+    for md in d.pop("models", []):
+        md = dict(md)
+        unknown = set(md) - {f.name for f in
+                             dataclasses.fields(FleetModelConfig)}
+        if unknown:
+            raise ValueError(
+                f"unknown fleet model key(s) {sorted(unknown)} in {md!r}")
+        if "overrides" in md:
+            md["overrides"] = tuple(md["overrides"])
+        models.append(FleetModelConfig(**md))
+    tenants = []
+    for td in d.pop("tenants", []):
+        td = dict(td)
+        unknown = set(td) - {f.name for f in
+                             dataclasses.fields(FleetTenantConfig)}
+        if unknown:
+            raise ValueError(
+                f"unknown fleet tenant key(s) {sorted(unknown)} in {td!r}")
+        tenants.append(FleetTenantConfig(**td))
+    known = {f.name for f in dataclasses.fields(FleetConfig)} \
+        - {"models", "tenants"}
+    unknown = set(d) - known
+    if unknown:
+        raise ValueError(f"unknown fleet config key(s) {sorted(unknown)}")
+    fc = FleetConfig(models=tuple(models), tenants=tuple(tenants), **d)
+    return validate_fleet_config(fc)
+
+
+def validate_fleet_config(fc: FleetConfig) -> FleetConfig:
+    """Invariants a fleet must satisfy before a single engine warms:
+    at least one model, unique routing keys, exactly one backend source
+    per member, unique tenant names.  Returns ``fc`` (with the default
+    tenant auto-registered when missing)."""
+    if not fc.models:
+        raise ValueError("fleet config needs at least one model")
+    seen = set()
+    for m in fc.models:
+        if not m.name:
+            raise ValueError(f"fleet model {m!r} needs a name (routing key)")
+        if m.name in seen:
+            raise ValueError(f"duplicate fleet model name {m.name!r}")
+        seen.add(m.name)
+        if m.url and (m.config or m.ckpt_dir or m.overrides):
+            raise ValueError(
+                f"fleet model {m.name!r}: url is exclusive of "
+                "config/ckpt_dir/overrides (the remote process owns its "
+                "own config)")
+        if not m.url and not m.ckpt_dir and not m.config:
+            raise ValueError(
+                f"fleet model {m.name!r} needs one of config / ckpt_dir "
+                "/ url")
+    tseen = set()
+    for t in fc.tenants:
+        if not t.name:
+            raise ValueError(f"fleet tenant {t!r} needs a name")
+        if t.name in tseen:
+            raise ValueError(f"duplicate fleet tenant name {t.name!r}")
+        tseen.add(t.name)
+        if t.rate_rps < 0 or t.burst < 0:
+            raise ValueError(
+                f"fleet tenant {t.name!r}: rate_rps/burst must be >= 0")
+    if fc.default_tenant not in tseen:
+        low = min((t.priority for t in fc.tenants), default=0)
+        fc = dataclasses.replace(
+            fc, tenants=fc.tenants + (FleetTenantConfig(
+                name=fc.default_tenant, priority=low),))
+    return fc
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     name: str = "default"
     data: DataConfig = dataclasses.field(default_factory=DataConfig)

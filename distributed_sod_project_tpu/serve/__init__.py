@@ -16,6 +16,7 @@ from .admission import (
 )
 from .batcher import DynamicBatcher, Request
 from .engine import InferenceEngine, preprocess_image
+from .fleet import EngineBackend, Fleet, FleetDispatcher, RemoteBackend
 from .precision import (
     PRECISION_ORDER,
     cast_variables,
@@ -24,21 +25,37 @@ from .precision import (
     supported_arms,
     validate_arms,
 )
+from .router import (
+    RouterStats,
+    TenantAdmission,
+    TokenBucket,
+    make_fleet_server,
+    serve_fleet_forever,
+)
 from .server import make_server
 
 __all__ = [
     "AdmissionController",
     "DeadlineExpired",
     "DynamicBatcher",
+    "EngineBackend",
     "EngineStopped",
+    "Fleet",
+    "FleetDispatcher",
     "InferenceEngine",
     "PRECISION_ORDER",
     "QueueFull",
+    "RemoteBackend",
     "Request",
+    "RouterStats",
+    "TenantAdmission",
+    "TokenBucket",
     "cast_variables",
+    "make_fleet_server",
     "make_precision_forward",
     "make_server",
     "preprocess_image",
+    "serve_fleet_forever",
     "step_down",
     "supported_arms",
     "validate_arms",

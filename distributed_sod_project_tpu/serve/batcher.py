@@ -140,6 +140,36 @@ class DynamicBatcher:
                     return head.bucket_key, [q.popleft() for _ in range(n)]
                 self._cv.wait(min(wait_left, 0.05))
 
+    def _ready_locked(self, now: float) -> bool:
+        head = self._oldest_head()
+        if head is None:
+            return False
+        q = self._queues[head.bucket_key]
+        return (len(q) >= self.max_batch
+                or (head.arrival + self.max_wait_s) <= now)
+
+    def ready(self) -> bool:
+        """True when a group would dispatch RIGHT NOW (full bucket, or
+        the oldest head past max-wait) — the non-blocking probe the
+        fleet's interleaved dispatch loop polls so one slow model's
+        coalescing wait never blocks its co-resident siblings."""
+        with self._cv:
+            if self._closed:
+                return False
+            return self._ready_locked(self._clock())
+
+    def poll_batch(self) -> Optional[Tuple[Tuple[int, str], List[Request]]]:
+        """Non-blocking :meth:`get_batch`: the next coalesced group if
+        one is ready, else None immediately (never waits on max-wait or
+        an empty queue)."""
+        with self._cv:
+            if self._closed or not self._ready_locked(self._clock()):
+                return None
+            head = self._oldest_head()
+            q = self._queues[head.bucket_key]
+            n = min(len(q), self.max_batch)
+            return head.bucket_key, [q.popleft() for _ in range(n)]
+
     def pick_batch_bucket(self, n: int) -> int:
         """Smallest static batch bucket that fits ``n`` requests (the
         largest bucket when none does — callers never hand us more than

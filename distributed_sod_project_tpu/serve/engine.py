@@ -175,7 +175,12 @@ class InferenceEngine:
 
     # -- lifecycle -----------------------------------------------------
 
-    def start(self) -> "InferenceEngine":
+    def start(self, own_dispatch: bool = True) -> "InferenceEngine":
+        """Warm the programs and start serving.  ``own_dispatch=False``
+        skips the engine's own dispatch thread — the fleet's interleaved
+        dispatcher (serve/fleet.py) drives :meth:`_dispatch_once`
+        instead, so N co-resident engines share one device through one
+        loop that drains their batchers fairly."""
         if self._running:
             return self
         from concurrent.futures import ThreadPoolExecutor
@@ -206,9 +211,11 @@ class InferenceEngine:
                 target=self._reload_loop, name="serve-reload", daemon=True)
             self._reload_thread.start()
         self._running = True
-        self._dispatch_thread = threading.Thread(
-            target=self._dispatch_loop, name="serve-dispatch", daemon=True)
-        self._dispatch_thread.start()
+        if own_dispatch:
+            self._dispatch_thread = threading.Thread(
+                target=self._dispatch_loop, name="serve-dispatch",
+                daemon=True)
+            self._dispatch_thread.start()
         return self
 
     def warm(self) -> int:
@@ -313,12 +320,18 @@ class InferenceEngine:
         degraded ladder may still step it further down).  Raises
         :class:`QueueFull` / :class:`EngineStopped` at the door
         (nothing enqueued)."""
+        # Every submit() call is a submitted request — door rejects
+        # included — so the accounting identity composes fleet-wide:
+        # a router's forwarded count equals this engine's submitted
+        # count exactly, whatever fate each request meets.
+        self.stats.inc("submitted")
         if not self._running:
+            self.stats.inc("errors")
             raise EngineStopped("engine not running")
         if not self.stats.healthy:
+            self.stats.inc("errors")
             raise EngineStopped(
                 f"engine unhealthy: {self.stats.health_reason}")
-        self.stats.inc("submitted")
         try:
             self.admission.try_admit(self.batcher.pending())
         except QueueFull:
@@ -378,37 +391,82 @@ class InferenceEngine:
 
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
+            self._dispatch_once(blocking=True)
+
+    def _observe_depth(self) -> int:
+        depth = self.batcher.pending()
+        self.stats.set_queue_depth(depth)
+        self.admission.observe(depth)
+        self.stats.set_degraded(self.admission.level)
+        return depth
+
+    def _dispatch_once(self, blocking: bool = True) -> bool:
+        """One dispatch-loop iteration; returns True when a group came
+        off the batcher.  ``blocking=True`` is the engine's own loop
+        (waits on the coalescing deadline / idle timeout).
+        ``blocking=False`` is the fleet's interleaved loop: it never
+        waits — not on an empty queue, not on a group still coalescing,
+        and not on this engine's inflight semaphore — so one
+        back-pressured model reports False and its co-resident siblings
+        keep dispatching.  The watchdog contract holds in both modes:
+        the beat STOPS while ready work cannot enter the device (the
+        wedged-device /healthz signal) and keeps ticking when idle."""
+        if blocking:
             if self._watchdog is not None:
                 self._watchdog.beat()
             got = self.batcher.get_batch(idle_timeout_s=0.1)
-            depth = self.batcher.pending()
-            self.stats.set_queue_depth(depth)
-            self.admission.observe(depth)
-            self.stats.set_degraded(self.admission.level)
+            self._observe_depth()
             if got is None:
-                continue
-            (res, arm), reqs = got
-            with self._est_lock:
-                est = self._est_s.get((res, arm), 0.0)
-            now = self._clock()
-            live = []
-            for r in reqs:
-                if AdmissionController.expired(r.deadline, est, now):
-                    self.stats.inc("expired")
-                    self._fail(r, DeadlineExpired(
-                        f"deadline missed before dispatch (est device "
-                        f"{est * 1000:.1f}ms)"))
-                else:
-                    live.append(r)
-            if not live:
-                continue
-            bb = self.batcher.pick_batch_bucket(len(live))
-            batch = pad_to_batch(
-                {"image": np.stack([r.tensor for r in live])}, bb)
-            with self._var_lock:
-                variables = self._arm_vars[arm]
-                step = self._loaded_step
-            tta = self.cfg.serve.tta and not self.admission.degraded
+                return False
+            return self._dispatch_group(got, preacquired=False)
+        self._observe_depth()
+        if not self.batcher.ready():
+            if self._watchdog is not None:
+                self._watchdog.beat()
+            return False
+        if not self._inflight_sem.acquire(blocking=False):
+            # Ready work but no device slot: NO beat, so a wedged
+            # device still flips THIS model's health while the fleet
+            # loop carries on serving its siblings.
+            return False
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        got = self.batcher.poll_batch()
+        if got is None:  # raced a close(); return the unused slot
+            self._inflight_sem.release()
+            return False
+        return self._dispatch_group(got, preacquired=True)
+
+    def _dispatch_group(self, got, preacquired: bool) -> bool:
+        """Expiry-filter, pad, and dispatch one coalesced group.
+        ``preacquired`` means the caller already holds one inflight
+        semaphore slot (the non-blocking path acquires it BEFORE
+        popping, so a group is never stranded outside the queue)."""
+        (res, arm), reqs = got
+        with self._est_lock:
+            est = self._est_s.get((res, arm), 0.0)
+        now = self._clock()
+        live = []
+        for r in reqs:
+            if AdmissionController.expired(r.deadline, est, now):
+                self.stats.inc("expired")
+                self._fail(r, DeadlineExpired(
+                    f"deadline missed before dispatch (est device "
+                    f"{est * 1000:.1f}ms)"))
+            else:
+                live.append(r)
+        if not live:
+            if preacquired:
+                self._inflight_sem.release()
+            return True
+        bb = self.batcher.pick_batch_bucket(len(live))
+        batch = pad_to_batch(
+            {"image": np.stack([r.tensor for r in live])}, bb)
+        with self._var_lock:
+            variables = self._arm_vars[arm]
+            step = self._loaded_step
+        tta = self.cfg.serve.tta and not self.admission.degraded
+        if not preacquired:
             # Bound run-ahead WITHOUT beating the watchdog while we
             # wait: a wedged device keeps this semaphore drained, the
             # beats stop, and /healthz flips — the intended signal.
@@ -421,31 +479,32 @@ class InferenceEngine:
                 for r in live:
                     self.stats.inc("errors")
                     self._fail(r, EngineStopped("engine stopped"))
-                continue
-            t0 = self._clock()
+                return True
+        t0 = self._clock()
+        for r in live:
+            r.dispatch_t = t0
+            self.stats.queue_ms.observe((t0 - r.arrival) * 1000.0)
+        # Count the in-flight slot the moment the semaphore is held
+        # so the error path's _release_inflight always undoes a
+        # matching increment (the gauge must never go negative-ish
+        # while OTHER batches are genuinely in flight).
+        with self._inflight_lock:
+            self._inflight_n += 1
+            self.stats.set_inflight(self._inflight_n)
+        try:
+            probs = self._forward(res, bb, arm, variables, batch, tta)
+        except Exception as e:  # noqa: BLE001 — per-request surface
+            self._release_inflight()
+            self._log.exception("serve: dispatch failed")
             for r in live:
-                r.dispatch_t = t0
-                self.stats.queue_ms.observe((t0 - r.arrival) * 1000.0)
-            # Count the in-flight slot the moment the semaphore is held
-            # so the error path's _release_inflight always undoes a
-            # matching increment (the gauge must never go negative-ish
-            # while OTHER batches are genuinely in flight).
-            with self._inflight_lock:
-                self._inflight_n += 1
-                self.stats.set_inflight(self._inflight_n)
-            try:
-                probs = self._forward(res, bb, arm, variables, batch, tta)
-            except Exception as e:  # noqa: BLE001 — per-request surface
-                self._release_inflight()
-                self._log.exception("serve: dispatch failed")
-                for r in live:
-                    self.stats.inc("errors")
-                    self._fail(r, e)
-                continue
-            self.stats.observe_batch(len(live), bb, arm=arm)
-            meta = {"res_bucket": res, "batch_bucket": bb, "tta": tta,
-                    "step": step, "precision": arm}
-            self._fetch_pool.submit(self._complete, probs, live, meta, t0)
+                self.stats.inc("errors")
+                self._fail(r, e)
+            return True
+        self.stats.observe_batch(len(live), bb, arm=arm)
+        meta = {"res_bucket": res, "batch_bucket": bb, "tta": tta,
+                "step": step, "precision": arm}
+        self._fetch_pool.submit(self._complete, probs, live, meta, t0)
+        return True
 
     def _forward(self, res: int, bb: int, arm: str, variables, batch,
                  tta: bool):

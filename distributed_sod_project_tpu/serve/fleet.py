@@ -1,0 +1,410 @@
+"""Multi-model serving fleet (docs/SERVING.md "Fleet").
+
+TF-Replicator's thin-abstraction thesis, extended one more axis: PR 5
+mapped a request stream onto ONE family of compiled programs; a fleet
+maps N model streams onto N families — and when those families are
+co-resident on one device, nothing about the engines changes except who
+turns the dispatch crank.  Three fleet-level invariants:
+
+- **One device, one loop.**  Co-resident in-process engines keep their
+  own batchers, program caches, admission ladders and watchdogs, but a
+  single :class:`FleetDispatcher` thread drains them round-robin — at
+  most one coalesced group per model per cycle, never waiting on one
+  model's coalescing window or back-pressured inflight semaphore — so
+  a hot model cannot starve a cold one (asserted under one-hot
+  overload in tests/test_fleet.py).
+- **Health degrades, never flips.**  /healthz reports per-model health;
+  a wedged subset marks the fleet ``degraded`` (200, with the wedged
+  models named) and only an all-models-down fleet answers 503.  A
+  fronting LB drains the whole process only when there is nothing left
+  to route to.
+- **One accounting book.**  The PR-5 identity
+  ``served + shed + expired + errors == submitted`` holds fleet-wide:
+  the router door counts submissions, router-terminal rejects
+  (tenant budget/priority sheds, pre-submit 400s, unreachable remotes)
+  add to the engines' own terminal counters, and each engine's local
+  identity is untouched (serve/router.py spells out the ledger).
+
+Backends are in-process engines (:class:`EngineBackend`) and/or remote
+serve processes (:class:`RemoteBackend` — scale-out across
+processes/hosts; the remote owns its own device loop and the router
+adds tenancy + aggregation on top).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..configs.base import FleetConfig, validate_fleet_config
+from ..utils.logging import get_logger
+from ..utils.observability import (merge_prom_families, parse_prom_text,
+                                   render_prom_families)
+from .router import RouterStats, TenantAdmission
+
+
+class EngineBackend:
+    """An in-process :class:`~..serve.engine.InferenceEngine` replica.
+    Started with ``own_dispatch=False`` — the fleet's interleaved
+    dispatcher turns its crank."""
+
+    kind = "engine"
+
+    def __init__(self, name: str, engine):
+        self.name = name
+        self.engine = engine
+
+    def start(self) -> None:
+        self.engine.start(own_dispatch=False)
+
+    def stop(self) -> None:
+        self.engine.stop()
+
+    def queue_depth(self) -> Optional[int]:
+        return self.engine.batcher.pending()
+
+    @property
+    def max_queue(self) -> Optional[int]:
+        return self.engine.cfg.serve.max_queue
+
+    def healthy(self) -> bool:
+        return self.engine._running and self.engine.stats.healthy
+
+    def health_reason(self) -> str:
+        if not self.engine._running:
+            return "engine not running"
+        return self.engine.stats.health_reason
+
+    def prom_families(self, labels: str):
+        return self.engine.stats.prom_families(labels)
+
+    def stats_snapshot(self) -> Dict:
+        return self.engine.stats.snapshot()
+
+    def describe(self) -> Dict:
+        cfg = self.engine.cfg
+        return {
+            "kind": self.kind,
+            "model": cfg.model.name,
+            "backbone": cfg.model.backbone,
+            "res_buckets": list(self.engine.res_buckets),
+            "batch_buckets": list(self.engine.batch_buckets),
+            "precision_arms": list(self.engine.precision_arms),
+        }
+
+
+class RemoteBackend:
+    """A remote serve process proxied by the router.  The remote owns
+    its own admission/accounting; the router adds tenancy on top and
+    scrapes /metrics + /stats into the fleet aggregation.  Health is
+    probed at most once per ``health_poll_s`` (cached in between) so
+    /healthz stays cheap."""
+
+    kind = "remote"
+
+    # Probe/scrape timeout (healthz, /metrics, /stats) — deliberately
+    # tight: these run inline in the router's /healthz and /metrics
+    # handlers, and a down remote must cost ONE short probe per
+    # ``health_poll_s`` window (the cached verdict gates the scrapes),
+    # not a Prometheus scrape-timeout for the whole fleet.
+    PROBE_TIMEOUT_S = 2.0
+
+    def __init__(self, name: str, url: str, *, timeout_s: float = 30.0,
+                 health_poll_s: float = 2.0, clock=time.monotonic):
+        self.name = name
+        self.url = url.rstrip("/")
+        self._timeout = float(timeout_s)
+        self._health_poll_s = float(health_poll_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._probed_at: Optional[float] = None
+        self._healthy = False
+        self._reason = "not probed yet"
+
+    def start(self) -> None:  # the remote process has its own lifecycle
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def queue_depth(self) -> Optional[int]:
+        return None  # unknown here; the remote's own admission bounds it
+
+    @property
+    def max_queue(self) -> Optional[int]:
+        return None
+
+    def healthy(self) -> bool:
+        with self._lock:
+            now = self._clock()
+            if (self._probed_at is not None
+                    and now - self._probed_at < self._health_poll_s):
+                return self._healthy
+            self._probed_at = now
+        try:
+            with urllib.request.urlopen(self.url + "/healthz",
+                                        timeout=self.PROBE_TIMEOUT_S) as r:
+                ok = r.status == 200
+                reason = "" if ok else f"/healthz {r.status}"
+        except (urllib.error.URLError, OSError) as e:
+            ok, reason = False, f"unreachable: {e}"
+        with self._lock:
+            self._healthy, self._reason = ok, reason
+            return ok
+
+    def health_reason(self) -> str:
+        with self._lock:
+            return self._reason
+
+    def predict_raw(self, body: bytes, headers: Dict[str, str]
+                    ) -> Tuple[int, List[Tuple[str, str]], bytes]:
+        """POST /predict on the remote; returns (status, headers,
+        body) — HTTP error statuses are answers, not exceptions (only
+        transport failures raise)."""
+        req = urllib.request.Request(self.url + "/predict", data=body,
+                                     headers=headers, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                return r.status, list(r.headers.items()), r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, list(e.headers.items()), e.read()
+
+    def prom_families(self, labels: str):
+        """The remote's /metrics relabeled under this fleet key; a
+        known-down replica (cached health verdict) is skipped without
+        a scrape — its absence plus ``dsod_fleet_replica_up 0`` is the
+        signal, and a dead host must not stall the fleet's scrape."""
+        if not self.healthy():
+            return []
+        try:
+            with urllib.request.urlopen(
+                    self.url + "/metrics",
+                    timeout=self.PROBE_TIMEOUT_S) as r:
+                return parse_prom_text(r.read().decode(), labels)
+        except (urllib.error.URLError, OSError):
+            return []
+
+    def stats_snapshot(self) -> Dict:
+        if not self.healthy():
+            return {"unreachable": self.health_reason()}
+        try:
+            with urllib.request.urlopen(
+                    self.url + "/stats",
+                    timeout=self.PROBE_TIMEOUT_S) as r:
+                return json.loads(r.read().decode())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            return {"unreachable": str(e)}
+
+    def describe(self) -> Dict:
+        return {"kind": self.kind, "url": self.url}
+
+
+class FleetDispatcher:
+    """ONE dispatch loop for N co-resident engines sharing a device.
+
+    Round-robin with a rotating head: each cycle offers every engine at
+    most one coalesced group, via the engine's non-blocking
+    ``_dispatch_once(blocking=False)`` — which never waits on an empty
+    queue, a still-coalescing group, or a back-pressured inflight
+    semaphore.  Fairness is structural: a hot model's deep backlog
+    cannot deny a cold model its one slot per cycle, and a wedged
+    model's drained semaphore costs the loop a failed try-acquire, not
+    a stall.  Per-engine watchdogs keep their PR-5 meaning (beats stop
+    while ready work cannot enter the device), so /healthz stays
+    per-model.
+    """
+
+    def __init__(self, engines: List, idle_sleep_s: float = 0.002):
+        self._engines = list(engines)
+        self._idle_sleep_s = float(idle_sleep_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._rr = 0
+        self._log = get_logger()
+
+    def start(self) -> None:
+        if self._thread is not None or not self._engines:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-dispatch", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        n = len(self._engines)
+        while not self._stop.is_set():
+            progressed = False
+            for i in range(n):
+                eng = self._engines[(self._rr + i) % n]
+                if not eng._running:
+                    continue
+                try:
+                    progressed = eng._dispatch_once(blocking=False) \
+                        or progressed
+                except Exception:  # noqa: BLE001 — keep siblings alive
+                    self._log.exception(
+                        "fleet: dispatch iteration failed; continuing")
+            self._rr = (self._rr + 1) % n
+            if not progressed:
+                self._stop.wait(self._idle_sleep_s)
+
+
+class Fleet:
+    """The assembled fleet: named backends + tenant admission + router
+    accounting + aggregation.  ``serve/router.py`` provides the HTTP
+    front end; tests may drive :meth:`resolve`/``backends`` directly."""
+
+    def __init__(self, backends: List, cfg: Optional[FleetConfig] = None,
+                 clock=time.monotonic):
+        cfg = cfg or FleetConfig()  # tenants/strictness only — the
+        #   backends list IS the model set when built programmatically
+        names = [b.name for b in backends]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate backend names in {names}")
+        self.cfg = cfg
+        self.backends: Dict[str, object] = {b.name: b for b in backends}
+        self.admission = TenantAdmission(
+            cfg.tenants, default_tenant=cfg.default_tenant,
+            strict=cfg.strict_tenants, clock=clock)
+        self.rstats = RouterStats()
+        self.dispatcher = FleetDispatcher(
+            [b.engine for b in backends if b.kind == "engine"])
+        self._started = False
+        self._log = get_logger()
+
+    @classmethod
+    def from_config(cls, fc: FleetConfig, extra_overrides=()) -> "Fleet":
+        """Build every backend a validated FleetConfig names.
+        ``extra_overrides`` (dotted ``section.field=value``) apply to
+        every IN-PROCESS member after its own overrides — the
+        tools/serve.py ``--set`` passthrough."""
+        from ..configs import apply_overrides, get_config
+        from .engine import InferenceEngine
+
+        fc = validate_fleet_config(fc)
+        backends = []
+        for m in fc.models:
+            if m.url:
+                backends.append(RemoteBackend(
+                    m.name, m.url, timeout_s=fc.request_timeout_s,
+                    health_poll_s=fc.health_poll_s))
+                continue
+            overrides = tuple(m.overrides) + tuple(extra_overrides)
+            if m.ckpt_dir:
+                eng = InferenceEngine.from_checkpoint(
+                    m.ckpt_dir, config_name=m.config,
+                    overrides=overrides)
+            else:
+                cfg = apply_overrides(get_config(m.config), overrides)
+                eng = InferenceEngine.from_random_init(cfg)
+            backends.append(EngineBackend(m.name, eng))
+        return cls(backends, fc)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "Fleet":
+        if self._started:
+            return self
+        for b in self.backends.values():
+            b.start()  # engines warm their AOT programs here
+        self.dispatcher.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        self.dispatcher.stop()
+        for b in self.backends.values():
+            b.stop()
+
+    # -- routing -------------------------------------------------------
+
+    def resolve(self, model: Optional[str]):
+        """Routing key → backend; None on an unknown key.  A
+        single-model fleet serves header-less requests (the
+        single-engine CLI posture behind the router)."""
+        if model is None or model == "":
+            if len(self.backends) == 1:
+                return next(iter(self.backends.values()))
+            return None
+        return self.backends.get(model)
+
+    # -- aggregation ---------------------------------------------------
+
+    def health(self) -> Tuple[int, Dict]:
+        """Degrading health: (200, ok) all healthy; (200, degraded +
+        the wedged models) when a SUBSET is wedged — the fleet still
+        routes around them; (503, unhealthy) only when NOTHING is left
+        to route to."""
+        per = {}
+        for name, b in sorted(self.backends.items()):
+            ok = b.healthy()
+            per[name] = "ok" if ok else (b.health_reason() or "unhealthy")
+        down = [n for n, v in per.items() if v != "ok"]
+        if not down:
+            return 200, {"status": "ok", "models": per}
+        if len(down) < len(per):
+            return 200, {"status": "degraded", "models": per,
+                         "unhealthy": down}
+        return 503, {"status": "unhealthy", "models": per,
+                     "unhealthy": down}
+
+    def metrics_text(self) -> str:
+        """The aggregated fleet /metrics: router families (tenant=/
+        model= labels), a per-replica up gauge, then every replica's
+        ServeStats families relabeled under its ``model=`` key — each
+        family declared ONCE across all replicas
+        (utils/observability.merge_prom_families)."""
+        groups = [self.rstats.prom_families()]
+        up = []
+        for name, b in sorted(self.backends.items()):
+            up.append('dsod_fleet_replica_up{model="%s"} %d'
+                      % (name, 1 if b.healthy() else 0))
+        groups.append([("dsod_fleet_replica_up", "gauge", up)])
+        for name, b in sorted(self.backends.items()):
+            groups.append(b.prom_families('model="%s"' % name))
+        return render_prom_families(merge_prom_families(groups))
+
+    def stats(self) -> Dict:
+        """One JSON object: router book, per-model replica snapshots,
+        and the fleet-wide accounting identity
+        (``served + shed + expired + errors == submitted``, with
+        router terminals folded in — eventually consistent while
+        requests are in flight)."""
+        router = self.rstats.snapshot()
+        models = {name: b.stats_snapshot()
+                  for name, b in sorted(self.backends.items())}
+
+        def total(key: str) -> float:
+            return sum(m.get(key, 0) for m in models.values()
+                       if isinstance(m, dict))
+
+        fleet = {
+            "submitted": router["submitted_total"],
+            "served": total("served"),
+            "shed": router["shed_total"] + total("shed"),
+            "expired": total("expired"),
+            "errors": (router["rejected_total"]
+                       + router["transport_errors_total"]
+                       + total("errors")),
+        }
+        fleet["terminal"] = (fleet["served"] + fleet["shed"]
+                             + fleet["expired"] + fleet["errors"])
+        fleet["consistent"] = fleet["terminal"] == fleet["submitted"]
+        return {"router": router, "models": models, "fleet": fleet}
+
+    def describe_models(self) -> Dict:
+        return {name: b.describe()
+                for name, b in sorted(self.backends.items())}

@@ -12,6 +12,12 @@ Two disciplines, because they answer different questions:
   regardless of completions, the arrival process real traffic has.
   Measures SLO behavior: p99 and shed rate at an offered rate, which is
   what the throughput-vs-p99 curve in tools/tpu_agenda_r7.sh sweeps.
+
+Either discipline can offer **mixed traffic** against a fleet router
+(``mix=``: weighted per-model/per-tenant request mix via X-Model /
+X-Tenant headers), with per-SERVED-model p50/p95/p99 broken out in the
+summary next to the per-arm breakdown — the fleet's mixed-model curve
+(tools/tpu_agenda_r9.sh) is one command.
 """
 
 from __future__ import annotations
@@ -50,34 +56,42 @@ def wait_ready(base_url: str, timeout_s: float = 60.0,
 
 
 def _one(base_url: str, body: bytes, slo_ms: Optional[float],
-         timeout_s: float, precision: Optional[str] = None
-         ) -> Tuple[str, float, Optional[str]]:
-    """One /predict round-trip → (outcome, latency_ms, served_arm).
-    Outcomes: ok | shed | expired | unhealthy | error.  ``served_arm``
-    is the response's X-Precision header (the arm the server actually
-    used — ladder-adjusted), None on non-200s."""
+         timeout_s: float, precision: Optional[str] = None,
+         model: Optional[str] = None, tenant: Optional[str] = None
+         ) -> Tuple[str, float, Dict[str, Optional[str]]]:
+    """One /predict round-trip → (outcome, latency_ms, info).
+    Outcomes: ok | shed | expired | unhealthy | error.  ``info`` holds
+    the response's X-Precision / X-Model headers (what the server
+    actually SERVED — the ladder may adjust the arm, the router names
+    the model), None values on non-200s.  ``model``/``tenant`` ride as
+    X-Model / X-Tenant request headers (fleet routing + tenancy)."""
     headers = {"Content-Type": "application/x-npy"}
     if slo_ms:
         headers["X-SLO-MS"] = str(slo_ms)
     if precision:
         headers["X-Precision"] = str(precision)
+    if model:
+        headers["X-Model"] = str(model)
+    if tenant:
+        headers["X-Tenant"] = str(tenant)
     req = urllib.request.Request(base_url + "/predict", data=body,
                                  headers=headers, method="POST")
     t0 = time.monotonic()
-    arm = None
+    info: Dict[str, Optional[str]] = {"arm": None, "model": None}
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             r.read()
             out = "ok" if r.status == 200 else "error"
             if out == "ok":
-                arm = r.headers.get("X-Precision")
+                info["arm"] = r.headers.get("X-Precision")
+                info["model"] = r.headers.get("X-Model")
     except urllib.error.HTTPError as e:
         e.read()
         out = {429: "shed", 504: "expired", 503: "unhealthy"}.get(
             e.code, "error")
     except (urllib.error.URLError, OSError):
         out = "error"
-    return out, (time.monotonic() - t0) * 1000.0, arm
+    return out, (time.monotonic() - t0) * 1000.0, info
 
 
 def _percentile(sorted_ms: List[float], p: float) -> float:
@@ -85,6 +99,29 @@ def _percentile(sorted_ms: List[float], p: float) -> float:
         return 0.0
     i = min(int(p * len(sorted_ms)), len(sorted_ms) - 1)
     return sorted_ms[i]
+
+
+def _normalize_mix(mix) -> List[Dict]:
+    """Mixed-traffic spec → ``[{"model", "tenant", "weight"}, ...]``.
+    Accepts dicts (``model`` required, ``tenant``/``weight`` optional)
+    or ``(model, weight)`` tuples."""
+    out = []
+    for entry in mix:
+        if isinstance(entry, dict):
+            e = {"model": entry.get("model"),
+                 "tenant": entry.get("tenant"),
+                 "weight": float(entry.get("weight", 1.0))}
+        else:
+            model, weight = entry
+            e = {"model": model, "tenant": None, "weight": float(weight)}
+        if not e["model"]:
+            raise ValueError(f"mix entry {entry!r} needs a model")
+        if e["weight"] <= 0:
+            raise ValueError(f"mix entry {entry!r} needs weight > 0")
+        out.append(e)
+    if not out:
+        raise ValueError("mix must not be empty")
+    return out
 
 
 def run_loadgen(
@@ -99,15 +136,25 @@ def run_loadgen(
     slo_ms: float = 0.0,
     timeout_s: float = 60.0,
     precision: Optional[str] = None,
+    model: Optional[str] = None,
+    tenant: Optional[str] = None,
+    mix=None,
 ) -> Dict[str, float]:
     """Drive ``base_url`` and return a summary dict (see module doc for
     the open/closed semantics).  Closed loop sends exactly ``requests``
     total across ``concurrency`` workers; open loop offers ``rps`` for
-    ``duration_s``.  ``precision`` rides every request as X-Precision.
-    Latency percentiles are exact over OK responses (client-side e2e,
-    including HTTP); the summary additionally breaks p50/p95/p99 down
-    per SERVED arm (the response's X-Precision — ladder-adjusted), so
-    the throughput-vs-p99 curve exists per precision arm."""
+    ``duration_s``.  ``precision`` rides every request as X-Precision;
+    ``model``/``tenant`` ride as X-Model / X-Tenant (fleet routing).
+
+    **Mixed traffic** (``mix``): a weighted list of
+    ``{"model", "tenant", "weight"}`` entries — each request draws its
+    (model, tenant) from the mix (deterministic under ``seed``), so ONE
+    loadgen run produces the fleet's mixed-model curve.  Latency
+    percentiles are exact over OK responses (client-side e2e, incl.
+    HTTP); the summary additionally breaks p50/p95/p99 down per SERVED
+    arm (X-Precision) and per SERVED model (X-Model — the router echo),
+    mirroring the per-arm breakdown, so the mixed-model
+    throughput-vs-p99 curve is one command."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     rng = np.random.RandomState(seed)
@@ -115,43 +162,63 @@ def run_loadgen(
     # numpy/npy encoding while it is supposed to be offering load.
     pool = [encode_image(rng, h, w)
             for h, w in (sizes * ((16 // max(len(sizes), 1)) + 1))[:16]]
+    n_total = (int(requests) if mode == "closed"
+               else max(int(float(duration_s) * float(rps)), 1))
+    if mix is not None:
+        entries = _normalize_mix(mix)
+        w = np.asarray([e["weight"] for e in entries], np.float64)
+        draws = rng.choice(len(entries), size=n_total, p=w / w.sum())
+        assignment = [entries[int(j)] for j in draws]
+    else:
+        assignment = [{"model": model, "tenant": tenant}] * n_total
     lock = threading.Lock()
     outcomes: Dict[str, int] = {"ok": 0, "shed": 0, "expired": 0,
                                 "unhealthy": 0, "error": 0}
     ok_ms: List[float] = []
     arm_ms: Dict[str, List[float]] = {}
+    model_ms: Dict[str, List[float]] = {}
+    model_sent: Dict[str, int] = {}
 
-    def record(out: str, ms: float, arm: Optional[str] = None) -> None:
+    def record(out: str, ms: float, info=None) -> None:
+        info = info or {}
         with lock:
             outcomes[out] += 1
             if out == "ok":
                 ok_ms.append(ms)
-                if arm:
-                    arm_ms.setdefault(arm, []).append(ms)
+                if info.get("arm"):
+                    arm_ms.setdefault(info["arm"], []).append(ms)
+                if info.get("model"):
+                    model_ms.setdefault(info["model"], []).append(ms)
+
+    def fire(i: int) -> None:
+        a = assignment[i]
+        if a["model"]:
+            with lock:
+                model_sent[a["model"]] = model_sent.get(a["model"], 0) + 1
+        record(*_one(base_url, pool[i % len(pool)], slo_ms or None,
+                     timeout_s, precision=precision, model=a["model"],
+                     tenant=a.get("tenant") or tenant))
 
     t_start = time.monotonic()
     if mode == "closed":
-        remaining = [int(requests)]
+        remaining = [n_total]
 
-        def worker(widx: int) -> None:
-            i = widx
+        def worker() -> None:
             while True:
                 with lock:
                     if remaining[0] <= 0:
                         return
                     remaining[0] -= 1
-                record(*_one(base_url, pool[i % len(pool)],
-                             slo_ms or None, timeout_s,
-                             precision=precision))
-                i += concurrency
+                    i = n_total - remaining[0] - 1
+                fire(i)
 
-        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
-                   for i in range(max(int(concurrency), 1))]
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(int(concurrency), 1))]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        sent = int(requests)
+        sent = n_total
     else:
         # Fixed worker pool, not thread-per-request: at a few hundred
         # rps the spawn cost inflates the very p99 the sweep measures,
@@ -162,7 +229,7 @@ def run_loadgen(
         from concurrent.futures import ThreadPoolExecutor
 
         interval = 1.0 / max(float(rps), 1e-6)
-        n = max(int(float(duration_s) * float(rps)), 1)
+        n = n_total
         workers = min(256, max(8, int(float(rps) * min(timeout_s, 10.0))))
         futures = []
         with ThreadPoolExecutor(max_workers=workers) as ex:
@@ -170,10 +237,7 @@ def run_loadgen(
                 delay = (t_start + i * interval) - time.monotonic()
                 if delay > 0:
                     time.sleep(delay)
-                futures.append(ex.submit(
-                    lambda i=i: record(*_one(
-                        base_url, pool[i % len(pool)], slo_ms or None,
-                        timeout_s, precision=precision))))
+                futures.append(ex.submit(fire, i))
             for f in futures:
                 f.result()
         sent = n
@@ -196,6 +260,13 @@ def run_loadgen(
     }
     if precision:
         out["precision"] = precision
+    if model:
+        out["model"] = model
+    if tenant:
+        out["tenant"] = tenant
+    if mix is not None:
+        out["mix"] = [{k: v for k, v in e.items() if v is not None}
+                      for e in _normalize_mix(mix)]
     if arm_ms:
         # Per-SERVED-arm latency breakdown: under the degraded ladder a
         # single offered arm can come back as several served arms, and
@@ -204,6 +275,21 @@ def run_loadgen(
         for arm in sorted(arm_ms):
             ms = sorted(arm_ms[arm])
             out["arms"][arm] = {
+                "ok": len(ms),
+                "p50_ms": round(_percentile(ms, 0.50), 2),
+                "p95_ms": round(_percentile(ms, 0.95), 2),
+                "p99_ms": round(_percentile(ms, 0.99), 2),
+            }
+    if model_ms or model_sent:
+        # Per-SERVED-model latency breakdown (the response's X-Model —
+        # the router's echo), mirroring the per-arm breakdown: under a
+        # mixed-model run this is the per-model half of the fleet's
+        # throughput-vs-p99 curve, from ONE command.
+        out["models"] = {}
+        for name in sorted(set(model_ms) | set(model_sent)):
+            ms = sorted(model_ms.get(name, []))
+            out["models"][name] = {
+                "sent": model_sent.get(name, 0),
                 "ok": len(ms),
                 "p50_ms": round(_percentile(ms, 0.50), 2),
                 "p95_ms": round(_percentile(ms, 0.95), 2),

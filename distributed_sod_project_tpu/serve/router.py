@@ -1,0 +1,457 @@
+"""Router tier for the multi-model serving fleet (docs/SERVING.md
+"Fleet"): model-aware routing, multi-tenant admission, and the fleet
+HTTP front end.
+
+The routing/tenancy POLICY lives here (``TokenBucket``,
+``TenantAdmission``, ``RouterStats``); the fleet ASSEMBLY — backends,
+the interleaved dispatch loop, metric aggregation — lives in
+``serve/fleet.py``.  The philosophy extends PR 5's admission story one
+tier up: the cheapest place to reject work the fleet cannot (or will
+not) do is the router door, BEFORE a request ever reaches an engine
+queue — an exhausted tenant budget costs one token-bucket read, not an
+engine slot.
+
+Request contract (``POST /predict``):
+
+- ``X-Model: <name>`` (or a ``model=`` query field) names the replica
+  set.  Unknown → 404, and the request never touches a counter — a
+  typo'd model name must not pollute the fleet accounting.  The served
+  model is echoed back as ``X-Model``.
+- ``X-Tenant: <name>`` names the tenant class (``default_tenant`` when
+  absent; unknown tenants ride the default class unless
+  ``strict_tenants``, then 403 uncounted).  The tenant's token-bucket
+  budget and priority class are enforced here: budget exhaustion and
+  priority shed answer 429 (``kind: tenant_budget | priority_shed``)
+  with the engine queues untouched.
+- Everything after admission is the single-engine contract verbatim
+  (``serve/server.py::run_predict``) — same headers, same status
+  mapping, bitwise-identical responses.
+
+Fleet-wide accounting identity (the PR-5 invariant, one tier up):
+
+    served + shed + expired + errors == submitted
+
+where ``submitted`` counts every routed-and-tenant-resolved request at
+the router door, ``shed`` adds router sheds (budget/priority) to the
+engines' queue sheds, and ``errors`` adds router-side terminal rejects
+(pre-submit 400s, remote transport failures) to the engines' error
+counts.  Each engine's own identity is preserved exactly — the router
+only ever adds terminals for requests the engines never saw.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+from ..configs.base import FleetTenantConfig
+from ..utils.logging import get_logger
+from .server import (JsonHTTPHandler, ThreadingHTTPServer, publish_port,
+                     read_predict_body, run_predict)
+
+
+class TokenBucket:
+    """Thread-safe token bucket: ``rate_per_s`` sustained, ``burst``
+    capacity.  ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, rate_per_s: float, burst: float = 0.0,
+                 clock=time.monotonic):
+        self.rate = float(rate_per_s)
+        self.burst = float(burst) if burst > 0 else self.rate
+        self._clock = clock
+        self._tokens = self.burst
+        self._t = clock()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        """Take ``n`` tokens if available (refilling lazily); False
+        when the budget is exhausted."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class TenantAdmission:
+    """Resolve a request's tenant class and enforce its budget +
+    priority BEFORE the engine queue.
+
+    Budgets: each tenant with ``rate_rps > 0`` owns a
+    :class:`TokenBucket`; an exhausted bucket sheds at the router.
+
+    Priorities: the distinct configured priorities form shed classes —
+    a class of rank ``r`` (0 = lowest) among ``n`` classes may use the
+    target replica's queue only while its depth is below
+    ``(r+1)/n * max_queue``.  The top class never priority-sheds (the
+    engine's own bound is its limit), so with a single class the
+    mechanism is inert.  Burst-proof by construction: under a one-hot
+    overload the low classes lose admission first, which is the
+    documented contract, not an emergent accident.
+    """
+
+    def __init__(self, tenants: Tuple[FleetTenantConfig, ...],
+                 default_tenant: str = "default",
+                 strict: bool = False, clock=time.monotonic):
+        tenants = tuple(tenants)
+        if default_tenant not in {t.name for t in tenants}:
+            low = min((t.priority for t in tenants), default=0)
+            tenants += (FleetTenantConfig(name=default_tenant,
+                                          priority=low),)
+        self.tenants: Dict[str, FleetTenantConfig] = {
+            t.name: t for t in tenants}
+        self.default_tenant = default_tenant
+        self.strict = strict
+        self._buckets: Dict[str, Optional[TokenBucket]] = {
+            t.name: (TokenBucket(t.rate_rps, t.burst, clock=clock)
+                     if t.rate_rps > 0 else None)
+            for t in tenants}
+        classes = sorted({t.priority for t in tenants})
+        n = len(classes)
+        self._frac = {p: (classes.index(p) + 1) / n for p in classes}
+
+    def resolve(self, name: Optional[str]) -> Optional[FleetTenantConfig]:
+        """Header value → tenant class.  None when ``strict`` and the
+        name is unknown (the caller 403s without counting)."""
+        if not name:
+            return self.tenants[self.default_tenant]
+        t = self.tenants.get(name)
+        if t is None and not self.strict:
+            return self.tenants[self.default_tenant]
+        return t
+
+    def backlog_frac(self, priority: int) -> float:
+        """The fraction of a replica's queue this priority class may
+        fill before it sheds (1.0 = never priority-sheds)."""
+        return self._frac[priority]
+
+    def try_admit(self, tenant: FleetTenantConfig,
+                  queue_depth: Optional[int],
+                  max_queue: Optional[int]) -> Optional[str]:
+        """None = admitted; otherwise the shed reason
+        (``budget`` | ``priority``).  Priority is checked FIRST so a
+        priority-shed request never burns a budget token — a tenant
+        must not exit a backlog spike budget-broke for requests the
+        router refused to route.  ``queue_depth=None`` (remote replica
+        — depth unknown here) skips the priority check; the remote
+        engine's own admission still bounds it."""
+        frac = self.backlog_frac(tenant.priority)
+        if (queue_depth is not None and max_queue and frac < 1.0
+                and queue_depth >= frac * max_queue):
+            return "priority"
+        bucket = self._buckets.get(tenant.name)
+        if bucket is not None and not bucket.try_take():
+            return "budget"
+        return None
+
+
+class RouterStats:
+    """Router-door accounting under ``tenant=`` / ``model=`` labels.
+
+    Terminal counters (requests the ENGINES never saw — the router's
+    contribution to the fleet identity): ``tenant_shed`` (budget /
+    priority, per reason), ``rejected`` (pre-submit 400s), and
+    ``transport_errors`` (remote replica unreachable).  ``responses``
+    is the observational per-tenant outcome tally (includes
+    engine-owned outcomes; NOT part of the identity — dashboards only).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tenant_submitted: Dict[str, int] = {}
+        self._tenant_shed: Dict[Tuple[str, str], int] = {}
+        self._responses: Dict[Tuple[str, str], int] = {}
+        self._routed: Dict[str, int] = {}
+        self._rejected = 0
+        self._transport_errors = 0
+
+    def inc_submitted(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant_submitted[tenant] = \
+                self._tenant_submitted.get(tenant, 0) + 1
+
+    def inc_shed(self, tenant: str, reason: str) -> None:
+        with self._lock:
+            key = (tenant, reason)
+            self._tenant_shed[key] = self._tenant_shed.get(key, 0) + 1
+
+    def inc_routed(self, model: str) -> None:
+        with self._lock:
+            self._routed[model] = self._routed.get(model, 0) + 1
+
+    def inc_response(self, tenant: str, outcome: str) -> None:
+        with self._lock:
+            key = (tenant, outcome)
+            self._responses[key] = self._responses.get(key, 0) + 1
+            if outcome == "rejected":
+                self._rejected += 1
+            elif outcome == "transport_error":
+                self._transport_errors += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            shed_total = sum(self._tenant_shed.values())
+            return {
+                "submitted_total": sum(self._tenant_submitted.values()),
+                "shed_total": shed_total,
+                "rejected_total": self._rejected,
+                "transport_errors_total": self._transport_errors,
+                "tenants": {
+                    t: {
+                        "submitted": n,
+                        "shed": {r: v for (tt, r), v
+                                 in sorted(self._tenant_shed.items())
+                                 if tt == t},
+                        "responses": {o: v for (tt, o), v
+                                      in sorted(self._responses.items())
+                                      if tt == t},
+                    }
+                    for t, n in sorted(self._tenant_submitted.items())},
+                "routed": dict(sorted(self._routed.items())),
+            }
+
+    def prom_families(self):
+        """Router families for the fleet /metrics (tenant=/model=
+        labels; one TYPE per family by construction)."""
+        with self._lock:
+            submitted = sorted(self._tenant_submitted.items())
+            shed = sorted(self._tenant_shed.items())
+            responses = sorted(self._responses.items())
+            routed = sorted(self._routed.items())
+        fams = []
+        if submitted:
+            fams.append(("dsod_fleet_tenant_submitted_total", "counter", [
+                'dsod_fleet_tenant_submitted_total{tenant="%s"} %d'
+                % (t, n) for t, n in submitted]))
+        if shed:
+            fams.append(("dsod_fleet_tenant_shed_total", "counter", [
+                'dsod_fleet_tenant_shed_total{tenant="%s",reason="%s"} %d'
+                % (t, r, n) for (t, r), n in shed]))
+        if responses:
+            fams.append(("dsod_fleet_tenant_responses_total", "counter", [
+                'dsod_fleet_tenant_responses_total'
+                '{tenant="%s",outcome="%s"} %d'
+                % (t, o, n) for (t, o), n in responses]))
+        if routed:
+            fams.append(("dsod_fleet_routed_total", "counter", [
+                'dsod_fleet_routed_total{model="%s"} %d'
+                % (m, n) for m, n in routed]))
+        return fams
+
+
+# -- HTTP front end ----------------------------------------------------
+
+# Request headers the router forwards to a remote replica verbatim.
+_FORWARD_HEADERS = ("Content-Type", "X-SLO-MS", "X-Precision")
+# Response headers relayed back from a remote replica's answer.
+_RELAY_HEADERS = ("X-Degraded", "X-Precision", "X-Res-Bucket",
+                  "X-Batch-Bucket", "X-Queue-MS", "X-Device-MS",
+                  "X-E2E-MS")
+
+
+class RouterHandler(JsonHTTPHandler):
+    """The fleet front door: /predict (routed), /healthz (degrading),
+    /metrics (aggregated), /stats, /models."""
+
+    @property
+    def fleet(self):
+        return self.server.fleet
+
+    # -- GET -----------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = urllib.parse.urlsplit(self.path).path
+        if path == "/healthz":
+            code, body = self.fleet.health()
+            self._send_json(code, body)
+        elif path == "/metrics":
+            self._send(200, self.fleet.metrics_text().encode(),
+                       "text/plain; version=0.0.4")
+        elif path == "/stats":
+            self._send_json(200, self.fleet.stats())
+        elif path == "/models":
+            self._send_json(200, {"models": self.fleet.describe_models()})
+        else:
+            self._send_json(404, {"error": f"no route {path}"})
+
+    # -- POST ----------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        split = urllib.parse.urlsplit(self.path)
+        if split.path != "/predict":
+            self._send_json(404, {"error": f"no route {split.path}"})
+            return
+        fleet = self.fleet
+        query = urllib.parse.parse_qs(split.query)
+        model = self.headers.get("X-Model") \
+            or (query.get("model") or [None])[0]
+        backend = fleet.resolve(model)
+        if backend is None:
+            # Unknown model: NO counter anywhere — a typo must not
+            # pollute the fleet accounting.  The body was never read;
+            # drop the connection so keep-alive can't misparse it.
+            self.close_connection = True
+            self._send_json(404, {
+                "error": f"unknown model {model!r}",
+                "models": sorted(fleet.backends)})
+            return
+        tenant = fleet.admission.resolve(self.headers.get("X-Tenant"))
+        if tenant is None:  # strict_tenants: unknown tenant, uncounted
+            self.close_connection = True
+            self._send_json(403, {
+                "error": "unknown tenant "
+                         f"{self.headers.get('X-Tenant')!r}",
+                "tenants": sorted(fleet.admission.tenants)})
+            return
+        echo = [("X-Model", backend.name), ("X-Tenant", tenant.name)]
+        # From here the request is IN the fleet accounting: every path
+        # below terminates it in exactly one router or engine counter —
+        # including a client that disconnects mid-request (the final
+        # except records the pre-engine abort as a router reject).
+        fleet.rstats.inc_submitted(tenant.name)
+        terminal = False
+        try:
+            # Admission BEFORE the body read: an exhausted budget (or a
+            # priority shed) must cost one bucket read, not a 64 MB
+            # upload.  The unread body forces dropping the connection.
+            reason = fleet.admission.try_admit(
+                tenant, backend.queue_depth(), backend.max_queue)
+            if reason is not None:
+                fleet.rstats.inc_shed(tenant.name, reason)
+                terminal = True
+                self.close_connection = True
+                self._send_json(429, {
+                    "error": f"tenant {tenant.name!r} shed at the router "
+                             f"({reason})",
+                    "kind": {"budget": "tenant_budget",
+                             "priority": "priority_shed"}[reason]},
+                    headers=echo)
+                return
+            body = read_predict_body(self)
+            if body is None:  # bad Content-Length, 400 already sent
+                fleet.rstats.inc_response(tenant.name, "rejected")
+                terminal = True
+                return
+            fleet.rstats.inc_routed(backend.name)
+            if backend.kind == "engine":
+                outcome = run_predict(self, backend.engine, body,
+                                      extra_headers=echo)
+            else:
+                outcome = self._proxy(backend, body, echo)
+            fleet.rstats.inc_response(tenant.name, outcome)
+            terminal = True
+        except Exception:  # noqa: BLE001 — dead client / broken pipe
+            get_logger().exception("router: predict handler failed")
+            self.close_connection = True
+            if not terminal:
+                # The engine never saw it (run_predict/_proxy never
+                # raise once a backend is engaged): close the book as
+                # a router reject, not a silent leak.
+                fleet.rstats.inc_response(tenant.name, "rejected")
+
+    def _proxy(self, backend, body: bytes, echo) -> str:
+        """Forward /predict to a remote replica and relay its answer
+        (status, selected headers, body) verbatim.  Sends are guarded:
+        the outcome is decided by the REMOTE's answer, and a client
+        that died mid-relay must not turn an already-counted remote
+        terminal into a second router terminal."""
+        headers = {k: v for k in _FORWARD_HEADERS
+                   if (v := self.headers.get(k)) is not None}
+
+        def send(*a, **kw):
+            try:
+                self._send(*a, **kw)
+            except Exception:  # noqa: BLE001 — client went away
+                self.close_connection = True
+
+        try:
+            status, rheaders, rbody = backend.predict_raw(body, headers)
+        except (urllib.error.URLError, OSError) as e:
+            get_logger().warning("router: replica %s unreachable: %s",
+                                 backend.name, e)
+            send(502, json.dumps({
+                "error": f"replica {backend.name!r} unreachable: {e}",
+                "kind": "replica_unreachable"}).encode(),
+                "application/json", headers=echo)
+            return "transport_error"
+        rh = {k: v for k, v in rheaders}
+        relay = echo + [(k, rh[k]) for k in _RELAY_HEADERS if k in rh]
+        ctype = rh.get("Content-Type", "application/octet-stream")
+        send(status, rbody, ctype, headers=relay)
+        if status == 400:
+            # The remote's 400 body says who counted it: a pre-submit
+            # "rejected" never entered the remote's accounting (this
+            # router must terminal-count it), an "invalid_input" was
+            # counted by the remote's engine (submitted+errors — no
+            # router terminal, or one request lands in two books).
+            try:
+                kind = json.loads(rbody.decode()).get("kind")
+            except (ValueError, UnicodeDecodeError):
+                kind = None
+            return "bad_request" if kind == "invalid_input" else "rejected"
+        return {200: "ok", 429: "shed", 504: "expired",
+                503: "stopped"}.get(status, "error")
+
+
+class FleetServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, fleet):
+        self.fleet = fleet
+        super().__init__(addr, RouterHandler)
+
+
+def make_fleet_server(fleet, host: str, port: int) -> FleetServer:
+    """Bind (``port=0`` → ephemeral; read ``server_address[1]``)."""
+    return FleetServer((host, port), fleet)
+
+
+def serve_fleet_forever(fleet, host: str, port: int,
+                        port_file: Optional[str] = None) -> int:
+    """Start the fleet (engines + interleaved dispatcher) and the
+    router HTTP server; block until SIGTERM/SIGINT, then drain cleanly
+    (exit 0 — the same contract tools/t1.sh smokes for the
+    single-engine server)."""
+    import signal
+
+    log = get_logger()
+    fleet.start()
+    srv = make_fleet_server(fleet, host, port)
+    bound = srv.server_address[1]
+    publish_port(port_file, bound)
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        log.info("fleet: signal %s — draining", signum)
+        stop.set()
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(s, _sig)
+        except ValueError:  # non-main thread (tests drive stop directly)
+            pass
+    t = threading.Thread(target=srv.serve_forever, name="fleet-http",
+                         daemon=True)
+    t.start()
+    log.info("fleet: listening on http://%s:%d (models=%s tenants=%s)",
+             host, bound, sorted(fleet.backends),
+             sorted(fleet.admission.tenants))
+    try:
+        while not stop.wait(0.2):
+            pass
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+        log.info("fleet: shut down cleanly")
+    return 0

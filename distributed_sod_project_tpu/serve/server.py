@@ -8,11 +8,13 @@ Endpoints:
   ``X-Precision`` selects a precision arm (must be enabled — 400 on an
   unknown arm; the degraded ladder may still step it down).  200
   responds with ``.npy`` float32 (H, W) saliency at the ORIGINAL
-  resolution plus ``X-Degraded`` (the ladder level, "0" when clean) /
-  ``X-Precision`` (the arm actually served) / ``X-Res-Bucket`` /
-  ``X-Batch-Bucket`` / ``X-Queue-MS`` / ``X-Device-MS`` / ``X-E2E-MS``
-  headers.  Overload sheds with 429, a missed SLO with 504, an
-  unhealthy engine with 503.
+  resolution plus ``X-Model`` (the served model — the same header the
+  fleet router echoes, so loadgen's per-model breakdown works against
+  either front end) / ``X-Degraded`` (the ladder level, "0" when
+  clean) / ``X-Precision`` (the arm actually served) /
+  ``X-Res-Bucket`` / ``X-Batch-Bucket`` / ``X-Queue-MS`` /
+  ``X-Device-MS`` / ``X-E2E-MS`` headers.  Overload sheds with 429, a
+  missed SLO with 504, an unhealthy engine with 503.
 - ``GET /healthz``  — 200 while the dispatch loop's resilience-watchdog
   heartbeat is live, 503 once it stalls (or the engine stopped).
 - ``GET /metrics``  — Prometheus text (ServeStats: latency histograms,
@@ -31,6 +33,7 @@ import signal
 import threading
 from concurrent.futures import TimeoutError as FutTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 import numpy as np
 
@@ -40,18 +43,154 @@ from .admission import DeadlineExpired, EngineStopped, QueueFull
 MAX_BODY_BYTES = 64 * 1024 * 1024  # reject absurd uploads before np.load
 
 
-class ServeHandler(BaseHTTPRequestHandler):
+def read_predict_body(handler) -> Optional[bytes]:
+    """Read + bound a /predict body; on a bad Content-Length, answer
+    400 (dropping the keep-alive connection — the unread image bytes
+    would otherwise be parsed as the next request) and return None."""
+    try:
+        length = int(handler.headers.get("Content-Length", 0))
+    except ValueError:
+        length = -1  # non-numeric header: rejected below, body unread
+    if not 0 < length <= MAX_BODY_BYTES:
+        handler.close_connection = True
+        handler._send_json(400, {
+            "error": f"Content-Length {length} outside "
+                     f"(0, {MAX_BODY_BYTES}]"})
+        return None
+    return handler.rfile.read(length)
+
+
+def run_predict(handler, engine, body: bytes, extra_headers=()) -> str:
+    """The whole /predict flow against one engine: decode the .npy
+    body, validate the precision arm, submit, wait, respond — including
+    the full error→status mapping.  Shared by the single-engine
+    ``ServeHandler`` and the fleet router (serve/router.py), so the two
+    front doors can never drift.  Returns the request's outcome for
+    caller-side (e.g. per-tenant) accounting — ``rejected`` means a
+    400 BEFORE submit (the engine never saw the request; the router
+    must terminal-count it itself), every other outcome
+    (``ok | bad_request | shed | expired | stopped | timeout | error``)
+    was or will be terminal-counted by the engine.
+
+    NEVER raises: every send is guarded, so a client that disconnects
+    mid-response still yields a definite outcome — ``rejected`` when
+    the engine never saw the request, ``error`` (engine-owned) after
+    submit.  An escaping exception here would strand a router-counted
+    submission with no terminal and break the fleet identity."""
+    submitted = False
+
+    def send(code, obj_or_bytes, content_type=None, headers=()):
+        try:
+            if content_type is None:
+                handler._send_json(code, obj_or_bytes, headers=headers)
+            else:
+                handler._send(code, obj_or_bytes, content_type,
+                              headers=headers)
+        except Exception:  # noqa: BLE001 — client went away mid-response
+            handler.close_connection = True
+
+    try:
+        try:
+            image = np.load(io.BytesIO(body), allow_pickle=False)
+        except Exception as e:  # noqa: BLE001 — client error surface
+            send(400, {"error": f"body is not .npy: {e}",
+                       "kind": "rejected"})
+            return "rejected"
+        precision = handler.headers.get("X-Precision")
+        if precision is not None:
+            precision = precision.strip().lower()
+            if precision not in engine.precision_arms:
+                # Rejected before submit(): never entered the
+                # engine's accounting (nothing was submitted).
+                send(400, {
+                    "error": f"unknown precision {precision!r}; "
+                             "enabled arms: "
+                             f"{list(engine.precision_arms)}",
+                    "kind": "rejected"})
+                return "rejected"
+        slo = handler.headers.get("X-SLO-MS")
+        if slo is not None:
+            try:
+                slo = float(slo)
+            except ValueError:
+                # Parsed BEFORE submit on purpose: a malformed header
+                # must be a pre-submit reject (the engine never sees
+                # it), not an engine-counted ValueError.
+                send(400, {
+                    "error": f"X-SLO-MS {slo!r} is not a number",
+                    "kind": "rejected"})
+                return "rejected"
+        fut = engine.submit(image, slo_ms=slo, precision=precision)
+        submitted = True
+        pred, meta = fut.result(
+            timeout=engine.cfg.serve.request_timeout_s)
+        buf = io.BytesIO()
+        np.save(buf, pred)
+        send(200, buf.getvalue(), "application/x-npy",
+             headers=list(extra_headers) + [
+            # The ladder rung the request was admitted at ("0" stays
+            # falsy for the historical binary readers).
+            ("X-Degraded", str(meta.get("degraded_level",
+                                        int(bool(meta.get("degraded")))))),
+            # The arm actually served (ladder-adjusted) — loadgen
+            # splits its latency curves on this.
+            ("X-Precision", str(meta.get("precision"))),
+            ("X-Res-Bucket", str(meta.get("res_bucket"))),
+            ("X-Batch-Bucket", str(meta.get("batch_bucket"))),
+            ("X-Queue-MS", f"{meta.get('queue_ms', 0):.3f}"),
+            ("X-Device-MS", f"{meta.get('device_ms', 0):.3f}"),
+            ("X-E2E-MS", f"{meta.get('e2e_ms', 0):.3f}"),
+        ])
+        return "ok"
+    except QueueFull as e:
+        send(429, {"error": str(e), "kind": "shed"})
+        return "shed"
+    except DeadlineExpired as e:
+        send(504, {"error": str(e), "kind": "expired"})
+        return "expired"
+    except EngineStopped as e:
+        send(503, {"error": str(e), "kind": "stopped"})
+        return "stopped"
+    except ValueError as e:
+        # Raised by engine.submit (malformed image): the ENGINE counted
+        # submitted+errors.  The "kind" lets a fronting router tell this
+        # engine-counted 400 apart from the pre-submit "rejected" ones
+        # when proxying a remote replica.
+        send(400, {"error": str(e), "kind": "invalid_input"})
+        return "bad_request"
+    except FutTimeout:
+        # The ENGINE owns the terminal counters; this request is
+        # still live and will be counted (served/errors) when its
+        # batch completes — counting it here too would terminate
+        # one request in two counters.
+        send(504, {
+            "error": "response not ready within "
+                     f"{engine.cfg.serve.request_timeout_s}s",
+            "kind": "timeout"})
+        return "timeout"
+    except Exception as e:  # noqa: BLE001 — last-resort 500
+        # No counter here either: every exception a future relays
+        # was already terminal-counted by the engine when it failed
+        # the request.
+        get_logger().exception("predict handler failed")
+        send(500, {"error": f"{type(e).__name__}: {e}"})
+        # Post-submit the ENGINE owns the terminal (observational
+        # "error"); pre-submit the engine never saw it — the caller
+        # must terminal-count the reject.
+        return "error" if submitted else "rejected"
+
+
+class JsonHTTPHandler(BaseHTTPRequestHandler):
+    """Shared stdlib-handler plumbing (response helpers + access-log
+    routing) for the serving front ends — the single-engine
+    ``ServeHandler`` here and the fleet ``RouterHandler``
+    (serve/router.py)."""
+
     protocol_version = "HTTP/1.1"
     server_version = "dsod-serve/1.0"
 
-    @property
-    def engine(self):
-        return self.server.engine
-
     def log_message(self, fmt, *args):  # route access logs to our logger
         get_logger().debug("http: " + fmt, *args)
-
-    # -- helpers -------------------------------------------------------
 
     def _send(self, code: int, body: bytes, content_type: str,
               headers=()) -> None:
@@ -63,8 +202,16 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, code: int, obj) -> None:
-        self._send(code, json.dumps(obj).encode(), "application/json")
+    def _send_json(self, code: int, obj, headers=()) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json",
+                   headers=headers)
+
+
+class ServeHandler(JsonHTTPHandler):
+
+    @property
+    def engine(self):
+        return self.server.engine
 
     # -- GET -----------------------------------------------------------
 
@@ -91,79 +238,14 @@ class ServeHandler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._send_json(404, {"error": f"no route {self.path}"})
             return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            if not 0 < length <= MAX_BODY_BYTES:
-                # The body was never read: a keep-alive client's next
-                # request would otherwise be parsed out of the unread
-                # image bytes.  Drop the connection with the rejection.
-                self.close_connection = True
-                self._send_json(400, {
-                    "error": f"Content-Length {length} outside "
-                             f"(0, {MAX_BODY_BYTES}]"})
-                return
-            body = self.rfile.read(length)
-            try:
-                image = np.load(io.BytesIO(body), allow_pickle=False)
-            except Exception as e:  # noqa: BLE001 — client error surface
-                self._send_json(400, {"error": f"body is not .npy: {e}"})
-                return
-            precision = self.headers.get("X-Precision")
-            if precision is not None:
-                precision = precision.strip().lower()
-                if precision not in self.engine.precision_arms:
-                    # Rejected before submit(): never entered the
-                    # engine's accounting (nothing was submitted).
-                    self._send_json(400, {
-                        "error": f"unknown precision {precision!r}; "
-                                 "enabled arms: "
-                                 f"{list(self.engine.precision_arms)}"})
-                    return
-            slo = self.headers.get("X-SLO-MS")
-            fut = self.engine.submit(
-                image, slo_ms=float(slo) if slo is not None else None,
-                precision=precision)
-            pred, meta = fut.result(
-                timeout=self.engine.cfg.serve.request_timeout_s)
-            buf = io.BytesIO()
-            np.save(buf, pred)
-            self._send(200, buf.getvalue(), "application/x-npy", headers=[
-                # The ladder rung the request was admitted at ("0" stays
-                # falsy for the historical binary readers).
-                ("X-Degraded", str(meta.get("degraded_level",
-                                            int(bool(meta.get("degraded")))))),
-                # The arm actually served (ladder-adjusted) — loadgen
-                # splits its latency curves on this.
-                ("X-Precision", str(meta.get("precision"))),
-                ("X-Res-Bucket", str(meta.get("res_bucket"))),
-                ("X-Batch-Bucket", str(meta.get("batch_bucket"))),
-                ("X-Queue-MS", f"{meta.get('queue_ms', 0):.3f}"),
-                ("X-Device-MS", f"{meta.get('device_ms', 0):.3f}"),
-                ("X-E2E-MS", f"{meta.get('e2e_ms', 0):.3f}"),
-            ])
-        except QueueFull as e:
-            self._send_json(429, {"error": str(e), "kind": "shed"})
-        except DeadlineExpired as e:
-            self._send_json(504, {"error": str(e), "kind": "expired"})
-        except EngineStopped as e:
-            self._send_json(503, {"error": str(e), "kind": "stopped"})
-        except ValueError as e:
-            self._send_json(400, {"error": str(e)})
-        except FutTimeout:
-            # The ENGINE owns the terminal counters; this request is
-            # still live and will be counted (served/errors) when its
-            # batch completes — counting it here too would terminate
-            # one request in two counters.
-            self._send_json(504, {
-                "error": "response not ready within "
-                         f"{self.engine.cfg.serve.request_timeout_s}s",
-                "kind": "timeout"})
-        except Exception as e:  # noqa: BLE001 — last-resort 500
-            # No counter here either: every exception a future relays
-            # was already terminal-counted by the engine when it failed
-            # the request.
-            get_logger().exception("predict handler failed")
-            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+        body = read_predict_body(self)
+        if body is None:
+            return
+        # X-Model on every 200: the single-engine server reports its
+        # one model under the same header the fleet router echoes, so
+        # loadgen's per-model breakdown works against either front end.
+        run_predict(self, self.engine, body, extra_headers=[
+            ("X-Model", str(self.engine.cfg.model.name))])
 
 
 class SODServer(ThreadingHTTPServer):
@@ -180,6 +262,19 @@ def make_server(engine, host: str, port: int) -> SODServer:
     return SODServer((host, port), engine)
 
 
+def publish_port(port_file: Optional[str], bound: int) -> None:
+    """Atomic port-file publish: pollers watch for the file's existence
+    and read immediately, so it must never be visible half-written."""
+    if not port_file:
+        return
+    import os
+
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(bound))
+    os.replace(tmp, port_file)
+
+
 def serve_forever(engine, host: str, port: int,
                   port_file: str = None) -> int:
     """Start the engine + HTTP server and block until SIGTERM/SIGINT;
@@ -188,15 +283,7 @@ def serve_forever(engine, host: str, port: int,
     engine.start()
     srv = make_server(engine, host, port)
     bound = srv.server_address[1]
-    if port_file:
-        # Atomic publish: pollers watch for the file's existence and
-        # read immediately, so it must never be visible half-written.
-        import os
-
-        tmp = port_file + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(str(bound))
-        os.replace(tmp, port_file)
+    publish_port(port_file, bound)
     stop = threading.Event()
 
     def _sig(signum, frame):

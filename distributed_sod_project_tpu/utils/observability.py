@@ -93,6 +93,91 @@ class PipelineStats:
             return out
 
 
+def _merge_labels(*parts: str) -> str:
+    """Merge pre-rendered label fragments (``'model="m"'``,
+    ``'arm="bf16"'``) into one label set, skipping empties."""
+    return ",".join(p for p in parts if p)
+
+
+def render_prom_families(families) -> str:
+    """Family list → Prometheus text: ``# TYPE`` once per family, then
+    every sample line (the text-format rule promtool/OpenMetrics
+    parsers enforce — a family's samples must be one contiguous group
+    under a single TYPE line)."""
+    lines = []
+    for name, typ, samples in families:
+        lines.append(f"# TYPE {name} {typ}")
+        lines.extend(samples)
+    return "\n".join(lines) + "\n"
+
+
+def merge_prom_families(groups):
+    """Concatenate several family lists (e.g. one per fleet replica,
+    each already carrying its ``model=`` label) into one list with each
+    family appearing ONCE — the aggregation a fleet /metrics endpoint
+    must do so that per-replica series share metric families instead of
+    re-declaring them.  Raises on a type conflict for the same family
+    name."""
+    order, merged = [], {}
+    for fams in groups:
+        for name, typ, samples in fams:
+            if name not in merged:
+                merged[name] = (typ, [])
+                order.append(name)
+            elif merged[name][0] != typ:
+                raise ValueError(
+                    f"metric family {name!r} declared as both "
+                    f"{merged[name][0]!r} and {typ!r}")
+            merged[name][1].extend(samples)
+    return [(n,) + tuple(merged[n]) for n in order]
+
+
+def _inject_labels(sample: str, labels: str) -> str:
+    """Merge ``labels`` into one exposition sample line."""
+    head, _, _ = sample.partition(" ")
+    if "{" in head:
+        return sample.replace("{", "{" + labels + ",", 1)
+    name, _, rest = sample.partition(" ")
+    return f"{name}{{{labels}}} {rest}"
+
+
+def parse_prom_text(text: str, labels: str = ""):
+    """Prometheus exposition text → family list
+    ``[(name, type, [sample, ...]), ...]`` with ``labels`` injected
+    into every sample — how a fleet router relabels a REMOTE replica's
+    scraped /metrics under its ``model=`` key before merging.  Samples
+    appearing before any ``# TYPE`` line get an ``untyped`` family per
+    metric name."""
+    fams = []
+    cur = None
+    untyped = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) < 4:
+                continue
+            cur = (parts[2], parts[3], [])
+            fams.append(cur)
+            continue
+        if line.startswith("#"):
+            continue
+        if labels:
+            line = _inject_labels(line, labels)
+        if cur is not None:
+            cur[2].append(line)
+        else:
+            name = line.partition("{")[0].partition(" ")[0]
+            fam = untyped.get(name)
+            if fam is None:
+                fam = untyped[name] = (name, "untyped", [])
+                fams.append(fam)
+            fam[2].append(line)
+    return fams
+
+
 class LatencyHistogram:
     """Fixed-bucket latency histogram (milliseconds) with Prometheus
     rendering and bucket-interpolated percentiles.
@@ -349,8 +434,13 @@ class ServeStats:
             out["arms"] = {a: st.snapshot() for a, st in sorted(arms.items())}
         return out
 
-    def render_prometheus(self) -> str:
-        """The /metrics payload (Prometheus text exposition format)."""
+    def prom_families(self, labels: str = ""):
+        """Every metric family as ``(name, type, [sample, ...])`` with
+        ``labels`` (e.g. ``'model="minet"'``) merged into every sample
+        — the unit a fleet aggregator merges across replicas so each
+        family keeps ONE ``# TYPE`` line no matter how many labeled
+        series export it (``merge_prom_families``).  Per-arm families
+        carry ``labels`` + their ``arm=`` label."""
         with self._lock:
             counts = dict(self._counts)
             gauges = {
@@ -362,53 +452,60 @@ class ServeStats:
             }
             occ = (self._occ_sum, self._occ_slots)
             arms = sorted(self._arms.items())
-        lines = []
+        sb = f"{{{labels}}}" if labels else ""
+        fams = []
         for k, v in sorted(counts.items()):
             name = f"dsod_serve_{k}_total"
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {v}")
+            fams.append((name, "counter", [f"{name}{sb} {v}"]))
         for name, v in sorted(gauges.items()):
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {v}")
-        lines.append("# TYPE dsod_serve_batch_occupancy_sum counter")
-        lines.append(f"dsod_serve_batch_occupancy_sum {occ[0]}")
-        lines.append("# TYPE dsod_serve_batch_slots_sum counter")
-        lines.append(f"dsod_serve_batch_slots_sum {occ[1]}")
-        lines += self.queue_ms.prom_lines("dsod_serve_queue_latency_ms")
-        lines += self.device_ms.prom_lines("dsod_serve_device_latency_ms")
-        lines += self.e2e_ms.prom_lines("dsod_serve_e2e_latency_ms")
-        # Per-arm families: each family ONE contiguous group (TYPE line
-        # first, then every arm's sample under an arm= label) — the
-        # text-format rule parsers enforce; interleaving families
-        # breaks promtool/OpenMetrics scrapes.
+            fams.append((name, "gauge", [f"{name}{sb} {v}"]))
+        fams.append(("dsod_serve_batch_occupancy_sum", "counter",
+                     [f"dsod_serve_batch_occupancy_sum{sb} {occ[0]}"]))
+        fams.append(("dsod_serve_batch_slots_sum", "counter",
+                     [f"dsod_serve_batch_slots_sum{sb} {occ[1]}"]))
+        for name, h in (("dsod_serve_queue_latency_ms", self.queue_ms),
+                        ("dsod_serve_device_latency_ms", self.device_ms),
+                        ("dsod_serve_e2e_latency_ms", self.e2e_ms)):
+            fams.append((name, "histogram",
+                         h.prom_lines(name, labels=labels,
+                                      include_type=False)))
+        # Per-arm families: every arm's sample in ONE family group.
         counters = []
         for a, st in arms:
             with st._lock:
                 counters.append((a, st._served, st._occ_sum, st._occ_slots))
+        def arm_labels(a):
+            return _merge_labels(labels, 'arm="' + a + '"')
+
         if counters:
-            lines.append("# TYPE dsod_serve_arm_served_total counter")
-            for a, served, _o, _s in counters:
-                lines.append(
-                    f'dsod_serve_arm_served_total{{arm="{a}"}} {served}')
-            lines.append("# TYPE dsod_serve_arm_batch_occupancy_sum counter")
-            for a, _served, occ_sum, _s in counters:
-                lines.append(
-                    f'dsod_serve_arm_batch_occupancy_sum{{arm="{a}"}} '
-                    f'{occ_sum}')
-            lines.append("# TYPE dsod_serve_arm_batch_slots_sum counter")
-            for a, _served, _o, occ_slots in counters:
-                lines.append(
-                    f'dsod_serve_arm_batch_slots_sum{{arm="{a}"}} '
-                    f'{occ_slots}')
-        for i, (a, st) in enumerate(arms):
-            lines += st.device_ms.prom_lines(
-                "dsod_serve_arm_device_latency_ms", labels=f'arm="{a}"',
-                include_type=(i == 0))
-        for i, (a, st) in enumerate(arms):
-            lines += st.e2e_ms.prom_lines(
-                "dsod_serve_arm_e2e_latency_ms", labels=f'arm="{a}"',
-                include_type=(i == 0))
-        return "\n".join(lines) + "\n"
+            fams.append(("dsod_serve_arm_served_total", "counter", [
+                'dsod_serve_arm_served_total{%s} %s'
+                % (arm_labels(a), served)
+                for a, served, _o, _s in counters]))
+            fams.append(("dsod_serve_arm_batch_occupancy_sum", "counter", [
+                'dsod_serve_arm_batch_occupancy_sum{%s} %s'
+                % (arm_labels(a), occ_sum)
+                for a, _served, occ_sum, _s in counters]))
+            fams.append(("dsod_serve_arm_batch_slots_sum", "counter", [
+                'dsod_serve_arm_batch_slots_sum{%s} %s'
+                % (arm_labels(a), occ_slots)
+                for a, _served, _o, occ_slots in counters]))
+        for fam_name, attr in (("dsod_serve_arm_device_latency_ms",
+                                "device_ms"),
+                               ("dsod_serve_arm_e2e_latency_ms", "e2e_ms")):
+            samples = []
+            for a, st in arms:
+                samples += getattr(st, attr).prom_lines(
+                    fam_name, labels=arm_labels(a), include_type=False)
+            if samples:
+                fams.append((fam_name, "histogram", samples))
+        return fams
+
+    def render_prometheus(self, labels: str = "") -> str:
+        """The /metrics payload (Prometheus text exposition format);
+        ``labels`` rides every sample (fleet replicas pass their
+        ``model=`` key)."""
+        return render_prom_families(self.prom_families(labels))
 
 
 class MetricWriter:

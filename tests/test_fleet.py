@@ -1,0 +1,710 @@
+"""Multi-model, multi-tenant serving fleet tests (serve/fleet.py +
+serve/router.py — docs/SERVING.md "Fleet").
+
+Invariants proven here:
+
+- the fleet accounting identity holds fleet-wide under CONCURRENT
+  mixed-model submitters over live HTTP, with every response
+  bitwise-identical to a direct ``make_forward`` (per model, per
+  bucket, per precision arm);
+- tenant token-bucket budgets shed at the ROUTER (429) with the engine
+  queues untouched, and priority classes shed low tenants first under
+  backlog;
+- an unknown model 404s without touching a single counter anywhere;
+- the interleaved dispatcher is fair: a one-hot-model overload cannot
+  starve a co-resident cold model;
+- /healthz degrades (not flips) while a subset of replicas is wedged;
+- the Prometheus text format stays parseable when per-model series
+  join each family: ``# TYPE`` exactly once per family, ``model=`` /
+  ``tenant=`` labels on every sample (regression for
+  utils/observability.py's family rendering).
+"""
+
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import wait as futures_wait
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+
+from distributed_sod_project_tpu.configs import (DataConfig,
+                                                 ExperimentConfig,
+                                                 FleetConfig,
+                                                 FleetModelConfig,
+                                                 FleetTenantConfig,
+                                                 ModelConfig, ServeConfig,
+                                                 fleet_config_from_dict)
+from distributed_sod_project_tpu.eval.inference import (_resize_pred,
+                                                        pad_to_batch)
+from distributed_sod_project_tpu.serve import precision as P
+from distributed_sod_project_tpu.serve.batcher import DynamicBatcher, Request
+from distributed_sod_project_tpu.serve.engine import (InferenceEngine,
+                                                      preprocess_image)
+from distributed_sod_project_tpu.serve.fleet import EngineBackend, Fleet
+from distributed_sod_project_tpu.serve.loadgen import run_loadgen
+from distributed_sod_project_tpu.serve.router import (TenantAdmission,
+                                                      TokenBucket,
+                                                      make_fleet_server)
+from distributed_sod_project_tpu.utils.observability import ServeStats
+
+
+class TinySOD(nn.Module):
+    """Minimal model with the zoo forward signature — keeps every
+    fleet test's compile in the milliseconds."""
+
+    @nn.compact
+    def __call__(self, image, depth=None, train=False):
+        x = nn.Conv(4, (3, 3), name="c1")(image)
+        x = nn.relu(x)
+        return (nn.Conv(1, (1, 1), name="head")(x),)
+
+
+def _cfg(mname="minet", **serve_kw):
+    serve_kw.setdefault("batch_buckets", (1, 2))
+    serve_kw.setdefault("resolution_buckets", (16,))
+    serve_kw.setdefault("max_wait_ms", 5.0)
+    serve_kw.setdefault("watchdog_deadline_s", 30.0)
+    return ExperimentConfig(data=DataConfig(image_size=(16, 16)),
+                            model=ModelConfig(name=mname),
+                            serve=ServeConfig(**serve_kw))
+
+
+@pytest.fixture(scope="module")
+def two_tiny():
+    """Two DIFFERENT weight sets of the tiny model — distinct models as
+    far as serving is concerned (responses must tell them apart)."""
+    model = TinySOD()
+    probe = np.zeros((1, 16, 16, 3), np.float32)
+    va = model.init(jax.random.key(0), probe, None, train=False)
+    vb = model.init(jax.random.key(1), probe, None, train=False)
+    return model, va, vb
+
+
+def _mk_fleet(two_tiny, fleet_cfg=None, serve_kw_a=None, serve_kw_b=None):
+    model, va, vb = two_tiny
+    ea = InferenceEngine(_cfg("tiny_a", **(serve_kw_a or {})), model, va)
+    eb = InferenceEngine(_cfg("tiny_b", **(serve_kw_b or {})), model, vb)
+    return Fleet([EngineBackend("a", ea), EngineBackend("b", eb)],
+                 fleet_cfg)
+
+
+def _start_http(fleet):
+    srv = make_fleet_server(fleet, "127.0.0.1", 0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _img(seed, h, w):
+    return np.random.RandomState(seed).randint(0, 256, (h, w, 3), np.uint8)
+
+
+def _post(url, img, model=None, tenant=None, precision=None, timeout=60.0):
+    buf = io.BytesIO()
+    np.save(buf, img)
+    headers = {"Content-Type": "application/x-npy"}
+    if model:
+        headers["X-Model"] = model
+    if tenant:
+        headers["X-Tenant"] = tenant
+    if precision:
+        headers["X-Precision"] = precision
+    req = urllib.request.Request(url + "/predict", data=buf.getvalue(),
+                                 headers=headers, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        pred = np.load(io.BytesIO(r.read()), allow_pickle=False)
+        return pred, dict(r.headers)
+
+
+def _get_json(url, path):
+    with urllib.request.urlopen(url + path, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+# ------------------------------------------------------- config parsing
+
+
+def test_fleet_config_from_dict_builds_and_validates():
+    fc = fleet_config_from_dict({
+        "models": [{"name": "m1", "config": "minet_vgg16_ref",
+                    "overrides": ["serve.precision_arms=f32"]},
+                   {"name": "m2", "url": "http://h:1"}],
+        "tenants": [{"name": "gold", "priority": 2, "rate_rps": 10}],
+        "default_tenant": "free",
+    })
+    assert [m.name for m in fc.models] == ["m1", "m2"]
+    assert fc.models[0].overrides == ("serve.precision_arms=f32",)
+    # The default tenant was auto-registered at the LOWEST priority.
+    names = {t.name: t for t in fc.tenants}
+    assert "free" in names
+    assert names["free"].priority == min(t.priority for t in fc.tenants)
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ({"models": []}, "at least one model"),
+    ({"models": [{"name": "m", "config": "c"},
+                 {"name": "m", "config": "c"}]}, "duplicate fleet model"),
+    ({"models": [{"name": "m"}]}, "needs one of"),
+    ({"models": [{"name": "m", "config": "c", "url": "http://h"}]},
+     "exclusive"),
+    ({"models": [{"name": "m", "config": "c", "bogus": 1}]},
+     "unknown fleet model key"),
+    ({"models": [{"name": "m", "config": "c"}], "bogus": 1},
+     "unknown fleet config key"),
+    ({"models": [{"name": "m", "config": "c"}],
+      "tenants": [{"name": "t"}, {"name": "t"}]}, "duplicate fleet tenant"),
+])
+def test_fleet_config_rejects_bad_shapes(bad, msg):
+    with pytest.raises(ValueError, match=msg):
+        fleet_config_from_dict(bad)
+
+
+# ---------------------------------------------------- tenancy primitives
+
+
+def test_token_bucket_budget_and_refill():
+    clk = [0.0]
+    b = TokenBucket(rate_per_s=2.0, burst=4.0, clock=lambda: clk[0])
+    assert all(b.try_take() for _ in range(4))  # burst
+    assert not b.try_take()  # exhausted
+    clk[0] = 1.0  # +2 tokens
+    assert b.try_take() and b.try_take()
+    assert not b.try_take()
+    clk[0] = 100.0  # refill clamps at burst
+    assert sum(b.try_take() for _ in range(10)) == 4
+
+
+def test_tenant_admission_priority_classes_shed_low_first():
+    tenants = (FleetTenantConfig(name="gold", priority=1),
+               FleetTenantConfig(name="free", priority=0))
+    adm = TenantAdmission(tenants, default_tenant="free")
+    gold, free = adm.tenants["gold"], adm.tenants["free"]
+    assert adm.backlog_frac(1) == 1.0  # top class: engine bound only
+    assert adm.backlog_frac(0) == 0.5
+    # Below the low class's threshold: both admit.
+    assert adm.try_admit(free, 4, 10) is None
+    assert adm.try_admit(gold, 4, 10) is None
+    # Past it: the low class sheds, the top class still admits.
+    assert adm.try_admit(free, 5, 10) == "priority"
+    assert adm.try_admit(gold, 9, 10) is None
+    # Unknown depth (remote replica): priority check is skipped.
+    assert adm.try_admit(free, None, None) is None
+
+
+def test_priority_shed_does_not_burn_budget_tokens():
+    """A priority-shed request must NOT consume a token — a tenant
+    must not exit a backlog spike budget-broke for requests the router
+    refused to route."""
+    clk = [0.0]
+    tenants = (FleetTenantConfig(name="gold", priority=1),
+               FleetTenantConfig(name="free", priority=0, rate_rps=1e-9,
+                                 burst=2.0))
+    adm = TenantAdmission(tenants, default_tenant="free",
+                          clock=lambda: clk[0])
+    free = adm.tenants["free"]
+    # Backlog spike: every attempt priority-sheds…
+    for _ in range(10):
+        assert adm.try_admit(free, 9, 10) == "priority"
+    # …and the burst is still intact once the backlog clears.
+    assert adm.try_admit(free, 0, 10) is None
+    assert adm.try_admit(free, 0, 10) is None
+    assert adm.try_admit(free, 0, 10) == "budget"
+
+
+def test_tenant_admission_resolve_unknown_and_strict():
+    tenants = (FleetTenantConfig(name="gold", priority=1),)
+    lax = TenantAdmission(tenants, default_tenant="default")
+    assert lax.resolve(None).name == "default"
+    assert lax.resolve("nope").name == "default"  # rides default class
+    strict = TenantAdmission(tenants, default_tenant="default",
+                             strict=True)
+    assert strict.resolve("nope") is None
+    assert strict.resolve("gold").name == "gold"
+
+
+# ------------------------------------------------ batcher poll (fleet)
+
+
+def test_batcher_poll_and_ready_are_nonblocking():
+    clk = [0.0]
+    b = DynamicBatcher((1, 4), max_wait_s=0.1, clock=lambda: clk[0])
+    assert b.ready() is False and b.poll_batch() is None  # empty: instant
+    b.put(Request(tensor=np.zeros((4, 4, 3), np.float32), orig_hw=(4, 4),
+                  res_bucket=16, arrival=0.0))
+    # Still coalescing (max-wait not reached, bucket not full): a poll
+    # must NOT pop and must NOT wait.
+    assert b.ready() is False and b.poll_batch() is None
+    assert b.pending() == 1
+    clk[0] = 0.2  # past max-wait: ready, poll pops
+    assert b.ready() is True
+    key, group = b.poll_batch()
+    assert key == (16, "f32") and len(group) == 1
+    # A full bucket is ready with no wait at all.
+    for _ in range(4):
+        b.put(Request(tensor=np.zeros((4, 4, 3), np.float32),
+                      orig_hw=(4, 4), res_bucket=16, arrival=clk[0]))
+    assert b.ready() is True and len(b.poll_batch()[1]) == 4
+
+
+# ------------------------------------------------------- live-HTTP e2e
+
+
+def test_e2e_fleet_mixed_models_bitwise_and_accounting(two_tiny):
+    """The acceptance run: concurrent mixed-model, mixed-arm traffic
+    through ONE router returns bitwise-identical maps to direct
+    forwards of EACH model's weights at the same buckets and arms, and
+    the fleet-wide book balances."""
+    model, va, vb = two_tiny
+    fleet = _mk_fleet(two_tiny, serve_kw_a={"max_wait_ms": 20.0},
+                      serve_kw_b={"max_wait_ms": 20.0})
+    fleet.start()
+    srv, url = _start_http(fleet)
+    try:
+        assert _get_json(url, "/healthz")["status"] == "ok"
+        assert set(_get_json(url, "/models")["models"]) == {"a", "b"}
+        arms = ("f32", "bf16")
+        n = 16
+        plan = [("a" if i % 2 == 0 else "b", arms[(i // 2) % 2], i)
+                for i in range(n)]
+        out = [None] * n
+        errs = []
+
+        def one(i):
+            mname, arm, seed = plan[i]
+            try:
+                out[i] = _post(url, _img(seed, 16 + 2 * (i % 3), 16),
+                               model=mname, precision=arm)
+            except Exception as e:  # pragma: no cover — surfaces below
+                errs.append((i, e))
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, f"request failures: {errs}"
+
+        variables = {"a": va, "b": vb}
+        fwds = {arm: P.make_precision_forward(model, arm) for arm in arms}
+        views = {(m, arm): P.cast_variables(variables[m], arm)
+                 for m in ("a", "b") for arm in arms}
+        cfg = _cfg()
+        for i in range(n):
+            mname, arm, seed = plan[i]
+            pred, headers = out[i]
+            assert headers["X-Model"] == mname  # served model echoed
+            assert headers["X-Precision"] == arm
+            img = _img(seed, 16 + 2 * (i % 3), 16)
+            res = int(headers["X-Res-Bucket"])
+            bb = int(headers["X-Batch-Bucket"])
+            x = preprocess_image(img, res, cfg.data.normalize_mean,
+                                 cfg.data.normalize_std)
+            ref = np.asarray(fwds[arm](
+                views[(mname, arm)],
+                pad_to_batch({"image": x[None]}, bb)))[0]
+            ref = _resize_pred(ref, img.shape[:2])
+            assert np.array_equal(pred, ref), \
+                f"request {i}: served map not bitwise-identical to the " \
+                f"direct {mname}/{arm} forward (res={res}, batch={bb})"
+
+        # Fleet-wide accounting: identity holds, the router's routed
+        # count equals the engines' submitted counts exactly.
+        stats = _get_json(url, "/stats")
+        assert stats["fleet"]["submitted"] == n
+        assert stats["fleet"]["consistent"] is True
+        assert stats["fleet"]["errors"] == 0
+        assert stats["router"]["routed"] == {"a": n // 2, "b": n // 2}
+        for name in ("a", "b"):
+            m = stats["models"][name]
+            assert m["submitted"] == n // 2
+            assert (m["served"] + m["shed"] + m["expired"]
+                    + m["errors"]) == m["submitted"]
+
+        # Aggregated /metrics: model labels + TYPE once per family.
+        prom = urllib.request.urlopen(url + "/metrics", timeout=10
+                                      ).read().decode()
+        assert f'dsod_serve_submitted_total{{model="a"}} {n // 2}' in prom
+        assert f'dsod_serve_submitted_total{{model="b"}} {n // 2}' in prom
+        assert 'dsod_fleet_replica_up{model="a"} 1' in prom
+        assert 'dsod_serve_arm_served_total{model="a",arm="bf16"}' in prom
+        for fam in ("dsod_serve_submitted_total",
+                    "dsod_serve_e2e_latency_ms",
+                    "dsod_serve_arm_served_total"):
+            assert prom.count(f"# TYPE {fam} ") == 1, \
+                f"family {fam} must declare TYPE exactly once"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_unknown_model_404_never_touches_counters(two_tiny):
+    fleet = _mk_fleet(two_tiny)
+    fleet.start()
+    srv, url = _start_http(fleet)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, _img(0, 16, 16), model="nope")
+        assert exc.value.code == 404
+        body = json.loads(exc.value.read().decode())
+        assert body["models"] == ["a", "b"]
+        # Ambiguous header-less request on a MULTI-model fleet: same.
+        with pytest.raises(urllib.error.HTTPError) as exc2:
+            _post(url, _img(0, 16, 16))
+        assert exc2.value.code == 404
+        exc2.value.read()
+        stats = _get_json(url, "/stats")
+        assert stats["fleet"]["submitted"] == 0
+        assert stats["router"]["submitted_total"] == 0
+        for name in ("a", "b"):
+            assert stats["models"][name]["submitted"] == 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_tenant_budget_exhaustion_429_with_engine_queues_untouched(
+        two_tiny):
+    """A tenant past its token budget sheds AT THE ROUTER: 429 with
+    kind=tenant_budget, nothing enqueued on any engine — proven under
+    CONCURRENT submitters, with the fleet book still balancing."""
+    fleet = _mk_fleet(two_tiny, FleetConfig(tenants=(
+        FleetTenantConfig(name="free", priority=0, rate_rps=1e-9,
+                          burst=3.0),),
+        default_tenant="free"))
+    fleet.start()
+    srv, url = _start_http(fleet)
+    try:
+        n = 12
+        codes = []
+        lock = threading.Lock()
+
+        def one(i):
+            try:
+                _post(url, _img(i, 16, 16), model="a", tenant="free")
+                with lock:
+                    codes.append(200)
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read().decode())
+                with lock:
+                    codes.append((e.code, body.get("kind")))
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        shed = [c for c in codes if c != 200]
+        assert len([c for c in codes if c == 200]) == 3  # the burst
+        assert shed and all(c == (429, "tenant_budget") for c in shed)
+        # The engines never saw the shed requests.
+        ea = fleet.backends["a"].engine
+        assert ea.stats.counter("submitted") == 3
+        assert ea.batcher.pending() == 0
+        stats = _get_json(url, "/stats")
+        assert stats["fleet"]["submitted"] == n
+        assert stats["fleet"]["shed"] == n - 3
+        assert stats["fleet"]["consistent"] is True
+        prom = urllib.request.urlopen(url + "/metrics", timeout=10
+                                      ).read().decode()
+        assert ('dsod_fleet_tenant_shed_total'
+                f'{{tenant="free",reason="budget"}} {n - 3}') in prom
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_malformed_headers_stay_in_the_fleet_book(two_tiny):
+    """Pre-submit 400s the router triggers AFTER counting submitted
+    (bad Content-Length, non-numeric X-SLO-MS) must terminal-count as
+    router rejects — or the fleet book never balances again."""
+    fleet = _mk_fleet(two_tiny)
+    fleet.start()
+    srv, url = _start_http(fleet)
+    try:
+        import http.client
+
+        # Non-numeric Content-Length: raw socket (urllib would fix it).
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          srv.server_address[1],
+                                          timeout=10)
+        conn.putrequest("POST", "/predict", skip_accept_encoding=True)
+        conn.putheader("X-Model", "a")
+        conn.putheader("Content-Length", "abc")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        resp.read()
+        conn.close()
+        # Non-numeric X-SLO-MS: rejected BEFORE the engine sees it.
+        buf = io.BytesIO()
+        np.save(buf, _img(0, 16, 16))
+        req = urllib.request.Request(
+            url + "/predict", data=buf.getvalue(),
+            headers={"Content-Type": "application/x-npy",
+                     "X-Model": "a", "X-SLO-MS": "fast"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc.value.code == 400
+        assert json.loads(exc.value.read().decode())["kind"] == "rejected"
+        assert fleet.backends["a"].engine.stats.counter("submitted") == 0
+        stats = _get_json(url, "/stats")
+        assert stats["fleet"]["submitted"] == 2
+        assert stats["fleet"]["errors"] == 2  # both router-rejected
+        assert stats["fleet"]["consistent"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_run_predict_never_raises_when_client_is_gone(two_tiny):
+    """run_predict must return a definite outcome even when every send
+    hits a dead client — an escaping exception would strand a
+    router-counted submission with no terminal counter."""
+    from distributed_sod_project_tpu.serve.server import run_predict
+
+    model, va, _vb = two_tiny
+    eng = InferenceEngine(_cfg("tiny_a"), model, va)
+    eng.start()
+
+    class DeadClient:
+        headers = {}
+        close_connection = False
+
+        def _send(self, *a, **kw):
+            raise BrokenPipeError("client gone")
+
+        def _send_json(self, *a, **kw):
+            raise BrokenPipeError("client gone")
+
+    try:
+        # Pre-submit reject (bad body): outcome for the router's book,
+        # engine untouched, nothing raised.
+        assert run_predict(DeadClient(), eng, b"not npy") == "rejected"
+        assert eng.stats.counter("submitted") == 0
+        # Post-submit: the 200 send fails, but the engine owns the
+        # terminal — the outcome must be engine-owned, not a second
+        # router terminal.
+        buf = io.BytesIO()
+        np.save(buf, _img(0, 16, 16))
+        assert run_predict(DeadClient(), eng, buf.getvalue()) == "ok"
+        deadline = time.monotonic() + 10
+        while (eng.stats.counter("served") < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert eng.stats.counter("submitted") == 1
+        assert eng.stats.counter("served") == 1
+    finally:
+        eng.stop()
+
+
+def test_strict_tenants_403_uncounted(two_tiny):
+    fleet = _mk_fleet(two_tiny, FleetConfig(
+        tenants=(FleetTenantConfig(name="gold", priority=0),),
+        strict_tenants=True))
+    fleet.start()
+    srv, url = _start_http(fleet)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(url, _img(0, 16, 16), model="a", tenant="nope")
+        assert exc.value.code == 403
+        exc.value.read()
+        _post(url, _img(0, 16, 16), model="a", tenant="gold")  # flows
+        stats = _get_json(url, "/stats")
+        assert stats["fleet"]["submitted"] == 1
+        assert stats["fleet"]["consistent"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_single_model_fleet_routes_headerless_requests(two_tiny):
+    """The tools/serve.py --model posture: one engine behind the
+    router; requests without X-Model route to it and get the echo."""
+    model, va, _vb = two_tiny
+    eng = InferenceEngine(_cfg("tiny_a"), model, va)
+    fleet = Fleet([EngineBackend("solo", eng)])
+    fleet.start()
+    srv, url = _start_http(fleet)
+    try:
+        _pred, headers = _post(url, _img(0, 16, 16))
+        assert headers["X-Model"] == "solo"
+        assert headers["X-Tenant"] == "default"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+# ------------------------------------------------- fairness + health
+
+
+def test_router_fairness_one_hot_overload_cannot_starve_cold_model(
+        two_tiny):
+    """Flood model a (slow completions, inflight=1); a trickle of
+    model b requests must be served promptly from the SAME interleaved
+    dispatch loop while a's backlog is still deep — round-robin gives
+    b its slot every cycle."""
+    fleet = _mk_fleet(
+        two_tiny,
+        serve_kw_a={"max_inflight": 1, "batch_buckets": (1,),
+                    "max_wait_ms": 1.0, "max_queue": 64},
+        serve_kw_b={"max_wait_ms": 1.0})
+    ea = fleet.backends["a"].engine
+    orig_complete = ea._complete
+
+    def slow_complete(*a, **kw):  # simulated long device time for `a`
+        time.sleep(0.15)
+        return orig_complete(*a, **kw)
+
+    ea._complete = slow_complete
+    fleet.start()
+    try:
+        img = _img(0, 16, 16)
+        hot = [ea.submit(img) for _ in range(10)]
+        time.sleep(0.1)  # the flood is in the loop's hands now
+        eb = fleet.backends["b"].engine
+        t0 = time.monotonic()
+        cold = [eb.submit(img) for _ in range(3)]
+        done, not_done = futures_wait(cold, timeout=5.0)
+        cold_t = time.monotonic() - t0
+        assert not not_done, "cold-model requests starved by hot model"
+        # The hot backlog is still deep when the cold model finished.
+        assert ea.batcher.pending() + len(
+            [f for f in hot if not f.done()]) >= 3, \
+            "hot model drained too fast for the fairness claim to bite"
+        assert cold_t < 3.0
+        futures_wait(hot, timeout=30.0)
+    finally:
+        fleet.stop()
+
+
+def test_healthz_degrades_for_subset_and_flips_only_when_all_down(
+        two_tiny):
+    fleet = _mk_fleet(two_tiny)
+    fleet.start()
+    srv, url = _start_http(fleet)
+    try:
+        assert _get_json(url, "/healthz")["status"] == "ok"
+        # Wedge ONE model: the fleet degrades but keeps answering 200.
+        fleet.backends["a"].engine.stats.set_health(False, "wedged")
+        body = _get_json(url, "/healthz")
+        assert body["status"] == "degraded"
+        assert body["unhealthy"] == ["a"]
+        # ...and the healthy sibling still serves.
+        _pred, headers = _post(url, _img(0, 16, 16), model="b")
+        assert headers["X-Model"] == "b"
+        # Wedge BOTH: only now does the fleet answer 503.
+        fleet.backends["b"].engine.stats.set_health(False, "wedged")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get_json(url, "/healthz")
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read().decode())
+        assert sorted(body["unhealthy"]) == ["a", "b"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+# ------------------------------------------------------- loadgen mix
+
+
+def test_loadgen_mixed_traffic_per_model_breakdown(two_tiny):
+    fleet = _mk_fleet(two_tiny)
+    fleet.start()
+    srv, url = _start_http(fleet)
+    try:
+        summary = run_loadgen(
+            url, mode="closed", concurrency=2, requests=12,
+            sizes=((16, 16),), seed=0, timeout_s=60,
+            mix=[{"model": "a", "tenant": "default", "weight": 3},
+                 {"model": "b", "weight": 1}])
+        assert summary["ok"] == 12
+        models = summary["models"]
+        assert set(models) == {"a", "b"}
+        assert models["a"]["sent"] + models["b"]["sent"] == 12
+        for name in ("a", "b"):
+            assert models[name]["ok"] == models[name]["sent"]
+            assert models[name]["p99_ms"] >= models[name]["p50_ms"] >= 0
+        # The weighted draw favors a (deterministic under seed=0).
+        assert models["a"]["sent"] > models["b"]["sent"]
+        stats = _get_json(url, "/stats")
+        assert stats["fleet"]["submitted"] == 12
+        assert stats["fleet"]["consistent"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+# --------------------------------------- prometheus format regression
+
+
+def test_prometheus_single_model_render_is_unchanged_without_labels():
+    s = ServeStats()
+    s.inc("submitted", 5)
+    s.observe_batch(3, 4, arm="bf16")
+    s.e2e_ms.observe(12.0)
+    prom = s.render_prometheus()
+    assert "dsod_serve_submitted_total 5" in prom  # no stray label set
+    assert 'dsod_serve_arm_served_total{arm="bf16"} 0' in prom
+    assert "# TYPE dsod_serve_e2e_latency_ms histogram" in prom
+
+
+def test_prometheus_model_labels_and_type_once_across_series():
+    """The satellite regression: when multiple labeled series export
+    one family, TYPE appears ONCE and every sample carries its model
+    label (promtool's contiguous-family rule)."""
+    from distributed_sod_project_tpu.utils.observability import (
+        merge_prom_families, parse_prom_text, render_prom_families)
+
+    stats = {}
+    for name in ("m1", "m2"):
+        s = stats[name] = ServeStats()
+        s.inc("submitted", 2)
+        s.inc("served", 2)
+        s.arm("f32").inc_served(2)
+        s.arm("f32").e2e_ms.observe(3.0)
+        s.e2e_ms.observe(3.0)
+    text = render_prom_families(merge_prom_families(
+        [stats[n].prom_families(f'model="{n}"') for n in ("m1", "m2")]))
+    for fam in ("dsod_serve_submitted_total", "dsod_serve_served_total",
+                "dsod_serve_e2e_latency_ms",
+                "dsod_serve_arm_served_total",
+                "dsod_serve_arm_e2e_latency_ms"):
+        assert text.count(f"# TYPE {fam} ") == 1
+    assert 'dsod_serve_submitted_total{model="m1"} 2' in text
+    assert 'dsod_serve_submitted_total{model="m2"} 2' in text
+    assert 'dsod_serve_arm_served_total{model="m1",arm="f32"} 2' in text
+    assert ('dsod_serve_e2e_latency_ms_bucket{model="m1",le="+Inf"} 1'
+            in text)
+    # Families are contiguous: every sample between a TYPE line and the
+    # next TYPE line belongs to that family.
+    current = None
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            current = line.split()[2]
+            continue
+        name = line.partition("{")[0].partition(" ")[0]
+        assert name.startswith(current), \
+            f"sample {name} outside its family group {current}"
+    # A remote replica's text round-trips through the relabeling
+    # parser into the same family structure.
+    solo = stats["m1"].render_prometheus()
+    fams = parse_prom_text(solo, 'model="r1"')
+    rendered = render_prom_families(fams)
+    assert 'dsod_serve_submitted_total{model="r1"} 2' in rendered
+    assert rendered.count("# TYPE dsod_serve_e2e_latency_ms ") == 1

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Fleet smoke for tools/t1.sh: start tools/serve.py --fleet-config as
+a REAL subprocess serving TWO models on an ephemeral port, push a
+mixed-model loadgen round through the router (weighted X-Model /
+X-Tenant traffic), assert the per-model breakdown and the fleet-wide
+accounting identity, then SIGTERM and assert a CLEAN drain (exit 0).
+Prints one JSON line; exits non-zero on any broken link.
+
+Budget contract: the internal deadlines (180 s bind incl. two models'
+AOT warms + 60 s healthz + 90 s requests + 60 s drain) sum under the
+t1.sh wrapper's 480 s, so a stall always reports its OWN JSON
+diagnostic instead of dying to the outer timeout mid-wait.
+
+Deliberately out-of-process (the serve_smoke posture, one tier up):
+the smoke must exercise the same process lifecycle a fleet deployment
+does — fleet-config parsing, two engines warming behind one
+interleaved dispatcher, signal handling, drain, port-file.
+tests/test_fleet.py covers the in-process side.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_sod_project_tpu.serve.loadgen import (  # noqa: E402
+    run_loadgen, wait_ready)
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+
+# Two REAL zoo architectures, shrunk to smoke size: 64 px, two batch
+# buckets, f32 only (each extra arm is another AOT program per model).
+FLEET = {
+    "default_tenant": "free",
+    "tenants": [
+        {"name": "gold", "priority": 1},
+        {"name": "free", "priority": 0},
+    ],
+    "models": [
+        {"name": "minet", "config": "minet_vgg16_ref", "overrides": [
+            "data.image_size=64,64", "serve.resolution_buckets=64",
+            "serve.batch_buckets=1,2", "serve.precision_arms=f32",
+            "serve.precision=f32"]},
+        {"name": "u2net", "config": "u2net_ds", "overrides": [
+            "data.image_size=64,64", "serve.resolution_buckets=64",
+            "serve.batch_buckets=1,2", "serve.precision_arms=f32",
+            "serve.precision=f32"]},
+    ],
+}
+
+
+def main(argv=None) -> int:
+    argparse.ArgumentParser(description=__doc__).parse_args(argv)
+    port_file = tempfile.mktemp(prefix="dsod_fleet_port_")
+    fleet_file = tempfile.mktemp(prefix="dsod_fleet_cfg_", suffix=".json")
+    with open(fleet_file, "w") as f:
+        json.dump(FLEET, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
+           "--fleet-config", fleet_file, "--device", "cpu",
+           "--port", "0", "--port-file", port_file]
+    proc = subprocess.Popen(cmd, env=env)
+    try:
+        deadline = time.monotonic() + 180
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                print(json.dumps({"error": "fleet died before binding",
+                                  "rc": proc.returncode}), flush=True)
+                return 1
+            if time.monotonic() > deadline:
+                print(json.dumps({"error": "fleet never bound a port"}),
+                      flush=True)
+                return 1
+            time.sleep(0.25)
+        with open(port_file) as f:
+            url = f"http://127.0.0.1:{int(f.read().strip())}"
+        if not wait_ready(url, timeout_s=60):
+            print(json.dumps({"error": "fleet never became healthy"}),
+                  flush=True)
+            return 1
+        # Mixed traffic through ONE router: weighted models x tenants.
+        summary = run_loadgen(
+            url, mode="closed", concurrency=2, requests=6,
+            sizes=((48, 56),), seed=0, timeout_s=90,
+            mix=[{"model": "minet", "tenant": "gold", "weight": 2},
+                 {"model": "u2net", "tenant": "free", "weight": 1}])
+        with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+            stats = json.loads(r.read().decode())
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        summary["server_rc"] = rc
+        summary["fleet"] = stats.get("fleet", {})
+        print(json.dumps(summary), flush=True)
+        models = summary.get("models", {})
+        ok = (summary.get("ok", 0) == 6 and rc == 0
+              # every request served by the model it named …
+              and models.get("minet", {}).get("ok", 0) \
+              == models.get("minet", {}).get("sent", -1)
+              and models.get("u2net", {}).get("ok", 0) \
+              == models.get("u2net", {}).get("sent", -1)
+              and models.get("u2net", {}).get("sent", 0) >= 1
+              # … and the fleet-wide book balances.
+              and stats.get("fleet", {}).get("consistent") is True
+              and stats.get("fleet", {}).get("submitted") == 6)
+        return 0 if ok else 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        for f in (port_file, fleet_file):
+            if os.path.exists(f):
+                os.unlink(f)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -50,6 +50,21 @@ def parse_args(argv=None):
                         "summary's per-arm breakdown reports what was "
                         "actually SERVED — the degraded ladder may "
                         "step it down)")
+    p.add_argument("--model", default=None,
+                   help="model routing key sent as X-Model on every "
+                        "request (fleet router; single-model fleets "
+                        "route header-less requests automatically)")
+    p.add_argument("--tenant", default=None,
+                   help="tenant sent as X-Tenant on every request "
+                        "(fleet tenancy; default tenant when omitted)")
+    p.add_argument("--mix", action="append", default=[],
+                   metavar="MODEL[:TENANT]=WEIGHT",
+                   help="mixed traffic: weighted per-model(/tenant) "
+                        "request mix, repeatable (e.g. --mix minet=3 "
+                        "--mix u2net:free=1).  Each request draws its "
+                        "(model, tenant) from the mix; the summary "
+                        "breaks p50/p95/p99 down per SERVED model, so "
+                        "the fleet's mixed-model curve is one command")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=60.0,
                    help="per-request client timeout seconds")
@@ -70,11 +85,23 @@ def main(argv=None) -> int:
                                    f"{args.wait_ready}s"}), flush=True)
         return 1
     sizes = tuple((s, s) for s in (args.size or [320]))
+    mix = None
+    if args.mix:
+        mix = []
+        for spec in args.mix:
+            if "=" not in spec:
+                raise SystemExit(
+                    f"--mix {spec!r} is not MODEL[:TENANT]=WEIGHT")
+            key, weight = spec.rsplit("=", 1)
+            model, _, tenant = key.partition(":")
+            mix.append({"model": model, "tenant": tenant or None,
+                        "weight": float(weight)})
     summary = run_loadgen(
         url, mode=args.mode, concurrency=args.concurrency,
         requests=args.requests, rps=args.rps, duration_s=args.duration,
         sizes=sizes, seed=args.seed, slo_ms=args.slo_ms,
-        timeout_s=args.timeout, precision=args.precision)
+        timeout_s=args.timeout, precision=args.precision,
+        model=args.model, tenant=args.tenant, mix=mix)
     if args.server_stats:
         try:
             summary["server"] = fetch_stats(url)

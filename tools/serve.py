@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Run the online serving engine over HTTP (docs/SERVING.md).
+"""Run the online serving engine — or a multi-model FLEET — over HTTP
+(docs/SERVING.md).
 
     # Serve a trained checkpoint (config sidecar aware), hot-reloading
     # whenever training writes a newer VALID checkpoint:
@@ -11,9 +12,20 @@
     python tools/serve.py --config minet_vgg16_ref --init-random \
         --port 0 --port-file /tmp/serve.port
 
+    # Single model behind the FLEET router (adds X-Model routing,
+    # tenancy, and the aggregated fleet /metrics):
+    python tools/serve.py --config minet_vgg16_ref --init-random \
+        --model minet --port 8080
+
+    # Multi-model fleet from a JSON config (docs/SERVING.md "Fleet"):
+    python tools/serve.py --fleet-config fleet.json \
+        --port 0 --port-file /tmp/fleet.port
+
 ``--port 0`` binds an ephemeral port; ``--port-file`` writes the bound
-port for scripts.  SIGTERM/SIGINT drain cleanly (exit 0).  Knobs live
-under the ``serve.*`` config section (``--set serve.max_wait_ms=10``).
+port atomically for scripts.  SIGTERM/SIGINT drain cleanly (exit 0).
+Knobs live under the ``serve.*`` config section
+(``--set serve.max_wait_ms=10``; with a fleet, ``--set`` applies to
+every in-process member after its own overrides).
 """
 
 from __future__ import annotations
@@ -38,6 +50,15 @@ def parse_args(argv=None):
                         "posture)")
     p.add_argument("--step", type=int, default=None,
                    help="checkpoint step (default: newest VALID)")
+    p.add_argument("--model", default=None,
+                   help="routing key: front the single engine with the "
+                        "fleet router under this model name (X-Model "
+                        "routing, tenancy, aggregated /metrics)")
+    p.add_argument("--fleet-config", default=None,
+                   help="JSON fleet config (models/tenants — "
+                        "docs/SERVING.md \"Fleet\"): serve a "
+                        "multi-model fleet behind the router instead "
+                        "of one engine")
     p.add_argument("--host", default=None,
                    help="bind host (default: serve.host)")
     p.add_argument("--port", type=int, default=None,
@@ -52,13 +73,39 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
-    if not args.ckpt_dir and not (args.init_random and args.config):
+    if args.fleet_config:
+        if (args.ckpt_dir or args.config or args.model
+                or args.init_random or args.step is not None):
+            raise SystemExit(
+                "--fleet-config is exclusive of --ckpt-dir/--config/"
+                "--model/--init-random/--step (members and their "
+                "sources are named in the JSON; a silently ignored "
+                "flag would serve the wrong weights)")
+    elif not args.ckpt_dir and not (args.init_random and args.config):
         raise SystemExit(
-            "need --ckpt-dir, or --init-random with --config")
+            "need --fleet-config, --ckpt-dir, or --init-random with "
+            "--config")
 
     from distributed_sod_project_tpu.utils.platform import select_platform
 
     select_platform(args.device)
+
+    if args.fleet_config:
+        import json
+
+        from distributed_sod_project_tpu.configs import \
+            fleet_config_from_dict
+        from distributed_sod_project_tpu.serve.fleet import Fleet
+        from distributed_sod_project_tpu.serve.router import \
+            serve_fleet_forever
+
+        with open(args.fleet_config) as f:
+            fc = fleet_config_from_dict(json.load(f))
+        fleet = Fleet.from_config(fc, extra_overrides=args.overrides)
+        host = args.host if args.host is not None else fc.host
+        port = args.port if args.port is not None else fc.port
+        return serve_fleet_forever(fleet, host, port,
+                                   port_file=args.port_file)
 
     from distributed_sod_project_tpu.serve.engine import InferenceEngine
     from distributed_sod_project_tpu.serve.server import serve_forever
@@ -76,6 +123,17 @@ def main(argv=None) -> int:
 
     host = args.host if args.host is not None else engine.cfg.serve.host
     port = args.port if args.port is not None else engine.cfg.serve.port
+    if args.model:
+        # One engine behind the router: same process, fleet front door
+        # (X-Model routing + tenancy + fleet metrics for one model).
+        from distributed_sod_project_tpu.serve.fleet import (EngineBackend,
+                                                             Fleet)
+        from distributed_sod_project_tpu.serve.router import \
+            serve_fleet_forever
+
+        fleet = Fleet([EngineBackend(args.model, engine)])
+        return serve_fleet_forever(fleet, host, port,
+                                   port_file=args.port_file)
     return serve_forever(engine, host, port, port_file=args.port_file)
 
 

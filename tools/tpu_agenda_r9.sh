@@ -1,0 +1,155 @@
+#!/bin/bash
+# Round-9 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh fires this automatically; default agenda since
+# round 9).  Round 9 landed the multi-model, multi-tenant serving
+# fleet (serve/fleet.py + serve/router.py: X-Model routing, per-tenant
+# token-bucket budgets, ONE interleaved dispatch loop draining
+# co-resident per-model batchers fairly — docs/SERVING.md "Fleet").
+# Routing/tenancy/accounting are already proven on CPU (tests +
+# tools/fleet_smoke.py); what only hardware can answer:
+#
+#   1. canonical b128 headline refresh (comparison anchor; untouched by
+#      the fleet work, so any drift is environmental)
+#   2. the MIXED-MODEL throughput-vs-p99 curve: one fleet process
+#      co-residing minet_r50_dp + u2net_ds on one chip, swept
+#      closed-loop at rising concurrency with weighted mixed traffic
+#      (loadgen --mix splits the curve per served model) — the measured
+#      cost of sharing a device between two compiled-program families
+#      vs the r7/r8 single-model curves
+#   3. single-model-through-router legs at the same concurrency grid:
+#      the ROUTER TAX in isolation (same model, same device, one front
+#      door more) — if this exceeds a few ms at the knee, the router
+#      needs a leaner in-process path before it fronts production
+#   4. fairness + tenancy under pressure: open-loop one-hot overload on
+#      minet with a trickle of u2net requests riding along, per-tenant
+#      budgets armed — the per-model breakdown tells whether the cold
+#      model's p99 survives the hot model's backlog (the interleaved
+#      dispatcher's whole job), and /stats records the tenant sheds
+#
+# Predictions on record (docs/SERVING.md "Fleet"): (a) the router tax
+# is < 5 ms p50 at c=1 and vanishes into batching at c>=8 (stdlib
+# handler + one dict lookup + token-bucket read); (b) co-resident
+# mixed 2:1 traffic lands each model within 25% of its solo r8
+# throughput at matched per-model offered load (one device, two
+# program families — the loop interleaves, the MXU does not multiply);
+# (c) under one-hot minet overload the u2net trickle's p99 stays
+# within 2x its unloaded p99 (round-robin guarantees its slot every
+# cycle); if it does NOT, the dispatcher needs per-model inflight
+# reservations, and that becomes the r10 lever.
+#
+# Serve legs talk to ONE fleet process started here (ephemeral port,
+# --port-file); loadgen itself never imports jax, so only the fleet
+# occupies the TPU.
+cd "$(dirname "$0")/.." || exit 1
+R=${R:-tpu_results9}
+mkdir -p "$R"
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+done_ok() {
+  [ -f "$R"/results.jsonl ] || return 1
+  local rec
+  rec=$(grep "\"step\": \"$1\", \"rc\": 0" "$R"/results.jsonl | tail -1)
+  [ -n "$rec" ] || return 1
+  ! printf '%s' "$rec" | grep -q '"error"'
+}
+
+# Circuit breaker (r4 pattern): after any failed leg, verify the
+# tunnel still runs REAL compute; abort the firing if not (the
+# watcher re-fires in the next window and done_ok() skips landed legs).
+tunnel_computes() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('computes')" 2>/dev/null | grep -q computes
+}
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  if done_ok "$name"; then
+    echo "[$name] skip: succeeded in a previous window" | tee -a "$R"/agenda.log
+    return 0
+  fi
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+  if { [ "$rc" -ne 0 ] || printf '%s' "$line" | grep -Eq 'wedged|unavailable'; } \
+      && ! tunnel_computes; then
+    echo "[$name] tunnel no longer computes — aborting firing (watcher will re-fire)" \
+      | tee -a "$R"/agenda.log
+    exit 2
+  fi
+}
+
+# -- 1. canonical headline refresh (the r5-r8 key replays unchanged)
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2-4. the fleet: minet_r50_dp + u2net_ds co-resident on one chip
+#         behind one router, gold/free tenants armed.
+FLEET_CFG="$R/fleet.json"
+cat > "$FLEET_CFG" <<'JSON'
+{
+  "default_tenant": "free",
+  "tenants": [
+    {"name": "gold", "priority": 1},
+    {"name": "free", "priority": 0, "rate_rps": 200, "burst": 400}
+  ],
+  "models": [
+    {"name": "minet", "config": "minet_r50_dp",
+     "overrides": ["serve.batch_buckets=1,4,8,16"]},
+    {"name": "u2net", "config": "u2net_ds",
+     "overrides": ["serve.batch_buckets=1,4,8,16"]}
+  ]
+}
+JSON
+FLEET_PORT_FILE="$R/fleet.port"
+rm -f "$FLEET_PORT_FILE"
+python tools/serve.py --fleet-config "$FLEET_CFG" --device tpu \
+  --port 0 --port-file "$FLEET_PORT_FILE" \
+  > "$R"/fleet_server.out 2> "$R"/fleet_server.err &
+FLEET_PID=$!
+for _ in $(seq 1 180); do [ -f "$FLEET_PORT_FILE" ] && break; sleep 2; done
+if [ -f "$FLEET_PORT_FILE" ]; then
+  URL="http://127.0.0.1:$(cat "$FLEET_PORT_FILE")"
+  LG="python tools/loadgen.py --url $URL --wait-ready 900 --size 320"
+  # 2. mixed-model closed-loop sweep: THE fleet curve (2:1 minet:u2net,
+  #    gold:free), per-model p50/p95/p99 split in every summary line.
+  for c in 1 8 32; do
+    run "fleet_mixed_c$c" 900 $LG --mode closed --concurrency "$c" \
+        --requests 200 --mix minet:gold=2 --mix u2net:free=1
+  done
+  # 3. router tax: single-model legs THROUGH the router at the same
+  #    grid — compare against the r8 serve_closed_f32_c* legs (same
+  #    model family, no router) to price the extra tier.
+  for c in 1 8 32; do
+    run "fleet_minet_only_c$c" 900 $LG --mode closed --concurrency "$c" \
+        --requests 200 --model minet --tenant gold
+  done
+  # 4. fairness under one-hot overload + tenant budgets: open-loop
+  #    minet flood with a u2net trickle riding the SAME router; the
+  #    summary's per-model breakdown shows whether u2net's p99
+  #    survives, and --server-stats records tenant sheds + the fleet
+  #    accounting block.
+  for rps in 60 120; do
+    run "fleet_onehot_rps$rps" 900 $LG --mode open --rps "$rps" \
+        --duration 20 --slo-ms 500 --server-stats \
+        --mix minet:free=19 --mix u2net:gold=1
+  done
+  kill -TERM "$FLEET_PID" 2>/dev/null
+  wait "$FLEET_PID"
+  echo "{\"step\": \"fleet_server_drain\", \"rc\": $?, \"result\": null}" >> "$R"/results.jsonl
+else
+  echo "fleet server never bound a port — skipping fleet legs" | tee -a "$R"/agenda.log
+  kill -9 "$FLEET_PID" 2>/dev/null
+fi
+
+# Host-side window report (touches no TPU).
+timeout 120 python tools/window_report.py "$R"/results.jsonl \
+    > "$R"/window_report.md 2> "$R"/window_report.err || true
+tail -20 "$R"/window_report.md | tee -a "$R"/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
