@@ -308,6 +308,11 @@ class FleetModelConfig:
     config: Optional[str] = None
     ckpt_dir: Optional[str] = None
     url: Optional[str] = None
+    # N remote replicas under ONE routing key (scale-out + failover):
+    # each URL becomes a RemoteBackend replica "name#i"; the router
+    # spreads requests round-robin and fails over between them
+    # (serve/failover.py).  Exclusive of url/config/ckpt_dir.
+    urls: Tuple[str, ...] = ()
     overrides: Tuple[str, ...] = ()
 
 
@@ -340,9 +345,37 @@ class FleetConfig:
     port: int = 8080
     # Router-side wait on an in-process engine future / remote response.
     request_timeout_s: float = 30.0
-    # Seconds between remote-replica /healthz probes feeding the
-    # aggregated health view (in-process engines are read directly).
+    # Seconds between remote-replica /healthz probes.  Probing runs on
+    # a BACKGROUND thread per remote (serve/fleet.py HealthProber) —
+    # the request path and the /healthz//metrics handlers only ever
+    # read the cached verdict, never pay a connect timeout inline.
     health_poll_s: float = 2.0
+
+    # -- fault tolerance (serve/failover.py; docs/SERVING.md
+    #    "Failure semantics") ------------------------------------------
+    # Total dispatch attempts per request (1 = no retry).  Retries fire
+    # on transport failures (connect refused/reset, timeout) and remote
+    # 5xx, preferring a DIFFERENT healthy replica (failover) before
+    # re-trying the same one.  Every retry is charged against the
+    # request's residual X-SLO-MS budget — the router forwards the
+    # residual, not the original, on every attempt.
+    retry_max_attempts: int = 2
+    # Capped exponential backoff between attempts (base, cap; ms).
+    retry_backoff_ms: float = 10.0
+    retry_backoff_max_ms: float = 250.0
+    # Tail-latency hedge: after this many ms without a first answer,
+    # fire the SAME request at a second healthy replica; first response
+    # wins, the loser is abandoned and counted.  0 = off; -1 = auto
+    # (hedge at the router's observed per-model p95).  Remote replicas
+    # only — an in-process engine shares the device with its siblings,
+    # so a hedge there would just queue behind itself.
+    hedge_ms: float = 0.0
+    # Circuit breaker per replica: this many CONSECUTIVE failures open
+    # it (dispatches route around the replica without paying its
+    # timeout); after breaker_reset_s one half-open probe request is
+    # let through and its outcome decides re-admission vs re-open.
+    breaker_failures: int = 3
+    breaker_reset_s: float = 5.0
 
 
 def fleet_config_from_dict(d: Dict) -> FleetConfig:
@@ -361,6 +394,8 @@ def fleet_config_from_dict(d: Dict) -> FleetConfig:
                 f"unknown fleet model key(s) {sorted(unknown)} in {md!r}")
         if "overrides" in md:
             md["overrides"] = tuple(md["overrides"])
+        if "urls" in md:
+            md["urls"] = tuple(md["urls"])
         models.append(FleetModelConfig(**md))
     tenants = []
     for td in d.pop("tenants", []):
@@ -399,10 +434,19 @@ def validate_fleet_config(fc: FleetConfig) -> FleetConfig:
                 f"fleet model {m.name!r}: url is exclusive of "
                 "config/ckpt_dir/overrides (the remote process owns its "
                 "own config)")
-        if not m.url and not m.ckpt_dir and not m.config:
+        if m.urls and (m.url or m.config or m.ckpt_dir or m.overrides):
+            raise ValueError(
+                f"fleet model {m.name!r}: urls (replica set) is "
+                "exclusive of url/config/ckpt_dir/overrides (each "
+                "remote replica owns its own config)")
+        if m.urls and len(set(m.urls)) != len(m.urls):
+            raise ValueError(
+                f"fleet model {m.name!r}: duplicate replica url in "
+                f"{m.urls}")
+        if not m.url and not m.urls and not m.ckpt_dir and not m.config:
             raise ValueError(
                 f"fleet model {m.name!r} needs one of config / ckpt_dir "
-                "/ url")
+                "/ url / urls")
     tseen = set()
     for t in fc.tenants:
         if not t.name:
@@ -413,6 +457,24 @@ def validate_fleet_config(fc: FleetConfig) -> FleetConfig:
         if t.rate_rps < 0 or t.burst < 0:
             raise ValueError(
                 f"fleet tenant {t.name!r}: rate_rps/burst must be >= 0")
+    if fc.retry_max_attempts < 1:
+        raise ValueError(
+            f"fleet retry_max_attempts must be >= 1 (1 = no retry), "
+            f"got {fc.retry_max_attempts}")
+    if fc.retry_backoff_ms < 0 or fc.retry_backoff_max_ms < 0:
+        raise ValueError(
+            "fleet retry_backoff_ms/retry_backoff_max_ms must be >= 0")
+    if fc.hedge_ms < 0 and fc.hedge_ms != -1:
+        raise ValueError(
+            f"fleet hedge_ms must be >= 0 (0 = off) or exactly -1 "
+            f"(auto: hedge at observed p95), got {fc.hedge_ms}")
+    if fc.breaker_failures < 1:
+        raise ValueError(
+            f"fleet breaker_failures must be >= 1, got "
+            f"{fc.breaker_failures}")
+    if fc.breaker_reset_s <= 0:
+        raise ValueError(
+            f"fleet breaker_reset_s must be > 0, got {fc.breaker_reset_s}")
     if fc.default_tenant not in tseen:
         low = min((t.priority for t in fc.tenants), default=0)
         fc = dataclasses.replace(

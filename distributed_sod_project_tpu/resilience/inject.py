@@ -17,6 +17,26 @@ as a comma-separated list of ``kind@where`` specs:
 - ``truncate_ckpt@S`` — right after the save of step S finalizes,
   truncate its step dir the way a mid-finalize preemption does.
 
+Serve-tier faults (the chaos suite's hand on a REPLICA — addressed by
+the 1-based ordinal of /predict requests the process has seen, or of
+engine dispatch groups for the stall; docs/SERVING.md "Failure
+semantics"):
+
+- ``serve_500@R`` / ``serve_500@RxN`` — answer HTTP 500 to the N
+  (default 1) consecutive /predict requests starting at ordinal R,
+  before the engine sees them (a crashed worker process behind a live
+  listener; the router's 5xx retry path).
+- ``serve_reset@R`` — request R gets its connection reset MID-BODY:
+  response headers claim the full length, half the bytes are written,
+  the socket dies (the torn-response transport-failure mode).
+- ``serve_drip@R:SEC`` — request R's response body drips out over SEC
+  seconds (a sick-but-alive replica; trips deadline-capped transport
+  timeouts without ever refusing a connection).
+- ``serve_stall@G:SEC`` — the engine's G-th dispatch group blocks SEC
+  seconds before the forward (the wedged-device mode; with SEC past
+  ``serve.watchdog_deadline_s`` the watchdog flips /healthz and the
+  router routes around the replica).
+
 Every fault fires ONCE per process: plans are cached per spec string,
 so a supervised retry (resilience/supervisor.py) re-runs clean — the
 transient-fault model the chaos suite asserts recovery under.  All
@@ -28,6 +48,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -35,7 +56,8 @@ from ..utils.logging import get_logger
 
 ENV_VAR = "DSOD_FAULTS"
 
-KINDS = ("nan_grad", "sigterm", "stall", "corrupt_sample", "truncate_ckpt")
+KINDS = ("nan_grad", "sigterm", "stall", "corrupt_sample", "truncate_ckpt",
+         "serve_500", "serve_reset", "serve_drip", "serve_stall")
 
 
 class InjectedSampleCorruption(RuntimeError):
@@ -52,13 +74,25 @@ class FaultPlan:
         self.stall_steps: Dict[int, float] = {}
         self.corrupt_indices: Set[int] = set()
         self.truncate_steps: Set[int] = set()
+        # Serve tier: keyed by the 1-based /predict request ordinal
+        # (dispatch-group ordinal for serve_stall).  The counters are
+        # lock-guarded — HTTP handler threads all consult one plan.
+        self.serve_500: Set[int] = set()
+        self.serve_reset: Set[int] = set()
+        self.serve_drip: Dict[int, float] = {}
+        self.serve_stall: Dict[int, float] = {}
+        self._serve_lock = threading.Lock()
+        self._serve_seq = 0  # /predict requests seen
+        self._dispatch_seq = 0  # engine dispatch groups seen
         self.fired: List[str] = []  # audit log, asserted in tests
         for part in filter(None, (p.strip() for p in spec.split(","))):
             kind, _, where = part.partition("@")
             if kind not in KINDS or not where:
                 raise ValueError(
                     f"bad fault spec {part!r} (kinds: {', '.join(KINDS)}; "
-                    "syntax kind@step, nan_grad@SxN, stall@S:SEC)")
+                    "syntax kind@step, nan_grad@SxN, stall@S:SEC, "
+                    "serve_500@RxN, serve_reset@R, serve_drip@R:SEC, "
+                    "serve_stall@G:SEC)")
             if kind == "nan_grad":
                 s, _, n = where.partition("x")
                 for k in range(int(n or 1)):
@@ -72,6 +106,18 @@ class FaultPlan:
                 self.corrupt_indices.add(int(where))
             elif kind == "truncate_ckpt":
                 self.truncate_steps.add(int(where))
+            elif kind == "serve_500":
+                s, _, n = where.partition("x")
+                for k in range(int(n or 1)):
+                    self.serve_500.add(int(s) + k)
+            elif kind == "serve_reset":
+                self.serve_reset.add(int(where))
+            elif kind == "serve_drip":
+                s, _, sec = where.partition(":")
+                self.serve_drip[int(s)] = float(sec or 1.0)
+            elif kind == "serve_stall":
+                s, _, sec = where.partition(":")
+                self.serve_stall[int(s)] = float(sec or 30.0)
 
     def _fire(self, tag: str) -> None:
         self.fired.append(tag)
@@ -113,6 +159,42 @@ class FaultPlan:
 
         truncate_step_dir(step_dir)
         return True
+
+    # -- serve tier ----------------------------------------------------
+
+    def next_serve_request(self) -> Optional[Tuple[str, float]]:
+        """Consulted by the HTTP front end once per /predict request:
+        advances the request ordinal and returns the scheduled fault
+        action ``(kind, arg)`` — ``("500", 0)``, ``("reset", 0)`` or
+        ``("drip", seconds)`` — or None.  Latches per ordinal."""
+        with self._serve_lock:
+            self._serve_seq += 1
+            seq = self._serve_seq
+            if seq in self.serve_500:
+                self.serve_500.discard(seq)
+                action = ("500", 0.0)
+            elif seq in self.serve_reset:
+                self.serve_reset.discard(seq)
+                action = ("reset", 0.0)
+            elif seq in self.serve_drip:
+                action = ("drip", self.serve_drip.pop(seq))
+            else:
+                return None
+        self._fire(f"serve_{action[0]}@{seq}"
+                   + (f":{action[1]:g}" if action[0] == "drip" else ""))
+        return action
+
+    def maybe_stall_serve_dispatch(self) -> None:
+        """Consulted by the engine once per dispatch group: blocks the
+        scheduled group SEC seconds before its forward (the wedged-
+        device serve mode — the watchdog's beat stops meanwhile)."""
+        with self._serve_lock:
+            self._dispatch_seq += 1
+            sec = self.serve_stall.pop(self._dispatch_seq, None)
+            seq = self._dispatch_seq
+        if sec is not None:
+            self._fire(f"serve_stall@{seq}:{sec:g}")
+            time.sleep(sec)
 
     def check_sample(self, index: int) -> None:
         """Raise for an injected corrupt sample (consulted by

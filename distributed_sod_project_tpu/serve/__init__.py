@@ -16,7 +16,9 @@ from .admission import (
 )
 from .batcher import DynamicBatcher, Request
 from .engine import InferenceEngine, preprocess_image
-from .fleet import EngineBackend, Fleet, FleetDispatcher, RemoteBackend
+from .failover import CircuitBreaker, RetryPolicy, pick_hedge_delay
+from .fleet import (EngineBackend, Fleet, FleetDispatcher, RemoteBackend,
+                    ReplicaSet)
 from .precision import (
     PRECISION_ORDER,
     cast_variables,
@@ -36,6 +38,7 @@ from .server import make_server
 
 __all__ = [
     "AdmissionController",
+    "CircuitBreaker",
     "DeadlineExpired",
     "DynamicBatcher",
     "EngineBackend",
@@ -46,7 +49,9 @@ __all__ = [
     "PRECISION_ORDER",
     "QueueFull",
     "RemoteBackend",
+    "ReplicaSet",
     "Request",
+    "RetryPolicy",
     "RouterStats",
     "TenantAdmission",
     "TokenBucket",
@@ -54,6 +59,7 @@ __all__ = [
     "make_fleet_server",
     "make_precision_forward",
     "make_server",
+    "pick_hedge_delay",
     "preprocess_image",
     "serve_fleet_forever",
     "step_down",

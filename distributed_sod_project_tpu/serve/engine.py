@@ -144,6 +144,7 @@ class InferenceEngine:
         self._est_s: Dict[Tuple[int, str], float] = {}
 
         self._stop = threading.Event()
+        self._fault_plan = None  # armed from DSOD_FAULTS in start()
         self._running = False
         self._inflight_sem = threading.Semaphore(sc.max_inflight)
         self._inflight_lock = threading.Lock()
@@ -185,9 +186,15 @@ class InferenceEngine:
             return self
         from concurrent.futures import ThreadPoolExecutor
 
+        from ..resilience.inject import plan_from_env
+
         sc = self.cfg.serve
         self.warm()
         self._stop.clear()
+        # Deterministic serve-tier chaos (resilience/inject.py): the
+        # plan is cached once here so the dispatch hot path pays a
+        # None check, not an environ read, per group.
+        self._fault_plan = plan_from_env()
         self._fetch_pool = ThreadPoolExecutor(
             max_workers=max(sc.max_inflight, 1),
             thread_name_prefix="serve-fetch")
@@ -442,6 +449,12 @@ class InferenceEngine:
         ``preacquired`` means the caller already holds one inflight
         semaphore slot (the non-blocking path acquires it BEFORE
         popping, so a group is never stranded outside the queue)."""
+        if self._fault_plan is not None:
+            # serve_stall@G:SEC — wedge THIS dispatch before its
+            # forward; the watchdog's beat stops while the stall holds
+            # ready work out of the device (the /healthz flip the
+            # router's health gate reads).
+            self._fault_plan.maybe_stall_serve_dispatch()
         (res, arm), reqs = got
         with self._est_lock:
             est = self._est_s.get((res, arm), 0.0)

@@ -1,0 +1,232 @@
+"""Fault-tolerance policy primitives for the serving fleet
+(docs/SERVING.md "Failure semantics").
+
+TF-Replicator's thesis one more time: the distributed-execution layer
+owns worker failure so user code never sees it.  For serving that
+means the ROUTER owns replica failure — a request that hits a dead,
+wedged, or resetting backend is re-dispatched, hedged, or terminally
+counted, and the client sees exactly one answer either way.  Three
+pure-policy pieces live here, each injectable-clock testable without a
+single socket:
+
+- :class:`CircuitBreaker` — per-replica closed → open → half-open
+  gate.  ``breaker_failures`` consecutive failures open it; an open
+  breaker swallows the dispatch attempt entirely (the wedged remote is
+  routed AROUND, costing a dict read instead of a connect timeout);
+  after ``breaker_reset_s`` ONE half-open probe is allowed through and
+  its outcome decides re-admission vs re-open.
+- :class:`RetryPolicy` — capped exponential backoff charged against
+  the request's residual deadline budget: a retry is only granted
+  while attempts remain AND the residual ``X-SLO-MS`` can still cover
+  the backoff, so retried attempts can never exceed the original
+  budget (asserted with a fake clock in tests/test_failover.py).
+- :func:`pick_hedge_delay` — the tail-latency hedge trigger: a fixed
+  delay, or the router's observed per-model p95 when configured to
+  auto (``hedge_ms = -1``).
+
+``serve/fleet.py`` owns replica GROUPING (which breaker guards which
+backend); ``serve/router.py`` owns the dispatch loop that consults
+these policies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+# Breaker states, in escalation order (also the value of the
+# dsod_fleet_breaker_state gauge: 0 closed, 1 half-open, 2 open).
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    """Per-replica failure gate: closed → open after ``failures``
+    CONSECUTIVE failures → half-open single probe after ``reset_s``.
+
+    Thread-safe; every router worker thread records outcomes into the
+    same breaker.  ``allow()`` is the dispatch gate: True from closed,
+    True exactly ONCE per reset window from open (the transition to
+    half-open — that caller is the probe), False while the probe is in
+    flight.  The probe's ``record_success`` re-admits the replica;
+    its ``record_failure`` re-opens for another full window.
+    """
+
+    def __init__(self, failures: int = 3, reset_s: float = 5.0,
+                 clock=time.monotonic):
+        if failures < 1:
+            raise ValueError(f"breaker failures must be >= 1, got {failures}")
+        if reset_s <= 0:
+            raise ValueError(f"breaker reset_s must be > 0, got {reset_s}")
+        self._failures = int(failures)
+        self._reset_s = float(reset_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._half_open_at = 0.0
+        self._opened_total = 0  # closed/half-open → open transitions
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def opened_total(self) -> int:
+        """How many times this breaker has tripped open (the
+        ``dsod_fleet_breaker_open_total`` counter)."""
+        with self._lock:
+            return self._opened_total
+
+    def would_allow(self) -> bool:
+        """Non-mutating routability read for health surfaces: could a
+        dispatch reach this replica now-or-imminently?  True for
+        closed, for half-open (a probe is assessing it), and for open
+        once the reset window has elapsed (the next pick IS the
+        probe); False only while open-and-cooling.  Never claims the
+        probe slot — /healthz must observe, not consume."""
+        with self._lock:
+            if self._state == OPEN:
+                return self._clock() - self._opened_at >= self._reset_s
+            return True
+
+    def allow(self) -> bool:
+        """May the caller dispatch to this replica right now?  An open
+        breaker answers True exactly once per ``reset_s`` window — that
+        caller IS the half-open probe and must report its outcome."""
+        with self._lock:
+            now = self._clock()
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if now - self._opened_at >= self._reset_s:
+                    self._state = HALF_OPEN
+                    self._half_open_at = now
+                    return True  # the single probe
+                return False
+            # HALF_OPEN: a probe is in flight — unless it evaporated
+            # (caller died before recording an outcome); after a full
+            # reset window with no verdict, grant a replacement probe
+            # so a lost one cannot wedge the breaker half-open forever.
+            if now - self._half_open_at >= self._reset_s:
+                self._half_open_at = now
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = CLOSED
+            self._consecutive = 0
+
+    def release_probe(self) -> None:
+        """Return an UNUSED half-open probe slot: the caller won
+        ``allow()``'s single probe but never dispatched (the request
+        was shed or rejected before reaching the replica).  Reverts to
+        OPEN with the original window intact, so the very next caller
+        can claim the probe instead of waiting out another reset."""
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._state = OPEN
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            tripped = (self._state == HALF_OPEN
+                       or self._consecutive >= self._failures)
+            if tripped and self._state != OPEN:
+                self._opened_total += 1
+            if tripped:
+                self._state = OPEN
+                self._opened_at = self._clock()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self._state,
+                    "consecutive_failures": self._consecutive,
+                    "opened_total": self._opened_total}
+
+
+class RetryPolicy:
+    """Retry/backoff under a deadline budget.
+
+    ``max_attempts`` is the TOTAL dispatch attempts a request may make
+    (1 = no retry).  Backoff between attempt k and k+1 is
+    ``backoff_ms * 2**(k-1)`` capped at ``backoff_max_ms`` — and a
+    retry is granted only while the residual budget can still cover
+    that backoff, so the sum of waits and attempts never exceeds the
+    request's original ``X-SLO-MS``.  ``clock``/``sleep`` are
+    injectable so the budget math is provable with a fake clock.
+    """
+
+    def __init__(self, max_attempts: int = 2, backoff_ms: float = 10.0,
+                 backoff_max_ms: float = 250.0, clock=time.monotonic,
+                 sleep=time.sleep):
+        if max_attempts < 1:
+            raise ValueError(
+                f"retry max_attempts must be >= 1, got {max_attempts}")
+        if backoff_ms < 0 or backoff_max_ms < 0:
+            raise ValueError("retry backoff must be >= 0")
+        self.max_attempts = int(max_attempts)
+        self.backoff_ms = float(backoff_ms)
+        self.backoff_max_ms = float(max(backoff_max_ms, backoff_ms))
+        self._clock = clock
+        self._sleep = sleep
+
+    def backoff_for(self, retry_index: int) -> float:
+        """Backoff in ms before the ``retry_index``-th RETRY (1-based:
+        the wait between attempt k and attempt k+1 has index k)."""
+        if retry_index < 1 or self.backoff_ms <= 0:
+            return 0.0
+        return min(self.backoff_ms * (2.0 ** (retry_index - 1)),
+                   self.backoff_max_ms)
+
+    def residual_ms(self, slo_ms: Optional[float], t0: float) -> Optional[float]:
+        """What is left of the request's original budget, charged
+        against everything since it crossed the router door at ``t0``
+        (router time, prior attempts, backoffs).  None = no deadline."""
+        if slo_ms is None:
+            return None
+        return float(slo_ms) - (self._clock() - t0) * 1000.0
+
+    def may_retry(self, attempts_done: int, slo_ms: Optional[float],
+                  t0: float) -> bool:
+        """Grant attempt ``attempts_done + 1``?  Requires an attempt
+        slot AND enough residual budget to cover the pre-retry backoff
+        with something left to actually dispatch."""
+        if attempts_done >= self.max_attempts:
+            return False
+        residual = self.residual_ms(slo_ms, t0)
+        if residual is None:
+            return True
+        return residual > self.backoff_for(attempts_done)
+
+    def wait_before_retry(self, retry_index: int, slo_ms: Optional[float],
+                          t0: float) -> None:
+        """Sleep the capped-exponential backoff, never past the
+        residual budget (the next residual_ms() check still gates the
+        dispatch itself)."""
+        wait_ms = self.backoff_for(retry_index)
+        residual = self.residual_ms(slo_ms, t0)
+        if residual is not None:
+            wait_ms = min(wait_ms, max(residual, 0.0))
+        if wait_ms > 0:
+            self._sleep(wait_ms / 1000.0)
+
+
+def pick_hedge_delay(hedge_ms: float, p95_ms: Optional[float]
+                     ) -> Optional[float]:
+    """The tail-latency hedge trigger delay in ms, or None when
+    hedging is off for this request.  ``hedge_ms > 0`` is a fixed
+    delay; ``hedge_ms == -1`` hedges at the router's observed p95 for
+    the model (no observations yet → no hedge — never guess a tail);
+    ``0`` disables."""
+    if hedge_ms > 0:
+        return float(hedge_ms)
+    if hedge_ms == -1:
+        return float(p95_ms) if p95_ms and p95_ms > 0 else None
+    return None

@@ -19,31 +19,39 @@ turns the dispatch crank.  Three fleet-level invariants:
   fronting LB drains the whole process only when there is nothing left
   to route to.
 - **One accounting book.**  The PR-5 identity
-  ``served + shed + expired + errors == submitted`` holds fleet-wide:
-  the router door counts submissions, router-terminal rejects
-  (tenant budget/priority sheds, pre-submit 400s, unreachable remotes)
-  add to the engines' own terminal counters, and each engine's local
-  identity is untouched (serve/router.py spells out the ledger).
+  ``served + shed + expired + errors == submitted`` holds fleet-wide —
+  and it is the ROUTER'S book: the door counts submissions and every
+  handler path terminates each one in exactly one router outcome, so
+  the identity survives retries, hedges, and a replica SIGKILLed
+  mid-load (a dead replica's local counters vanish from scrape; a
+  router-owned book cannot lose history it wrote itself).  Per-replica
+  engine books remain exposed as observational detail — each replica's
+  LOCAL identity still holds over the attempts it saw.
 
 Backends are in-process engines (:class:`EngineBackend`) and/or remote
 serve processes (:class:`RemoteBackend` — scale-out across
 processes/hosts; the remote owns its own device loop and the router
-adds tenancy + aggregation on top).
+adds tenancy + aggregation on top).  Backends sharing one routing key
+form a :class:`ReplicaSet`: round-robin spread, health- and circuit-
+breaker-gated pick, failover between members (serve/failover.py;
+docs/SERVING.md "Failure semantics").
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..configs.base import FleetConfig, validate_fleet_config
 from ..utils.logging import get_logger
-from ..utils.observability import (merge_prom_families, parse_prom_text,
-                                   render_prom_families)
+from ..utils.observability import (TailEstimator, merge_prom_families,
+                                   parse_prom_text, render_prom_families)
+from .failover import STATE_GAUGE, CircuitBreaker, RetryPolicy
 from .router import RouterStats, TenantAdmission
 
 
@@ -100,36 +108,81 @@ class EngineBackend:
 class RemoteBackend:
     """A remote serve process proxied by the router.  The remote owns
     its own admission/accounting; the router adds tenancy on top and
-    scrapes /metrics + /stats into the fleet aggregation.  Health is
-    probed at most once per ``health_poll_s`` (cached in between) so
-    /healthz stays cheap."""
+    scrapes /metrics + /stats into the fleet aggregation.
+
+    Health is probed by a BACKGROUND thread every ``health_poll_s``;
+    :meth:`healthy` only ever reads the cached verdict, so the 2 s
+    connect timeout of a dead host can never run inline inside the
+    router's request path or its /healthz//metrics handlers.  The
+    verdict starts optimistic ("not probed yet" but routable) — the
+    per-replica circuit breaker catches a genuinely dead remote on the
+    first dispatch, which is cheaper than holding every request
+    hostage to the first probe's round trip.
+    """
 
     kind = "remote"
 
     # Probe/scrape timeout (healthz, /metrics, /stats) — deliberately
-    # tight: these run inline in the router's /healthz and /metrics
-    # handlers, and a down remote must cost ONE short probe per
-    # ``health_poll_s`` window (the cached verdict gates the scrapes),
-    # not a Prometheus scrape-timeout for the whole fleet.
+    # tight: a dead host must cost the PROBER thread one short dial
+    # per window (and a /metrics scrape of a believed-healthy remote
+    # at most this), never a Prometheus scrape-timeout for the fleet.
     PROBE_TIMEOUT_S = 2.0
 
     def __init__(self, name: str, url: str, *, timeout_s: float = 30.0,
-                 health_poll_s: float = 2.0, clock=time.monotonic):
+                 health_poll_s: float = 2.0):
         self.name = name
         self.url = url.rstrip("/")
         self._timeout = float(timeout_s)
         self._health_poll_s = float(health_poll_s)
-        self._clock = clock
         self._lock = threading.Lock()
-        self._probed_at: Optional[float] = None
-        self._healthy = False
-        self._reason = "not probed yet"
+        self._healthy = True  # optimistic until the first probe lands
+        self._reason = ""
+        self._probed_once = False
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
 
-    def start(self) -> None:  # the remote process has its own lifecycle
-        pass
+    def start(self) -> None:
+        """Start the background prober (the remote PROCESS has its own
+        lifecycle — this only owns the health loop)."""
+        if self._prober is not None:
+            return
+        self._stop.clear()
+        self._prober = threading.Thread(
+            target=self._probe_loop, name=f"fleet-probe-{self.name}",
+            daemon=True)
+        self._prober.start()
 
     def stop(self) -> None:
-        pass
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=self.PROBE_TIMEOUT_S + 5.0)
+            self._prober = None
+
+    def _probe_loop(self) -> None:
+        # First probe immediately (the optimistic verdict should be
+        # corrected within one dial, not one poll window), then every
+        # health_poll_s.
+        while True:
+            self.probe_now()
+            if self._stop.wait(self._health_poll_s):
+                return
+
+    def probe_now(self) -> bool:
+        """One synchronous /healthz dial; updates the cached verdict.
+        Called by the prober thread (and tests); the request path
+        NEVER calls this."""
+        try:
+            with urllib.request.urlopen(self.url + "/healthz",
+                                        timeout=self.PROBE_TIMEOUT_S) as r:
+                ok = r.status == 200
+                reason = "" if ok else f"/healthz {r.status}"
+        except (urllib.error.URLError, OSError,
+                http.client.HTTPException) as e:
+            ok, reason = False, f"unreachable: {e}"
+        with self._lock:
+            self._healthy, self._reason = ok, reason
+            self._probed_once = True
+        return ok
 
     def queue_depth(self) -> Optional[int]:
         return None  # unknown here; the remote's own admission bounds it
@@ -139,36 +192,40 @@ class RemoteBackend:
         return None
 
     def healthy(self) -> bool:
+        """The CACHED verdict — never dials (the prober thread owns
+        the refresh; the router's note_transport_failure fast-paths a
+        flip the moment a dispatch sees the remote dead)."""
         with self._lock:
-            now = self._clock()
-            if (self._probed_at is not None
-                    and now - self._probed_at < self._health_poll_s):
-                return self._healthy
-            self._probed_at = now
-        try:
-            with urllib.request.urlopen(self.url + "/healthz",
-                                        timeout=self.PROBE_TIMEOUT_S) as r:
-                ok = r.status == 200
-                reason = "" if ok else f"/healthz {r.status}"
-        except (urllib.error.URLError, OSError) as e:
-            ok, reason = False, f"unreachable: {e}"
-        with self._lock:
-            self._healthy, self._reason = ok, reason
-            return ok
+            return self._healthy
 
     def health_reason(self) -> str:
         with self._lock:
+            if not self._probed_once and self._healthy:
+                return "not probed yet (optimistic)"
             return self._reason
 
-    def predict_raw(self, body: bytes, headers: Dict[str, str]
+    def note_transport_failure(self, reason: str) -> None:
+        """Router fast path: a dispatch just saw this remote dead —
+        flip the cached verdict NOW instead of waiting out the poll
+        window.  The prober flips it back when /healthz answers."""
+        with self._lock:
+            self._healthy = False
+            self._reason = f"transport failure: {reason}"
+
+    def predict_raw(self, body: bytes, headers: Dict[str, str],
+                    timeout_s: Optional[float] = None
                     ) -> Tuple[int, List[Tuple[str, str]], bytes]:
         """POST /predict on the remote; returns (status, headers,
         body) — HTTP error statuses are answers, not exceptions (only
-        transport failures raise)."""
+        transport failures raise).  ``timeout_s`` caps this attempt
+        below the default (deadline-budgeted retries must not let a
+        stalled remote eat the full router timeout)."""
         req = urllib.request.Request(self.url + "/predict", data=body,
                                      headers=headers, method="POST")
+        timeout = self._timeout if timeout_s is None \
+            else min(self._timeout, float(timeout_s))
         try:
-            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
                 return r.status, list(r.headers.items()), r.read()
         except urllib.error.HTTPError as e:
             return e.code, list(e.headers.items()), e.read()
@@ -201,6 +258,90 @@ class RemoteBackend:
 
     def describe(self) -> Dict:
         return {"kind": self.kind, "url": self.url}
+
+
+class ReplicaSet:
+    """All backends sharing ONE routing key, plus their circuit
+    breakers.  :meth:`pick` is the router's dispatch gate: rotate
+    round-robin over members, skipping anything excluded by the
+    caller, flagged unhealthy by its probe/watchdog, or blocked by an
+    OPEN breaker — so a wedged replica is routed AROUND for the cost
+    of two predicate reads, not its connect timeout.  A single-member
+    set keeps the member's replica id equal to the group name (the
+    PR-7 label/metric surface is unchanged until a second replica
+    actually exists)."""
+
+    def __init__(self, name: str, members: List[Tuple[str, object]],
+                 breaker_factory=CircuitBreaker):
+        if not members:
+            raise ValueError(f"replica set {name!r} needs >= 1 member")
+        self.name = name
+        self.members: List[Tuple[str, object]] = list(members)
+        self.breakers: Dict[str, CircuitBreaker] = {
+            rid: breaker_factory() for rid, _ in members}
+        self.tail = TailEstimator()  # router-observed e2e ms (hedging)
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+    def pick(self, exclude: Optional[Set[str]] = None
+             ) -> Optional[Tuple[str, object, CircuitBreaker]]:
+        """The next dispatchable replica ``(rid, backend, breaker)``,
+        or None when every member is excluded, unhealthy, or breaker-
+        blocked.  Advances the round-robin head past the pick so
+        successive requests spread over the set."""
+        exclude = exclude or set()
+        with self._lock:
+            start = self._rr
+            n = len(self.members)
+            for i in range(n):
+                j = (start + i) % n
+                rid, backend = self.members[j]
+                if rid in exclude:
+                    continue
+                # Health BEFORE the breaker: allow() on an open-but-
+                # rested breaker grants its single half-open probe, and
+                # an unhealthy member must not eat that slot for a
+                # request that will never be dispatched to it.
+                if not backend.healthy():
+                    continue
+                breaker = self.breakers[rid]
+                if not breaker.allow():
+                    continue
+                self._rr = (j + 1) % n
+                return rid, backend, breaker
+            return None
+
+    def healthy(self) -> bool:
+        """Is ANYTHING routable?  A member counts only while its probe
+        verdict is good AND its breaker would admit a dispatch now or
+        imminently — a live listener whose /predict 5xxes keeps its
+        probe verdict but trips the breaker, and /healthz must tell
+        the fronting LB the truth about routability, not liveness."""
+        return any(b.healthy() and self.breakers[rid].would_allow()
+                   for rid, b in self.members)
+
+    def member_state(self, rid: str) -> str:
+        """One member's routability verdict for health surfaces."""
+        backend = dict(self.members)[rid]
+        if not backend.healthy():
+            return backend.health_reason() or "unhealthy"
+        if not self.breakers[rid].would_allow():
+            snap = self.breakers[rid].snapshot()
+            return ("breaker open "
+                    f"({snap['consecutive_failures']} consecutive "
+                    "failures)")
+        return "ok"
+
+    def health_reason(self) -> str:
+        reasons = []
+        for rid, _ in self.members:
+            state = self.member_state(rid)
+            if state != "ok":
+                reasons.append(f"{rid}: {state}")
+        return "; ".join(reasons)
 
 
 class FleetDispatcher:
@@ -266,17 +407,38 @@ class Fleet:
 
     def __init__(self, backends: List, cfg: Optional[FleetConfig] = None,
                  clock=time.monotonic):
-        cfg = cfg or FleetConfig()  # tenants/strictness only — the
+        cfg = cfg or FleetConfig()  # tenants/policy only — the
         #   backends list IS the model set when built programmatically
-        names = [b.name for b in backends]
-        if len(set(names)) != len(names):
-            raise ValueError(f"duplicate backend names in {names}")
         self.cfg = cfg
-        self.backends: Dict[str, object] = {b.name: b for b in backends}
+        self._clock = clock
+        # Backends sharing a name form a ReplicaSet (failover targets);
+        # a lone name keeps its replica id == group name, so the PR-7
+        # single-replica metric/label surface is byte-identical.
+        grouped: Dict[str, List] = {}
+        for b in backends:
+            grouped.setdefault(b.name, []).append(b)
+        self.backends: Dict[str, object] = {}  # flat: replica id → backend
+        self.groups: Dict[str, ReplicaSet] = {}
+
+        def breaker_factory():
+            return CircuitBreaker(cfg.breaker_failures,
+                                  cfg.breaker_reset_s, clock=clock)
+
+        for name, members in grouped.items():
+            ids = ([name] if len(members) == 1
+                   else [f"{name}#{i}" for i in range(len(members))])
+            for rid, b in zip(ids, members):
+                self.backends[rid] = b
+            self.groups[name] = ReplicaSet(
+                name, list(zip(ids, members)),
+                breaker_factory=breaker_factory)
         self.admission = TenantAdmission(
             cfg.tenants, default_tenant=cfg.default_tenant,
             strict=cfg.strict_tenants, clock=clock)
         self.rstats = RouterStats()
+        self.retry_policy = RetryPolicy(
+            cfg.retry_max_attempts, cfg.retry_backoff_ms,
+            cfg.retry_backoff_max_ms, clock=clock)
         self.dispatcher = FleetDispatcher(
             [b.engine for b in backends if b.kind == "engine"])
         self._started = False
@@ -294,6 +456,12 @@ class Fleet:
         fc = validate_fleet_config(fc)
         backends = []
         for m in fc.models:
+            if m.urls:  # remote replica set under one routing key
+                for u in m.urls:
+                    backends.append(RemoteBackend(
+                        m.name, u, timeout_s=fc.request_timeout_s,
+                        health_poll_s=fc.health_poll_s))
+                continue
             if m.url:
                 backends.append(RemoteBackend(
                     m.name, m.url, timeout_s=fc.request_timeout_s,
@@ -331,80 +499,144 @@ class Fleet:
 
     # -- routing -------------------------------------------------------
 
-    def resolve(self, model: Optional[str]):
-        """Routing key → backend; None on an unknown key.  A
-        single-model fleet serves header-less requests (the
+    def resolve(self, model: Optional[str]) -> Optional[ReplicaSet]:
+        """Routing key → :class:`ReplicaSet`; None on an unknown key.
+        A single-model fleet serves header-less requests (the
         single-engine CLI posture behind the router)."""
         if model is None or model == "":
-            if len(self.backends) == 1:
-                return next(iter(self.backends.values()))
+            if len(self.groups) == 1:
+                return next(iter(self.groups.values()))
             return None
-        return self.backends.get(model)
+        return self.groups.get(model)
+
+    def observe_latency(self, model: str, ms: float) -> None:
+        """Router-observed e2e per successful attempt — feeds the
+        per-model tail estimate the auto hedge trigger reads."""
+        g = self.groups.get(model)
+        if g is not None:
+            g.tail.observe(ms)
 
     # -- aggregation ---------------------------------------------------
+
+    def _replica_label(self, group: ReplicaSet, rid: str) -> str:
+        """Metric label set for one replica: ``model=`` only while the
+        group has a single member (the PR-7 surface), ``model=`` +
+        ``replica=`` once real replicas exist."""
+        if len(group) == 1:
+            return 'model="%s"' % group.name
+        return 'model="%s",replica="%s"' % (group.name, rid)
 
     def health(self) -> Tuple[int, Dict]:
         """Degrading health: (200, ok) all healthy; (200, degraded +
         the wedged models) when a SUBSET is wedged — the fleet still
         routes around them; (503, unhealthy) only when NOTHING is left
-        to route to."""
+        to route to.  A MODEL is healthy while ANY of its replicas is
+        (that is what "routes around" means); the per-replica detail
+        rides under ``replicas``."""
         per = {}
-        for name, b in sorted(self.backends.items()):
-            ok = b.healthy()
-            per[name] = "ok" if ok else (b.health_reason() or "unhealthy")
+        replicas = {}
+        for name, g in sorted(self.groups.items()):
+            ok = g.healthy()
+            per[name] = "ok" if ok else (g.health_reason() or "unhealthy")
+            if len(g) > 1:
+                replicas.update({rid: g.member_state(rid)
+                                 for rid, _b in g.members})
         down = [n for n, v in per.items() if v != "ok"]
+        body = {"models": per}
+        if replicas:
+            body["replicas"] = replicas
         if not down:
-            return 200, {"status": "ok", "models": per}
+            return 200, dict(body, status="ok")
         if len(down) < len(per):
-            return 200, {"status": "degraded", "models": per,
-                         "unhealthy": down}
-        return 503, {"status": "unhealthy", "models": per,
-                     "unhealthy": down}
+            return 200, dict(body, status="degraded", unhealthy=down)
+        return 503, dict(body, status="unhealthy", unhealthy=down)
 
     def metrics_text(self) -> str:
         """The aggregated fleet /metrics: router families (tenant=/
-        model= labels), a per-replica up gauge, then every replica's
-        ServeStats families relabeled under its ``model=`` key — each
-        family declared ONCE across all replicas
-        (utils/observability.merge_prom_families)."""
+        model= labels, incl. the retry/hedge/failover counters), a
+        per-replica up gauge, per-replica breaker state/trip families,
+        then every replica's ServeStats families relabeled under its
+        ``model=`` (+ ``replica=``) key — each family declared ONCE
+        across all replicas (utils/observability.merge_prom_families)."""
         groups = [self.rstats.prom_families()]
-        up = []
-        for name, b in sorted(self.backends.items()):
-            up.append('dsod_fleet_replica_up{model="%s"} %d'
-                      % (name, 1 if b.healthy() else 0))
-        groups.append([("dsod_fleet_replica_up", "gauge", up)])
-        for name, b in sorted(self.backends.items()):
-            groups.append(b.prom_families('model="%s"' % name))
+        up, bstate, bopen = [], [], []
+        for name, g in sorted(self.groups.items()):
+            for rid, b in g.members:
+                labels = self._replica_label(g, rid)
+                up.append('dsod_fleet_replica_up{%s} %d'
+                          % (labels, 1 if b.healthy() else 0))
+                snap = g.breakers[rid].snapshot()
+                bstate.append('dsod_fleet_breaker_state{%s} %d'
+                              % (labels, STATE_GAUGE[snap["state"]]))
+                bopen.append('dsod_fleet_breaker_open_total{%s} %d'
+                             % (labels, snap["opened_total"]))
+        groups.append([("dsod_fleet_replica_up", "gauge", up),
+                       ("dsod_fleet_breaker_state", "gauge", bstate),
+                       ("dsod_fleet_breaker_open_total", "counter", bopen)])
+        groups.extend(self._gather_replicas(
+            lambda g, rid, b: b.prom_families(
+                self._replica_label(g, rid))))
         return render_prom_families(merge_prom_families(groups))
 
+    def _gather_replicas(self, fn):
+        """Run ``fn(group, rid, backend)`` for every replica and
+        return the results in sorted-replica order — CONCURRENTLY when
+        remotes are present, because each believed-healthy remote
+        scrape can cost up to PROBE_TIMEOUT_S and N replicas paid
+        serially is exactly the Prometheus scrape-timeout the probe
+        comment forbids."""
+        work = []
+        for name, g in sorted(self.groups.items()):
+            for rid, b in g.members:
+                work.append((g, rid, b))
+        if sum(1 for _g, _r, b in work if b.kind == "remote") <= 1:
+            return [fn(g, rid, b) for g, rid, b in work]
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+                max_workers=min(8, len(work)),
+                thread_name_prefix="fleet-scrape") as ex:
+            futs = [ex.submit(fn, g, rid, b) for g, rid, b in work]
+            return [f.result() for f in futs]
+
     def stats(self) -> Dict:
-        """One JSON object: router book, per-model replica snapshots,
-        and the fleet-wide accounting identity
-        (``served + shed + expired + errors == submitted``, with
-        router terminals folded in — eventually consistent while
-        requests are in flight)."""
+        """One JSON object: router book, per-replica snapshots, breaker
+        states, and the fleet-wide accounting identity
+        (``served + shed + expired + errors == submitted``) computed
+        from the ROUTER'S OWN terminal book — exact through retries,
+        hedges, and replica death (a killed replica cannot scrape away
+        counters the router wrote), eventually consistent while
+        requests are in flight."""
         router = self.rstats.snapshot()
-        models = {name: b.stats_snapshot()
-                  for name, b in sorted(self.backends.items())}
+        snaps = self._gather_replicas(
+            lambda _g, rid, b: (rid, b.stats_snapshot()))
+        models = dict(sorted(snaps))
+        breakers = {}
+        for name, g in sorted(self.groups.items()):
+            for rid in g.breakers:
+                breakers[rid] = g.breakers[rid].snapshot()
 
-        def total(key: str) -> float:
-            return sum(m.get(key, 0) for m in models.values()
-                       if isinstance(m, dict))
-
-        fleet = {
-            "submitted": router["submitted_total"],
-            "served": total("served"),
-            "shed": router["shed_total"] + total("shed"),
-            "expired": total("expired"),
-            "errors": (router["rejected_total"]
-                       + router["transport_errors_total"]
-                       + total("errors")),
-        }
+        # The router terminates every counted submission in exactly one
+        # outcome; classify those outcomes into the four identity
+        # buckets.  Engine-owned semantics map 1:1 (ok→served, …);
+        # router-only terminals (rejected, transport_error,
+        # no_healthy_replica) are errors; "timeout" joins expired (the
+        # client-visible fate — the engine's own late terminal is
+        # per-replica detail, not fleet book).
+        outcomes = router["outcomes"]
+        cls = {"ok": "served", "shed": "shed", "expired": "expired",
+               "timeout": "expired"}
+        book = {"served": 0, "shed": router["shed_total"], "expired": 0,
+                "errors": 0}
+        for outcome, n in outcomes.items():
+            book[cls.get(outcome, "errors")] += n
+        fleet = dict(book, submitted=router["submitted_total"])
         fleet["terminal"] = (fleet["served"] + fleet["shed"]
                              + fleet["expired"] + fleet["errors"])
         fleet["consistent"] = fleet["terminal"] == fleet["submitted"]
-        return {"router": router, "models": models, "fleet": fleet}
+        return {"router": router, "models": models, "fleet": fleet,
+                "breakers": breakers}
 
     def describe_models(self) -> Dict:
-        return {name: b.describe()
-                for name, b in sorted(self.backends.items())}
+        return {rid: b.describe()
+                for rid, b in sorted(self.backends.items())}

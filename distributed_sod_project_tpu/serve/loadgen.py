@@ -22,6 +22,7 @@ summary next to the per-arm breakdown — the fleet's mixed-model curve
 
 from __future__ import annotations
 
+import http.client
 import io
 import json
 import threading
@@ -60,11 +61,16 @@ def _one(base_url: str, body: bytes, slo_ms: Optional[float],
          model: Optional[str] = None, tenant: Optional[str] = None
          ) -> Tuple[str, float, Dict[str, Optional[str]]]:
     """One /predict round-trip → (outcome, latency_ms, info).
-    Outcomes: ok | shed | expired | unhealthy | error.  ``info`` holds
-    the response's X-Precision / X-Model headers (what the server
-    actually SERVED — the ladder may adjust the arm, the router names
-    the model), None values on non-200s.  ``model``/``tenant`` ride as
-    X-Model / X-Tenant request headers (fleet routing + tenancy)."""
+    Outcomes: ok | shed | expired | unhealthy | error | transport —
+    ``transport`` is a connection-level failure (refused, reset,
+    timeout, short body) as opposed to an HTTP-status ``error``; the
+    split is what makes failover/chaos experiments readable (a killed
+    replica produces transports, a sick one produces 5xx errors).
+    ``info`` holds the response's X-Precision / X-Model headers (what
+    the server actually SERVED — the ladder may adjust the arm, the
+    router names the model), None values on non-200s.
+    ``model``/``tenant`` ride as X-Model / X-Tenant request headers
+    (fleet routing + tenancy)."""
     headers = {"Content-Type": "application/x-npy"}
     if slo_ms:
         headers["X-SLO-MS"] = str(slo_ms)
@@ -89,8 +95,10 @@ def _one(base_url: str, body: bytes, slo_ms: Optional[float],
         e.read()
         out = {429: "shed", 504: "expired", 503: "unhealthy"}.get(
             e.code, "error")
-    except (urllib.error.URLError, OSError):
-        out = "error"
+    except (urllib.error.URLError, OSError, http.client.HTTPException):
+        # Connection-level death (incl. IncompleteRead on a mid-body
+        # reset): counted apart from HTTP-status errors.
+        out = "transport"
     return out, (time.monotonic() - t0) * 1000.0, info
 
 
@@ -173,13 +181,20 @@ def run_loadgen(
         assignment = [{"model": model, "tenant": tenant}] * n_total
     lock = threading.Lock()
     outcomes: Dict[str, int] = {"ok": 0, "shed": 0, "expired": 0,
-                                "unhealthy": 0, "error": 0}
+                                "unhealthy": 0, "error": 0,
+                                "transport": 0}
     ok_ms: List[float] = []
     arm_ms: Dict[str, List[float]] = {}
     model_ms: Dict[str, List[float]] = {}
     model_sent: Dict[str, int] = {}
+    # Failures per ASSIGNED model (the response names no model on a
+    # failed request): the per-model half of a failover/chaos read.
+    # "unhealthy" (503 — a dead replica set) belongs here too, or a
+    # killed single-replica model's failures vanish from its row.
+    _MODEL_FAIL_OUTCOMES = ("error", "transport", "unhealthy")
+    model_fail: Dict[Tuple[str, str], int] = {}
 
-    def record(out: str, ms: float, info=None) -> None:
+    def record(out: str, ms: float, info=None, sent_model=None) -> None:
         info = info or {}
         with lock:
             outcomes[out] += 1
@@ -189,6 +204,9 @@ def run_loadgen(
                     arm_ms.setdefault(info["arm"], []).append(ms)
                 if info.get("model"):
                     model_ms.setdefault(info["model"], []).append(ms)
+            elif out in _MODEL_FAIL_OUTCOMES and sent_model:
+                key = (sent_model, out)
+                model_fail[key] = model_fail.get(key, 0) + 1
 
     def fire(i: int) -> None:
         a = assignment[i]
@@ -197,7 +215,8 @@ def run_loadgen(
                 model_sent[a["model"]] = model_sent.get(a["model"], 0) + 1
         record(*_one(base_url, pool[i % len(pool)], slo_ms or None,
                      timeout_s, precision=precision, model=a["model"],
-                     tenant=a.get("tenant") or tenant))
+                     tenant=a.get("tenant") or tenant),
+               sent_model=a["model"])
 
     t_start = time.monotonic()
     if mode == "closed":
@@ -291,6 +310,9 @@ def run_loadgen(
             out["models"][name] = {
                 "sent": model_sent.get(name, 0),
                 "ok": len(ms),
+                "error": model_fail.get((name, "error"), 0),
+                "transport": model_fail.get((name, "transport"), 0),
+                "unhealthy": model_fail.get((name, "unhealthy"), 0),
                 "p50_ms": round(_percentile(ms, 0.50), 2),
                 "p95_ms": round(_percentile(ms, 0.95), 2),
                 "p99_ms": round(_percentile(ms, 0.99), 2),

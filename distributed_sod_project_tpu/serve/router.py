@@ -32,16 +32,31 @@ Fleet-wide accounting identity (the PR-5 invariant, one tier up):
     served + shed + expired + errors == submitted
 
 where ``submitted`` counts every routed-and-tenant-resolved request at
-the router door, ``shed`` adds router sheds (budget/priority) to the
-engines' queue sheds, and ``errors`` adds router-side terminal rejects
-(pre-submit 400s, remote transport failures) to the engines' error
-counts.  Each engine's own identity is preserved exactly — the router
-only ever adds terminals for requests the engines never saw.
+the router door and every other term is computed from the ROUTER'S OWN
+terminal book: each counted submission ends in exactly one
+``inc_shed`` or ``inc_response`` call, whatever mix of retries,
+hedges, failovers, or replica deaths the request lived through.  The
+engines' local books remain exposed per replica (each one's own
+identity holds over the attempts it saw), but the fleet identity no
+longer depends on scraping them — a SIGKILLed replica cannot lose the
+fleet history (serve/fleet.py ``Fleet.stats`` classifies the
+outcomes).
+
+Failure semantics (docs/SERVING.md "Failure semantics"): transport
+failures and remote 5xx re-dispatch to the next healthy replica under
+the per-replica circuit breaker, retries are charged against the
+residual ``X-SLO-MS`` (the router forwards the RESIDUAL budget, never
+the original, on every attempt), and an optional tail-latency hedge
+races a second replica at the observed p95 — first answer wins, the
+loser is abandoned and its breaker outcome still recorded
+(serve/failover.py owns the policy math).
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import queue
 import threading
 import time
 import urllib.error
@@ -51,6 +66,7 @@ from typing import Dict, Optional, Tuple
 
 from ..configs.base import FleetTenantConfig
 from ..utils.logging import get_logger
+from .failover import pick_hedge_delay
 from .server import (JsonHTTPHandler, ThreadingHTTPServer, publish_port,
                      read_predict_body, run_predict)
 
@@ -157,12 +173,16 @@ class TenantAdmission:
 class RouterStats:
     """Router-door accounting under ``tenant=`` / ``model=`` labels.
 
-    Terminal counters (requests the ENGINES never saw — the router's
-    contribution to the fleet identity): ``tenant_shed`` (budget /
-    priority, per reason), ``rejected`` (pre-submit 400s), and
-    ``transport_errors`` (remote replica unreachable).  ``responses``
-    is the observational per-tenant outcome tally (includes
-    engine-owned outcomes; NOT part of the identity — dashboards only).
+    Terminal book: every counted submission ends in exactly ONE
+    ``inc_shed`` or ``inc_response`` call — ``outcomes`` (per-outcome
+    totals) plus ``tenant_shed`` ARE the fleet identity's terms
+    (serve/fleet.py classifies them into served/shed/expired/errors).
+    ``rejected``/``transport_errors`` remain as convenience rollups.
+    Fault-tolerance counters (per model): ``retries`` (re-dispatched
+    attempts beyond the first), ``hedges`` (tail-latency second
+    attempts fired), ``failovers`` (re-dispatches that switched
+    replica) — attempt accounting, deliberately OUTSIDE the identity
+    (one request, however many attempts, is one terminal).
     """
 
     def __init__(self):
@@ -170,7 +190,11 @@ class RouterStats:
         self._tenant_submitted: Dict[str, int] = {}
         self._tenant_shed: Dict[Tuple[str, str], int] = {}
         self._responses: Dict[Tuple[str, str], int] = {}
+        self._outcomes: Dict[str, int] = {}
         self._routed: Dict[str, int] = {}
+        self._retries: Dict[str, int] = {}
+        self._hedges: Dict[str, int] = {}
+        self._failovers: Dict[str, int] = {}
         self._rejected = 0
         self._transport_errors = 0
 
@@ -188,13 +212,26 @@ class RouterStats:
         with self._lock:
             self._routed[model] = self._routed.get(model, 0) + 1
 
+    def inc_retry(self, model: str) -> None:
+        with self._lock:
+            self._retries[model] = self._retries.get(model, 0) + 1
+
+    def inc_hedge(self, model: str) -> None:
+        with self._lock:
+            self._hedges[model] = self._hedges.get(model, 0) + 1
+
+    def inc_failover(self, model: str) -> None:
+        with self._lock:
+            self._failovers[model] = self._failovers.get(model, 0) + 1
+
     def inc_response(self, tenant: str, outcome: str) -> None:
         with self._lock:
             key = (tenant, outcome)
             self._responses[key] = self._responses.get(key, 0) + 1
+            self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
             if outcome == "rejected":
                 self._rejected += 1
-            elif outcome == "transport_error":
+            elif outcome in ("transport_error", "no_healthy_replica"):
                 self._transport_errors += 1
 
     def snapshot(self) -> Dict:
@@ -205,6 +242,13 @@ class RouterStats:
                 "shed_total": shed_total,
                 "rejected_total": self._rejected,
                 "transport_errors_total": self._transport_errors,
+                "retries_total": sum(self._retries.values()),
+                "hedges_total": sum(self._hedges.values()),
+                "failovers_total": sum(self._failovers.values()),
+                "outcomes": dict(sorted(self._outcomes.items())),
+                "retries": dict(sorted(self._retries.items())),
+                "hedges": dict(sorted(self._hedges.items())),
+                "failovers": dict(sorted(self._failovers.items())),
                 "tenants": {
                     t: {
                         "submitted": n,
@@ -245,17 +289,39 @@ class RouterStats:
             fams.append(("dsod_fleet_routed_total", "counter", [
                 'dsod_fleet_routed_total{model="%s"} %d'
                 % (m, n) for m, n in routed]))
+        with self._lock:
+            fault = (("dsod_fleet_retries_total", sorted(
+                self._retries.items())),
+                ("dsod_fleet_hedges_total", sorted(self._hedges.items())),
+                ("dsod_fleet_failovers_total", sorted(
+                    self._failovers.items())))
+        for fam, items in fault:
+            if items:
+                fams.append((fam, "counter", [
+                    '%s{model="%s"} %d' % (fam, m, n) for m, n in items]))
         return fams
 
 
 # -- HTTP front end ----------------------------------------------------
 
 # Request headers the router forwards to a remote replica verbatim.
-_FORWARD_HEADERS = ("Content-Type", "X-SLO-MS", "X-Precision")
+# X-SLO-MS is NOT here: the router forwards the RESIDUAL budget (the
+# original minus elapsed router time and prior attempts) per attempt.
+_FORWARD_HEADERS = ("Content-Type", "X-Precision")
 # Response headers relayed back from a remote replica's answer.
 _RELAY_HEADERS = ("X-Degraded", "X-Precision", "X-Res-Bucket",
                   "X-Batch-Bucket", "X-Queue-MS", "X-Device-MS",
                   "X-E2E-MS")
+# Remote answers that trigger failover/retry: the replica itself is
+# broken (500 crash, 502 its own upstream, 503 stopped/unhealthy).
+# 429/504 are POLICY answers (shed/deadline) — retrying those would
+# amplify the very overload they signal; 4xx are the client's fault.
+_RETRYABLE_STATUSES = frozenset((500, 502, 503))
+# Transport failures: the connection itself broke (refused, reset,
+# timeout, short body).  http.client errors (IncompleteRead on a
+# mid-body reset) are transport too — the injected chaos mode.
+_TRANSPORT_ERRORS = (urllib.error.URLError, OSError,
+                     http.client.HTTPException)
 
 
 class RouterHandler(JsonHTTPHandler):
@@ -285,6 +351,20 @@ class RouterHandler(JsonHTTPHandler):
 
     # -- POST ----------------------------------------------------------
 
+    def _guarded_send(self, *a, **kw) -> None:
+        """Send, tolerating a client that went away mid-response: the
+        request's outcome was decided by the BACKEND's answer (or the
+        router's policy), and a dead client must never turn one
+        terminal into two."""
+        try:
+            self._send(*a, **kw)
+        except Exception:  # noqa: BLE001 — client gone
+            self.close_connection = True
+
+    def _guarded_send_json(self, code: int, obj, headers=()) -> None:
+        self._guarded_send(code, json.dumps(obj).encode(),
+                           "application/json", headers=headers)
+
     def do_POST(self):  # noqa: N802 — http.server API
         split = urllib.parse.urlsplit(self.path)
         if split.path != "/predict":
@@ -294,15 +374,15 @@ class RouterHandler(JsonHTTPHandler):
         query = urllib.parse.parse_qs(split.query)
         model = self.headers.get("X-Model") \
             or (query.get("model") or [None])[0]
-        backend = fleet.resolve(model)
-        if backend is None:
+        group = fleet.resolve(model)
+        if group is None:
             # Unknown model: NO counter anywhere — a typo must not
             # pollute the fleet accounting.  The body was never read;
             # drop the connection so keep-alive can't misparse it.
             self.close_connection = True
             self._send_json(404, {
                 "error": f"unknown model {model!r}",
-                "models": sorted(fleet.backends)})
+                "models": sorted(fleet.groups)})
             return
         tenant = fleet.admission.resolve(self.headers.get("X-Tenant"))
         if tenant is None:  # strict_tenants: unknown tenant, uncounted
@@ -312,24 +392,62 @@ class RouterHandler(JsonHTTPHandler):
                          f"{self.headers.get('X-Tenant')!r}",
                 "tenants": sorted(fleet.admission.tenants)})
             return
-        echo = [("X-Model", backend.name), ("X-Tenant", tenant.name)]
+        echo = [("X-Model", group.name), ("X-Tenant", tenant.name)]
+        # The deadline budget is stamped at the DOOR: every retry,
+        # hedge, and backoff below is charged against it.
+        t_door = fleet._clock()
+        slo_hdr = self.headers.get("X-SLO-MS")
         # From here the request is IN the fleet accounting: every path
-        # below terminates it in exactly one router or engine counter —
-        # including a client that disconnects mid-request (the final
-        # except records the pre-engine abort as a router reject).
+        # below terminates it in exactly one router outcome — including
+        # a client that disconnects mid-request (the final except
+        # records the pre-dispatch abort as a router reject).
         fleet.rstats.inc_submitted(tenant.name)
         terminal = False
+        picked = None
+        dispatched = False
         try:
+            slo_ms = None
+            if slo_hdr is not None:
+                try:
+                    slo_ms = float(slo_hdr)
+                except ValueError:
+                    # Malformed deadline: pre-dispatch reject at the
+                    # ROUTER (the budget math below needs the number).
+                    fleet.rstats.inc_response(tenant.name, "rejected")
+                    terminal = True
+                    self.close_connection = True
+                    self._guarded_send_json(400, {
+                        "error": f"X-SLO-MS {slo_hdr!r} is not a number",
+                        "kind": "rejected"}, headers=echo)
+                    return
+            picked = group.pick()
+            if picked is None:
+                # Every replica is dead, probe-flagged, or breaker-
+                # open: terminal at the router, no timeout paid.
+                fleet.rstats.inc_response(tenant.name,
+                                          "no_healthy_replica")
+                terminal = True
+                self.close_connection = True
+                self._guarded_send_json(503, {
+                    "error": f"model {group.name!r}: no healthy replica",
+                    "kind": "no_healthy_replica"}, headers=echo)
+                return
             # Admission BEFORE the body read: an exhausted budget (or a
             # priority shed) must cost one bucket read, not a 64 MB
             # upload.  The unread body forces dropping the connection.
             reason = fleet.admission.try_admit(
-                tenant, backend.queue_depth(), backend.max_queue)
+                tenant, picked[1].queue_depth(), picked[1].max_queue)
             if reason is not None:
+                # The pick may have claimed the replica's single
+                # half-open probe slot; this request will never
+                # dispatch, so hand the probe back — a shed-destined
+                # request must not stall a recovered replica's
+                # re-admission.
+                picked[2].release_probe()
                 fleet.rstats.inc_shed(tenant.name, reason)
                 terminal = True
                 self.close_connection = True
-                self._send_json(429, {
+                self._guarded_send_json(429, {
                     "error": f"tenant {tenant.name!r} shed at the router "
                              f"({reason})",
                     "kind": {"budget": "tenant_budget",
@@ -338,61 +456,288 @@ class RouterHandler(JsonHTTPHandler):
                 return
             body = read_predict_body(self)
             if body is None:  # bad Content-Length, 400 already sent
+                picked[2].release_probe()  # never dispatched
                 fleet.rstats.inc_response(tenant.name, "rejected")
                 terminal = True
                 return
-            fleet.rstats.inc_routed(backend.name)
-            if backend.kind == "engine":
-                outcome = run_predict(self, backend.engine, body,
-                                      extra_headers=echo)
-            else:
-                outcome = self._proxy(backend, body, echo)
+            fleet.rstats.inc_routed(group.name)
+            dispatched = True
+            outcome = self._dispatch(group, picked, body, echo, slo_ms,
+                                     slo_hdr is not None, t_door)
             fleet.rstats.inc_response(tenant.name, outcome)
             terminal = True
         except Exception:  # noqa: BLE001 — dead client / broken pipe
             get_logger().exception("router: predict handler failed")
             self.close_connection = True
+            if picked is not None and not dispatched:
+                picked[2].release_probe()  # claimed but never used
             if not terminal:
-                # The engine never saw it (run_predict/_proxy never
-                # raise once a backend is engaged): close the book as
-                # a router reject, not a silent leak.
+                # No backend outcome was booked (every dispatch path
+                # books through the single inc_response above): close
+                # the book as a router reject, not a silent leak.
                 fleet.rstats.inc_response(tenant.name, "rejected")
 
-    def _proxy(self, backend, body: bytes, echo) -> str:
-        """Forward /predict to a remote replica and relay its answer
-        (status, selected headers, body) verbatim.  Sends are guarded:
-        the outcome is decided by the REMOTE's answer, and a client
-        that died mid-relay must not turn an already-counted remote
-        terminal into a second router terminal."""
+    # -- failover dispatch ---------------------------------------------
+
+    def _dispatch(self, group, picked, body: bytes, echo,
+                  slo_ms: Optional[float], has_slo: bool,
+                  t_door: float) -> str:
+        """Run one request against a replica set under the fleet's
+        retry/hedge/breaker policy and write exactly one response.
+        Returns the request's single terminal outcome.  NEVER raises
+        (sends are guarded; attempt failures are data)."""
+        fleet = self.fleet
+        policy = fleet.retry_policy
+        rid, backend, breaker = picked
+        attempts = 0
+        excluded = set()
+        last = None
+        while True:
+            residual = policy.residual_ms(slo_ms, t_door)
+            if residual is not None and residual <= 0:
+                # The budget died in router hands (backoffs, prior
+                # attempts): expired, same as an engine would answer.
+                # The current pick never dispatches — hand back any
+                # half-open probe slot it claimed.
+                breaker.release_probe()
+                self._guarded_send_json(504, {
+                    "error": "deadline exhausted at the router after "
+                             f"{attempts} attempt(s)",
+                    "kind": "expired"}, headers=echo)
+                return "expired"
+            if backend.kind == "engine":
+                # An engaged engine writes its own response — its
+                # outcome is terminal (no retry after bytes moved).
+                # Dead/wedged engines were routed around by pick().
+                return self._engine_attempt(group, rid, backend, breaker,
+                                            body, echo, slo_ms, has_slo,
+                                            t_door)
+            result = self._remote_attempt_maybe_hedged(
+                group, rid, backend, breaker, body, slo_ms, t_door,
+                hedge_allowed=(attempts == 0), excluded=excluded)
+            attempts += 1
+            if result[0] == "http" \
+                    and result[1] not in _RETRYABLE_STATUSES:
+                return self._relay_remote(result, echo, group, t_door)
+            last = result
+            # The failing result names the replica that ACTUALLY
+            # produced it — under a hedge that may be the secondary,
+            # not the loop's primary.  Exclude both: the failed member
+            # for obvious reasons, the slow primary because hedging
+            # already judged it past its window.
+            failed_rid = result[2] if result[0] == "transport" \
+                else result[4]
+            excluded.update((rid, failed_rid))
+            if len(excluded) >= len(group):
+                # Every member has failed once this request: allow
+                # re-tries of failed members (their breakers may
+                # already block them — that is the breaker's call).
+                excluded.clear()
+            if not policy.may_retry(attempts, slo_ms, t_door):
+                break
+            policy.wait_before_retry(attempts, slo_ms, t_door)
+            nxt = group.pick(exclude=excluded) or group.pick()
+            if nxt is None and breaker.allow():
+                # Nothing else is routable and the failed member's own
+                # fast health flip blocks a fresh pick — but its
+                # breaker still grants attempts: a single-replica
+                # transient fault (reset mid-body) deserves its retry;
+                # a persistent one trips the breaker and stops here.
+                nxt = (rid, backend, breaker)
+            if nxt is None:
+                break
+            fleet.rstats.inc_retry(group.name)
+            if nxt[0] != failed_rid:
+                fleet.rstats.inc_failover(group.name)
+            rid, backend, breaker = nxt
+        # The loop ended without an answer.  If the DEADLINE is what
+        # ran out (the attempt burned the residual), the honest answer
+        # is expired — the client's budget died, whatever the last
+        # transport symptom was.
+        residual = policy.residual_ms(slo_ms, t_door)
+        if residual is not None and residual <= 0:
+            self._guarded_send_json(504, {
+                "error": "deadline exhausted after "
+                         f"{attempts} attempt(s)",
+                "kind": "expired"}, headers=echo)
+            return "expired"
+        # Otherwise attempts ran out: relay the last failure as the
+        # request's one terminal answer.
+        if last is not None and last[0] == "http":
+            return self._relay_remote(last, echo, group, t_door,
+                                      final_failure=True)
+        reason = last[1] if last is not None else "no replica available"
+        self._guarded_send(502, json.dumps({
+            "error": f"model {group.name!r} unreachable after "
+                     f"{attempts} attempt(s): {reason}",
+            "kind": "replica_unreachable"}).encode(),
+            "application/json", headers=echo)
+        return "transport_error"
+
+    def _engine_attempt(self, group, rid: str, backend, breaker,
+                        body: bytes, echo, slo_ms: Optional[float],
+                        has_slo: bool, t_door: float) -> str:
+        fleet = self.fleet
+        extra = list(echo) + [("X-Replica", rid)]
+        kw = {}
+        if has_slo:
+            # Charge elapsed router time against the engine's deadline
+            # too — the residual-budget contract is backend-agnostic.
+            kw["slo_ms"] = fleet.retry_policy.residual_ms(slo_ms, t_door)
+        outcome = run_predict(self, backend.engine, body,
+                              extra_headers=extra, **kw)
+        if outcome in ("stopped", "error"):
+            breaker.record_failure()
+        else:
+            breaker.record_success()
+        # Engine attempts deliberately do NOT feed the group's hedge
+        # tail estimate: hedging only ever targets remotes, and a
+        # door-to-done engine time (queueing included) would inflate
+        # the per-ATTEMPT p95 the hedge trigger needs.
+        return outcome
+
+    def _one_remote_call(self, group, rid: str, backend, breaker,
+                         body: bytes, slo_ms: Optional[float],
+                         t_door: float):
+        """One POST to one remote replica.  Returns
+        ``("http", status, headers, body, rid)`` for ANY HTTP answer or
+        ``("transport", reason, rid)`` when the connection itself broke
+        — recording the breaker outcome and the health fast-flip, and
+        touching NOTHING client-facing (hedge losers run this exact
+        path and must stay invisible)."""
+        fleet = self.fleet
         headers = {k: v for k in _FORWARD_HEADERS
                    if (v := self.headers.get(k)) is not None}
-
-        def send(*a, **kw):
-            try:
-                self._send(*a, **kw)
-            except Exception:  # noqa: BLE001 — client went away
-                self.close_connection = True
-
+        residual = fleet.retry_policy.residual_ms(slo_ms, t_door)
+        timeout_s = None
+        if residual is not None:
+            # Forward the RESIDUAL budget — the remote must judge its
+            # own expiry against what is actually left, and a retry
+            # paid for its predecessors.  Cap the transport wait just
+            # past it so a stalled remote cannot hold the slot hostage.
+            headers["X-SLO-MS"] = "%.3f" % max(residual, 0.0)
+            timeout_s = max(residual, 0.0) / 1000.0 + 0.5
+        t0 = fleet._clock()
         try:
-            status, rheaders, rbody = backend.predict_raw(body, headers)
-        except (urllib.error.URLError, OSError) as e:
-            get_logger().warning("router: replica %s unreachable: %s",
-                                 backend.name, e)
-            send(502, json.dumps({
-                "error": f"replica {backend.name!r} unreachable: {e}",
-                "kind": "replica_unreachable"}).encode(),
-                "application/json", headers=echo)
-            return "transport_error"
+            status, rheaders, rbody = backend.predict_raw(
+                body, headers, timeout_s=timeout_s)
+        except _TRANSPORT_ERRORS as e:
+            breaker.record_failure()
+            note = getattr(backend, "note_transport_failure", None)
+            if note is not None:
+                note(str(e))
+            get_logger().warning(
+                "router: replica %s transport failure: %s", rid, e)
+            return ("transport", f"{type(e).__name__}: {e}", rid)
+        if status in _RETRYABLE_STATUSES:
+            breaker.record_failure()
+        else:
+            breaker.record_success()
+            if status == 200:
+                # Only SERVED attempts feed the hedge-trigger tail
+                # estimate (per-attempt time, remote attempts only):
+                # fast 429/400 answers under overload would collapse
+                # the p95 and make auto-hedging amplify the very
+                # overload that sheds.
+                fleet.observe_latency(group.name,
+                                      (fleet._clock() - t0) * 1000.0)
+        return ("http", status, rheaders, rbody, rid)
+
+    def _remote_attempt_maybe_hedged(self, group, rid: str, backend,
+                                     breaker, body: bytes,
+                                     slo_ms: Optional[float],
+                                     t_door: float, hedge_allowed: bool,
+                                     excluded) -> tuple:
+        """The FIRST dispatch may race a tail-latency hedge: if the
+        primary hasn't answered within the hedge delay (fixed, or the
+        router's observed per-model p95), fire the same request at a
+        second healthy replica and take whichever answers first.  The
+        loser is abandoned — its thread still records its breaker
+        outcome but can never touch the response or the book."""
+        fleet = self.fleet
+        delay_ms = None
+        if hedge_allowed and len(group) > 1:
+            delay_ms = pick_hedge_delay(fleet.cfg.hedge_ms,
+                                        group.tail.percentile(0.95))
+        if delay_ms is None:
+            return self._one_remote_call(group, rid, backend, breaker,
+                                         body, slo_ms, t_door)
+        residual = fleet.retry_policy.residual_ms(slo_ms, t_door)
+        if residual is not None and residual <= delay_ms:
+            # No budget left to wait out a hedge window — plain call.
+            return self._one_remote_call(group, rid, backend, breaker,
+                                         body, slo_ms, t_door)
+        results: "queue.Queue" = queue.Queue()
+        # Every results.get() below is bounded by this: the attempts'
+        # own transport timeouts are tighter, so the bound only bites
+        # when a worker thread died without enqueueing (in which case
+        # the synthetic transport failure keeps the request terminal).
+        worker_bound_s = fleet.cfg.request_timeout_s + 5.0
+
+        def attempt(rid_, backend_, breaker_):
+            try:
+                results.put(self._one_remote_call(
+                    group, rid_, backend_, breaker_, body, slo_ms,
+                    t_door))
+            except Exception as e:  # noqa: BLE001 — keep the handler fed
+                get_logger().exception(
+                    "router: hedge attempt worker failed")
+                results.put(("transport",
+                             f"attempt worker died: {e}", rid_))
+
+        def bounded_get(fallback_rid):
+            try:
+                return results.get(timeout=worker_bound_s)
+            except queue.Empty:
+                return ("transport", "attempt worker lost", fallback_rid)
+
+        threading.Thread(target=attempt, args=(rid, backend, breaker),
+                         name="router-hedge-primary",
+                         daemon=True).start()
+        try:
+            return results.get(timeout=delay_ms / 1000.0)
+        except queue.Empty:
+            pass
+        hedge_pick = group.pick(exclude=set(excluded) | {rid})
+        if hedge_pick is not None and hedge_pick[1].kind != "remote":
+            # Never hedge onto an in-process engine: it shares the
+            # device with its siblings (a hedge there queues behind
+            # itself) and has no predict_raw.  Hand back any probe
+            # slot the pick claimed.
+            hedge_pick[2].release_probe()
+            hedge_pick = None
+        if hedge_pick is None:  # no second healthy replica: wait it out
+            return bounded_get(rid)
+        fleet.rstats.inc_hedge(group.name)
+        threading.Thread(target=attempt, args=hedge_pick,
+                         name="router-hedge-secondary",
+                         daemon=True).start()
+        first = bounded_get(rid)
+        if first[0] == "http" and first[1] not in _RETRYABLE_STATUSES:
+            return first
+        # The faster answer was a failure; the slower attempt may still
+        # succeed — waiting for it beats surfacing a known failure.
+        second = bounded_get(hedge_pick[0])
+        if second[0] == "http" and second[1] not in _RETRYABLE_STATUSES:
+            return second
+        return first
+
+    def _relay_remote(self, result, echo, group, t_door: float,
+                      final_failure: bool = False) -> str:
+        """Relay a remote's HTTP answer (status, selected headers,
+        body) to the client verbatim and classify the outcome."""
+        _, status, rheaders, rbody, rid = result
         rh = {k: v for k, v in rheaders}
-        relay = echo + [(k, rh[k]) for k in _RELAY_HEADERS if k in rh]
+        relay = echo + [("X-Replica", rid)] \
+            + [(k, rh[k]) for k in _RELAY_HEADERS if k in rh]
         ctype = rh.get("Content-Type", "application/octet-stream")
-        send(status, rbody, ctype, headers=relay)
+        self._guarded_send(status, rbody, ctype, headers=relay)
         if status == 400:
             # The remote's 400 body says who counted it: a pre-submit
-            # "rejected" never entered the remote's accounting (this
-            # router must terminal-count it), an "invalid_input" was
-            # counted by the remote's engine (submitted+errors — no
-            # router terminal, or one request lands in two books).
+            # "rejected" never entered the remote's accounting, an
+            # "invalid_input" was counted by the remote's engine — the
+            # router book classifies both as errors either way, the
+            # split is kept for the per-replica reconciliation.
             try:
                 kind = json.loads(rbody.decode()).get("kind")
             except (ValueError, UnicodeDecodeError):

@@ -31,12 +31,14 @@ import io
 import json
 import signal
 import threading
+import time
 from concurrent.futures import TimeoutError as FutTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from ..resilience.inject import plan_from_env
 from ..utils.logging import get_logger
 from .admission import DeadlineExpired, EngineStopped, QueueFull
 
@@ -60,7 +62,11 @@ def read_predict_body(handler) -> Optional[bytes]:
     return handler.rfile.read(length)
 
 
-def run_predict(handler, engine, body: bytes, extra_headers=()) -> str:
+_SLO_FROM_HEADER = object()  # sentinel: parse X-SLO-MS off the request
+
+
+def run_predict(handler, engine, body: bytes, extra_headers=(),
+                slo_ms=_SLO_FROM_HEADER) -> str:
     """The whole /predict flow against one engine: decode the .npy
     body, validate the precision arm, submit, wait, respond — including
     the full error→status mapping.  Shared by the single-engine
@@ -108,18 +114,24 @@ def run_predict(handler, engine, body: bytes, extra_headers=()) -> str:
                              f"{list(engine.precision_arms)}",
                     "kind": "rejected"})
                 return "rejected"
-        slo = handler.headers.get("X-SLO-MS")
-        if slo is not None:
-            try:
-                slo = float(slo)
-            except ValueError:
-                # Parsed BEFORE submit on purpose: a malformed header
-                # must be a pre-submit reject (the engine never sees
-                # it), not an engine-counted ValueError.
-                send(400, {
-                    "error": f"X-SLO-MS {slo!r} is not a number",
-                    "kind": "rejected"})
-                return "rejected"
+        if slo_ms is not _SLO_FROM_HEADER:
+            # Caller-supplied deadline (the fleet router passes the
+            # request's RESIDUAL budget so elapsed router time and
+            # prior attempts are charged; None = no deadline).
+            slo = slo_ms
+        else:
+            slo = handler.headers.get("X-SLO-MS")
+            if slo is not None:
+                try:
+                    slo = float(slo)
+                except ValueError:
+                    # Parsed BEFORE submit on purpose: a malformed
+                    # header must be a pre-submit reject (the engine
+                    # never sees it), not an engine-counted ValueError.
+                    send(400, {
+                        "error": f"X-SLO-MS {slo!r} is not a number",
+                        "kind": "rejected"})
+                    return "rejected"
         fut = engine.submit(image, slo_ms=slo, precision=precision)
         submitted = True
         pred, meta = fut.result(
@@ -200,11 +212,57 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
         for k, v in headers:
             self.send_header(k, v)
         self.end_headers()
+        drip_s = getattr(self, "_inject_drip_s", 0.0)
+        if drip_s > 0 and len(body) > 1:
+            # Injected slow-drip (resilience/inject.py serve_drip@R:SEC):
+            # the sick-but-alive replica that accepts connections and
+            # then starves the reader.  One response only; then clear.
+            self._inject_drip_s = 0.0
+            n = min(8, len(body))
+            step = (len(body) + n - 1) // n
+            for i in range(0, len(body), step):
+                self.wfile.write(body[i:i + step])
+                self.wfile.flush()
+                time.sleep(drip_s / n)
+            return
         self.wfile.write(body)
 
     def _send_json(self, code: int, obj, headers=()) -> None:
         self._send(code, json.dumps(obj).encode(), "application/json",
                    headers=headers)
+
+    def _apply_injected_fault(self, action) -> bool:
+        """Apply a scheduled serve-tier fault (resilience/inject.py).
+        True = the fault WAS the response (stop handling); False = the
+        request proceeds (drip arms the send path)."""
+        kind, arg = action
+        if kind == "500":
+            # Body unread: drop the connection so keep-alive can't
+            # misparse the image bytes as the next request.
+            self.close_connection = True
+            self._send_json(500, {"error": "injected fault: 5xx burst",
+                                  "kind": "injected_fault"})
+            return True
+        if kind == "reset":
+            # Mid-body reset: claim the full length, write half, kill
+            # the socket — the reader sees a short body / reset.
+            payload = json.dumps(
+                {"error": "injected fault: mid-body reset"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload) * 2))
+            self.end_headers()
+            self.wfile.write(payload[: len(payload) // 2])
+            self.wfile.flush()
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+            return True
+        if kind == "drip":
+            self._inject_drip_s = float(arg)
+        return False
 
 
 class ServeHandler(JsonHTTPHandler):
@@ -238,6 +296,11 @@ class ServeHandler(JsonHTTPHandler):
         if self.path != "/predict":
             self._send_json(404, {"error": f"no route {self.path}"})
             return
+        plan = plan_from_env()
+        if plan is not None:
+            action = plan.next_serve_request()
+            if action is not None and self._apply_injected_fault(action):
+                return
         body = read_predict_body(self)
         if body is None:
             return

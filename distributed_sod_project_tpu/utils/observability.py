@@ -267,6 +267,49 @@ class LatencyHistogram:
         return lines
 
 
+class TailEstimator:
+    """Windowed latency-tail estimate over the last ``window``
+    observations (exact order statistic, not a histogram bound).
+
+    The fleet router keeps one per model to pick the tail-latency
+    HEDGE trigger (serve/failover.py ``pick_hedge_delay``): hedging at
+    an EWMA would hedge half of all traffic, hedging at a fixed guess
+    would miss regime changes — the observed p95 over a sliding window
+    tracks the actual tail cheaply (the window is a few hundred floats
+    and percentile() sorts only on demand, off the hot path)."""
+
+    def __init__(self, window: int = 256):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self._window = int(window)
+        self._buf = []
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def observe(self, ms: float) -> None:
+        with self._lock:
+            if len(self._buf) < self._window:
+                self._buf.append(float(ms))
+            else:  # ring overwrite: O(1), no deque rotation
+                self._buf[self._i] = float(ms)
+                self._i = (self._i + 1) % self._window
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 1] → the windowed order statistic, or None before
+        the first observation (callers must not invent a tail)."""
+        with self._lock:
+            if not self._buf:
+                return None
+            s = sorted(self._buf)
+        i = min(int(p * len(s)), len(s) - 1)
+        return s[i]
+
+
 class ArmStats:
     """Per-precision-arm serving telemetry (one instance per arm,
     created lazily by :meth:`ServeStats.arm`): the latency tail and the

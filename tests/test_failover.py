@@ -1,0 +1,680 @@
+"""Fleet fault-tolerance tests (serve/failover.py + the failover
+dispatch in serve/router.py — docs/SERVING.md "Failure semantics").
+
+Invariants proven here:
+
+- the circuit breaker walks closed → open (after N consecutive
+  failures) → half-open (exactly ONE probe per reset window) →
+  closed/re-open, under a fake clock;
+- retried attempts NEVER exceed the request's original ``X-SLO-MS``
+  budget (fake clock: backoffs + attempts are charged against the
+  residual, and the grant is withdrawn before the budget can go
+  negative);
+- the router fails over: a dead replica's transport error re-dispatches
+  to the next healthy replica within the same request, the residual
+  (not the original) deadline is forwarded on every attempt, and the
+  fleet book still balances with exactly one terminal per request;
+- a replica with an OPEN breaker is routed AROUND without paying its
+  timeout, and recovers through the half-open probe;
+- hedging fires a second attempt after the configured delay, first
+  answer wins, the loser stays invisible (no second terminal);
+- with NOTHING routable the router answers 503 ``no_healthy_replica``
+  as its own terminal — the identity holds when every replica is dead;
+- RemoteBackend health probing runs on a background thread: the
+  request-path ``healthy()`` read never dials, and ``stop()`` joins.
+"""
+
+import http.server
+import io
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+
+from distributed_sod_project_tpu.configs import (DataConfig,
+                                                 ExperimentConfig,
+                                                 FleetConfig,
+                                                 FleetModelConfig,
+                                                 ModelConfig, ServeConfig,
+                                                 fleet_config_from_dict,
+                                                 validate_fleet_config)
+from distributed_sod_project_tpu.serve.engine import InferenceEngine
+from distributed_sod_project_tpu.serve.failover import (CircuitBreaker,
+                                                        RetryPolicy,
+                                                        pick_hedge_delay)
+from distributed_sod_project_tpu.serve.fleet import (EngineBackend, Fleet,
+                                                     RemoteBackend,
+                                                     ReplicaSet)
+from distributed_sod_project_tpu.serve.router import make_fleet_server
+from distributed_sod_project_tpu.utils.observability import TailEstimator
+
+
+# ------------------------------------------------------ policy units
+
+
+def test_circuit_breaker_opens_after_consecutive_failures():
+    clk = [0.0]
+    b = CircuitBreaker(failures=3, reset_s=5.0, clock=lambda: clk[0])
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed" and b.allow()  # 2 < 3: still closed
+    b.record_success()  # consecutive, not cumulative
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open"
+    assert b.opened_total == 1
+    assert not b.allow()  # open: routed around, no timeout paid
+    clk[0] = 4.9
+    assert not b.allow()
+    clk[0] = 5.1  # reset window elapsed: exactly ONE half-open probe
+    assert b.allow()
+    assert b.state == "half_open"
+    assert not b.allow()  # the probe is in flight; nobody else enters
+
+
+def test_circuit_breaker_half_open_probe_decides():
+    clk = [0.0]
+    b = CircuitBreaker(failures=1, reset_s=1.0, clock=lambda: clk[0])
+    b.record_failure()
+    assert b.state == "open" and b.opened_total == 1
+    clk[0] = 1.5
+    assert b.allow()  # the probe
+    b.record_failure()  # probe failed: re-open for a NEW full window
+    assert b.state == "open" and b.opened_total == 2
+    assert not b.allow()
+    clk[0] = 2.0  # only 0.5 s into the new window
+    assert not b.allow()
+    clk[0] = 2.6
+    assert b.allow()
+    b.record_success()  # probe succeeded: re-admitted
+    assert b.state == "closed" and b.allow() and b.allow()
+
+
+def test_circuit_breaker_release_probe_returns_unused_slot():
+    """A caller that wins the half-open probe but never dispatches
+    (request shed/rejected after pick) must hand the slot back, or a
+    recovered replica's re-admission stalls a full reset window."""
+    clk = [0.0]
+    b = CircuitBreaker(failures=1, reset_s=1.0, clock=lambda: clk[0])
+    b.record_failure()
+    clk[0] = 1.5
+    assert b.allow()  # probe claimed...
+    b.release_probe()  # ...but the request was shed before dispatch
+    assert b.allow()  # the very NEXT caller gets the probe
+    b.record_success()
+    assert b.state == "closed"
+    b.release_probe()  # no-op outside half-open
+    assert b.state == "closed"
+
+
+def test_circuit_breaker_rejects_bad_params():
+    with pytest.raises(ValueError, match="failures"):
+        CircuitBreaker(failures=0)
+    with pytest.raises(ValueError, match="reset_s"):
+        CircuitBreaker(reset_s=0)
+
+
+def test_retry_policy_backoff_caps():
+    p = RetryPolicy(max_attempts=5, backoff_ms=10.0, backoff_max_ms=35.0)
+    assert p.backoff_for(1) == 10.0
+    assert p.backoff_for(2) == 20.0
+    assert p.backoff_for(3) == 35.0  # capped, not 40
+    assert p.backoff_for(4) == 35.0
+    assert RetryPolicy(backoff_ms=0.0).backoff_for(1) == 0.0
+
+
+def test_retry_budget_never_exceeds_original_slo_fake_clock():
+    """The acceptance assertion: drive the retry loop with a fake
+    clock where every sleep and every attempt advances time, and show
+    the policy stops granting attempts BEFORE the original budget is
+    exceeded — whatever the attempt cost."""
+    clk = [0.0]
+
+    def clock():
+        return clk[0]
+
+    def sleep(s):
+        clk[0] += s
+
+    slo_ms = 100.0
+    p = RetryPolicy(max_attempts=10, backoff_ms=8.0, backoff_max_ms=64.0,
+                    clock=clock, sleep=sleep)
+    t0 = clock()
+    attempts = 0
+    attempt_cost_ms = 23.0  # each dispatch burns this much budget
+    while p.may_retry(attempts, slo_ms, t0):
+        residual_before = p.residual_ms(slo_ms, t0)
+        assert residual_before > 0  # a granted attempt has budget left
+        if attempts:  # backoff precedes every RETRY, charged too
+            p.wait_before_retry(attempts, slo_ms, t0)
+        clk[0] += attempt_cost_ms / 1000.0  # the attempt itself
+        attempts += 1
+    # The loop stopped with the ORIGINAL budget never overdrawn by a
+    # grant: at every grant residual was positive, and no further
+    # attempt is granted now that it isn't.
+    assert attempts >= 2  # the budget did allow retries
+    assert not p.may_retry(attempts, slo_ms, t0)
+    # Elapsed ≤ budget + one attempt's in-flight cost (the last
+    # attempt may complete past the line; it can never START past it).
+    assert (clock() - t0) * 1000.0 <= slo_ms + attempt_cost_ms
+
+
+def test_retry_policy_no_deadline_grants_up_to_max_attempts():
+    p = RetryPolicy(max_attempts=3, backoff_ms=1.0)
+    assert p.may_retry(1, None, 0.0)
+    assert p.may_retry(2, None, 0.0)
+    assert not p.may_retry(3, None, 0.0)
+
+
+def test_pick_hedge_delay_modes():
+    assert pick_hedge_delay(0.0, 50.0) is None  # off
+    assert pick_hedge_delay(25.0, 50.0) == 25.0  # fixed
+    assert pick_hedge_delay(-1, 50.0) == 50.0  # auto: observed p95
+    assert pick_hedge_delay(-1, None) is None  # auto with no data: off
+
+
+def test_tail_estimator_windowed_percentile():
+    t = TailEstimator(window=8)
+    assert t.percentile(0.95) is None  # no data: never invent a tail
+    for ms in (10, 20, 30, 40):
+        t.observe(ms)
+    assert t.percentile(0.0) == 10
+    assert t.percentile(0.95) == 40
+    for ms in range(100, 108):  # roll the window completely over
+        t.observe(ms)
+    assert t.percentile(0.0) >= 100
+
+
+# ------------------------------------------------- config validation
+
+
+@pytest.mark.parametrize("kw,msg", [
+    ({"retry_max_attempts": 0}, "retry_max_attempts"),
+    ({"retry_backoff_ms": -1.0}, "retry_backoff"),
+    ({"hedge_ms": -2.0}, "hedge_ms"),
+    ({"breaker_failures": 0}, "breaker_failures"),
+    ({"breaker_reset_s": 0.0}, "breaker_reset_s"),
+])
+def test_fleet_config_rejects_bad_fault_tolerance_knobs(kw, msg):
+    fc = FleetConfig(models=(FleetModelConfig(name="m", config="c"),), **kw)
+    with pytest.raises(ValueError, match=msg):
+        validate_fleet_config(fc)
+
+
+def test_fleet_config_urls_replica_set_parses_and_validates():
+    fc = fleet_config_from_dict({
+        "models": [{"name": "m", "urls": ["http://h:1", "http://h:2"]}],
+        "retry_max_attempts": 3, "hedge_ms": -1,
+    })
+    assert fc.models[0].urls == ("http://h:1", "http://h:2")
+    with pytest.raises(ValueError, match="exclusive"):
+        fleet_config_from_dict({"models": [
+            {"name": "m", "urls": ["http://h:1"], "config": "c"}]})
+    with pytest.raises(ValueError, match="duplicate replica url"):
+        fleet_config_from_dict({"models": [
+            {"name": "m", "urls": ["http://h:1", "http://h:1"]}]})
+
+
+# ------------------------------------------------------- replica sets
+
+
+class FakeRemote:
+    """Scriptable remote backend: behaviors is a list consumed one per
+    predict_raw call; the last entry repeats.  Entries: "ok",
+    "refuse" (ConnectionRefusedError), "http:<code>", or a float
+    (sleep seconds, then ok)."""
+
+    kind = "remote"
+
+    def __init__(self, name, behaviors=("ok",), healthy=True):
+        self.name = name
+        self.behaviors = list(behaviors)
+        self._healthy = healthy
+        self._reason = "" if healthy else "scripted unhealthy"
+        self.calls = []  # (headers) per predict_raw
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def queue_depth(self):
+        return None
+
+    @property
+    def max_queue(self):
+        return None
+
+    def healthy(self):
+        return self._healthy
+
+    def health_reason(self):
+        return self._reason
+
+    def note_transport_failure(self, reason):
+        self._reason = reason
+
+    def prom_families(self, labels):
+        return []
+
+    def stats_snapshot(self):
+        return {}
+
+    def describe(self):
+        return {"kind": self.kind, "fake": True}
+
+    def _next(self):
+        with self._lock:
+            i = min(self._i, len(self.behaviors) - 1)
+            self._i += 1
+            return self.behaviors[i]
+
+    def predict_raw(self, body, headers, timeout_s=None):
+        self.calls.append(dict(headers))
+        b = self._next()
+        if isinstance(b, float):
+            time.sleep(b)
+            b = "ok"
+        if b == "refuse":
+            raise ConnectionRefusedError("scripted refuse")
+        if b.startswith("http:"):
+            code = int(b.split(":", 1)[1])
+            return code, [("Content-Type", "application/json")], \
+                json.dumps({"error": "scripted", "kind": "x"}).encode()
+        buf = io.BytesIO()
+        np.save(buf, np.zeros((4, 4), np.float32))
+        return 200, [("Content-Type", "application/x-npy"),
+                     ("X-E2E-MS", "1.0")], buf.getvalue()
+
+
+def test_replica_set_pick_skips_unhealthy_and_open_breakers():
+    a, b, c = (FakeRemote("m"), FakeRemote("m", healthy=False),
+               FakeRemote("m"))
+    rs = ReplicaSet("m", [("m#0", a), ("m#1", b), ("m#2", c)])
+    # Rotation spreads over the HEALTHY members only.
+    picks = [rs.pick()[0] for _ in range(4)]
+    assert "m#1" not in picks
+    assert set(picks) == {"m#0", "m#2"}
+    # An open breaker removes a member without touching its health.
+    for _ in range(3):
+        rs.breakers["m#0"].record_failure()
+    assert rs.breakers["m#0"].state == "open"
+    assert all(rs.pick()[0] == "m#2" for _ in range(3))
+    # Exclusion on top: nothing left → None.
+    assert rs.pick(exclude={"m#2"}) is None
+    assert rs.healthy()
+    assert "m#1" in rs.health_reason()
+
+
+def test_replica_set_health_reflects_breaker_routability():
+    """A live listener whose /predict 5xxes keeps its probe verdict
+    but trips the breaker — /healthz must report ROUTABILITY to the
+    fronting LB, not liveness: all-breakers-open == unhealthy until a
+    reset window makes a probe imminent again."""
+    clk = [0.0]
+    a = FakeRemote("m")
+    rs = ReplicaSet(
+        "m", [("m", a)],
+        breaker_factory=lambda: CircuitBreaker(
+            failures=1, reset_s=5.0, clock=lambda: clk[0]))
+    assert rs.healthy()
+    rs.breakers["m"].record_failure()  # opens (failures=1)
+    assert a.healthy()  # the probe verdict is still good...
+    assert not rs.healthy()  # ...but nothing is routable
+    assert "breaker open" in rs.health_reason()
+    clk[0] = 6.0  # reset window elapsed: the next pick IS the probe
+    assert rs.healthy()
+    assert rs.breakers["m"].state == "open"  # observing consumed nothing
+
+
+# ------------------------------------------------- router failover e2e
+
+
+def _mk_remote_fleet(replicas, **cfg_kw):
+    cfg_kw.setdefault("retry_max_attempts", 3)
+    cfg_kw.setdefault("retry_backoff_ms", 1.0)
+    cfg_kw.setdefault("retry_backoff_max_ms", 5.0)
+    fleet = Fleet(replicas, FleetConfig(**cfg_kw))
+    srv = make_fleet_server(fleet, "127.0.0.1", 0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return fleet, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _post_npy(url, slo_ms=None, timeout=30.0, close_early_s=None):
+    buf = io.BytesIO()
+    np.save(buf, np.zeros((8, 8, 3), np.uint8))
+    headers = {"Content-Type": "application/x-npy"}
+    if slo_ms is not None:
+        headers["X-SLO-MS"] = str(slo_ms)
+    req = urllib.request.Request(url + "/predict", data=buf.getvalue(),
+                                 headers=headers, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _stats(fleet):
+    return fleet.stats()
+
+
+def test_failover_rides_transport_failure_to_next_replica():
+    r0 = FakeRemote("m", behaviors=["refuse"])
+    r1 = FakeRemote("m", behaviors=["ok"])
+    fleet, srv, url = _mk_remote_fleet([r0, r1])
+    try:
+        status, headers, _ = _post_npy(url)
+        assert status == 200
+        assert headers["X-Replica"] == "m#1"  # the failover target
+        s = _stats(fleet)
+        assert s["router"]["retries_total"] == 1
+        assert s["router"]["failovers_total"] == 1
+        assert s["fleet"]["submitted"] == 1
+        assert s["fleet"]["served"] == 1
+        assert s["fleet"]["consistent"] is True
+        # The dead replica's breaker recorded the failure and its
+        # cached health verdict was fast-flipped by the router.
+        assert s["breakers"]["m#0"]["consecutive_failures"] == 1
+        assert "refuse" in r0.health_reason()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_failover_rides_5xx_to_next_replica_and_breaker_opens():
+    r0 = FakeRemote("m", behaviors=["http:500"])
+    r1 = FakeRemote("m", behaviors=["ok"])
+    fleet, srv, url = _mk_remote_fleet([r0, r1], breaker_failures=2,
+                                       breaker_reset_s=60.0)
+    try:
+        for i in range(2):  # two requests, each first hits r0 (rr)
+            status, headers, _ = _post_npy(url)
+            assert status == 200 and headers["X-Replica"] == "m#1"
+        s = _stats(fleet)
+        assert s["breakers"]["m#0"]["state"] == "open"
+        assert s["breakers"]["m#0"]["opened_total"] == 1
+        calls_before = len(r0.calls)
+        # Breaker open: r0 is routed AROUND — no attempt reaches it.
+        status, headers, _ = _post_npy(url)
+        assert status == 200 and headers["X-Replica"] == "m#1"
+        assert len(r0.calls) == calls_before
+        s = _stats(fleet)
+        assert s["fleet"]["consistent"] is True
+        assert s["fleet"]["served"] == 3
+        prom = fleet.metrics_text()
+        assert ('dsod_fleet_breaker_open_total'
+                '{model="m",replica="m#0"} 1') in prom
+        assert 'dsod_fleet_retries_total{model="m"} 2' in prom
+        assert ('dsod_fleet_failovers_total{model="m"} 2') in prom
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_breaker_half_open_readmits_recovered_replica():
+    r0 = FakeRemote("m", behaviors=["http:503", "ok"])  # fails once
+    r1 = FakeRemote("m", behaviors=["ok"])
+    fleet, srv, url = _mk_remote_fleet([r0, r1], breaker_failures=1,
+                                       breaker_reset_s=0.2)
+    try:
+        status, headers, _ = _post_npy(url)
+        assert status == 200 and headers["X-Replica"] == "m#1"
+        assert fleet.groups["m"].breakers["m#0"].state == "open"
+        time.sleep(0.25)  # reset window: next pick is the probe
+        # r0 is at the rotation head again; the half-open probe rides a
+        # real request and its success re-admits the replica.
+        status, headers, _ = _post_npy(url)
+        assert status == 200 and headers["X-Replica"] == "m#0"
+        assert fleet.groups["m"].breakers["m#0"].state == "closed"
+        s = _stats(fleet)
+        assert s["fleet"]["consistent"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_residual_slo_budget_forwarded_not_original():
+    r0 = FakeRemote("m", behaviors=[0.05])  # 50 ms before answering
+    r1 = FakeRemote("m", behaviors=["ok"])
+    # Force r0 to fail AFTER its sleep so the retry carries the charge.
+    r0.behaviors = ["refuse_after_sleep"]
+
+    def slow_refuse(body, headers, timeout_s=None):
+        r0.calls.append(dict(headers))
+        time.sleep(0.05)
+        raise ConnectionResetError("scripted reset after 50ms")
+
+    r0.predict_raw = slow_refuse
+    fleet, srv, url = _mk_remote_fleet([r0, r1])
+    try:
+        status, headers, _ = _post_npy(url, slo_ms=5000)
+        assert status == 200 and headers["X-Replica"] == "m#1"
+        first = float(r0.calls[0]["X-SLO-MS"])
+        second = float(r1.calls[0]["X-SLO-MS"])
+        assert first <= 5000.0
+        # The retry was charged for the first attempt's 50 ms (plus
+        # backoff): the REMAINDER, not the original, was forwarded.
+        assert second <= first - 45.0
+        assert second > 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_exhausted_budget_is_expired_not_retried():
+    r0 = FakeRemote("m")
+
+    def slow_reset(body, headers, timeout_s=None):
+        r0.calls.append(dict(headers))
+        time.sleep(0.08)
+        raise ConnectionResetError("scripted")
+
+    r0.predict_raw = slow_reset
+    r1 = FakeRemote("m", behaviors=["ok"])
+    fleet, srv, url = _mk_remote_fleet([r0, r1])
+    try:
+        # 60 ms budget dies inside attempt 1: the router must answer
+        # 504 expired WITHOUT dispatching the retry.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_npy(url, slo_ms=60)
+        assert exc.value.code == 504
+        assert json.loads(exc.value.read().decode())["kind"] == "expired"
+        assert len(r1.calls) == 0
+        s = _stats(fleet)
+        assert s["fleet"]["expired"] == 1
+        assert s["fleet"]["consistent"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_all_replicas_down_503_is_a_router_terminal():
+    r0 = FakeRemote("m", healthy=False)
+    r1 = FakeRemote("m", healthy=False)
+    fleet, srv, url = _mk_remote_fleet([r0, r1])
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_npy(url)
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read().decode())
+        assert body["kind"] == "no_healthy_replica"
+        assert not r0.calls and not r1.calls  # nothing was dialed
+        s = _stats(fleet)
+        assert s["fleet"]["submitted"] == 1
+        assert s["fleet"]["errors"] == 1
+        assert s["fleet"]["consistent"] is True
+        # /healthz names the model as down (nothing left to route to).
+        code, health = fleet.health()
+        assert code == 503 and health["unhealthy"] == ["m"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_hedge_fires_and_first_answer_wins():
+    r0 = FakeRemote("m", behaviors=[0.4])  # slow primary
+    r1 = FakeRemote("m", behaviors=["ok"])  # fast hedge target
+    fleet, srv, url = _mk_remote_fleet([r0, r1], hedge_ms=40.0)
+    try:
+        t0 = time.monotonic()
+        status, headers, _ = _post_npy(url)
+        dt = time.monotonic() - t0
+        assert status == 200
+        assert headers["X-Replica"] == "m#1"  # the hedge won
+        assert dt < 0.35  # did not wait out the slow primary
+        s = _stats(fleet)
+        assert s["router"]["hedges_total"] == 1
+        assert s["router"]["retries_total"] == 0  # a hedge, not a retry
+        assert s["fleet"]["submitted"] == 1
+        assert s["fleet"]["served"] == 1
+        assert s["fleet"]["consistent"] is True
+        # The loser eventually completes without a second terminal.
+        time.sleep(0.45)
+        s = _stats(fleet)
+        assert s["fleet"]["terminal"] == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_engine_replica_set_routes_around_wedged_member():
+    class TinySOD(nn.Module):
+        @nn.compact
+        def __call__(self, image, depth=None, train=False):
+            return (nn.Conv(1, (1, 1), name="head")(image),)
+
+    model = TinySOD()
+    probe = np.zeros((1, 16, 16, 3), np.float32)
+    variables = model.init(jax.random.key(0), probe, None, train=False)
+
+    def mk_engine():
+        cfg = ExperimentConfig(
+            data=DataConfig(image_size=(16, 16)),
+            model=ModelConfig(name="tiny"),
+            serve=ServeConfig(batch_buckets=(1, 2),
+                              resolution_buckets=(16,), max_wait_ms=5.0))
+        return InferenceEngine(cfg, model, variables)
+
+    ea, eb = mk_engine(), mk_engine()
+    fleet = Fleet([EngineBackend("m", ea), EngineBackend("m", eb)],
+                  FleetConfig())
+    fleet.start()
+    srv = make_fleet_server(fleet, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        # Wedge member 0: every request lands on m#1, health degrades
+        # per-REPLICA while the model stays routable.
+        ea.stats.set_health(False, "wedged by test")
+        for _ in range(3):
+            status, headers, _ = _post_npy(url)
+            assert status == 200
+            assert headers["X-Replica"] == "m#1"
+        code, health = fleet.health()
+        assert code == 200 and health["status"] == "ok"
+        assert health["replicas"]["m#0"] != "ok"
+        s = _stats(fleet)
+        assert s["fleet"]["served"] == 3
+        assert s["fleet"]["consistent"] is True
+        # Both wedged: now the model is down and the fleet 503s.
+        eb.stats.set_health(False, "wedged by test")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_npy(url)
+        assert exc.value.code == 503
+        exc.value.read()
+        code, health = fleet.health()
+        assert code == 503
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+# ---------------------------------------------- background health probe
+
+
+class _HealthzServer(http.server.ThreadingHTTPServer):
+    pass
+
+
+def _tiny_healthz_server():
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b'{"status": "ok"}'
+            self.send_response(200 if self.path == "/healthz" else 404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = _HealthzServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_remote_health_probe_runs_off_the_request_path():
+    rb = RemoteBackend("m", f"http://127.0.0.1:{_free_port()}",
+                       health_poll_s=0.05)
+    assert rb.healthy()  # optimistic before the first probe
+    rb.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while rb.healthy() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not rb.healthy(), "prober never flipped a dead remote"
+        assert "unreachable" in rb.health_reason()
+        # The request-path read is a cached verdict: instant even
+        # though the remote is a dead host (a dial would cost ~2 s).
+        t0 = time.monotonic()
+        for _ in range(100):
+            rb.healthy()
+        assert time.monotonic() - t0 < 0.5
+    finally:
+        rb.stop()
+    assert rb._prober is None  # joined cleanly
+
+
+def test_remote_health_probe_recovers_when_remote_returns():
+    srv, url = _tiny_healthz_server()
+    rb = RemoteBackend("m", url, health_poll_s=0.05)
+    rb.note_transport_failure("simulated dispatch failure")
+    assert not rb.healthy()  # fast-flip wins over optimism
+    rb.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not rb.healthy() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rb.healthy(), "prober never re-admitted a live remote"
+    finally:
+        rb.stop()
+        srv.shutdown()
+        srv.server_close()

@@ -509,6 +509,100 @@ def test_run_predict_never_raises_when_client_is_gone(two_tiny):
         eng.stop()
 
 
+def test_hedged_winner_after_client_disconnect_books_one_terminal():
+    """PR-8 regression beside the client-abort test above: when a
+    hedge is in flight and the CLIENT disconnects before the winner
+    lands, the winner's relay fails silently, the loser is abandoned,
+    and the request still terminates in EXACTLY one router outcome —
+    no loser cancellation + client-abort double count."""
+    import socket as socket_mod
+
+    from distributed_sod_project_tpu.serve.router import make_fleet_server
+
+    class SlowRemote:
+        kind = "remote"
+
+        def __init__(self, name, delay_s):
+            self.name = name
+            self.delay_s = delay_s
+
+        def start(self):
+            pass
+
+        def stop(self):
+            pass
+
+        def queue_depth(self):
+            return None
+
+        @property
+        def max_queue(self):
+            return None
+
+        def healthy(self):
+            return True
+
+        def health_reason(self):
+            return ""
+
+        def prom_families(self, labels):
+            return []
+
+        def stats_snapshot(self):
+            return {}
+
+        def describe(self):
+            return {"kind": self.kind}
+
+        def predict_raw(self, body, headers, timeout_s=None):
+            time.sleep(self.delay_s)
+            buf = io.BytesIO()
+            np.save(buf, np.zeros((4, 4), np.float32))
+            return 200, [("Content-Type", "application/x-npy")], \
+                buf.getvalue()
+
+    fleet = Fleet([SlowRemote("m", 0.35), SlowRemote("m", 0.3)],
+                  FleetConfig(hedge_ms=50.0))
+    srv = make_fleet_server(fleet, "127.0.0.1", 0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        buf = io.BytesIO()
+        np.save(buf, _img(0, 16, 16))
+        payload = buf.getvalue()
+        req = (b"POST /predict HTTP/1.1\r\n"
+               b"Host: 127.0.0.1\r\n"
+               b"X-Model: m\r\n"
+               b"Content-Type: application/x-npy\r\n"
+               b"Content-Length: " + str(len(payload)).encode()
+               + b"\r\n\r\n" + payload)
+        s = socket_mod.create_connection(
+            ("127.0.0.1", srv.server_address[1]), timeout=10)
+        s.sendall(req)
+        time.sleep(0.12)  # past the hedge trigger, before any answer
+        s.close()  # the client is gone; winner AND loser still land
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = fleet.stats()
+            if st["fleet"]["terminal"] >= 1:
+                break
+            time.sleep(0.02)
+        st = fleet.stats()
+        assert st["router"]["hedges_total"] == 1
+        assert st["fleet"]["submitted"] == 1
+        assert st["fleet"]["terminal"] == 1  # exactly one, not two
+        assert st["fleet"]["consistent"] is True
+        time.sleep(0.5)  # the loser finishes well after the winner
+        st = fleet.stats()
+        assert st["fleet"]["terminal"] == 1, \
+            "the hedge loser added a second terminal after the abort"
+        assert st["fleet"]["consistent"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
 def test_strict_tenants_403_uncounted(two_tiny):
     fleet = _mk_fleet(two_tiny, FleetConfig(
         tenants=(FleetTenantConfig(name="gold", priority=0),),

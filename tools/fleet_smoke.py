@@ -1,21 +1,33 @@
 #!/usr/bin/env python
-"""Fleet smoke for tools/t1.sh: start tools/serve.py --fleet-config as
-a REAL subprocess serving TWO models on an ephemeral port, push a
-mixed-model loadgen round through the router (weighted X-Model /
-X-Tenant traffic), assert the per-model breakdown and the fleet-wide
-accounting identity, then SIGTERM and assert a CLEAN drain (exit 0).
-Prints one JSON line; exits non-zero on any broken link.
+"""Fleet smoke for tools/t1.sh: start a REAL two-model fleet — minet
+as an in-process engine inside the router process, u2net as a REAL
+remote replica subprocess proxied by URL — push a mixed-model loadgen
+round through the router (weighted X-Model / X-Tenant traffic), assert
+the per-model breakdown and the fleet-wide accounting identity, then
+SIGKILL the remote replica mid-fleet and assert the failure semantics:
+/healthz flips to ``degraded`` NAMING the dead model, the surviving
+model keeps serving, a request to the dead model terminates in a
+counted error (no hang, no lost response), and the book still
+balances.  Finally SIGTERM the fleet and assert a CLEAN drain (exit
+0).  Prints one JSON line; exits non-zero on any broken link.
 
-Budget contract: the internal deadlines (180 s bind incl. two models'
-AOT warms + 60 s healthz + 90 s requests + 60 s drain) sum under the
-t1.sh wrapper's 480 s, so a stall always reports its OWN JSON
+Budget contract: the internal deadlines — 150 s replica bind + 150 s
+fleet bind (each ONE model's AOT warm) + 60 s healthz + the request
+legs at their WORST-CASE per-request timeouts (mixed round: 6 req /
+concurrency 2 x 45 s = 135 s; kill leg: 20 s degraded poll + 2 x 45 s
+survivor + 30 s dead-model) + 60 s drain — sum to ~650 s, under the
+t1.sh wrapper's 720 s, so a stall always reports its OWN JSON
 diagnostic instead of dying to the outer timeout mid-wait.
 
 Deliberately out-of-process (the serve_smoke posture, one tier up):
-the smoke must exercise the same process lifecycle a fleet deployment
-does — fleet-config parsing, two engines warming behind one
-interleaved dispatcher, signal handling, drain, port-file.
-tests/test_fleet.py covers the in-process side.
+the smoke must exercise the same process lifecycle a scaled-out fleet
+deployment does — fleet-config parsing, a remote replica behind a real
+socket, the background health prober, signal handling, drain,
+port-file.  The kill leg uses SIGKILL (no drain, no goodbye) and the
+replacement policy is a FRESH subprocess — per the RESILIENCE.md
+jaxlib note, nothing is ever revived in-process.
+tests/test_fleet.py + tests/test_failover.py cover the in-process
+side.
 """
 
 from __future__ import annotations
@@ -28,6 +40,7 @@ import subprocess
 import sys
 import tempfile
 import time
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -39,38 +52,66 @@ TOOLS = os.path.dirname(os.path.abspath(__file__))
 
 # Two REAL zoo architectures, shrunk to smoke size: 64 px, two batch
 # buckets, f32 only (each extra arm is another AOT program per model).
-FLEET = {
-    "default_tenant": "free",
-    "tenants": [
-        {"name": "gold", "priority": 1},
-        {"name": "free", "priority": 0},
-    ],
-    "models": [
-        {"name": "minet", "config": "minet_vgg16_ref", "overrides": [
-            "data.image_size=64,64", "serve.resolution_buckets=64",
-            "serve.batch_buckets=1,2", "serve.precision_arms=f32",
-            "serve.precision=f32"]},
-        {"name": "u2net", "config": "u2net_ds", "overrides": [
-            "data.image_size=64,64", "serve.resolution_buckets=64",
-            "serve.batch_buckets=1,2", "serve.precision_arms=f32",
-            "serve.precision=f32"]},
-    ],
-}
+SMOKE_OVERRIDES = [
+    "data.image_size=64,64", "serve.resolution_buckets=64",
+    "serve.batch_buckets=1,2", "serve.precision_arms=f32",
+    "serve.precision=f32"]
+
+
+def fleet_config(u2net_url: str) -> dict:
+    return {
+        "default_tenant": "free",
+        "tenants": [
+            {"name": "gold", "priority": 1},
+            {"name": "free", "priority": 0},
+        ],
+        "models": [
+            {"name": "minet", "config": "minet_vgg16_ref",
+             "overrides": SMOKE_OVERRIDES},
+            {"name": "u2net", "url": u2net_url},
+        ],
+        # Tight health window so the SIGKILL leg's degraded flip is
+        # observable within the smoke budget.
+        "health_poll_s": 0.5,
+        "retry_backoff_ms": 5,
+    }
 
 
 def main(argv=None) -> int:
     argparse.ArgumentParser(description=__doc__).parse_args(argv)
     port_file = tempfile.mktemp(prefix="dsod_fleet_port_")
+    replica_port_file = tempfile.mktemp(prefix="dsod_fleet_replica_port_")
     fleet_file = tempfile.mktemp(prefix="dsod_fleet_cfg_", suffix=".json")
-    with open(fleet_file, "w") as f:
-        json.dump(FLEET, f)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
-           "--fleet-config", fleet_file, "--device", "cpu",
-           "--port", "0", "--port-file", port_file]
-    proc = subprocess.Popen(cmd, env=env)
+    replica_cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
+                   "--config", "u2net_ds", "--init-random",
+                   "--device", "cpu", "--port", "0",
+                   "--port-file", replica_port_file]
+    for ov in SMOKE_OVERRIDES:
+        replica_cmd += ["--set", ov]
+    replica = subprocess.Popen(replica_cmd, env=env)
+    proc = None
     try:
-        deadline = time.monotonic() + 180
+        deadline = time.monotonic() + 150
+        while not os.path.exists(replica_port_file):
+            if replica.poll() is not None:
+                print(json.dumps({"error": "replica died before binding",
+                                  "rc": replica.returncode}), flush=True)
+                return 1
+            if time.monotonic() > deadline:
+                print(json.dumps({"error": "replica never bound a port"}),
+                      flush=True)
+                return 1
+            time.sleep(0.25)
+        with open(replica_port_file) as f:
+            replica_url = f"http://127.0.0.1:{int(f.read().strip())}"
+        with open(fleet_file, "w") as f:
+            json.dump(fleet_config(replica_url), f)
+        cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
+               "--fleet-config", fleet_file, "--device", "cpu",
+               "--port", "0", "--port-file", port_file]
+        proc = subprocess.Popen(cmd, env=env)
+        deadline = time.monotonic() + 150
         while not os.path.exists(port_file):
             if proc.poll() is not None:
                 print(json.dumps({"error": "fleet died before binding",
@@ -87,20 +128,60 @@ def main(argv=None) -> int:
             print(json.dumps({"error": "fleet never became healthy"}),
                   flush=True)
             return 1
-        # Mixed traffic through ONE router: weighted models x tenants.
+        # Mixed traffic through ONE router: weighted models x tenants,
+        # minet in-process and u2net proxied over a real socket.
         summary = run_loadgen(
             url, mode="closed", concurrency=2, requests=6,
-            sizes=((48, 56),), seed=0, timeout_s=90,
+            sizes=((48, 56),), seed=0, timeout_s=45,
             mix=[{"model": "minet", "tenant": "gold", "weight": 2},
                  {"model": "u2net", "tenant": "free", "weight": 1}])
+
+        # -- SIGKILL the remote replica mid-fleet ----------------------
+        replica.kill()
+        replica.wait(timeout=30)
+        kill = {}
+        # The background prober must flip /healthz to DEGRADED naming
+        # the dead model within its 0.5 s window (plus probe timeout).
+        degraded_seen = False
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=10) as r:
+                    health = json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                health = json.loads(e.read().decode())
+            if (health.get("status") == "degraded"
+                    and "u2net" in health.get("unhealthy", [])):
+                degraded_seen = True
+                break
+            time.sleep(0.25)
+        kill["degraded_names_model"] = degraded_seen
+        # The SURVIVING model still serves through the same router...
+        alive = run_loadgen(url, mode="closed", concurrency=1,
+                            requests=2, sizes=((48, 56),), seed=1,
+                            timeout_s=45, model="minet", tenant="gold")
+        kill["survivor_ok"] = alive.get("ok", 0)
+        # ...and a request to the DEAD model terminates in a counted
+        # error (503 no-healthy-replica or 502 transport) — never a
+        # hang, never a lost response.
+        dead = run_loadgen(url, mode="closed", concurrency=1,
+                           requests=1, sizes=((48, 56),), seed=2,
+                           timeout_s=30, model="u2net", tenant="free")
+        kill["dead_model_outcomes"] = {
+            k: dead.get(k, 0)
+            for k in ("ok", "unhealthy", "transport", "error")}
         with urllib.request.urlopen(url + "/stats", timeout=10) as r:
             stats = json.loads(r.read().decode())
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=60)
         summary["server_rc"] = rc
         summary["fleet"] = stats.get("fleet", {})
+        summary["kill_leg"] = kill
         print(json.dumps(summary), flush=True)
         models = summary.get("models", {})
+        dead_terminated = (dead.get("done", 0) == 1
+                           and dead.get("ok", 0) == 0)
         ok = (summary.get("ok", 0) == 6 and rc == 0
               # every request served by the model it named …
               and models.get("minet", {}).get("ok", 0) \
@@ -108,15 +189,21 @@ def main(argv=None) -> int:
               and models.get("u2net", {}).get("ok", 0) \
               == models.get("u2net", {}).get("sent", -1)
               and models.get("u2net", {}).get("sent", 0) >= 1
-              # … and the fleet-wide book balances.
+              # … the kill leg's failure semantics held …
+              and degraded_seen
+              and kill["survivor_ok"] == 2
+              and dead_terminated
+              # … and the fleet-wide book balances THROUGH the kill
+              # (6 mixed + 2 survivor + 1 dead-model terminal error).
               and stats.get("fleet", {}).get("consistent") is True
-              and stats.get("fleet", {}).get("submitted") == 6)
+              and stats.get("fleet", {}).get("submitted") == 9)
         return 0 if ok else 1
     finally:
-        if proc.poll() is None:
-            proc.kill()
-            proc.wait(timeout=30)
-        for f in (port_file, fleet_file):
+        for pr in (proc, replica):
+            if pr is not None and pr.poll() is None:
+                pr.kill()
+                pr.wait(timeout=30)
+        for f in (port_file, replica_port_file, fleet_file):
             if os.path.exists(f):
                 os.unlink(f)
 

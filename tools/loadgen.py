@@ -4,6 +4,11 @@ prints ONE JSON summary line (docs/SERVING.md "Measuring throughput vs
 p99").  No jax import: runs anywhere, including next to a TPU-bound
 server.
 
+The summary counts transport failures (connection refused/reset,
+timeout, short body — a killed replica) SEPARATELY from HTTP-status
+errors (a sick replica answering 5xx), both overall and in the
+per-model breakdown, so failover/chaos experiments read cleanly.
+
     # capacity probe: 8 closed-loop workers, 200 requests
     python tools/loadgen.py --url http://127.0.0.1:8080 \
         --mode closed --concurrency 8 --requests 200

@@ -34,7 +34,10 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/serve_smoke.py --precision 
 echo "== precision quality gate: per-arm max-Fbeta/MAE deltas vs f32 on the tiny synthetic set (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/precision_gate.py \
   || echo "precision gate smoke failed (non-gating; --fail-on-increase gates locally)"
-echo "== fleet smoke: real-process two-model router, mixed-tenant loadgen, fleet accounting, clean SIGTERM drain (recorded, non-gating) =="
-timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py \
+echo "== fleet smoke: real-process router + remote replica, mixed-tenant loadgen, SIGKILL-mid-fleet degraded health, fleet accounting, clean SIGTERM drain (recorded, non-gating) =="
+timeout -k 10 720 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py \
   || echo "fleet smoke failed (non-gating; tests/test_fleet.py below gates the in-process side)"
+echo "== fleet chaos: SIGKILL a replica under open-loop load — zero lost responses, exact accounting, breaker half-open re-admission (recorded, non-gating) =="
+timeout -k 10 540 env JAX_PLATFORMS=cpu python tools/fleet_chaos.py \
+  || echo "fleet chaos failed (non-gating; tests/test_failover.py + tests/test_serve_chaos.py below gate the in-process side)"
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
