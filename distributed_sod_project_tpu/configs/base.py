@@ -265,6 +265,19 @@ class ServeConfig:
     degraded_low: float = 0.25
     degraded_engage_s: float = 2.0
     degraded_disengage_s: float = 5.0
+    # End-to-end tracing (utils/tracing.py; docs/OBSERVABILITY.md).
+    # trace_sample is the fraction of requests whose span timelines are
+    # recorded (deterministic in the request id, so a router and its
+    # replicas trace the SAME requests); 0 disables tracing entirely —
+    # /metrics output is then byte-identical to the pre-tracing
+    # rendering.  The X-Timing response header rides every 200
+    # regardless (it is computed from numbers the engine already
+    # tracks).  trace_capacity bounds the in-memory ring of completed
+    # traces; trace_worst_n pins the slowest N traces per
+    # (model, res bucket) as exemplars that survive the ring.
+    trace_sample: float = 0.01
+    trace_capacity: int = 256
+    trace_worst_n: int = 4
 
 
 @dataclasses.dataclass(frozen=True)
@@ -376,6 +389,16 @@ class FleetConfig:
     # let through and its outcome decides re-admission vs re-open.
     breaker_failures: int = 3
     breaker_reset_s: float = 5.0
+    # Router-tier tracing (utils/tracing.py; docs/OBSERVABILITY.md):
+    # the router mints X-Request-ID, records a child span per dispatch
+    # attempt (replica + breaker state; retries and hedges share the
+    # request's one trace id), and serves sampled + worst-N exemplar
+    # traces at /debug/traces.  Sampling is deterministic in the
+    # request id, so in-process engines (serve.trace_sample) and
+    # remote replicas at the same rate trace the same requests.
+    trace_sample: float = 0.01
+    trace_capacity: int = 256
+    trace_worst_n: int = 4
 
 
 def fleet_config_from_dict(d: Dict) -> FleetConfig:
@@ -475,6 +498,13 @@ def validate_fleet_config(fc: FleetConfig) -> FleetConfig:
     if fc.breaker_reset_s <= 0:
         raise ValueError(
             f"fleet breaker_reset_s must be > 0, got {fc.breaker_reset_s}")
+    if not 0.0 <= fc.trace_sample <= 1.0:
+        raise ValueError(
+            f"fleet trace_sample must be in [0, 1], got {fc.trace_sample}")
+    if fc.trace_capacity < 1 or fc.trace_worst_n < 0:
+        raise ValueError(
+            "fleet trace_capacity must be >= 1 and trace_worst_n >= 0, "
+            f"got {fc.trace_capacity}/{fc.trace_worst_n}")
     if fc.default_tenant not in tseen:
         low = min((t.priority for t in fc.tenants), default=0)
         fc = dataclasses.replace(
@@ -524,6 +554,18 @@ class ExperimentConfig:
     # Grace for the FIRST step, which includes XLA compilation
     # (minutes, legitimately).  Only read when the watchdog is armed.
     watchdog_compile_grace_s: float = 600.0
+    # Opt-in trainer telemetry sidecar (utils/telemetry.py;
+    # docs/OBSERVABILITY.md): >= 0 binds a stdlib HTTP server on that
+    # port (0 = ephemeral; publish via train.py --telemetry-port-file)
+    # exposing /metrics (PipelineStats + StepTimer + device memory),
+    # /healthz (step-watchdog heartbeat), /debug/traces, and
+    # /debug/profile?seconds=N (on-demand jax.profiler window).
+    # -1 (default) = off: zero threads, zero sockets.
+    telemetry_port: int = -1
+    # Fraction of train chunks whose span timelines are recorded
+    # (data-wait/dispatch/flush + ckpt/eval spans correlated to step
+    # numbers — utils/tracing.py).  0 = off (no per-chunk clock reads).
+    trace_sample: float = 0.0
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

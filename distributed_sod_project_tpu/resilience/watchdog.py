@@ -142,6 +142,16 @@ class StepWatchdog:
             if metrics:
                 self.last_metrics = dict(metrics)
 
+    def seconds_since_beat(self) -> Optional[float]:
+        """Age of the last heartbeat (None before start()) — the
+        trainer telemetry sidecar's /healthz reads this so liveness is
+        the watchdog's OWN signal, not a second, subtly different
+        clock."""
+        with self._lock:
+            if self._last_beat is None:
+                return None
+            return time.monotonic() - self._last_beat
+
     # -- monitor ------------------------------------------------------
 
     def _run(self) -> None:

@@ -50,6 +50,11 @@ class Request:
     level: int = 0
     future: Future = field(default_factory=Future)
     dispatch_t: float = 0.0
+    # Tracing (utils/tracing.py): the request's trace id (propagated
+    # from X-Request-ID) and its open root span — None when the trace
+    # was not sampled, and every span touch downstream guards on that.
+    trace_id: Optional[str] = None
+    root: object = field(default=None, repr=False)
 
     @property
     def bucket_key(self) -> Tuple[int, str]:

@@ -37,7 +37,8 @@ import numpy as np
 
 from ..eval.inference import _resize_pred, flip_tta, pad_to_batch
 from ..utils.logging import get_logger
-from ..utils.observability import ServeStats
+from ..utils.observability import ServeStats, TelemetryRegistry
+from ..utils.tracing import Tracer
 from .admission import (AdmissionController, DeadlineExpired, EngineStopped,
                         QueueFull)
 from .batcher import DynamicBatcher, Request
@@ -90,6 +91,20 @@ class InferenceEngine:
         self.stats = stats or ServeStats()
         self._clock = clock
         self._log = get_logger()
+        # Request tracing (utils/tracing.py; docs/OBSERVABILITY.md):
+        # the per-request queue/coalesce/device/fetch/resize_back span
+        # timeline, sampled deterministically by trace id.  At
+        # trace_sample=0 every touch below is a None check — the
+        # /metrics surface and request path are byte-for-byte the
+        # pre-tracing behavior.
+        self.tracer = Tracer(sample=cfg.serve.trace_sample,
+                             capacity=cfg.serve.trace_capacity,
+                             worst_n=cfg.serve.trace_worst_n, clock=clock)
+        # /metrics renders through the shared registry (one code path
+        # with the trainer sidecar); a single provider renders
+        # byte-identical to ServeStats.render_prometheus().
+        self.telemetry = TelemetryRegistry().register(
+            "serve", self.stats.prom_families)
 
         sc = cfg.serve
         self.res_buckets = tuple(sorted(
@@ -256,6 +271,7 @@ class InferenceEngine:
         self._stop.set()
         for r in self.batcher.close():
             self.stats.inc("errors")
+            self._trace_end(r, "stopped")
             self._fail(r, EngineStopped("engine stopped"))
         if self._dispatch_thread is not None:
             self._dispatch_thread.join(timeout=10.0)
@@ -319,14 +335,20 @@ class InferenceEngine:
 
     def submit(self, image: np.ndarray,
                slo_ms: Optional[float] = None,
-               precision: Optional[str] = None):
+               precision: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               trace_parent: Optional[str] = None):
         """Enqueue one prediction; returns a ``concurrent.futures.Future``
         resolving to ``(pred, meta)`` — pred float32 (H, W) at the
         request's original resolution.  ``precision`` selects the arm
         (default ``serve.precision``; must be an enabled arm — the
-        degraded ladder may still step it further down).  Raises
-        :class:`QueueFull` / :class:`EngineStopped` at the door
-        (nothing enqueued)."""
+        degraded ladder may still step it further down).  ``trace_id``
+        joins the request to an end-to-end trace (the HTTP front ends
+        pass the X-Request-ID; sampling decides whether spans are
+        actually recorded); ``trace_parent`` is the caller's span id —
+        the fleet router parents the engine's request span under its
+        dispatch-attempt span.  Raises :class:`QueueFull` /
+        :class:`EngineStopped` at the door (nothing enqueued)."""
         # Every submit() call is a submitted request — door rejects
         # included — so the accounting identity composes fleet-wide:
         # a router's forwarded count equals this engine's submitted
@@ -368,11 +390,20 @@ class InferenceEngine:
             raise
         now = self._clock()
         slo = self.cfg.serve.slo_ms if slo_ms is None else slo_ms
+        # Root span for the request's in-engine life (None unless the
+        # trace is sampled — every later touch guards on that).  The
+        # root PARENT may live in another tracer (the router's attempt
+        # span); within this tracer the request span is the root whose
+        # end completes the trace.
+        root = self.tracer.begin(
+            "request", trace_id, parent_id=trace_parent, t0=now, root=True,
+            attrs={"model": self.cfg.model.name, "res_bucket": res,
+                   "arm": arm, "level": level})
         req = Request(
             tensor=tensor, orig_hw=(int(arr.shape[0]), int(arr.shape[1])),
             res_bucket=res, arrival=now, precision=arm,
             deadline=(now + slo / 1000.0) if slo and slo > 0 else None,
-            degraded=level > 0, level=level)
+            degraded=level > 0, level=level, trace_id=trace_id, root=root)
         try:
             # The batcher re-checks the bound under ITS lock (the
             # try_admit above is the cheap pre-preprocess gate; N
@@ -380,9 +411,11 @@ class InferenceEngine:
             self.batcher.put(req)
         except QueueFull:
             self.stats.inc("shed")
+            self._trace_end(req, "shed")
             raise
         except RuntimeError as e:  # closed: stop() raced this submit
             self.stats.inc("errors")
+            self._trace_end(req, "stopped")
             raise EngineStopped(str(e)) from e
         self.stats.set_queue_depth(self.batcher.pending())
         return req.future
@@ -449,6 +482,7 @@ class InferenceEngine:
         ``preacquired`` means the caller already holds one inflight
         semaphore slot (the non-blocking path acquires it BEFORE
         popping, so a group is never stranded outside the queue)."""
+        t_pop = self._clock()  # the group just left the batcher
         if self._fault_plan is not None:
             # serve_stall@G:SEC — wedge THIS dispatch before its
             # forward; the watchdog's beat stops while the stall holds
@@ -463,6 +497,7 @@ class InferenceEngine:
         for r in reqs:
             if AdmissionController.expired(r.deadline, est, now):
                 self.stats.inc("expired")
+                self._trace_end(r, "expired", t_pop=t_pop)
                 self._fail(r, DeadlineExpired(
                     f"deadline missed before dispatch (est device "
                     f"{est * 1000:.1f}ms)"))
@@ -491,12 +526,24 @@ class InferenceEngine:
             if not acquired:
                 for r in live:
                     self.stats.inc("errors")
+                    self._trace_end(r, "stopped", t_pop=t_pop)
                     self._fail(r, EngineStopped("engine stopped"))
                 return True
         t0 = self._clock()
         for r in live:
             r.dispatch_t = t0
             self.stats.queue_ms.observe((t0 - r.arrival) * 1000.0)
+            if r.root is not None:
+                # queue: batcher wait (backlog + coalescing window);
+                # coalesce: group assembly — expiry filter, padding,
+                # the inflight-semaphore wait.  Together they tile
+                # arrival → dispatch exactly (== the queue_ms
+                # histogram's observation for this request).
+                self.tracer.record(r.trace_id, "queue", r.arrival, t_pop,
+                                   parent_id=r.root.span_id)
+                self.tracer.record(r.trace_id, "coalesce", t_pop, t0,
+                                   parent_id=r.root.span_id,
+                                   attrs={"group": len(live), "bucket": bb})
         # Count the in-flight slot the moment the semaphore is held
         # so the error path's _release_inflight always undoes a
         # matching increment (the gauge must never go negative-ish
@@ -511,6 +558,7 @@ class InferenceEngine:
             self._log.exception("serve: dispatch failed")
             for r in live:
                 self.stats.inc("errors")
+                self._trace_end(r, "error")
                 self._fail(r, e)
             return True
         self.stats.observe_batch(len(live), bb, arm=arm)
@@ -542,8 +590,21 @@ class InferenceEngine:
 
     def _complete(self, probs, live, meta, t0: float) -> None:
         try:
+            t_f0 = self._clock()
             arr = np.asarray(probs)[: len(live)]  # the blocking fetch
-            dev_ms = (self._clock() - t0) * 1000.0
+            t_f1 = self._clock()
+            dev_ms = (t_f1 - t0) * 1000.0
+            for r in live:
+                if r.root is not None:
+                    # device: dispatch → fetch complete (== the
+                    # device_ms histogram's observation); fetch is the
+                    # host-blocking tail of it, parented under device.
+                    dev_sid = self.tracer.record(
+                        r.trace_id, "device", t0, t_f1,
+                        parent_id=r.root.span_id,
+                        attrs={"batch_bucket": meta["batch_bucket"]})
+                    self.tracer.record(r.trace_id, "fetch", t_f0, t_f1,
+                                       parent_id=dev_sid)
             est_key = (meta["res_bucket"], meta["precision"])
             with self._est_lock:
                 old = self._est_s.get(est_key)
@@ -561,18 +622,35 @@ class InferenceEngine:
             self._log.exception("serve: completion failed")
             for r in live:
                 self.stats.inc("errors")
+                self._trace_end(r, "error")
                 self._fail(r, e)
         finally:
             self._release_inflight()
 
     def _finish(self, r: Request, row: np.ndarray, meta: dict) -> None:
         try:
+            t_r0 = self._clock()
             pred = _resize_pred(row, r.orig_hw)
-            e2e = (self._clock() - r.arrival) * 1000.0
+            t_done = self._clock()
+            e2e = (t_done - r.arrival) * 1000.0
             meta.update(
                 degraded=r.degraded, degraded_level=r.level,
                 queue_ms=round((r.dispatch_t - r.arrival) * 1000.0, 3),
-                e2e_ms=round(e2e, 3))
+                resize_ms=round((t_done - t_r0) * 1000.0, 3),
+                e2e_ms=round(e2e, 3),
+                # trace_id only when the trace was SAMPLED (spans
+                # exist in /debug/traces); X-Timing says "trace=-"
+                # otherwise, request id still echoed separately.
+                trace_id=r.trace_id if r.root is not None else None)
+            if r.root is not None:
+                self.tracer.record(r.trace_id, "resize_back", t_r0, t_done,
+                                   parent_id=r.root.span_id)
+                # Root ends with t1 = the same instant e2e_ms was
+                # computed at, so the trace's dur_ms, the X-Timing
+                # header, and the e2e histogram observation agree.
+                r.root.end(t1=t_done,
+                           key=(self.cfg.model.name, r.res_bucket),
+                           outcome="served")
             self.stats.e2e_ms.observe(e2e)
             arm_stats = self.stats.arm(r.precision)
             arm_stats.e2e_ms.observe(e2e)
@@ -581,7 +659,22 @@ class InferenceEngine:
             self._set_result(r, (pred, meta))
         except Exception as e:  # noqa: BLE001 — per-request surface
             self.stats.inc("errors")
+            self._trace_end(r, "error")
             self._fail(r, e)
+
+    def _trace_end(self, r: Request, outcome: str,
+                   t_pop: Optional[float] = None) -> None:
+        """Close a failed/shed request's trace with its outcome (the
+        happy path ends the root in :meth:`_finish`).  ``t_pop`` (the
+        expiry path) records the queue span the request DID spend
+        before being dropped."""
+        if r.root is None:
+            return
+        if t_pop is not None:
+            self.tracer.record(r.trace_id, "queue", r.arrival, t_pop,
+                               parent_id=r.root.span_id)
+        r.root.end(key=(self.cfg.model.name, r.res_bucket),
+                   outcome=outcome)
 
     @staticmethod
     def _set_result(r: Request, value) -> None:

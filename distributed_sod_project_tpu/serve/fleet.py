@@ -51,6 +51,7 @@ from ..configs.base import FleetConfig, validate_fleet_config
 from ..utils.logging import get_logger
 from ..utils.observability import (TailEstimator, merge_prom_families,
                                    parse_prom_text, render_prom_families)
+from ..utils.tracing import Tracer
 from .failover import STATE_GAUGE, CircuitBreaker, RetryPolicy
 from .router import RouterStats, TenantAdmission
 
@@ -92,6 +93,9 @@ class EngineBackend:
 
     def stats_snapshot(self) -> Dict:
         return self.engine.stats.snapshot()
+
+    def debug_traces(self, n: int = 50) -> Dict:
+        return self.engine.tracer.snapshot(n)
 
     def describe(self) -> Dict:
         cfg = self.engine.cfg
@@ -255,6 +259,22 @@ class RemoteBackend:
                 return json.loads(r.read().decode())
         except (urllib.error.URLError, OSError, ValueError) as e:
             return {"unreachable": str(e)}
+
+    def debug_traces(self, n: int = 50) -> Dict:
+        """The remote's /debug/traces (its half of the end-to-end
+        timelines — same trace ids as the router's spans, thanks to
+        deterministic sampling on the forwarded X-Request-ID).  Empty
+        on a known-down or unreachable replica: a debug endpoint must
+        never stall on a dead host either."""
+        if not self.healthy():
+            return {}
+        try:
+            with urllib.request.urlopen(
+                    self.url + f"/debug/traces?n={int(n)}",
+                    timeout=self.PROBE_TIMEOUT_S) as r:
+                return json.loads(r.read().decode())
+        except (urllib.error.URLError, OSError, ValueError):
+            return {}
 
     def describe(self) -> Dict:
         return {"kind": self.kind, "url": self.url}
@@ -436,6 +456,13 @@ class Fleet:
             cfg.tenants, default_tenant=cfg.default_tenant,
             strict=cfg.strict_tenants, clock=clock)
         self.rstats = RouterStats()
+        # Router-tier tracing: the request root + per-attempt spans
+        # (serve/router.py); in-process engines record their half of
+        # the same trace ids in their OWN tracers, merged on demand by
+        # :meth:`debug_traces`.
+        self.tracer = Tracer(sample=cfg.trace_sample,
+                             capacity=cfg.trace_capacity,
+                             worst_n=cfg.trace_worst_n, clock=clock)
         self.retry_policy = RetryPolicy(
             cfg.retry_max_attempts, cfg.retry_backoff_ms,
             cfg.retry_backoff_max_ms, clock=clock)
@@ -640,3 +667,35 @@ class Fleet:
     def describe_models(self) -> Dict:
         return {rid: b.describe()
                 for rid, b in sorted(self.backends.items())}
+
+    def debug_traces(self, n: int = 50) -> Dict:
+        """The router's /debug/traces payload: every source's snapshot
+        (router + one per replica) PLUS a merged per-trace view — the
+        router's request/attempt spans and each replica's in-engine
+        spans grouped under their shared trace id, which is what "follow
+        ONE request through router → replica → batcher → device →
+        fetch" renders as.  Replica snapshots gather concurrently
+        (remote scrapes are bounded by PROBE_TIMEOUT_S and skipped for
+        known-down replicas)."""
+        sources = {"router": self.tracer.snapshot(n)}
+        snaps = self._gather_replicas(
+            lambda _g, rid, b: (rid, b.debug_traces(n)
+                                if hasattr(b, "debug_traces") else {}))
+        for rid, snap in snaps:
+            if snap:
+                sources[f"replica:{rid}"] = snap
+        merged: Dict[str, Dict] = {}
+        for src, snap in sources.items():
+            for tr in snap.get("traces", []):
+                m = merged.setdefault(tr["trace_id"], {
+                    "trace_id": tr["trace_id"], "spans": [],
+                    "sources": []})
+                m["spans"].extend(tr["spans"])
+                m["sources"].append(src)
+                if src == "router":
+                    # The router root's duration IS the request's
+                    # door-to-response time.
+                    m["dur_ms"] = tr.get("dur_ms")
+        return {"sources": sources,
+                "merged": sorted(merged.values(),
+                                 key=lambda t: t.get("dur_ms") or 0.0)}

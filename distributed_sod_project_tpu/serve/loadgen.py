@@ -22,6 +22,7 @@ summary next to the per-arm breakdown — the fleet's mixed-model curve
 
 from __future__ import annotations
 
+import heapq
 import http.client
 import io
 import json
@@ -32,6 +33,8 @@ import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..utils.tracing import mint_trace_id, parse_timing
 
 
 def encode_image(rng: np.random.RandomState, h: int, w: int) -> bytes:
@@ -58,7 +61,8 @@ def wait_ready(base_url: str, timeout_s: float = 60.0,
 
 def _one(base_url: str, body: bytes, slo_ms: Optional[float],
          timeout_s: float, precision: Optional[str] = None,
-         model: Optional[str] = None, tenant: Optional[str] = None
+         model: Optional[str] = None, tenant: Optional[str] = None,
+         request_id: Optional[str] = None
          ) -> Tuple[str, float, Dict[str, Optional[str]]]:
     """One /predict round-trip → (outcome, latency_ms, info).
     Outcomes: ok | shed | expired | unhealthy | error | transport —
@@ -68,9 +72,12 @@ def _one(base_url: str, body: bytes, slo_ms: Optional[float],
     replica produces transports, a sick one produces 5xx errors).
     ``info`` holds the response's X-Precision / X-Model headers (what
     the server actually SERVED — the ladder may adjust the arm, the
-    router names the model), None values on non-200s.
+    router names the model), None values on non-200s, plus the echoed
+    X-Request-ID (``rid``) and raw X-Timing (``timing`` — the
+    server-side stage split; docs/OBSERVABILITY.md).
     ``model``/``tenant`` ride as X-Model / X-Tenant request headers
-    (fleet routing + tenancy)."""
+    (fleet routing + tenancy); ``request_id`` rides as X-Request-ID so
+    the client's latency record and the server's trace share an id."""
     headers = {"Content-Type": "application/x-npy"}
     if slo_ms:
         headers["X-SLO-MS"] = str(slo_ms)
@@ -80,10 +87,13 @@ def _one(base_url: str, body: bytes, slo_ms: Optional[float],
         headers["X-Model"] = str(model)
     if tenant:
         headers["X-Tenant"] = str(tenant)
+    if request_id:
+        headers["X-Request-ID"] = str(request_id)
     req = urllib.request.Request(base_url + "/predict", data=body,
                                  headers=headers, method="POST")
     t0 = time.monotonic()
-    info: Dict[str, Optional[str]] = {"arm": None, "model": None}
+    info: Dict[str, Optional[str]] = {"arm": None, "model": None,
+                                      "rid": None, "timing": None}
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             r.read()
@@ -91,6 +101,8 @@ def _one(base_url: str, body: bytes, slo_ms: Optional[float],
             if out == "ok":
                 info["arm"] = r.headers.get("X-Precision")
                 info["model"] = r.headers.get("X-Model")
+                info["rid"] = r.headers.get("X-Request-ID")
+                info["timing"] = r.headers.get("X-Timing")
     except urllib.error.HTTPError as e:
         e.read()
         out = {429: "shed", 504: "expired", 503: "unhealthy"}.get(
@@ -147,6 +159,7 @@ def run_loadgen(
     model: Optional[str] = None,
     tenant: Optional[str] = None,
     mix=None,
+    slowest: int = 0,
 ) -> Dict[str, float]:
     """Drive ``base_url`` and return a summary dict (see module doc for
     the open/closed semantics).  Closed loop sends exactly ``requests``
@@ -162,7 +175,14 @@ def run_loadgen(
     HTTP); the summary additionally breaks p50/p95/p99 down per SERVED
     arm (X-Precision) and per SERVED model (X-Model — the router echo),
     mirroring the per-arm breakdown, so the mixed-model
-    throughput-vs-p99 curve is one command."""
+    throughput-vs-p99 curve is one command.
+
+    ``slowest > 0``: every request carries a generated ``X-Request-ID``
+    and the summary reports the N slowest OK responses with their
+    request/trace ids and the SERVER-side stage breakdown parsed from
+    ``X-Timing`` (queue/device/resize/e2e ms) — "which requests were
+    slow and WHERE" without a server round trip; when a row's trace
+    was sampled, its id keys straight into /debug/traces."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     rng = np.random.RandomState(seed)
@@ -193,6 +213,11 @@ def run_loadgen(
     # killed single-replica model's failures vanish from its row.
     _MODEL_FAIL_OUTCOMES = ("error", "transport", "unhealthy")
     model_fail: Dict[Tuple[str, str], int] = {}
+    # slowest-N tracking: a min-heap bounded at N, so a long soak holds
+    # N rows, not one per OK response.  Entries are (ms, seq, info);
+    # seq breaks latency ties (dicts don't compare).
+    slow_rows: List[Tuple[float, int, Dict]] = []
+    slow_seq = [0]
 
     def record(out: str, ms: float, info=None, sent_model=None) -> None:
         info = info or {}
@@ -204,6 +229,13 @@ def run_loadgen(
                     arm_ms.setdefault(info["arm"], []).append(ms)
                 if info.get("model"):
                     model_ms.setdefault(info["model"], []).append(ms)
+                if slowest > 0:
+                    slow_seq[0] += 1
+                    row = (ms, slow_seq[0], info)
+                    if len(slow_rows) < slowest:
+                        heapq.heappush(slow_rows, row)
+                    elif ms > slow_rows[0][0]:
+                        heapq.heapreplace(slow_rows, row)
             elif out in _MODEL_FAIL_OUTCOMES and sent_model:
                 key = (sent_model, out)
                 model_fail[key] = model_fail.get(key, 0) + 1
@@ -213,9 +245,13 @@ def run_loadgen(
         if a["model"]:
             with lock:
                 model_sent[a["model"]] = model_sent.get(a["model"], 0) + 1
+        # A request id per request (the X-Request-ID header) so the
+        # slowest-N rows key into the server's /debug/traces; ids do
+        # not perturb the seeded (model, tenant) draws above.
+        rid = mint_trace_id() if slowest > 0 else None
         record(*_one(base_url, pool[i % len(pool)], slo_ms or None,
                      timeout_s, precision=precision, model=a["model"],
-                     tenant=a.get("tenant") or tenant),
+                     tenant=a.get("tenant") or tenant, request_id=rid),
                sent_model=a["model"])
 
     t_start = time.monotonic()
@@ -317,6 +353,23 @@ def run_loadgen(
                 "p95_ms": round(_percentile(ms, 0.95), 2),
                 "p99_ms": round(_percentile(ms, 0.99), 2),
             }
+    if slowest > 0 and slow_rows:
+        # The N slowest OK responses, server-side stage split attached:
+        # client e2e minus the X-Timing e2e is the network + front-door
+        # share, and a sampled row's trace id keys into /debug/traces.
+        slow_rows.sort(key=lambda e: -e[0])
+        rows = []
+        for ms, _seq, info in slow_rows[:slowest]:
+            trace_id, stages = parse_timing(info.get("timing"))
+            rows.append({
+                "ms": round(ms, 2),
+                "request_id": info.get("rid"),
+                "trace": trace_id,  # None = not sampled server-side
+                "model": info.get("model"),
+                "arm": info.get("arm"),
+                "stages": {k: round(v, 3) for k, v in stages.items()},
+            })
+        out["slowest"] = rows
     if mode == "open":
         out["offered_rps"] = round(float(rps), 2)
     return out

@@ -67,8 +67,9 @@ from typing import Dict, Optional, Tuple
 from ..configs.base import FleetTenantConfig
 from ..utils.logging import get_logger
 from .failover import pick_hedge_delay
-from .server import (JsonHTTPHandler, ThreadingHTTPServer, publish_port,
-                     read_predict_body, run_predict)
+from .server import (JsonHTTPHandler, ThreadingHTTPServer, _query_int,
+                     publish_port, read_predict_body, resolve_request_id,
+                     run_predict)
 
 
 class TokenBucket:
@@ -309,9 +310,12 @@ class RouterStats:
 # original minus elapsed router time and prior attempts) per attempt.
 _FORWARD_HEADERS = ("Content-Type", "X-Precision")
 # Response headers relayed back from a remote replica's answer.
+# X-Timing rides so the stage split (and sampled trace id) a remote
+# computed reaches the client through the router unchanged; the
+# router's own X-Request-ID echo is authoritative for the request id.
 _RELAY_HEADERS = ("X-Degraded", "X-Precision", "X-Res-Bucket",
                   "X-Batch-Bucket", "X-Queue-MS", "X-Device-MS",
-                  "X-E2E-MS")
+                  "X-E2E-MS", "X-Timing")
 # Remote answers that trigger failover/retry: the replica itself is
 # broken (500 crash, 502 its own upstream, 503 stopped/unhealthy).
 # 429/504 are POLICY answers (shed/deadline) — retrying those would
@@ -346,6 +350,10 @@ class RouterHandler(JsonHTTPHandler):
             self._send_json(200, self.fleet.stats())
         elif path == "/models":
             self._send_json(200, {"models": self.fleet.describe_models()})
+        elif path == "/debug/traces":
+            q = urllib.parse.urlsplit(self.path).query
+            self._send_json(200, self.fleet.debug_traces(
+                n=_query_int(q, "n", 50)))
         else:
             self._send_json(404, {"error": f"no route {path}"})
 
@@ -392,7 +400,13 @@ class RouterHandler(JsonHTTPHandler):
                          f"{self.headers.get('X-Tenant')!r}",
                 "tenants": sorted(fleet.admission.tenants)})
             return
-        echo = [("X-Model", group.name), ("X-Tenant", tenant.name)]
+        # The request id doubles as the END-TO-END trace id: minted
+        # here (or honored from the client), forwarded to every
+        # replica attempt, echoed back — retries and hedges all share
+        # it, so one slow request reads as ONE timeline.
+        req_id = resolve_request_id(self.headers.get("X-Request-ID"))
+        echo = [("X-Model", group.name), ("X-Tenant", tenant.name),
+                ("X-Request-ID", req_id)]
         # The deadline budget is stamped at the DOOR: every retry,
         # hedge, and backoff below is charged against it.
         t_door = fleet._clock()
@@ -402,6 +416,14 @@ class RouterHandler(JsonHTTPHandler):
         # a client that disconnects mid-request (the final except
         # records the pre-dispatch abort as a router reject).
         fleet.rstats.inc_submitted(tenant.name)
+        root = fleet.tracer.begin(
+            "request", req_id, t0=t_door, root=True,
+            attrs={"model": group.name, "tenant": tenant.name})
+
+        def end_root(outcome: str) -> None:
+            if root is not None:
+                root.end(key=(group.name,), outcome=outcome)
+
         terminal = False
         picked = None
         dispatched = False
@@ -414,6 +436,7 @@ class RouterHandler(JsonHTTPHandler):
                     # Malformed deadline: pre-dispatch reject at the
                     # ROUTER (the budget math below needs the number).
                     fleet.rstats.inc_response(tenant.name, "rejected")
+                    end_root("rejected")
                     terminal = True
                     self.close_connection = True
                     self._guarded_send_json(400, {
@@ -426,6 +449,7 @@ class RouterHandler(JsonHTTPHandler):
                 # open: terminal at the router, no timeout paid.
                 fleet.rstats.inc_response(tenant.name,
                                           "no_healthy_replica")
+                end_root("no_healthy_replica")
                 terminal = True
                 self.close_connection = True
                 self._guarded_send_json(503, {
@@ -445,6 +469,7 @@ class RouterHandler(JsonHTTPHandler):
                 # re-admission.
                 picked[2].release_probe()
                 fleet.rstats.inc_shed(tenant.name, reason)
+                end_root(f"shed_{reason}")
                 terminal = True
                 self.close_connection = True
                 self._guarded_send_json(429, {
@@ -458,13 +483,16 @@ class RouterHandler(JsonHTTPHandler):
             if body is None:  # bad Content-Length, 400 already sent
                 picked[2].release_probe()  # never dispatched
                 fleet.rstats.inc_response(tenant.name, "rejected")
+                end_root("rejected")
                 terminal = True
                 return
             fleet.rstats.inc_routed(group.name)
             dispatched = True
             outcome = self._dispatch(group, picked, body, echo, slo_ms,
-                                     slo_hdr is not None, t_door)
+                                     slo_hdr is not None, t_door,
+                                     req_id, root)
             fleet.rstats.inc_response(tenant.name, outcome)
+            end_root(outcome)
             terminal = True
         except Exception:  # noqa: BLE001 — dead client / broken pipe
             get_logger().exception("router: predict handler failed")
@@ -476,19 +504,25 @@ class RouterHandler(JsonHTTPHandler):
                 # books through the single inc_response above): close
                 # the book as a router reject, not a silent leak.
                 fleet.rstats.inc_response(tenant.name, "rejected")
+                end_root("rejected")
 
     # -- failover dispatch ---------------------------------------------
 
     def _dispatch(self, group, picked, body: bytes, echo,
                   slo_ms: Optional[float], has_slo: bool,
-                  t_door: float) -> str:
+                  t_door: float, req_id: Optional[str] = None,
+                  root=None) -> str:
         """Run one request against a replica set under the fleet's
         retry/hedge/breaker policy and write exactly one response.
         Returns the request's single terminal outcome.  NEVER raises
-        (sends are guarded; attempt failures are data)."""
+        (sends are guarded; attempt failures are data).  Every attempt
+        below — first dispatch, retries, hedges — records a child span
+        under ``root`` tagged with its replica and breaker state, all
+        sharing the ``req_id`` trace."""
         fleet = self.fleet
         policy = fleet.retry_policy
         rid, backend, breaker = picked
+        root_sid = root.span_id if root is not None else None
         attempts = 0
         excluded = set()
         last = None
@@ -511,10 +545,12 @@ class RouterHandler(JsonHTTPHandler):
                 # Dead/wedged engines were routed around by pick().
                 return self._engine_attempt(group, rid, backend, breaker,
                                             body, echo, slo_ms, has_slo,
-                                            t_door)
+                                            t_door, req_id, root_sid,
+                                            attempt_n=attempts)
             result = self._remote_attempt_maybe_hedged(
                 group, rid, backend, breaker, body, slo_ms, t_door,
-                hedge_allowed=(attempts == 0), excluded=excluded)
+                hedge_allowed=(attempts == 0), excluded=excluded,
+                req_id=req_id, root_sid=root_sid, attempt_n=attempts)
             attempts += 1
             if result[0] == "http" \
                     and result[1] not in _RETRYABLE_STATUSES:
@@ -576,16 +612,31 @@ class RouterHandler(JsonHTTPHandler):
 
     def _engine_attempt(self, group, rid: str, backend, breaker,
                         body: bytes, echo, slo_ms: Optional[float],
-                        has_slo: bool, t_door: float) -> str:
+                        has_slo: bool, t_door: float,
+                        req_id: Optional[str] = None,
+                        root_sid: Optional[str] = None,
+                        attempt_n: int = 0) -> str:
         fleet = self.fleet
         extra = list(echo) + [("X-Replica", rid)]
+        span = None
+        if req_id is not None and fleet.tracer.sampled(req_id):
+            # breaker.snapshot() only on the sampled path — unsampled
+            # requests pay one crc32, nothing else.
+            span = fleet.tracer.begin(
+                "attempt", req_id, parent_id=root_sid,
+                attrs={"replica": rid, "kind": "engine", "n": attempt_n,
+                       "breaker": breaker.snapshot()["state"]})
         kw = {}
         if has_slo:
             # Charge elapsed router time against the engine's deadline
             # too — the residual-budget contract is backend-agnostic.
             kw["slo_ms"] = fleet.retry_policy.residual_ms(slo_ms, t_door)
         outcome = run_predict(self, backend.engine, body,
-                              extra_headers=extra, **kw)
+                              extra_headers=extra, request_id=req_id,
+                              trace_parent=span.span_id if span else None,
+                              **kw)
+        if span is not None:
+            span.end(outcome=outcome)
         if outcome in ("stopped", "error"):
             breaker.record_failure()
         else:
@@ -598,16 +649,31 @@ class RouterHandler(JsonHTTPHandler):
 
     def _one_remote_call(self, group, rid: str, backend, breaker,
                          body: bytes, slo_ms: Optional[float],
-                         t_door: float):
+                         t_door: float, req_id: Optional[str] = None,
+                         root_sid: Optional[str] = None,
+                         attempt_n: int = 0, hedge: bool = False):
         """One POST to one remote replica.  Returns
         ``("http", status, headers, body, rid)`` for ANY HTTP answer or
         ``("transport", reason, rid)`` when the connection itself broke
         — recording the breaker outcome and the health fast-flip, and
         touching NOTHING client-facing (hedge losers run this exact
-        path and must stay invisible)."""
+        path and must stay invisible — their attempt SPAN is recorded,
+        the one trace-visible mark a loser leaves)."""
         fleet = self.fleet
         headers = {k: v for k in _FORWARD_HEADERS
                    if (v := self.headers.get(k)) is not None}
+        span = None
+        if req_id is not None:
+            # The trace id rides to the replica: a remote tracing at
+            # the same rate records the in-engine half of THIS trace
+            # under the same id (deterministic sampling).
+            headers["X-Request-ID"] = req_id
+            if fleet.tracer.sampled(req_id):
+                span = fleet.tracer.begin(
+                    "attempt", req_id, parent_id=root_sid,
+                    attrs={"replica": rid, "kind": "remote",
+                           "n": attempt_n, "hedge": hedge,
+                           "breaker": breaker.snapshot()["state"]})
         residual = fleet.retry_policy.residual_ms(slo_ms, t_door)
         timeout_s = None
         if residual is not None:
@@ -623,12 +689,16 @@ class RouterHandler(JsonHTTPHandler):
                 body, headers, timeout_s=timeout_s)
         except _TRANSPORT_ERRORS as e:
             breaker.record_failure()
+            if span is not None:
+                span.end(result="transport", error=f"{type(e).__name__}")
             note = getattr(backend, "note_transport_failure", None)
             if note is not None:
                 note(str(e))
             get_logger().warning(
                 "router: replica %s transport failure: %s", rid, e)
             return ("transport", f"{type(e).__name__}: {e}", rid)
+        if span is not None:
+            span.end(status=status)
         if status in _RETRYABLE_STATUSES:
             breaker.record_failure()
         else:
@@ -647,7 +717,10 @@ class RouterHandler(JsonHTTPHandler):
                                      breaker, body: bytes,
                                      slo_ms: Optional[float],
                                      t_door: float, hedge_allowed: bool,
-                                     excluded) -> tuple:
+                                     excluded,
+                                     req_id: Optional[str] = None,
+                                     root_sid: Optional[str] = None,
+                                     attempt_n: int = 0) -> tuple:
         """The FIRST dispatch may race a tail-latency hedge: if the
         primary hasn't answered within the hedge delay (fixed, or the
         router's observed per-model p95), fire the same request at a
@@ -661,12 +734,14 @@ class RouterHandler(JsonHTTPHandler):
                                         group.tail.percentile(0.95))
         if delay_ms is None:
             return self._one_remote_call(group, rid, backend, breaker,
-                                         body, slo_ms, t_door)
+                                         body, slo_ms, t_door, req_id,
+                                         root_sid, attempt_n)
         residual = fleet.retry_policy.residual_ms(slo_ms, t_door)
         if residual is not None and residual <= delay_ms:
             # No budget left to wait out a hedge window — plain call.
             return self._one_remote_call(group, rid, backend, breaker,
-                                         body, slo_ms, t_door)
+                                         body, slo_ms, t_door, req_id,
+                                         root_sid, attempt_n)
         results: "queue.Queue" = queue.Queue()
         # Every results.get() below is bounded by this: the attempts'
         # own transport timeouts are tighter, so the bound only bites
@@ -674,11 +749,11 @@ class RouterHandler(JsonHTTPHandler):
         # the synthetic transport failure keeps the request terminal).
         worker_bound_s = fleet.cfg.request_timeout_s + 5.0
 
-        def attempt(rid_, backend_, breaker_):
+        def attempt(rid_, backend_, breaker_, hedge_=False):
             try:
                 results.put(self._one_remote_call(
                     group, rid_, backend_, breaker_, body, slo_ms,
-                    t_door))
+                    t_door, req_id, root_sid, attempt_n, hedge=hedge_))
             except Exception as e:  # noqa: BLE001 — keep the handler fed
                 get_logger().exception(
                     "router: hedge attempt worker failed")
@@ -709,7 +784,7 @@ class RouterHandler(JsonHTTPHandler):
         if hedge_pick is None:  # no second healthy replica: wait it out
             return bounded_get(rid)
         fleet.rstats.inc_hedge(group.name)
-        threading.Thread(target=attempt, args=hedge_pick,
+        threading.Thread(target=attempt, args=tuple(hedge_pick) + (True,),
                          name="router-hedge-secondary",
                          daemon=True).start()
         first = bounded_get(rid)

@@ -20,6 +20,12 @@ Endpoints:
 - ``GET /metrics``  — Prometheus text (ServeStats: latency histograms,
   shed/expired counters, batch occupancy, degraded/health gauges).
 - ``GET /stats``    — the same telemetry as one JSON object.
+- ``GET /debug/traces?n=N`` — sampled request span timelines + the
+  worst-N exemplars per (model, res bucket) (docs/OBSERVABILITY.md).
+
+Every 200 also carries ``X-Request-ID`` (client-supplied or minted —
+doubles as the trace id) and ``X-Timing`` (the server-side stage
+split; ``trace=-`` when the request was not sampled).
 
 No framework on purpose: the serving story must not add dependencies
 the training image doesn't have (stdlib ``http.server`` + threads).
@@ -40,9 +46,49 @@ import numpy as np
 
 from ..resilience.inject import plan_from_env
 from ..utils.logging import get_logger
+from ..utils.tracing import format_timing, mint_trace_id
 from .admission import DeadlineExpired, EngineStopped, QueueFull
 
 MAX_BODY_BYTES = 64 * 1024 * 1024  # reject absurd uploads before np.load
+
+_REQUEST_ID_SAFE = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_.")
+
+
+def resolve_request_id(header_value) -> str:
+    """Honor a client-supplied ``X-Request-ID`` (sanitized: the id is
+    echoed into response headers and trace exports) or mint one.  The
+    id doubles as the trace id, so a caller that supplies its own can
+    correlate its logs with /debug/traces."""
+    if header_value:
+        rid = "".join(c for c in header_value.strip()
+                      if c in _REQUEST_ID_SAFE)[:64]
+        if rid:
+            return rid
+    return mint_trace_id()
+
+
+def _query_int(query: str, key: str, default: int) -> int:
+    """One int query field (``?n=20``), tolerant of garbage."""
+    import urllib.parse
+
+    try:
+        return int(urllib.parse.parse_qs(query).get(key, [default])[0])
+    except (ValueError, TypeError):
+        return default
+
+
+def timing_header(request_id, meta) -> str:
+    """The ``X-Timing`` value for a served request: the server-side
+    stage split (ms) from the request's own meta — the exact numbers
+    the latency histograms observed, so client-side e2e reconciles
+    against the server's split without a /debug/traces round trip."""
+    return format_timing(
+        request_id if meta.get("trace_id") else None,
+        {"queue": meta.get("queue_ms", 0.0),
+         "device": meta.get("device_ms", 0.0),
+         "resize": meta.get("resize_ms", 0.0),
+         "e2e": meta.get("e2e_ms", 0.0)})
 
 
 def read_predict_body(handler) -> Optional[bytes]:
@@ -66,7 +112,8 @@ _SLO_FROM_HEADER = object()  # sentinel: parse X-SLO-MS off the request
 
 
 def run_predict(handler, engine, body: bytes, extra_headers=(),
-                slo_ms=_SLO_FROM_HEADER) -> str:
+                slo_ms=_SLO_FROM_HEADER, request_id=None,
+                trace_parent=None) -> str:
     """The whole /predict flow against one engine: decode the .npy
     body, validate the precision arm, submit, wait, respond — including
     the full error→status mapping.  Shared by the single-engine
@@ -132,14 +179,18 @@ def run_predict(handler, engine, body: bytes, extra_headers=(),
                         "error": f"X-SLO-MS {slo!r} is not a number",
                         "kind": "rejected"})
                     return "rejected"
-        fut = engine.submit(image, slo_ms=slo, precision=precision)
+        fut = engine.submit(image, slo_ms=slo, precision=precision,
+                            trace_id=request_id,
+                            trace_parent=trace_parent)
         submitted = True
         pred, meta = fut.result(
             timeout=engine.cfg.serve.request_timeout_s)
         buf = io.BytesIO()
         np.save(buf, pred)
+        timing = ([("X-Timing", timing_header(request_id, meta))]
+                  if request_id else [])
         send(200, buf.getvalue(), "application/x-npy",
-             headers=list(extra_headers) + [
+             headers=list(extra_headers) + timing + [
             # The ladder rung the request was admitted at ("0" stays
             # falsy for the historical binary readers).
             ("X-Degraded", str(meta.get("degraded_level",
@@ -274,7 +325,11 @@ class ServeHandler(JsonHTTPHandler):
     # -- GET -----------------------------------------------------------
 
     def do_GET(self):  # noqa: N802 — http.server API
-        if self.path == "/healthz":
+        import urllib.parse
+
+        split = urllib.parse.urlsplit(self.path)
+        path = split.path
+        if path == "/healthz":
             stats = self.engine.stats
             if stats.healthy and self.engine._running:
                 self._send_json(200, {"status": "ok"})
@@ -282,13 +337,19 @@ class ServeHandler(JsonHTTPHandler):
                 self._send_json(503, {
                     "status": "unhealthy",
                     "reason": stats.health_reason or "engine stopped"})
-        elif self.path == "/metrics":
-            self._send(200, self.engine.stats.render_prometheus().encode(),
+        elif path == "/metrics":
+            # The shared TelemetryRegistry render path — with the one
+            # "serve" provider this is byte-identical to
+            # stats.render_prometheus() (asserted in tests).
+            self._send(200, self.engine.telemetry.render().encode(),
                        "text/plain; version=0.0.4")
-        elif self.path == "/stats":
+        elif path == "/stats":
             self._send_json(200, self.engine.stats.snapshot())
+        elif path == "/debug/traces":
+            self._send_json(200, self.engine.tracer.snapshot(
+                n=_query_int(split.query, "n", 50)))
         else:
-            self._send_json(404, {"error": f"no route {self.path}"})
+            self._send_json(404, {"error": f"no route {path}"})
 
     # -- POST ----------------------------------------------------------
 
@@ -307,8 +368,13 @@ class ServeHandler(JsonHTTPHandler):
         # X-Model on every 200: the single-engine server reports its
         # one model under the same header the fleet router echoes, so
         # loadgen's per-model breakdown works against either front end.
-        run_predict(self, self.engine, body, extra_headers=[
-            ("X-Model", str(self.engine.cfg.model.name))])
+        # X-Request-ID (client-supplied or minted) doubles as the
+        # trace id; X-Timing carries the stage split on every 200.
+        rid = resolve_request_id(self.headers.get("X-Request-ID"))
+        run_predict(self, self.engine, body, request_id=rid,
+                    extra_headers=[
+                        ("X-Model", str(self.engine.cfg.model.name)),
+                        ("X-Request-ID", rid)])
 
 
 class SODServer(ThreadingHTTPServer):

@@ -52,6 +52,8 @@ def fit(
     max_steps: Optional[int] = None,
     hooks: Optional[Dict[str, Callable]] = None,
     profile_dir: Optional[str] = None,
+    telemetry_port: Optional[int] = None,
+    telemetry_port_file: Optional[str] = None,
 ) -> Dict[str, float]:
     """Run the full training loop; returns final scalar metrics.
 
@@ -60,6 +62,14 @@ def fit(
     ``on_chunk_metrics(step, stacked_dict)`` for test instrumentation;
     ``profile_dir`` captures a jax.profiler trace of a short post-warmup
     step window (view in TensorBoard/Perfetto).
+
+    ``telemetry_port`` (overrides ``cfg.telemetry_port``; >= 0 = on,
+    0 = ephemeral) starts the opt-in telemetry sidecar
+    (utils/telemetry.py — /metrics, /healthz off the step watchdog,
+    /debug/traces, on-demand /debug/profile), publishing the bound
+    port atomically to ``telemetry_port_file``.  ``cfg.trace_sample``
+    additionally records per-chunk span timelines
+    (docs/OBSERVABILITY.md).
 
     ``cfg.steps_per_dispatch=k > 1`` folds k steps into one
     ``lax.scan`` dispatch: the loop advances chunk-by-chunk (every
@@ -134,6 +144,13 @@ def fit(
     from ..utils.observability import PipelineStats
 
     data_stats = PipelineStats()
+    # Chunk tracing (utils/tracing.py; docs/OBSERVABILITY.md): sampled
+    # chunks record data_wait/dispatch/flush (+ckpt/eval, + synthetic
+    # build/ring-wait/h2d children from the data-plane counters)
+    # correlated to step numbers.  sample=0 (default): no clock reads.
+    from ..utils.tracing import Tracer, mint_trace_id
+
+    tracer = Tracer(sample=cfg.trace_sample)
     loader = make_loader(
         dataset, cfg.data,
         global_batch_size=cfg.global_batch_size,
@@ -355,6 +372,16 @@ def fit(
     last_metrics: Dict[str, float] = {}
     eval_metrics: Dict[str, float] = {}
     step = start_step
+    # Opt-in telemetry sidecar: READS the objects above (stats, timer,
+    # watchdog heartbeat, tracer, the live ``step``) over stdlib HTTP;
+    # the loop's own behavior is identical with it on or off.
+    from ..utils.telemetry import build_trainer_telemetry
+
+    telemetry = build_trainer_telemetry(
+        cfg, data_stats=data_stats, timer=timer, writer=writer,
+        watchdog=watchdog, tracer=tracer, workdir=workdir,
+        step_fn=lambda: step, port=telemetry_port,
+        port_file=telemetry_port_file)
     # A restore means this step's checkpoint already exists on disk — a
     # zero-progress run must not force-save over it (orbax raises).
     last_saved = resumed_from
@@ -441,14 +468,21 @@ def fit(
         return bool(cfg.checkpoint_every_steps
                     and at_step % cfg.checkpoint_every_steps == 0)
 
-    def _run_state_events(at_step):
+    def _run_state_events(at_step, trace=None):
         """Eval/checkpoint at a boundary — these read the CURRENT state,
         so under chunking they may only run while ``state`` still is the
         state at ``at_step`` (before the next chunk's donated dispatch
-        replaces it)."""
+        replaces it).  ``trace`` (the boundary chunk's open trace dict)
+        gets an eval/ckpt span per event."""
         nonlocal eval_metrics, last_eval_step, last_saved
         if _eval_due(at_step):
+            t_e0 = time.monotonic() if trace else 0.0
             eval_metrics = eval_fn(state)
+            if trace:
+                tracer.record(trace["root"].trace_id, "eval", t_e0,
+                              time.monotonic(),
+                              parent_id=trace["root"].span_id,
+                              attrs={"step": at_step})
             last_eval_step = at_step
             writer.scalars(at_step, {f"eval/{k}": v
                                      for k, v in eval_metrics.items()})
@@ -470,7 +504,13 @@ def fit(
                 last_eval_step = at_step
             # state passed as-is: orbax's async save does the D2H
             # copy behind the next train steps (no device_get stall).
+            t_c0 = time.monotonic() if trace else 0.0
             mgr.save(at_step, state, metrics=eval_metrics or None)
+            if trace:
+                tracer.record(trace["root"].trace_id, "ckpt", t_c0,
+                              time.monotonic(),
+                              parent_id=trace["root"].span_id,
+                              attrs={"step": at_step})
             last_saved = at_step
             if watchdog is not None:
                 watchdog.beat(at_step)
@@ -486,17 +526,43 @@ def fit(
     # otherwise idle the device once per chunk).  Boundaries that need
     # the post-chunk STATE (eval/checkpoint) flush synchronously before
     # the next dispatch instead — donation replaces the state.
-    pending = None  # (end_step, metrics_device, epoch)
+    pending = None  # (end_step, metrics_device, epoch, chunk_trace)
+
+    def _finish_chunk_trace(trace, at_step):
+        """Close a sampled chunk's trace: synthesize the data-plane
+        children (build/ring-wait/h2d durations accumulated by the
+        pipeline THREADS during this chunk, placed at the root's start
+        and tagged synthetic — durations are measured, placement is
+        not), then end the root."""
+        if not trace:
+            return
+        root = trace["root"]
+        snap = data_stats.snapshot()
+        for key, name in (("data_build_wait_ms", "build_wait"),
+                          ("data_ring_wait_ms", "ring_wait"),
+                          ("data_h2d_ms", "h2d")):
+            dur_ms = snap.get(key, 0.0) - trace["snap"].get(key, 0.0)
+            if dur_ms > 0:
+                tracer.record(root.trace_id, name, root.t0,
+                              root.t0 + dur_ms / 1000.0,
+                              parent_id=root.span_id,
+                              attrs={"synthetic": True})
+        root.end(key=("train",), step=at_step)
 
     def _flush_chunk(with_state: bool):
         nonlocal pending, stop
-        at_step, metrics_dev, at_epoch = pending
+        at_step, metrics_dev, at_epoch, trace = pending
         pending = None
         # The fetch cannot return before chunk `at_step` completed, so
         # it doubles as the completed-work signal — the timer/watchdog
         # beat is fed by finished device work, not by dispatch
         # (utils/timing.py).
+        t_f0 = time.monotonic() if trace else 0.0
         metrics_host = jax.device_get(metrics_dev)
+        if trace:
+            tracer.record(trace["root"].trace_id, "flush", t_f0,
+                          time.monotonic(),
+                          parent_id=trace["root"].span_id)
         timer.tick(steps=k)
         if "on_chunk_metrics" in hooks:
             hooks["on_chunk_metrics"](at_step, metrics_host)
@@ -504,8 +570,13 @@ def fit(
         if at_step % cfg.log_every_steps == 0 or at_step == total_steps:
             _process_log(at_step, metrics_host, at_epoch)
         if with_state:
-            _run_state_events(at_step)
+            _run_state_events(at_step, trace=trace)
+        _finish_chunk_trace(trace, at_step)
 
+    # End-of-previous-chunk timestamp: the gap to the next body entry
+    # is the chunk's data_wait span (blocked on the prefetch queue).
+    # Only maintained while tracing is on — sample=0 reads no clocks.
+    t_prev_end = None
     try:
       with PreemptionGuard() as guard:
         for epoch in itertools.count(start_epoch):
@@ -546,15 +617,41 @@ def fit(
                     _flush_chunk(with_state=True)
                     if stop:
                         break
+                # Chunk trace: root spans the data wait + dispatch (+
+                # flush/ckpt/eval recorded where they happen); None
+                # unless this chunk is sampled.
+                chunk_tr = None
+                if tracer.enabled:
+                    t_now = time.monotonic()
+                    root = tracer.begin(
+                        "chunk", mint_trace_id(),
+                        t0=t_prev_end if t_prev_end is not None else t_now,
+                        root=True,
+                        attrs={"step_first": step + 1, "step_last": step + k,
+                               "epoch": epoch})
+                    if root is not None:
+                        chunk_tr = {"root": root,
+                                    "snap": data_stats.snapshot()}
+                        if t_prev_end is not None:
+                            tracer.record(root.trace_id, "data_wait",
+                                          t_prev_end, t_now,
+                                          parent_id=root.span_id)
                 train_step = train_step_at(step)
                 if plan is not None:
                     batch = plan.maybe_poison_batch(step + 1, batch)
+                t_d0 = time.monotonic() if chunk_tr else 0.0
                 if step == profile_at:
                     with profile_window(profile_dir):
                         state, metrics = train_step(state, batch)
                         jax.block_until_ready(metrics["total"])
                 else:
                     state, metrics = train_step(state, batch)
+                if chunk_tr:
+                    # Host-side dispatch time (the device runs async;
+                    # completed-work time shows up in the flush span).
+                    tracer.record(chunk_tr["root"].trace_id, "dispatch",
+                                  t_d0, time.monotonic(),
+                                  parent_id=chunk_tr["root"].span_id)
                 step += k
                 if k > 1:
                     # Lagged flush: observe chunk n only after chunk
@@ -563,7 +660,9 @@ def fit(
                     # gap (see _flush_chunk).
                     if pending is not None:
                         _flush_chunk(with_state=False)
-                    pending = (step, metrics, epoch)
+                    pending = (step, metrics, epoch, chunk_tr)
+                    if tracer.enabled:
+                        t_prev_end = time.monotonic()
                     continue
                 # ---- k == 1: the historical per-step path, unchanged.
                 if plan is not None:
@@ -578,8 +677,17 @@ def fit(
                     # ONE batched device_get for the whole metric dict —
                     # not a blocking float(v) per scalar (each paid a
                     # full host↔device round trip on remote transports).
-                    _process_log(step, jax.device_get(metrics), epoch)
-                _run_state_events(step)
+                    t_f0 = time.monotonic() if chunk_tr else 0.0
+                    metrics_host = jax.device_get(metrics)
+                    if chunk_tr:
+                        tracer.record(chunk_tr["root"].trace_id, "flush",
+                                      t_f0, time.monotonic(),
+                                      parent_id=chunk_tr["root"].span_id)
+                    _process_log(step, metrics_host, epoch)
+                _run_state_events(step, trace=chunk_tr)
+                _finish_chunk_trace(chunk_tr, step)
+                if tracer.enabled:
+                    t_prev_end = time.monotonic()
             if step >= total_steps or stop:
                 break
         if pending is not None:
@@ -599,6 +707,8 @@ def fit(
                 last_eval_step = step
             mgr.save(step, state, metrics=eval_metrics or None, force=True)
     finally:
+        if telemetry is not None:
+            telemetry.stop()
         if watchdog is not None:
             # Idempotent; also covers the exception paths, so the daemon
             # can never outlive fit() and 114 a healthy caller later.

@@ -92,6 +92,40 @@ class PipelineStats:
                 self._depth_n = 0
             return out
 
+    # The documented counter set (every blocking point above plus the
+    # chunk-assembly stage) rendered UNCONDITIONALLY, so the /metrics
+    # family inventory is stable across runs and platforms — a family
+    # that happens to be zero this run must not read as "vanished" to
+    # tools/metrics_lint.py.
+    CANONICAL = ("data_starved_ms", "data_h2d_ms", "data_prefetch_full_ms",
+                 "data_build_wait_ms", "data_ring_wait_ms", "data_batches",
+                 "data_chunk_assemble_ms", "data_chunks",
+                 "data_partial_chunks_dropped")
+
+    def prom_families(self, labels: str = "", prefix: str = "dsod_train_"):
+        """The host-data-plane telemetry as Prometheus families (the
+        trainer sidecar's half of the rendering the serve stack already
+        does through ``ServeStats.prom_families``)."""
+        with self._lock:
+            counts = dict(self._counts)
+            depth = (self._depth_sum / self._depth_n
+                     if self._depth_n else 0.0)
+            size = self._depth_size
+        sb = f"{{{labels}}}" if labels else ""
+        fams = []
+        for key in self.CANONICAL:
+            name = f"{prefix}{key}_total"
+            fams.append((name, "counter",
+                         [f"{name}{sb} {counts.pop(key, 0.0):g}"]))
+        for key in sorted(counts):  # anything non-canonical still shows
+            name = f"{prefix}{key}_total"
+            fams.append((name, "counter",
+                         [f"{name}{sb} {counts[key]:g}"]))
+        for name, v in ((f"{prefix}data_queue_depth_avg", depth),
+                        (f"{prefix}data_queue_size", float(size))):
+            fams.append((name, "gauge", [f"{name}{sb} {v:g}"]))
+        return fams
+
 
 def _merge_labels(*parts: str) -> str:
     """Merge pre-rendered label fragments (``'model="m"'``,
@@ -551,16 +585,75 @@ class ServeStats:
         return render_prom_families(self.prom_families(labels))
 
 
+class TelemetryRegistry:
+    """Named providers of Prometheus families behind ONE render path.
+
+    Both telemetry surfaces — the serve /metrics endpoints and the
+    trainer sidecar — register ``provider(labels) -> families``
+    callables here and render through the same
+    ``merge_prom_families`` + ``render_prom_families`` machinery, so
+    the TYPE-once-per-family discipline (and any future exposition
+    change) cannot drift between the two stacks.  With a single
+    provider the output is byte-identical to rendering that provider
+    directly (merge of one group is the identity).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._providers = []  # (name, provider)
+
+    def register(self, name: str, provider) -> "TelemetryRegistry":
+        """``provider(labels: str) -> [(family, type, samples), ...]``.
+        Registration order is render order."""
+        with self._lock:
+            if any(n == name for n, _p in self._providers):
+                raise ValueError(f"telemetry provider {name!r} already "
+                                 "registered")
+            self._providers.append((name, provider))
+        return self
+
+    def prom_families(self, labels: str = ""):
+        with self._lock:
+            providers = list(self._providers)
+        return merge_prom_families([p(labels) for _n, p in providers])
+
+    def render(self, labels: str = "") -> str:
+        """The /metrics payload (Prometheus text exposition format)."""
+        return render_prom_families(self.prom_families(labels))
+
+
 class MetricWriter:
-    """Rank-0-gated scalar writer over clu.metric_writers."""
+    """Rank-0-gated scalar writer over clu.metric_writers.
+
+    ``backend`` names what is actually writing (``clu`` | ``noop``):
+    when clu is not importable the writer degrades to a LOGGED no-op
+    (once per process, not per construction) instead of a silent one —
+    a run that thinks it is writing TensorBoard curves but isn't is a
+    debugging trap — and the trainer telemetry sidecar surfaces the
+    active backend in /metrics
+    (``dsod_train_metric_writer_info{backend=...}``).
+    """
+
+    _warned_missing_clu = False  # process-wide: log the fallback ONCE
 
     def __init__(self, logdir: Optional[str]):
         self._writer = None
+        self.backend = "noop"
         if logdir and is_primary_process():
-            from clu import metric_writers
-
+            try:
+                from clu import metric_writers
+            except ImportError:
+                if not MetricWriter._warned_missing_clu:
+                    MetricWriter._warned_missing_clu = True
+                    get_logger().warning(
+                        "clu is not installed — TensorBoard metric "
+                        "writing is DISABLED (scalars still stream to "
+                        "the log and the telemetry sidecar); pip "
+                        "install clu to restore event files")
+                return
             self._writer = metric_writers.create_default_writer(
                 logdir, asynchronous=True)
+            self.backend = "clu"
 
     def scalars(self, step: int, values: Dict[str, float]) -> None:
         if self._writer is not None:

@@ -1,0 +1,309 @@
+"""Opt-in trainer telemetry sidecar (docs/OBSERVABILITY.md).
+
+Until now a running fit() exposed nothing at runtime except log lines
+and a fixed-step ``profile_window`` — debugging "why is step time
+noisy on host 3" meant killing the run.  The sidecar is the serving
+stack's introspection surface, grafted onto training:
+
+- ``GET /metrics``  — Prometheus text: PipelineStats (host data
+  plane), StepTimer (windowed step time / throughput), device memory,
+  the MetricWriter backend, all rendered through the SAME
+  ``TelemetryRegistry`` + ``prom_families`` machinery the serve
+  endpoints use (one exposition code path for both stacks).
+- ``GET /healthz``  — fed by the PR-1 step watchdog's OWN heartbeat
+  (``seconds_since_beat``): 200 while chunks complete, 503 once the
+  watchdog fired (on its default policy the process exits 114 anyway;
+  tests run with an ``on_stall`` observer).
+- ``GET /debug/traces`` — the train loop's sampled chunk span
+  timelines (utils/tracing.py).
+- ``GET /debug/profile?seconds=N`` — arm ``jax.profiler`` ON DEMAND
+  for an N-second window instead of only at a pre-configured step:
+  the handler blocks for the window (the HTTP server is threaded;
+  /metrics stays live) and answers with the dump directory.
+
+Opt-in and additive: ``telemetry_port=-1`` (the default) starts no
+thread and binds no socket; the train loop's behavior is untouched
+either way (the sidecar only ever READS the objects the loop already
+maintains).  Stdlib HTTP only — the training image gains no
+dependency, and the port file publish reuses the serving stack's
+atomic ``publish_port``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .logging import get_logger
+from .observability import TelemetryRegistry
+from .tracing import Tracer
+
+# Profile windows any longer would mostly measure the requester's
+# patience; jax.profiler dumps also grow linearly with the window.
+MAX_PROFILE_SECONDS = 120.0
+
+
+def trainer_prom_families(*, data_stats, timer, batch_size: int,
+                          writer_backend: str = "noop",
+                          step_fn: Optional[Callable[[], int]] = None,
+                          tracer: Optional[Tracer] = None,
+                          device_memory: bool = True):
+    """The trainer's /metrics families.  ONE function builds them — the
+    sidecar renders it live, tools/metrics_lint.py renders it
+    synthetically — so the inventory a lint checks and the surface a
+    run exposes cannot drift.
+
+    Families are emitted UNCONDITIONALLY (zero-valued when idle / on
+    platforms without ``memory_stats``) so the family inventory is
+    stable across runs and platforms.
+    """
+    fams = list(data_stats.prom_families())
+    snap = timer.snapshot()
+    step = int(step_fn()) if step_fn is not None else 0
+    mean_ms = snap["mean_step_ms"]
+    imgs = (batch_size / (mean_ms / 1000.0)) if mean_ms > 0 else 0.0
+    gauges = [
+        ("dsod_train_step", step),
+        ("dsod_train_step_time_ms", mean_ms),
+        ("dsod_train_imgs_per_sec", round(imgs, 3)),
+    ]
+    counters = [("dsod_train_chunks_total", snap["ticks"])]
+    if tracer is not None:
+        counters.append(("dsod_train_traces_total",
+                         tracer.completed_total))
+    for name, v in gauges:
+        fams.append((name, "gauge", [f"{name} {v:g}"]))
+    for name, v in counters:
+        fams.append((name, "counter", [f"{name} {v:g}"]))
+    # Device memory: the two stable keys every jax memory_stats()
+    # implementation reports (TPU/GPU); 0 where the platform has none
+    # (CPU) so the family set does not depend on the platform.
+    in_use, peak = [], []
+    devices = []
+    if device_memory:
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:  # noqa: BLE001 — no backend: render zeros
+            devices = []
+    for d in devices:
+        try:
+            ms = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — platform without the API
+            ms = {}
+        lbl = f'device="{d.id}"'
+        in_use.append('dsod_train_device_bytes_in_use{%s} %d'
+                      % (lbl, int(ms.get("bytes_in_use", 0))))
+        peak.append('dsod_train_device_peak_bytes_in_use{%s} %d'
+                    % (lbl, int(ms.get("peak_bytes_in_use", 0))))
+    if not devices:
+        in_use = ['dsod_train_device_bytes_in_use{device="0"} 0']
+        peak = ['dsod_train_device_peak_bytes_in_use{device="0"} 0']
+    fams.append(("dsod_train_device_bytes_in_use", "gauge", in_use))
+    fams.append(("dsod_train_device_peak_bytes_in_use", "gauge", peak))
+    # Which scalar backend is actually writing (the MetricWriter
+    # clu-missing fallback is visible here, not just in one log line).
+    fams.append(("dsod_train_metric_writer_info", "gauge", [
+        'dsod_train_metric_writer_info{backend="%s"} 1' % writer_backend]))
+    return fams
+
+
+class TrainerTelemetry:
+    """The sidecar server.  Construct with live references, ``start()``
+    after the watchdog exists, ``stop()`` in the train loop's finally.
+
+    ``registry`` is the :class:`TelemetryRegistry` to render at
+    /metrics; ``watchdog`` (may be None = not armed) feeds /healthz;
+    ``tracer`` backs /debug/traces; ``profile_dir`` roots the
+    on-demand profiler dumps.
+    """
+
+    def __init__(self, registry: TelemetryRegistry, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 port_file: Optional[str] = None, watchdog=None,
+                 tracer: Optional[Tracer] = None,
+                 profile_dir: Optional[str] = None):
+        self.registry = registry
+        self.watchdog = watchdog
+        self.tracer = tracer
+        self.profile_dir = profile_dir or "."
+        self._host = host
+        self._port = int(port)
+        self._port_file = port_file
+        self._srv = None
+        self._thread: Optional[threading.Thread] = None
+        self._profile_lock = threading.Lock()
+        self._log = get_logger()
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        return self._srv.server_address[1] if self._srv else None
+
+    def start(self) -> "TrainerTelemetry":
+        if self._srv is not None:
+            return self
+        # Imported here, not at module top: the handler plumbing and
+        # the atomic port-file publish are the serving stack's — one
+        # implementation of each — but a fit() without telemetry must
+        # not pay the serve imports.
+        from ..serve.server import (JsonHTTPHandler, ThreadingHTTPServer,
+                                    publish_port)
+
+        telemetry = self
+
+        class _Handler(JsonHTTPHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                telemetry._handle_get(self)
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._srv = _Server((self._host, self._port), _Handler)
+        publish_port(self._port_file, self._srv.server_address[1])
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="trainer-telemetry",
+            daemon=True)
+        self._thread.start()
+        self._log.info(
+            "telemetry: sidecar listening on http://%s:%d "
+            "(/metrics /healthz /debug/traces /debug/profile)",
+            self._host, self._srv.server_address[1])
+        return self
+
+    def stop(self) -> None:
+        if self._srv is None:
+            return
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._srv = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- request handling ----------------------------------------------
+
+    def _handle_get(self, handler) -> None:
+        import urllib.parse
+
+        split = urllib.parse.urlsplit(handler.path)
+        path = split.path
+        if path == "/metrics":
+            handler._send(200, self.registry.render().encode(),
+                          "text/plain; version=0.0.4")
+        elif path == "/healthz":
+            code, body = self._health()
+            handler._send_json(code, body)
+        elif path == "/debug/traces":
+            from ..serve.server import _query_int
+
+            n = _query_int(split.query, "n", 50)
+            if self.tracer is None:
+                handler._send_json(200, {"sample": 0.0, "traces": [],
+                                         "worst": {}})
+            else:
+                handler._send_json(200, self.tracer.snapshot(n))
+        elif path == "/debug/profile":
+            self._handle_profile(handler, split.query)
+        else:
+            handler._send_json(404, {"error": f"no route {path}"})
+
+    def _health(self):
+        wd = self.watchdog
+        if wd is None:
+            # No watchdog armed: the sidecar answering at all proves
+            # the process lives; say so honestly instead of inventing
+            # a liveness signal the loop is not feeding.
+            return 200, {"status": "ok", "watchdog": "off"}
+        if wd.fired:
+            return 503, {"status": "stalled", "watchdog": "fired",
+                         "last_step": wd.last_step}
+        age = wd.seconds_since_beat()
+        return 200, {"status": "ok",
+                     "last_beat_s": round(age, 3) if age is not None
+                     else None,
+                     "last_step": wd.last_step}
+
+    def _handle_profile(self, handler, query: str) -> None:
+        import urllib.parse
+
+        q = urllib.parse.parse_qs(query)
+        try:
+            seconds = float((q.get("seconds") or ["2"])[0])
+        except ValueError:
+            handler._send_json(400, {"error": "seconds must be a number"})
+            return
+        if not 0 < seconds <= MAX_PROFILE_SECONDS:
+            handler._send_json(400, {
+                "error": f"seconds must be in (0, {MAX_PROFILE_SECONDS:g}]"
+            })
+            return
+        if not self._profile_lock.acquire(blocking=False):
+            handler._send_json(409, {"error": "a profile window is "
+                                              "already armed"})
+            return
+        try:
+            import jax
+
+            logdir = os.path.join(
+                self.profile_dir, f"profile_ondemand_{int(time.time())}")
+            try:
+                jax.profiler.start_trace(logdir)
+            except Exception as e:  # noqa: BLE001 — e.g. profiler busy
+                handler._send_json(409, {
+                    "error": f"profiler unavailable: {e}"})
+                return
+            # Block THIS handler thread for the window (the server is
+            # threaded — /metrics and /healthz stay live meanwhile),
+            # then answer with the dump path: the caller knows the
+            # trace is complete the moment the response lands.
+            stop_err = None
+            try:
+                time.sleep(seconds)
+            finally:
+                # stop_trace ALWAYS runs (and its own failure must not
+                # escape): a started-but-never-stopped trace wedges
+                # jax's profiler for the life of the process — every
+                # later window (on-demand or profile_window) would 409.
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    stop_err = e
+            if stop_err is not None:
+                self._log.warning("telemetry: profiler stop failed: %s",
+                                  stop_err)
+                handler._send_json(500, {
+                    "error": f"profiler stop failed: {stop_err}"})
+                return
+            self._log.info("telemetry: on-demand profile (%.1fs) "
+                           "written to %s", seconds, logdir)
+            handler._send_json(200, {"logdir": logdir,
+                                     "seconds": seconds})
+        finally:
+            self._profile_lock.release()
+
+
+def build_trainer_telemetry(cfg, *, data_stats, timer, writer,
+                            watchdog=None, tracer=None, workdir=None,
+                            step_fn=None, port: Optional[int] = None,
+                            port_file: Optional[str] = None
+                            ) -> Optional[TrainerTelemetry]:
+    """fit()'s one-call bring-up: None when telemetry is off
+    (``cfg.telemetry_port < 0`` and no explicit ``port``)."""
+    eff_port = cfg.telemetry_port if port is None else port
+    if eff_port is None or eff_port < 0:
+        return None
+    registry = TelemetryRegistry().register(
+        "trainer", lambda labels="": trainer_prom_families(
+            data_stats=data_stats, timer=timer,
+            batch_size=cfg.global_batch_size,
+            writer_backend=writer.backend, step_fn=step_fn,
+            tracer=tracer))
+    return TrainerTelemetry(
+        registry, host="127.0.0.1", port=eff_port, port_file=port_file,
+        watchdog=watchdog, tracer=tracer, profile_dir=workdir).start()

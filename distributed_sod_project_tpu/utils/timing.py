@@ -63,3 +63,16 @@ class StepTimer:
         is the per-STEP global batch (not the chunk total)."""
         st = self.mean_step_time
         return batch_size / st if st == st and st > 0 else float("nan")
+
+    @property
+    def ticks(self) -> int:
+        """Completed-work observations so far (chunks, not steps)."""
+        return self._count
+
+    def snapshot(self) -> dict:
+        """Telemetry-sidecar view: windowed per-step time and tick
+        count (NaN-free — 0.0 before the window fills, so Prometheus
+        samples stay parseable)."""
+        st = self.mean_step_time
+        return {"ticks": self._count,
+                "mean_step_ms": round(st * 1000.0, 3) if st == st else 0.0}

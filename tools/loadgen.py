@@ -70,6 +70,13 @@ def parse_args(argv=None):
                         "(model, tenant) from the mix; the summary "
                         "breaks p50/p95/p99 down per SERVED model, so "
                         "the fleet's mixed-model curve is one command")
+    p.add_argument("--slowest", type=int, default=0,
+                   help="report the N slowest OK responses with their "
+                        "request/trace ids and the server-side stage "
+                        "breakdown from X-Timing (queue/device/resize/"
+                        "e2e ms) — a sampled row's trace id keys into "
+                        "the server's /debug/traces "
+                        "(docs/OBSERVABILITY.md)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=60.0,
                    help="per-request client timeout seconds")
@@ -106,7 +113,8 @@ def main(argv=None) -> int:
         requests=args.requests, rps=args.rps, duration_s=args.duration,
         sizes=sizes, seed=args.seed, slo_ms=args.slo_ms,
         timeout_s=args.timeout, precision=args.precision,
-        model=args.model, tenant=args.tenant, mix=mix)
+        model=args.model, tenant=args.tenant, mix=mix,
+        slowest=args.slowest)
     if args.server_stats:
         try:
             summary["server"] = fetch_stats(url)

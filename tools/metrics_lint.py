@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Metrics-family inventory lint — catch telemetry-surface drift at t1
+time (hlo_guard discipline: one JSON line, ``--update-baseline``, exit
+2 on undocumented or vanished families).
+
+Dashboards, alerts, and the agenda scripts key on metric FAMILY names
+(``dsod_serve_e2e_latency_ms``, ``dsod_fleet_routed_total``,
+``dsod_train_data_starved_ms_total``, …).  A renamed or dropped family
+breaks them silently — Prometheus happily scrapes whatever is there.
+This tool renders the full family surface of BOTH stacks and diffs the
+``{family: type}`` inventory against the checked-in
+``tools/metrics_inventory.json``:
+
+- ``fleet``   — the aggregated fleet /metrics (router families, replica
+  up/breaker gauges, every ServeStats family incl. the per-arm ones),
+  rendered in-process from synthetically POPULATED stats objects: the
+  inventory needs every lazily-created family (arms, hedges, …) to
+  exist, and standing up real engines would cost AOT compiles for a
+  name check.  The construction goes through the real ``Fleet``
+  aggregation code path, so renames there are caught too.
+- ``trainer`` — the trainer sidecar /metrics via the SAME
+  ``trainer_prom_families`` function the sidecar serves (one renderer,
+  no drift by construction).
+
+``--url URL`` (repeatable) instead scrapes live endpoints and lints
+their families against the union inventory — the form the TPU agenda
+runs against a real fleet + trainer sidecar.
+
+Usage:
+    python tools/metrics_lint.py                     # print delta line
+    python tools/metrics_lint.py --update-baseline   # re-seed the file
+    python tools/metrics_lint.py --url http://127.0.0.1:8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "metrics_inventory.json")
+
+
+def _family_types(families) -> dict:
+    return {name: typ for name, typ, _samples in families}
+
+
+def fleet_inventory() -> dict:
+    """Render the aggregated fleet /metrics surface from populated
+    stats objects through the real Fleet aggregation path."""
+    from distributed_sod_project_tpu.serve.fleet import Fleet
+    from distributed_sod_project_tpu.utils.observability import ServeStats
+
+    stats = ServeStats()
+    for key in ServeStats.COUNTERS:
+        stats.inc(key)
+    stats.observe_batch(1, 2, arm="f32")
+    stats.set_queue_depth(1)
+    stats.set_inflight(1)
+    stats.set_degraded(1)
+    for h in (stats.queue_ms, stats.device_ms, stats.e2e_ms):
+        h.observe(1.0)
+    arm = stats.arm("f32")
+    arm.inc_served()
+    arm.device_ms.observe(1.0)
+    arm.e2e_ms.observe(1.0)
+
+    class _StubBackend:
+        """Metric-surface stand-in for one replica: real ServeStats
+        families, no engine (the inventory is a NAME check — an AOT
+        warmup would buy nothing)."""
+
+        kind = "stub"
+        name = "m"
+
+        def healthy(self):
+            return True
+
+        def prom_families(self, labels):
+            return stats.prom_families(labels)
+
+        def stats_snapshot(self):
+            return stats.snapshot()
+
+        def debug_traces(self, n=50):
+            return {}
+
+        def describe(self):
+            return {"kind": self.kind}
+
+    fleet = Fleet([_StubBackend()])
+    r = fleet.rstats
+    r.inc_submitted("default")
+    r.inc_shed("default", "budget")
+    r.inc_routed("m")
+    r.inc_retry("m")
+    r.inc_hedge("m")
+    r.inc_failover("m")
+    r.inc_response("default", "ok")
+    from distributed_sod_project_tpu.utils.observability import \
+        parse_prom_text
+
+    return _family_types(parse_prom_text(fleet.metrics_text()))
+
+
+def trainer_inventory() -> dict:
+    """Render the trainer sidecar /metrics surface via the function the
+    sidecar itself serves."""
+    from distributed_sod_project_tpu.utils.observability import \
+        PipelineStats
+    from distributed_sod_project_tpu.utils.telemetry import \
+        trainer_prom_families
+    from distributed_sod_project_tpu.utils.timing import StepTimer
+    from distributed_sod_project_tpu.utils.tracing import Tracer
+
+    stats = PipelineStats()
+    for key in PipelineStats.CANONICAL:
+        stats.add(key, 1.0)
+    stats.observe_depth(1, 2)
+    timer = StepTimer(warmup=0)
+    timer.tick()
+    timer.tick()
+    fams = trainer_prom_families(
+        data_stats=stats, timer=timer, batch_size=8,
+        writer_backend="noop", step_fn=lambda: 1,
+        tracer=Tracer(sample=1.0), device_memory=False)
+    return _family_types(fams)
+
+
+def scrape_inventory(url: str) -> dict:
+    from distributed_sod_project_tpu.utils.observability import \
+        parse_prom_text
+
+    with urllib.request.urlopen(url.rstrip("/") + "/metrics",
+                                timeout=10) as r:
+        return _family_types(parse_prom_text(r.read().decode()))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--baseline", default=_BASELINE)
+    p.add_argument("--update-baseline", action="store_true")
+    p.add_argument("--url", action="append", default=[],
+                   help="scrape a live /metrics instead of the "
+                        "in-process synthetic render (repeatable; "
+                        "lints against the union inventory)")
+    args = p.parse_args(argv)
+
+    if args.url:
+        live = {}
+        for u in args.url:
+            live.update(scrape_inventory(u))
+        sections = {"live": live}
+    else:
+        sections = {"fleet": fleet_inventory(),
+                    "trainer": trainer_inventory()}
+
+    baseline = None
+    if os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+
+    if args.update_baseline or baseline is None:
+        if args.url:
+            print("metrics_lint: refusing to seed the baseline from a "
+                  "live scrape (the synthetic render is the canonical "
+                  "surface; run without --url)", file=sys.stderr)
+            return 1
+        with open(args.baseline, "w") as f:
+            json.dump(sections, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps({
+            "metric": "metrics_inventory",
+            "families": {s: len(v) for s, v in sections.items()},
+            "recorded": True,
+        }), flush=True)
+        return 0
+
+    base_union = {}
+    for sec in baseline.values():
+        base_union.update(sec)
+    rc = 0
+    report = {"metric": "metrics_inventory",
+              "families": {s: len(v) for s, v in sections.items()}}
+    undocumented, vanished, retyped = {}, {}, {}
+    for sec, inv in sections.items():
+        base = base_union if args.url else baseline.get(sec, {})
+        extra = sorted(set(inv) - set(base))
+        if extra:
+            undocumented[sec] = extra
+        if not args.url:
+            gone = sorted(set(base) - set(inv))
+            if gone:
+                vanished[sec] = gone
+        changed = sorted(n for n in set(inv) & set(base)
+                         if inv[n] != base[n])
+        if changed:
+            retyped[sec] = changed
+    if undocumented:
+        report["undocumented"] = undocumented
+        rc = 2
+    if vanished:
+        report["vanished"] = vanished
+        rc = 2
+    if retyped:
+        report["retyped"] = retyped
+        rc = 2
+    report["delta"] = 0 if rc == 0 else sum(
+        len(v) for d in (undocumented, vanished, retyped)
+        for v in d.values())
+    print(json.dumps(report), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

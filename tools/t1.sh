@@ -34,6 +34,9 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/serve_smoke.py --precision 
 echo "== precision quality gate: per-arm max-Fbeta/MAE deltas vs f32 on the tiny synthetic set (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/precision_gate.py \
   || echo "precision gate smoke failed (non-gating; --fail-on-increase gates locally)"
+echo "== metrics-family inventory lint: fleet + trainer /metrics surfaces vs tools/metrics_inventory.json (recorded, non-gating) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_lint.py \
+  || echo "metrics lint failed (non-gating; --update-baseline re-seeds after an INTENDED surface change)"
 echo "== fleet smoke: real-process router + remote replica, mixed-tenant loadgen, SIGKILL-mid-fleet degraded health, fleet accounting, clean SIGTERM drain (recorded, non-gating) =="
 timeout -k 10 720 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py \
   || echo "fleet smoke failed (non-gating; tests/test_fleet.py below gates the in-process side)"

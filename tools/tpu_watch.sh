@@ -98,8 +98,8 @@ case "$1" in
     ;;
 esac
 
-AGENDA=${AGENDA:-tools/tpu_agenda_r10.sh}
-RDIR=${RDIR:-tpu_results10}
+AGENDA=${AGENDA:-tools/tpu_agenda_r11.sh}
+RDIR=${RDIR:-tpu_results11}
 mkdir -p "$RDIR"
 MAX_HOURS=${MAX_HOURS:-11}
 MAX_FIRINGS=${MAX_FIRINGS:-3}

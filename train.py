@@ -46,6 +46,15 @@ def parse_args(argv=None):
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace of a post-warmup "
                         "step window into this directory")
+    p.add_argument("--telemetry-port", type=int, default=None,
+                   help="start the trainer telemetry sidecar on this "
+                        "port (0 = ephemeral): /metrics, /healthz, "
+                        "/debug/traces, /debug/profile?seconds=N "
+                        "(docs/OBSERVABILITY.md; overrides "
+                        "cfg.telemetry_port)")
+    p.add_argument("--telemetry-port-file", default=None,
+                   help="write the sidecar's bound port here once "
+                        "listening (atomic, for scripts)")
     p.add_argument("--eval-every", type=int, default=None,
                    help="run held-out eval every N steps (overrides "
                         "config eval_every_steps)")
@@ -102,7 +111,9 @@ def main(argv=None):
         cfg = cfg.replace(eval_every_steps=args.eval_every)
 
     metrics = fit(cfg, workdir=args.workdir, resume=args.resume,
-                  max_steps=args.max_steps, profile_dir=args.profile_dir)
+                  max_steps=args.max_steps, profile_dir=args.profile_dir,
+                  telemetry_port=args.telemetry_port,
+                  telemetry_port_file=args.telemetry_port_file)
     print({k: round(v, 4) if isinstance(v, float) else v
            for k, v in metrics.items()})
     return 0
