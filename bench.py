@@ -482,7 +482,8 @@ def _run(args):
         step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
                                remat=cfg.model.remat,
                                remat_policy=cfg.model.remat_policy,
-                               steps_per_dispatch=k_spd)
+                               steps_per_dispatch=k_spd,
+                               health=cfg.health_numerics)
         if k_spd > 1:
             # One resident k-stacked batch; each timed "step" below is
             # one dispatch = k train steps (the A/B isolates dispatch

@@ -278,6 +278,43 @@ class ServeConfig:
     trace_sample: float = 0.01
     trace_capacity: int = 256
     trace_worst_n: int = 4
+    # -- model-health quality/drift monitors (serve/quality.py;
+    #    docs/OBSERVABILITY.md "Model health").  All OFF by default:
+    #    with quality_monitor=false the request hot path pays nothing
+    #    and /metrics is byte-identical to the monitor-less rendering.
+    # Master switch: per-request output statistics (foreground
+    # fraction, mean confidence, boundary entropy) + input/output
+    # drift histograms with PSI vs a checked-in reference
+    # (tools/quality_reference.json), under model=/arm= labels.
+    quality_monitor: bool = False
+    # Fraction of non-f32 responses re-scored on the f32 reference arm
+    # (shadow scoring): live arm-vs-f32 disagreement gauges turn the
+    # offline tools/precision_gate.py budget into a continuous online
+    # check.  Deterministic counter sampling; requires "f32" among
+    # precision_arms; shadow forwards run on a bounded side lane and
+    # DROP (counted) rather than queue behind live traffic.
+    quality_shadow_sample: float = 0.0
+    # Reference-histogram file for PSI drift ("" = the checked-in
+    # tools/quality_reference.json when it has an entry for this
+    # model; no reference = drift gauges idle, stats still collected).
+    quality_reference: str = ""
+    # Default alert budgets (utils/alerts.py; wired when the monitor
+    # is on): shadow mean-abs-disagreement budget, PSI drift bound,
+    # and the hysteresis dwells of the built-in quality rules.
+    quality_shadow_budget: float = 0.02
+    quality_psi_threshold: float = 0.25
+    # Minimum online-histogram observations before a PSI verdict is
+    # rendered at all: one request is not drift evidence, and an
+    # unwarmed histogram scored against a reference reads as a huge
+    # (false) shift.  Below the floor the drift gauges stay absent
+    # and quality_psi_max reports 0 (no verdict).
+    quality_psi_min_count: int = 64
+    quality_alert_for_s: float = 5.0
+    quality_alert_clear_s: float = 10.0
+    # Extra alert rules, colon DSL ("name:signal:kind:value[:for[:clear]]"
+    # — comma-free so --set tuple coercion passes them through); they
+    # join the built-in quality rules when the monitor is on.
+    alert_rules: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -566,6 +603,28 @@ class ExperimentConfig:
     # (data-wait/dispatch/flush + ckpt/eval spans correlated to step
     # numbers — utils/tracing.py).  0 = off (no per-chunk clock reads).
     trace_sample: float = 0.0
+    # -- training numerics telemetry (utils/modelhealth.py;
+    #    docs/OBSERVABILITY.md "Model health").  OFF by default: the
+    #    compiled step and the metric stream are byte-for-byte the
+    #    historical ones.  On, every step additionally emits per-
+    #    parameter-group gradient norms, non-finite PROVENANCE (which
+    #    group first went NaN — skip_nonfinite counts but cannot
+    #    attribute), and the update/weight ratio; the host aggregates
+    #    them into dsod_health_* sidecar families and feeds the alert
+    #    engine (utils/alerts.py, /alerts on the sidecar).
+    health_numerics: bool = False
+    # Extra alert rules (colon DSL, see serve.alert_rules) joining the
+    # built-in numerics set (nonfinite / grad-norm-z / loss-z).
+    health_alert_rules: Tuple[str, ...] = ()
+    # Clear dwell of the built-in numerics rules: how long the signal
+    # must stay healthy before an alert resolves (hysteresis).
+    health_alert_clear_s: float = 30.0
+    # Opt-in hand-off to the PR-1 resilience supervisor: when a
+    # rollback-hinted alert (numerics_nonfinite) FIRES, fit() raises
+    # the divergence RuntimeError the supervisor's rollback-and-retry
+    # policy recognizes — the alert engine becomes a rollback hint,
+    # not just a dashboard.  Off: alerts only report.
+    health_rollback_hint: bool = False
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

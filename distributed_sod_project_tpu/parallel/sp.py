@@ -43,7 +43,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..losses.ssim import _C1, _C2, _blur, gaussian_window
 from ..train.state import TrainState
-from ..train.step import apply_update, maybe_remat, notfinite_count
+from ..train.step import (apply_update, maybe_health_metrics, maybe_remat,
+                          notfinite_count)
 from .ring_attention import ring_attention
 from ..utils.compat import axis_size, shard_map
 
@@ -258,6 +259,7 @@ def make_sp_train_step(
     remat: bool = False,
     remat_policy: str = "none",
     steps_per_dispatch: int = 1,
+    health: bool = False,
     _always_scan: bool = False,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
               Tuple[TrainState, Dict[str, jnp.ndarray]]]:
@@ -343,6 +345,8 @@ def make_sp_train_step(
                                  ema_decay=ema_decay)
         metrics = dict(comps)
         metrics["grad_norm"] = optax.global_norm(grads)
+        maybe_health_metrics(metrics, state.params, grads,
+                             new_state.params, health)
         nfc = notfinite_count(new_state.opt_state)
         if nfc is not None:
             metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)

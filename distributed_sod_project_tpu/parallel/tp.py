@@ -217,6 +217,7 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
                        donate_batch: bool = False,
                        remat: bool = False, remat_policy: str = "none",
                        steps_per_dispatch: int = 1,
+                       health: bool = False,
                        _always_scan: bool = False):
     """Build the GSPMD train step: ``(state, batch) -> (state, metrics)``.
 
@@ -239,8 +240,8 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
 
     from ..losses import deep_supervision_loss
     from ..train.step import (_loss_kwargs, apply_update, chunk_batch_spec,
-                              chunked_step_fn, maybe_remat,
-                              notfinite_count, rescale_batch,
+                              chunked_step_fn, maybe_health_metrics,
+                              maybe_remat, notfinite_count, rescale_batch,
                               resolve_remat_policy)
     from .mesh import batch_sharding, batch_spec
 
@@ -273,6 +274,8 @@ def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
                                  ema_decay=ema_decay)
         metrics = dict(comps)
         metrics["grad_norm"] = optax.global_norm(grads)
+        maybe_health_metrics(metrics, state.params, grads,
+                             new_state.params, health)
         nfc = notfinite_count(new_state.opt_state)
         if nfc is not None:
             metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)

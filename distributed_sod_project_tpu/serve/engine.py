@@ -120,6 +120,52 @@ class InferenceEngine:
         self.precision_arms = validate_arms(sc.precision_arms, sc.precision)
         self.default_precision = sc.precision
 
+        # Online quality/drift monitors + alert engine (serve/quality.py,
+        # utils/alerts.py; docs/OBSERVABILITY.md "Model health").  Both
+        # None unless serve.quality_monitor — every touch on the request
+        # path guards on that, and with them off the telemetry registry
+        # holds the one "serve" provider, so /metrics stays
+        # byte-identical to the monitor-less rendering.
+        self.quality = None
+        self.alerts = None
+        self._next_alert_eval = 0.0
+        if not sc.quality_monitor:
+            # Loudness: a monitor-scoped knob set while the monitor is
+            # off would be silently ignored — the operator believes
+            # online validation is running when nothing is.
+            if sc.quality_shadow_sample > 0:
+                raise ValueError(
+                    "serve.quality_shadow_sample > 0 requires "
+                    "serve.quality_monitor=true (shadow scoring is part "
+                    "of the quality monitor)")
+            if sc.alert_rules:
+                raise ValueError(
+                    "serve.alert_rules set but serve.quality_monitor is "
+                    "false — the serving alert engine only runs with the "
+                    "monitor on")
+        if sc.quality_monitor:
+            from ..utils.alerts import AlertEngine, parse_rules
+            from .quality import (QualityMonitor, default_quality_rules,
+                                  load_reference)
+
+            if sc.quality_shadow_sample > 0 and \
+                    "f32" not in self.precision_arms:
+                raise ValueError(
+                    "serve.quality_shadow_sample > 0 needs the f32 "
+                    "reference arm among serve.precision_arms — shadow "
+                    "scoring re-scores sampled requests on f32")
+            self.quality = QualityMonitor(
+                cfg.model.name,
+                shadow_sample=sc.quality_shadow_sample,
+                reference=load_reference(sc.quality_reference,
+                                         cfg.model.name),
+                psi_min_count=sc.quality_psi_min_count)
+            self.alerts = AlertEngine(
+                default_quality_rules(sc) + parse_rules(sc.alert_rules),
+                clock=clock)
+            self.telemetry.register("quality", self.quality.prom_families)
+            self.telemetry.register("alerts", self.alerts.prom_families)
+
         self._template = state if hasattr(state, "eval_variables") else None
         variables = (state.eval_variables()
                      if self._template is not None else state)
@@ -169,6 +215,11 @@ class InferenceEngine:
         self._watchdog = None
         self._fetch_pool = None
         self._post_pool = None
+        # Shadow-scoring side lane: one worker, at most 2 queued+running
+        # (try-acquire — a busy lane DROPS, counted, never queues live
+        # traffic behind reference forwards).
+        self._shadow_pool = None
+        self._shadow_sem = threading.BoundedSemaphore(2)
 
     # -- precision arms ------------------------------------------------
 
@@ -216,6 +267,9 @@ class InferenceEngine:
         self._post_pool = ThreadPoolExecutor(
             max_workers=max(sc.post_workers, 1),
             thread_name_prefix="serve-post")
+        if self.quality is not None and sc.quality_shadow_sample > 0:
+            self._shadow_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="serve-shadow")
         if sc.watchdog_deadline_s > 0:
             from ..resilience.watchdog import StepWatchdog
 
@@ -285,6 +339,9 @@ class InferenceEngine:
         if self._post_pool is not None:
             self._post_pool.shutdown(wait=True)
             self._post_pool = None
+        if self._shadow_pool is not None:
+            self._shadow_pool.shutdown(wait=True)
+            self._shadow_pool = None
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
@@ -381,6 +438,18 @@ class InferenceEngine:
             res = self.choose_res_bucket(arr.shape[0], arr.shape[1],
                                          level > self._n_precision_rungs)
             tensor = preprocess_image(arr, res, self._mean, self._std)
+            if self.quality is not None:
+                # Input drift histogram (serve/quality.py) — one mean()
+                # over an image preprocess already walked.  Guarded
+                # separately from the validation above: a monitor bug
+                # (or a NaN-poisoned but servable input) may only cost
+                # telemetry, never the request.
+                try:
+                    from .quality import input_mean01
+
+                    self.quality.observe_input(input_mean01(arr))
+                except Exception:  # noqa: BLE001
+                    self._log.exception("serve: quality monitor failed")
         except Exception:
             # Malformed input / unknown arm: terminate the request in
             # the accounting (the engine owns ALL terminal counters, so
@@ -438,6 +507,15 @@ class InferenceEngine:
         self.stats.set_queue_depth(depth)
         self.admission.observe(depth)
         self.stats.set_degraded(self.admission.level)
+        if self.alerts is not None:
+            # Throttled quality→alert evaluation rides the dispatch
+            # loop's existing observe point (the fleet loop spins this
+            # at ms cadence; the rules only need ~1 Hz).
+            now = self._clock()
+            if now >= self._next_alert_eval:
+                self._next_alert_eval = now + 1.0
+                sigs, details = self.quality.signals()
+                self.alerts.evaluate(sigs, now=now, details=details)
         return depth
 
     def _dispatch_once(self, blocking: bool = True) -> bool:
@@ -661,6 +739,80 @@ class InferenceEngine:
             self.stats.inc("errors")
             self._trace_end(r, "error")
             self._fail(r, e)
+            return
+        if self.quality is not None:
+            # Quality monitors run AFTER the future resolved: the
+            # response never waits on stats, and a monitor bug can
+            # only cost telemetry, not a request.
+            try:
+                self.quality.observe_output(row)
+                # Shadow only non-f32, non-TTA responses (a TTA row
+                # vs a plain f32 forward would measure TTA, not the
+                # arm) — the sampler sees every eligible response.
+                if (r.precision != "f32" and not meta.get("tta")
+                        and self.quality.should_shadow()):
+                    self._submit_shadow(r.tensor, row, meta)
+            except Exception:  # noqa: BLE001 — telemetry must not throw
+                self._log.exception("serve: quality monitor failed")
+
+    # -- shadow scoring (serve/quality.py) ------------------------------
+
+    def _submit_shadow(self, tensor: np.ndarray, row: np.ndarray,
+                       meta: dict) -> None:
+        """Queue one arm-vs-f32 shadow score on the side lane, or DROP
+        (counted) when the lane is full — reference forwards must never
+        queue live traffic behind them."""
+        if self._shadow_pool is None \
+                or not self._shadow_sem.acquire(blocking=False):
+            self.quality.record_shadow_dropped()
+            return
+        try:
+            self._shadow_pool.submit(self._shadow_score, tensor, row,
+                                     dict(meta))
+        except RuntimeError:  # pool shut down under us
+            self._shadow_sem.release()
+            self.quality.record_shadow_dropped()
+
+    def _shadow_score(self, tensor: np.ndarray, row: np.ndarray,
+                      meta: dict) -> None:
+        """Re-run one served input through the f32 reference program
+        and record the live disagreement (mean |Δ| + thresholded-mask
+        flip rate) for the arm that served it.  A hot reload between
+        the serve and the shadow invalidates the comparison (the arm
+        row came from other weights) — dropped, counted."""
+        try:
+            with self._var_lock:
+                variables = self._arm_vars["f32"]
+                step = self._loaded_step
+            if step != meta.get("step"):
+                self.quality.record_shadow_dropped()
+                return
+            res = meta["res_bucket"]
+            bb = self.batcher.pick_batch_bucket(1)
+            batch = pad_to_batch({"image": tensor[None]}, bb)
+            probs = self._forward(res, bb, "f32", variables, batch,
+                                  tta=False)
+            ref = np.asarray(probs)[0].astype(np.float32)
+            arm_row = np.asarray(row, np.float32)
+            mae = float(np.mean(np.abs(arm_row - ref)))
+            flip = float(np.mean((arm_row > 0.5) != (ref > 0.5)))
+            self.quality.record_shadow(meta["precision"], mae, flip)
+        except Exception:  # noqa: BLE001 — telemetry must not throw
+            self._log.exception("serve: shadow score failed")
+            self.quality.record_shadow_dropped()
+        finally:
+            self._shadow_sem.release()
+
+    def stats_snapshot(self) -> Dict:
+        """The /stats payload: ServeStats plus — when the monitors are
+        on — the quality snapshot and the active alerts (the full rule
+        states live at /alerts)."""
+        out = self.stats.snapshot()
+        if self.quality is not None:
+            out["quality"] = self.quality.snapshot()
+        if self.alerts is not None:
+            out["alerts"] = self.alerts.active()
+        return out
 
     def _trace_end(self, r: Request, outcome: str,
                    t_pop: Optional[float] = None) -> None:

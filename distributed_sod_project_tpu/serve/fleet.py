@@ -89,10 +89,23 @@ class EngineBackend:
         return self.engine.stats.health_reason
 
     def prom_families(self, labels: str):
-        return self.engine.stats.prom_families(labels)
+        # The engine's full registry (ServeStats + — when the quality
+        # monitors are on — dsod_quality_*/dsod_alert_* families), so
+        # the fleet aggregation carries model health per replica.  With
+        # one provider registered this is exactly
+        # stats.prom_families(labels) (merge of one group = identity).
+        return self.engine.telemetry.prom_families(labels)
 
     def stats_snapshot(self) -> Dict:
-        return self.engine.stats.snapshot()
+        return self.engine.stats_snapshot()
+
+    def alerts_snapshot(self) -> Optional[Dict]:
+        return (self.engine.alerts.snapshot()
+                if self.engine.alerts is not None else None)
+
+    def alert_reasons(self) -> List[str]:
+        return (self.engine.alerts.active_reasons()
+                if self.engine.alerts is not None else [])
 
     def debug_traces(self, n: int = 50) -> Dict:
         return self.engine.tracer.snapshot(n)
@@ -259,6 +272,24 @@ class RemoteBackend:
                 return json.loads(r.read().decode())
         except (urllib.error.URLError, OSError, ValueError) as e:
             return {"unreachable": str(e)}
+
+    def alerts_snapshot(self) -> Optional[Dict]:
+        """The remote's /alerts (bounded like every other scrape;
+        None on a known-down/unreachable replica or an old remote
+        without the endpoint)."""
+        if not self.healthy():
+            return None
+        try:
+            with urllib.request.urlopen(
+                    self.url + "/alerts",
+                    timeout=self.PROBE_TIMEOUT_S) as r:
+                return json.loads(r.read().decode())
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def alert_reasons(self) -> List[str]:
+        snap = self.alerts_snapshot()
+        return list(snap.get("active", [])) if snap else []
 
     def debug_traces(self, n: int = 50) -> Dict:
         """The remote's /debug/traces (its half of the end-to-end
@@ -572,7 +603,24 @@ class Fleet:
         body = {"models": per}
         if replicas:
             body["replicas"] = replicas
+        # Active model-health alerts (docs/OBSERVABILITY.md "Model
+        # health") from IN-PROCESS engines only — a remote's alerts
+        # would cost a dial on the request path; they surface through
+        # the aggregated /alerts (bounded, concurrent) and the
+        # remote's own /healthz instead.
+        alerts = {}
+        for name, g in sorted(self.groups.items()):
+            for rid, b in g.members:
+                reasons = (b.alert_reasons()
+                           if b.kind == "engine"
+                           and hasattr(b, "alert_reasons") else [])
+                if reasons:
+                    alerts.setdefault(name, []).extend(reasons)
+        if alerts:
+            body["alerts"] = alerts
         if not down:
+            if alerts:
+                return 200, dict(body, status="degraded")
             return 200, dict(body, status="ok")
         if len(down) < len(per):
             return 200, dict(body, status="degraded", unhealthy=down)
@@ -663,6 +711,20 @@ class Fleet:
         fleet["consistent"] = fleet["terminal"] == fleet["submitted"]
         return {"router": router, "models": models, "fleet": fleet,
                 "breakers": breakers}
+
+    def alerts(self) -> Dict:
+        """The router's /alerts payload: every replica's alert-engine
+        snapshot (in-process engines read directly; healthy remotes
+        scraped bounded + concurrently, dead ones skipped) plus the
+        fleet-wide active union."""
+        snaps = self._gather_replicas(
+            lambda _g, rid, b: (rid, b.alerts_snapshot()
+                                if hasattr(b, "alerts_snapshot")
+                                else None))
+        models = {rid: s for rid, s in snaps if s}
+        active = sorted({a for s in models.values()
+                         for a in s.get("active", [])})
+        return {"active": active, "models": models}
 
     def describe_models(self) -> Dict:
         return {rid: b.describe()

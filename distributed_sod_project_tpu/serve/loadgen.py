@@ -160,6 +160,7 @@ def run_loadgen(
     tenant: Optional[str] = None,
     mix=None,
     slowest: int = 0,
+    quality: bool = False,
 ) -> Dict[str, float]:
     """Drive ``base_url`` and return a summary dict (see module doc for
     the open/closed semantics).  Closed loop sends exactly ``requests``
@@ -176,6 +177,13 @@ def run_loadgen(
     arm (X-Precision) and per SERVED model (X-Model — the router echo),
     mirroring the per-arm breakdown, so the mixed-model
     throughput-vs-p99 curve is one command.
+
+    ``quality=True``: the summary ends with one /metrics scrape of the
+    per-model shadow-disagreement and drift gauges
+    (:func:`scrape_quality`) under ``"quality"`` — a chaos or agenda
+    leg records model quality alongside its latency curve from the
+    same command.  Omitted when the endpoint exports none (monitors
+    off).
 
     ``slowest > 0``: every request carries a generated ``X-Request-ID``
     and the summary reports the N slowest OK responses with their
@@ -372,9 +380,92 @@ def run_loadgen(
         out["slowest"] = rows
     if mode == "open":
         out["offered_rps"] = round(float(rps), 2)
+    if quality:
+        q = scrape_quality(base_url)
+        if q:
+            out["quality"] = q
     return out
 
 
 def fetch_stats(base_url: str, timeout_s: float = 10.0) -> Dict[str, float]:
     with urllib.request.urlopen(base_url + "/stats", timeout=timeout_s) as r:
         return json.loads(r.read().decode())
+
+
+# Quality gauges worth carrying into a load summary (serve/quality.py;
+# docs/OBSERVABILITY.md "Model health").
+_QUALITY_FAMILIES = ("dsod_quality_psi", "dsod_quality_shadow_mae_avg",
+                     "dsod_quality_shadow_flip_avg",
+                     "dsod_quality_shadow_total",
+                     "dsod_quality_shadow_dropped_total",
+                     "dsod_quality_scored_total")
+
+
+def _parse_labels(frag: str) -> Dict[str, str]:
+    """Label fragment → dict.  Split-on-comma is sufficient for the
+    quality families: every label value here (model/arm/signal/replica
+    names) comes from validated identifier-like config fields — none
+    may contain a comma or an escaped quote."""
+    out = {}
+    for part in frag.split(","):
+        k, sep, v = part.partition("=")
+        if sep:
+            out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def scrape_quality(base_url: str, timeout_s: float = 10.0) -> Dict:
+    """End-of-run /metrics scrape of the model-health quality gauges,
+    grouped per model label (the single-engine server exports no
+    ``model=`` label — those series land under ``""``; a multi-member
+    replica set's series carry ``replica=`` and land under
+    ``model[replica]`` so replicas never overwrite each other):
+
+        {model: {"psi": {signal: v}, "shadow": {arm: {...}},
+                 "scored": n, "shadow_dropped": n}}
+
+    Empty when the endpoint is unreachable or the quality monitors are
+    off — a chaos/agenda leg records quality alongside latency exactly
+    when there is quality telemetry to record."""
+    from ..utils.observability import parse_prom_text
+
+    try:
+        with urllib.request.urlopen(base_url.rstrip("/") + "/metrics",
+                                    timeout=timeout_s) as r:
+            text = r.read().decode()
+    except (urllib.error.URLError, OSError):
+        return {}
+    out: Dict[str, Dict] = {}
+
+    def model_entry(labels):
+        key = labels.get("model", "")
+        if "replica" in labels:
+            key = f'{key}[{labels["replica"]}]'
+        return out.setdefault(key, {})
+
+    samples = []
+    for fam_name, _typ, fam_samples in parse_prom_text(text):
+        if fam_name in _QUALITY_FAMILIES:
+            samples.extend(fam_samples)
+    for line in samples:
+        head, _, rest = line.partition(" ")
+        name, _, frag = head.partition("{")
+        labels = _parse_labels(frag.rstrip("}"))
+        try:
+            value = float(rest.split()[0])
+        except (ValueError, IndexError):
+            continue
+        entry = model_entry(labels)
+        if name == "dsod_quality_psi":
+            entry.setdefault("psi", {})[labels.get("signal", "")] = value
+        elif name == "dsod_quality_scored_total":
+            entry["scored"] = value
+        elif name == "dsod_quality_shadow_dropped_total":
+            entry["shadow_dropped"] = value
+        else:
+            arm = labels.get("arm", "")
+            key = {"dsod_quality_shadow_mae_avg": "mae_avg",
+                   "dsod_quality_shadow_flip_avg": "flip_avg",
+                   "dsod_quality_shadow_total": "n"}[name]
+            entry.setdefault("shadow", {}).setdefault(arm, {})[key] = value
+    return {m: v for m, v in out.items() if v}

@@ -1,0 +1,399 @@
+"""Online serving quality/drift monitors (docs/OBSERVABILITY.md
+"Model health").
+
+The serving telemetry so far answers "how fast" (PR 5/9) and "how
+available" (PR 7/8); this module answers "how *good*, right now":
+
+- **Per-request output statistics** — foreground fraction, mean
+  confidence, boundary entropy — cheap scalars of the predicted
+  saliency map, windowed so a regime change moves the gauge.  A model
+  that suddenly predicts empty masks (a bad hot reload, a broken
+  preprocessing change upstream) shows up here within one window.
+- **Input/output distribution drift** — online histograms of the input
+  mean intensity and the output foreground fraction, scored as PSI
+  (population stability index) against a CHECKED-IN reference
+  histogram per model (``tools/quality_reference.json``).  PSI is the
+  standard "has traffic moved off the distribution my quality gate was
+  run on" number: 0 = identical, >0.25 = conventionally "major shift".
+- **Shadow scoring** — a sampled fraction of non-f32 responses
+  re-scored on the f32 reference arm, recording the live arm-vs-f32
+  disagreement (mean |Δ| and thresholded-mask flip rate).  This turns
+  ``tools/precision_gate.py``'s offline per-arm budget into a
+  CONTINUOUS online check against real traffic: the offline gate
+  proves an arm safe on the eval set at ship time; the shadow gauges
+  prove it is still safe on today's traffic and today's weights.
+
+All of it renders as ``dsod_quality_*`` families through the engine's
+``TelemetryRegistry`` (model=/arm= labels ride the same label plumbing
+as every other serve family, so the fleet router aggregates them for
+free) and feeds the alert engine (utils/alerts.py).  Everything is off
+by default (``serve.quality_monitor=false``): the request hot path
+pays a None check and /metrics stays byte-identical.
+
+Cost model (measured in docs/OBSERVABILITY.md): output stats subsample
+the bucket-resolution map to ≤ ~4k pixels (a few µs of numpy); the
+input stat is one ``mean()`` over the request image; shadow scoring is
+the expensive lever — ONE extra f32 forward per sampled response, run
+on a single-thread side lane capped at 2 in flight that DROPS (counted
+``dsod_quality_shadow_dropped_total``) rather than queue behind live
+traffic, so its worst-case tax is bounded by the sampling rate, not
+the offered load.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.alerts import Rule
+
+# Uniform-bin histograms over [0, 1] for both drift signals.
+PSI_BINS = 10
+# Halve the online histogram once it holds this many observations so
+# the PSI compares RECENT traffic, not the run's whole history.
+HIST_CAP = 4096
+# Windowed means: enough to be stable, small enough to track a regime
+# change within ~a window of traffic.
+WINDOW = 256
+
+DRIFT_SIGNALS = ("input_mean", "fg_fraction")
+
+DEFAULT_REFERENCE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tools", "quality_reference.json")
+
+
+def input_mean01(image: np.ndarray) -> float:
+    """The request image's mean intensity in [0, 1] (uint8 and float
+    [0,1] images normalize identically — the drift histogram must not
+    split on the client's dtype)."""
+    arr = np.asarray(image)
+    if arr.dtype == np.uint8:
+        return float(arr.mean()) / 255.0
+    return float(np.clip(arr, 0.0, 1.0).mean())
+
+
+def output_stats(pred: np.ndarray, max_pixels: int = 4096
+                 ) -> Tuple[float, float, float]:
+    """``(fg_fraction, mean_confidence, boundary_entropy)`` of one
+    probability map, on a strided subsample bounded at ``max_pixels``:
+
+    - fg_fraction — fraction of pixels past the 0.5 decision line;
+    - mean_confidence — mean ``|p - 0.5| * 2`` (1 = saturated, 0 =
+      everywhere-ambiguous);
+    - boundary_entropy — mean binary entropy in bits (high = wide
+      uncertain boundary band, the classic quality-collapse shape).
+    """
+    p = np.asarray(pred, np.float32)
+    if p.size > max_pixels:
+        stride = int(math.ceil(math.sqrt(p.size / max_pixels)))
+        p = p[::stride, ::stride]
+    p = np.clip(p, 1e-6, 1.0 - 1e-6)
+    fg = float((p > 0.5).mean())
+    conf = float(np.abs(p - 0.5).mean() * 2.0)
+    ent = float((-(p * np.log2(p) + (1 - p) * np.log2(1 - p))).mean())
+    return fg, conf, ent
+
+
+def psi(cur_counts: Sequence[float], ref_counts: Sequence[float],
+        eps: float = 1e-4) -> float:
+    """Population stability index between two histograms (smoothed so
+    empty bins cannot produce infinities)."""
+    cur = np.asarray(cur_counts, np.float64)
+    ref = np.asarray(ref_counts, np.float64)
+    if cur.sum() <= 0 or ref.sum() <= 0:
+        return 0.0
+    n = len(cur)
+    p = (cur + eps) / (cur.sum() + eps * n)
+    q = (ref + eps) / (ref.sum() + eps * n)
+    return float(np.sum((p - q) * np.log(p / q)))
+
+
+def load_reference(path: str, model_name: str) -> Optional[Dict]:
+    """Reference histograms for ``model_name``:
+    ``{signal: [counts per uniform [0,1] bin]}``.
+
+    ``path=""`` falls back to the checked-in
+    ``tools/quality_reference.json`` and answers None (drift gauges
+    idle) when it is absent or has no entry; an EXPLICIT path that is
+    missing or lacks the model raises — a named reference that
+    silently doesn't apply would report PSI 0 forever."""
+    p = path or DEFAULT_REFERENCE_PATH
+    if not os.path.exists(p):
+        if path:
+            raise ValueError(f"serve.quality_reference {path!r} not found")
+        return None
+    with open(p) as f:
+        data = json.load(f)
+    entry = data.get(model_name)
+    if entry is None:
+        if path:
+            raise ValueError(
+                f"serve.quality_reference {path!r} has no entry for "
+                f"model {model_name!r} (has: {sorted(data)})")
+        return None
+    out = {}
+    for sig in DRIFT_SIGNALS:
+        counts = entry.get(sig)
+        if counts is not None:
+            if len(counts) != PSI_BINS:
+                raise ValueError(
+                    f"reference {sig!r} for {model_name!r} has "
+                    f"{len(counts)} bins, expected {PSI_BINS}")
+            out[sig] = [float(c) for c in counts]
+    return out or None
+
+
+def default_quality_rules(sc) -> List[Rule]:
+    """The built-in serving alert set (custom rules join via
+    ``serve.alert_rules``): drift PSI past its threshold, shadow
+    disagreement past its budget — both with the configured hysteresis
+    dwells."""
+    return [
+        Rule("quality_drift_psi", "quality_psi_max", "gt",
+             sc.quality_psi_threshold,
+             for_s=sc.quality_alert_for_s,
+             clear_s=sc.quality_alert_clear_s),
+        Rule("quality_shadow_disagreement", "shadow_mae_max", "gt",
+             sc.quality_shadow_budget,
+             for_s=sc.quality_alert_for_s,
+             clear_s=sc.quality_alert_clear_s),
+    ]
+
+
+class _Ring:
+    """Fixed-window mean (the TailEstimator idiom without the sort)."""
+
+    __slots__ = ("_buf", "_i", "_cap", "_n")
+
+    def __init__(self, cap: int = WINDOW):
+        self._buf: List[float] = []
+        self._i = 0
+        self._cap = cap
+        self._n = 0  # total ever observed
+
+    def add(self, v: float) -> None:
+        if len(self._buf) < self._cap:
+            self._buf.append(float(v))
+        else:
+            self._buf[self._i] = float(v)
+            self._i = (self._i + 1) % self._cap
+        self._n += 1
+
+    def mean(self) -> float:
+        return (sum(self._buf) / len(self._buf)) if self._buf else 0.0
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+
+class QualityMonitor:
+    """Per-engine (one model) online quality state.  Thread-safe: the
+    HTTP handler pool writes input stats, the post pool writes output
+    stats, the shadow lane writes disagreements, and the telemetry
+    renderers read concurrently."""
+
+    def __init__(self, model_name: str, *, shadow_sample: float = 0.0,
+                 reference: Optional[Dict] = None,
+                 psi_min_count: int = 64):
+        if not 0.0 <= float(shadow_sample) <= 1.0:
+            raise ValueError(
+                "serve.quality_shadow_sample must be in [0, 1], got "
+                f"{shadow_sample}")
+        if int(psi_min_count) < 1:
+            raise ValueError(
+                "serve.quality_psi_min_count must be >= 1, got "
+                f"{psi_min_count}")
+        self.model_name = model_name
+        self.shadow_sample = float(shadow_sample)
+        self.reference = reference
+        self.psi_min_count = int(psi_min_count)
+        self._lock = threading.Lock()
+        self._scored = 0
+        self._fg = _Ring()
+        self._conf = _Ring()
+        self._ent = _Ring()
+        self._hists: Dict[str, List[float]] = {
+            s: [0.0] * PSI_BINS for s in DRIFT_SIGNALS}
+        # arm → (mae ring, flip ring)
+        self._shadow: Dict[str, Tuple[_Ring, _Ring]] = {}
+        self._shadow_total: Dict[str, int] = {}
+        self._shadow_dropped = 0
+        self._shadow_acc = 0.0  # deterministic sampling accumulator
+
+    # -- ingest --------------------------------------------------------
+
+    def _bump_hist(self, signal: str, value01: float) -> None:
+        if not math.isfinite(value01):
+            return  # a NaN-poisoned input is not drift evidence
+        h = self._hists[signal]
+        i = min(max(int(value01 * PSI_BINS), 0), PSI_BINS - 1)
+        h[i] += 1.0
+        if sum(h) >= HIST_CAP:  # keep PSI about RECENT traffic
+            self._hists[signal] = [c / 2.0 for c in h]
+
+    def observe_input(self, mean01: float) -> None:
+        with self._lock:
+            self._bump_hist("input_mean", mean01)
+
+    def observe_output(self, pred: np.ndarray) -> None:
+        fg, conf, ent = output_stats(pred)
+        with self._lock:
+            self._scored += 1
+            self._fg.add(fg)
+            self._conf.add(conf)
+            self._ent.add(ent)
+            self._bump_hist("fg_fraction", fg)
+
+    def should_shadow(self) -> bool:
+        """Deterministic counter sampling: at rate r, True on the
+        requests where the accumulated rate crosses an integer — every
+        request at r=1, every other at r=0.5, never at r=0."""
+        if self.shadow_sample <= 0.0:
+            return False
+        with self._lock:
+            self._shadow_acc += self.shadow_sample
+            if self._shadow_acc >= 1.0:
+                self._shadow_acc -= 1.0
+                return True
+            return False
+
+    def record_shadow(self, arm: str, mae: float, flip: float) -> None:
+        with self._lock:
+            rings = self._shadow.get(arm)
+            if rings is None:
+                rings = self._shadow[arm] = (_Ring(), _Ring())
+            rings[0].add(mae)
+            rings[1].add(flip)
+            self._shadow_total[arm] = self._shadow_total.get(arm, 0) + 1
+
+    def record_shadow_dropped(self) -> None:
+        with self._lock:
+            self._shadow_dropped += 1
+
+    # -- reads ---------------------------------------------------------
+
+    def psi_values(self) -> Dict[str, float]:
+        """PSI per drift signal vs the reference.  Empty without a
+        reference, and a signal renders no verdict until its online
+        histogram holds ``psi_min_count`` observations — an unwarmed
+        histogram scored against a reference reads as a huge (false)
+        shift."""
+        with self._lock:
+            if not self.reference:
+                return {}
+            return {s: round(psi(self._hists[s], self.reference[s]), 6)
+                    for s in DRIFT_SIGNALS
+                    if s in self.reference
+                    and sum(self._hists[s]) >= self.psi_min_count}
+
+    def histogram(self, signal: str) -> List[float]:
+        with self._lock:
+            return list(self._hists[signal])
+
+    def signals(self) -> Tuple[Dict[str, float], Dict[str, str]]:
+        """``(signals, details)`` for the alert engine: the worst PSI
+        and the worst per-arm shadow disagreement, detail-tagged with
+        which signal/arm is responsible."""
+        psis = self.psi_values()
+        with self._lock:
+            shadow = {a: (r[0].mean(), r[1].mean())
+                      for a, r in self._shadow.items()}
+            sigs = {
+                "fg_fraction_avg": self._fg.mean(),
+                "confidence_avg": self._conf.mean(),
+                "boundary_entropy_avg": self._ent.mean(),
+            }
+        details: Dict[str, str] = {}
+        sigs["quality_psi_max"] = max(psis.values(), default=0.0)
+        if psis:
+            worst = max(psis, key=psis.get)
+            details["quality_psi_max"] = f"signal={worst}"
+        sigs["shadow_mae_max"] = max(
+            (m for m, _f in shadow.values()), default=0.0)
+        sigs["shadow_flip_max"] = max(
+            (f for _m, f in shadow.values()), default=0.0)
+        if shadow:
+            worst = max(shadow, key=lambda a: shadow[a][0])
+            details["shadow_mae_max"] = f"arm={worst}"
+        return sigs, details
+
+    def snapshot(self) -> Dict:
+        psis = self.psi_values()
+        with self._lock:
+            out = {
+                "scored": self._scored,
+                "fg_fraction_avg": round(self._fg.mean(), 6),
+                "confidence_avg": round(self._conf.mean(), 6),
+                "boundary_entropy_avg": round(self._ent.mean(), 6),
+                "shadow_sample": self.shadow_sample,
+                "shadow_dropped": self._shadow_dropped,
+                "shadow": {
+                    a: {"n": self._shadow_total[a],
+                        "mae_avg": round(r[0].mean(), 6),
+                        "flip_avg": round(r[1].mean(), 6)}
+                    for a, r in sorted(self._shadow.items())},
+            }
+        if psis:
+            out["psi"] = psis
+        return out
+
+    def reference_counts(self) -> Dict[str, List[float]]:
+        """The CURRENT histograms in the reference-file shape — what
+        ``tools/quality_reference.py`` writes after an in-distribution
+        calibration run."""
+        with self._lock:
+            return {s: list(self._hists[s]) for s in DRIFT_SIGNALS}
+
+    # -- exposition ----------------------------------------------------
+
+    def prom_families(self, labels: str = ""):
+        """The ``dsod_quality_*`` families.  Base families render
+        unconditionally (inventory-stable); per-arm shadow families
+        render one sample per arm that has shadow data, sharing one
+        TYPE line (the per-arm ServeStats idiom); PSI renders one
+        sample per referenced signal."""
+        psis = self.psi_values()
+        with self._lock:
+            scored = self._scored
+            fg, conf, ent = (self._fg.mean(), self._conf.mean(),
+                             self._ent.mean())
+            dropped = self._shadow_dropped
+            shadow = [(a, self._shadow_total[a], r[0].mean(), r[1].mean())
+                      for a, r in sorted(self._shadow.items())]
+        sb = f"{{{labels}}}" if labels else ""
+        pre = f"{labels}," if labels else ""
+        fams = [
+            ("dsod_quality_scored_total", "counter",
+             [f"dsod_quality_scored_total{sb} {scored}"]),
+            ("dsod_quality_fg_fraction_avg", "gauge",
+             [f"dsod_quality_fg_fraction_avg{sb} {fg:g}"]),
+            ("dsod_quality_confidence_avg", "gauge",
+             [f"dsod_quality_confidence_avg{sb} {conf:g}"]),
+            ("dsod_quality_boundary_entropy_avg", "gauge",
+             [f"dsod_quality_boundary_entropy_avg{sb} {ent:g}"]),
+            ("dsod_quality_shadow_dropped_total", "counter",
+             [f"dsod_quality_shadow_dropped_total{sb} {dropped}"]),
+        ]
+        if psis:
+            fams.append(("dsod_quality_psi", "gauge", [
+                'dsod_quality_psi{%ssignal="%s"} %g' % (pre, s, v)
+                for s, v in sorted(psis.items())]))
+        if shadow:
+            fams.append(("dsod_quality_shadow_total", "counter", [
+                'dsod_quality_shadow_total{%sarm="%s"} %d'
+                % (pre, a, n) for a, n, _m, _f in shadow]))
+            fams.append(("dsod_quality_shadow_mae_avg", "gauge", [
+                'dsod_quality_shadow_mae_avg{%sarm="%s"} %g'
+                % (pre, a, m) for a, _n, m, _f in shadow]))
+            fams.append(("dsod_quality_shadow_flip_avg", "gauge", [
+                'dsod_quality_shadow_flip_avg{%sarm="%s"} %g'
+                % (pre, a, f) for a, _n, _m, f in shadow]))
+        return fams

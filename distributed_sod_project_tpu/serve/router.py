@@ -350,6 +350,11 @@ class RouterHandler(JsonHTTPHandler):
             self._send_json(200, self.fleet.stats())
         elif path == "/models":
             self._send_json(200, {"models": self.fleet.describe_models()})
+        elif path == "/alerts":
+            # Aggregated model-health alerts (docs/OBSERVABILITY.md
+            # "Model health"): per-replica rule states + the fleet-wide
+            # active union.
+            self._send_json(200, self.fleet.alerts())
         elif path == "/debug/traces":
             q = urllib.parse.urlsplit(self.path).query
             self._send_json(200, self.fleet.debug_traces(

@@ -18,8 +18,11 @@ Endpoints:
 - ``GET /healthz``  — 200 while the dispatch loop's resilience-watchdog
   heartbeat is live, 503 once it stalls (or the engine stopped).
 - ``GET /metrics``  — Prometheus text (ServeStats: latency histograms,
-  shed/expired counters, batch occupancy, degraded/health gauges).
+  shed/expired counters, batch occupancy, degraded/health gauges; plus
+  ``dsod_quality_*``/``dsod_alert_*`` when ``serve.quality_monitor``).
 - ``GET /stats``    — the same telemetry as one JSON object.
+- ``GET /alerts``   — the alert engine's rule states (utils/alerts.py;
+  empty rule list when the quality monitors are off).
 - ``GET /debug/traces?n=N`` — sampled request span timelines + the
   worst-N exemplars per (model, res bucket) (docs/OBSERVABILITY.md).
 
@@ -332,19 +335,35 @@ class ServeHandler(JsonHTTPHandler):
         if path == "/healthz":
             stats = self.engine.stats
             if stats.healthy and self.engine._running:
-                self._send_json(200, {"status": "ok"})
+                # Active model-health alerts DEGRADE the verdict (200
+                # with the rules named: the engine still serves, the
+                # MODEL may be drifting — a fronting LB must not drain
+                # a replica over a quality worry, an operator must see
+                # it).  docs/OBSERVABILITY.md "Model health".
+                alerts = self.engine.alerts
+                active = alerts.active_reasons() if alerts else []
+                if active:
+                    self._send_json(200, {"status": "degraded",
+                                          "alerts": active})
+                else:
+                    self._send_json(200, {"status": "ok"})
             else:
                 self._send_json(503, {
                     "status": "unhealthy",
                     "reason": stats.health_reason or "engine stopped"})
         elif path == "/metrics":
             # The shared TelemetryRegistry render path — with the one
-            # "serve" provider this is byte-identical to
-            # stats.render_prometheus() (asserted in tests).
+            # "serve" provider (quality monitors off) this is
+            # byte-identical to stats.render_prometheus() (asserted in
+            # tests).
             self._send(200, self.engine.telemetry.render().encode(),
                        "text/plain; version=0.0.4")
         elif path == "/stats":
-            self._send_json(200, self.engine.stats.snapshot())
+            self._send_json(200, self.engine.stats_snapshot())
+        elif path == "/alerts":
+            alerts = self.engine.alerts
+            self._send_json(200, alerts.snapshot() if alerts
+                            else {"active": [], "rules": []})
         elif path == "/debug/traces":
             self._send_json(200, self.engine.tracer.snapshot(
                 n=_query_int(split.query, "n", 50)))

@@ -94,6 +94,20 @@ def fit(
     workdir = workdir or cfg.checkpoint_dir
     plan = inject.plan_from_env()
 
+    if not cfg.health_numerics:
+        # Loudness: both knobs only act through the numerics monitor —
+        # set without it they would be silent no-ops, and an operator
+        # who opted into rollback protection must not run unprotected.
+        if cfg.health_rollback_hint:
+            raise ValueError(
+                "health_rollback_hint=true requires health_numerics=true "
+                "(the rollback hand-off consumes the numerics alerts)")
+        if cfg.health_alert_rules:
+            raise ValueError(
+                "health_alert_rules set but health_numerics is false — "
+                "the training alert engine only runs with the numerics "
+                "telemetry on")
+
     # Device-side step chunking (docs/PERFORMANCE.md): k steps fold
     # into one lax.scan dispatch and the loop advances chunk-by-chunk.
     # Fault plans force k=1 — poison/stall/SIGTERM are PER-STEP
@@ -197,6 +211,47 @@ def fit(
     state = create_train_state(jax.random.key(cfg.seed), model, tx, sample,
                                pretrained=cfg.model.pretrained,
                                ema=cfg.optim.ema_decay > 0)
+    # Training numerics telemetry (utils/modelhealth.py;
+    # docs/OBSERVABILITY.md "Model health"): the step emits per-group
+    # grad norms / nonfinite provenance / update ratio, the monitor
+    # aggregates them for the sidecar, and the alert engine watches the
+    # derived signals.  All None when the knob is off — every touch
+    # below guards on that, so the default path pays nothing.
+    health_monitor = None
+    health_alerts = None
+    if cfg.health_numerics:
+        from ..utils.alerts import AlertEngine, parse_rules
+        from ..utils.modelhealth import (HealthMonitor,
+                                         default_numerics_rules,
+                                         param_group_names)
+
+        health_monitor = HealthMonitor(param_group_names(state.params))
+        health_alerts = AlertEngine(
+            default_numerics_rules(clear_s=cfg.health_alert_clear_s)
+            + parse_rules(cfg.health_alert_rules))
+
+    def _observe_health(metrics_host) -> None:
+        """Feed one fetched metric dict to the health monitor + alert
+        engine.  Under ``health_rollback_hint`` a FIRING rollback-
+        hinted alert (numerics_nonfinite) raises the divergence
+        RuntimeError the PR-1 supervisor's rollback-and-retry policy
+        recognizes (resilience/supervisor.py::is_divergence)."""
+        if health_monitor is None:
+            return
+        health_monitor.observe(metrics_host)
+        sigs, details = health_monitor.signals()
+        health_alerts.evaluate(sigs, details=details)
+        if cfg.health_rollback_hint:
+            fired = health_alerts.firing(hint="rollback")
+            if fired:
+                snap = health_monitor.snapshot()
+                raise RuntimeError(
+                    f"model-health alert {fired[0].name!r} "
+                    f"(first non-finite group: "
+                    f"{snap['last_nonfinite_group'] or '?'}): non-finite "
+                    "gradient updates detected — rolling back to the "
+                    "last checkpoint (health_rollback_hint)")
+
     log.info("model=%s params=%.2fM devices=%d global_batch=%d "
              "steps/epoch=%d total_steps=%d",
              cfg.model.name, param_count(state) / 1e6, n_dev,
@@ -278,7 +333,8 @@ def fit(
                 sp_strategy=cfg.mesh.sp_strategy,
                 remat=cfg.model.remat,
                 remat_policy=cfg.model.remat_policy,
-                steps_per_dispatch=k)
+                steps_per_dispatch=k,
+                health=cfg.health_numerics)
     elif use_gspmd:
         from ..parallel.tp import make_tp_train_step, shard_state
 
@@ -312,7 +368,8 @@ def fit(
                 scale_hw=scale_hw, donate_batch=True,
                 remat=cfg.model.remat,
                 remat_policy=cfg.model.remat_policy,
-                steps_per_dispatch=k)
+                steps_per_dispatch=k,
+                health=cfg.health_numerics)
     else:
         state = jax.device_put(state, replicated_sharding(mesh))
 
@@ -322,7 +379,8 @@ def fit(
                 remat=cfg.model.remat, ema_decay=cfg.optim.ema_decay,
                 scale_hw=scale_hw, donate_batch=True,
                 remat_policy=cfg.model.remat_policy,
-                steps_per_dispatch=k)
+                steps_per_dispatch=k,
+                health=cfg.health_numerics)
 
     # Multi-scale training: one compiled step per size in the cycle
     # (each is a distinct static-shape XLA program; the resize happens
@@ -381,7 +439,8 @@ def fit(
         cfg, data_stats=data_stats, timer=timer, writer=writer,
         watchdog=watchdog, tracer=tracer, workdir=workdir,
         step_fn=lambda: step, port=telemetry_port,
-        port_file=telemetry_port_file)
+        port_file=telemetry_port_file,
+        health=health_monitor, alerts=health_alerts)
     # A restore means this step's checkpoint already exists on disk — a
     # zero-progress run must not force-save over it (orbax raises).
     last_saved = resumed_from
@@ -564,6 +623,9 @@ def fit(
                           time.monotonic(),
                           parent_id=trace["root"].span_id)
         timer.tick(steps=k)
+        # Health observes EVERY fetched chunk (a mid-interval NaN must
+        # reach the provenance counters even off the logging cadence).
+        _observe_health(metrics_host)
         if "on_chunk_metrics" in hooks:
             hooks["on_chunk_metrics"](at_step, metrics_host)
         stop = _poll_stop(guard, at_step, sync_every) or stop
@@ -683,6 +745,7 @@ def fit(
                         tracer.record(chunk_tr["root"].trace_id, "flush",
                                       t_f0, time.monotonic(),
                                       parent_id=chunk_tr["root"].span_id)
+                    _observe_health(metrics_host)
                     _process_log(step, metrics_host, epoch)
                 _run_state_events(step, trace=chunk_tr)
                 _finish_chunk_trace(chunk_tr, step)

@@ -166,6 +166,22 @@ def rescale_batch(batch, scale_hw):
     return out
 
 
+def maybe_health_metrics(metrics, params, grads, new_params,
+                         health: bool):
+    """Append the model-health numerics scalars (per-group grad norms,
+    nonfinite provenance, update/weight ratio — utils/modelhealth.py)
+    when ``health`` is on.  ONE helper shared by the DP/TP/SP step
+    builders so the health surface cannot diverge between them; with
+    the knob off the metric dict is returned untouched and the step
+    program stays byte-for-byte the historical one."""
+    if not health:
+        return metrics
+    from ..utils.modelhealth import health_step_metrics
+
+    metrics.update(health_step_metrics(params, grads, new_params))
+    return metrics
+
+
 def make_train_step(
     model,
     loss_cfg,
@@ -179,6 +195,7 @@ def make_train_step(
     donate_batch: bool = False,
     remat_policy: str = "none",
     steps_per_dispatch: int = 1,
+    health: bool = False,
     _always_scan: bool = False,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build ``(state, batch) -> (state, metrics)``.
@@ -204,6 +221,11 @@ def make_train_step(
     image/mask/depth to that (H, W) on-device before the forward, so
     the loader keeps emitting one static shape and every train size is
     its own compiled program (no dynamic shapes anywhere).
+
+    ``health=True`` (cfg.health_numerics) additionally emits the
+    model-health numerics scalars — per-group gradient norms,
+    non-finite provenance, update/weight ratio
+    (``maybe_health_metrics``; docs/OBSERVABILITY.md "Model health").
     """
     resolve_remat_policy(remat_policy)  # fail fast on typos, remat or not
     lkw = _loss_kwargs(loss_cfg)
@@ -245,6 +267,8 @@ def make_train_step(
                                  ema_decay=ema_decay)
         metrics = dict(comps)
         metrics["grad_norm"] = optax.global_norm(grads)
+        maybe_health_metrics(metrics, state.params, grads,
+                             new_state.params, health)
         nfc = notfinite_count(new_state.opt_state)
         if nfc is not None:
             metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)

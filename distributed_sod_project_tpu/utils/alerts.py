@@ -1,0 +1,380 @@
+"""Alert engine: declarative rules over the telemetry surface
+(docs/OBSERVABILITY.md "Model health").
+
+The metric families (utils/observability.py) make model health
+*visible*; this module makes it *actionable* without a Prometheus
+deployment in the loop: a small set of declarative rules evaluated
+in-process over the same signals the /metrics endpoints render, with a
+hysteretic firing state machine and a uniform surface (``/alerts``
+JSON + ``dsod_alert_*`` families) on every front end — the trainer
+sidecar, the single-engine server, and the fleet router.
+
+Design constraints, in order:
+
+- **Fake-clock deterministic.**  Every transition is a pure function of
+  (observed value, injected clock) — the same discipline as the
+  degraded-mode ladder (serve/admission.py), so the fire → hold →
+  clear sequences are provable in tests without sleeps.
+- **Hysteretic by construction.**  A rule must BREACH for ``for_s``
+  before it fires and must stay CLEAR for ``clear_s`` before it
+  resolves; in between it holds.  A monitor that flaps per scrape is
+  worse than no monitor (every alert consumer debounces it again,
+  differently).
+- **Stable surface.**  ``prom_families`` renders one sample per rule
+  UNCONDITIONALLY (0 when quiet) so the family inventory
+  (tools/metrics_lint.py) cannot drift with alert activity.
+
+Rule kinds:
+
+- ``gt`` / ``lt`` — plain threshold on the signal's current value.
+- ``z``  — EWMA z-score: the rule tracks an exponentially-weighted
+  mean/variance of the signal and breaches when the standardized
+  residual exceeds ``value`` (one-sided, high).  Warmup-gated: no
+  breach before ``min_n`` observations, so the first samples cannot
+  alarm against an unseeded baseline.
+
+Rules are declared either programmatically (:class:`Rule`) or as a
+compact colon DSL that survives ``--set`` tuple coercion (no commas):
+
+    name:signal:kind:value[:for_s[:clear_s]]
+    e.g.  drift_psi:quality_psi_max:gt:0.25:5:10
+          grad_spike:grad_norm:z:6:0:60
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_KINDS = ("gt", "lt", "z")
+
+# States of the per-rule machine.  "pending" = breached, serving its
+# for_s dwell; "clearing" = stopped breaching, serving its clear_s
+# dwell (still ACTIVE — the hold half of the hysteresis).
+OK, PENDING, FIRING, CLEARING = "ok", "pending", "firing", "clearing"
+ACTIVE_STATES = (FIRING, CLEARING)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One declarative alert rule over a named scalar signal.
+
+    ``hint`` tags what a firing rule should *mean* to an opt-in
+    consumer — the train loop hands rules with ``hint="rollback"`` to
+    the PR-1 resilience supervisor as a divergence (rollback-and-retry)
+    when ``health_rollback_hint`` is on.
+    """
+
+    name: str
+    signal: str
+    kind: str = "gt"          # gt | lt | z
+    value: float = 0.0        # threshold, or z-score bound for kind=z
+    for_s: float = 0.0        # breach dwell before firing
+    clear_s: float = 0.0      # clear dwell before resolving
+    hint: str = ""            # e.g. "rollback" (opt-in consumer tag)
+    ewma_alpha: float = 0.1   # kind=z: mean/var smoothing
+    min_n: int = 8            # kind=z: observations before arming
+
+    def __post_init__(self):
+        if not self.name or not self.signal:
+            raise ValueError(f"alert rule needs name and signal: {self!r}")
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"alert rule {self.name!r}: kind must be one of "
+                f"{_KINDS}, got {self.kind!r}")
+        if self.for_s < 0 or self.clear_s < 0:
+            raise ValueError(
+                f"alert rule {self.name!r}: for_s/clear_s must be >= 0")
+        if self.kind == "z" and self.value <= 0:
+            raise ValueError(
+                f"alert rule {self.name!r}: z rules need value > 0")
+
+    @classmethod
+    def parse(cls, spec: str, **kw) -> "Rule":
+        """``name:signal:kind:value[:for_s[:clear_s]]`` → Rule.  Colon
+        DSL on purpose: it survives the config system's comma-splitting
+        tuple coercion, so custom rules ride ``--set`` cleanly."""
+        parts = [p.strip() for p in str(spec).split(":")]
+        if len(parts) < 4:
+            raise ValueError(
+                f"alert rule spec {spec!r} needs at least "
+                "name:signal:kind:value")
+        try:
+            value = float(parts[3])
+            for_s = float(parts[4]) if len(parts) > 4 else 0.0
+            clear_s = float(parts[5]) if len(parts) > 5 else 0.0
+        except ValueError as e:
+            raise ValueError(
+                f"alert rule spec {spec!r}: non-numeric field ({e})")
+        if len(parts) > 6:
+            raise ValueError(f"alert rule spec {spec!r}: too many fields")
+        return cls(name=parts[0], signal=parts[1], kind=parts[2],
+                   value=value, for_s=for_s, clear_s=clear_s, **kw)
+
+
+def parse_rules(specs: Sequence[str]) -> List[Rule]:
+    return [Rule.parse(s) for s in specs or ()]
+
+
+class _RuleState:
+    __slots__ = ("state", "since", "last_value", "last_z", "fired_total",
+                 "detail", "ewma_mean", "ewma_var", "n")
+
+    def __init__(self):
+        self.state = OK
+        self.since: Optional[float] = None
+        self.last_value: Optional[float] = None
+        self.last_z: Optional[float] = None
+        self.fired_total = 0
+        self.detail = ""
+        self.ewma_mean = 0.0
+        self.ewma_var = 0.0
+        self.n = 0
+
+
+class AlertEngine:
+    """Evaluate a rule set against pushed signal values.
+
+    Feed values with :meth:`feed` (one signal) or :meth:`evaluate`
+    (a dict — the cadence point both stacks use: the train loop at its
+    metric boundaries, the serve engine at its dispatch-loop observe
+    point, throttled).  All clock reads go through the injected
+    ``clock`` so the full fire → hold → clear ladder is provable with
+    a fake clock.  ``on_fire(rule, state_dict)`` is invoked (outside
+    the lock) on each ok/pending → firing transition.
+    """
+
+    def __init__(self, rules: Sequence[Rule], *, clock=time.monotonic,
+                 on_fire: Optional[Callable] = None):
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate alert rule names in {names}")
+        self.rules: Tuple[Rule, ...] = tuple(rules)
+        self._clock = clock
+        self._on_fire = on_fire
+        self._lock = threading.Lock()
+        self._st: Dict[str, _RuleState] = {r.name: _RuleState()
+                                           for r in rules}
+
+    # -- evaluation ----------------------------------------------------
+
+    def _breach(self, rule: Rule, st: _RuleState, value: float) -> bool:
+        if rule.kind == "gt":
+            return value > rule.value
+        if rule.kind == "lt":
+            return value < rule.value
+        # kind == "z": score against the PRE-update EWMA baseline (the
+        # value must not dilute the mean it is judged against), then
+        # fold it in.  Warmup-gated on min_n.
+        breach = False
+        if st.n >= rule.min_n:
+            sd = math.sqrt(max(st.ewma_var, 1e-12))
+            st.last_z = (value - st.ewma_mean) / sd
+            breach = st.last_z > rule.value
+        a = rule.ewma_alpha
+        if st.n == 0:
+            st.ewma_mean = value
+        else:
+            delta = value - st.ewma_mean
+            st.ewma_mean += a * delta
+            st.ewma_var = (1.0 - a) * (st.ewma_var + a * delta * delta)
+        st.n += 1
+        return breach
+
+    def feed(self, signal: str, value: float,
+             now: Optional[float] = None, detail: str = "") -> None:
+        """Advance every rule watching ``signal`` with one observation.
+        ``detail`` (e.g. the nonfinite parameter group) is stored on
+        breach and surfaced in /alerts and healthz reasons."""
+        if value is None or not math.isfinite(float(value)):
+            return  # a broken signal must not wedge or fire the machine
+        value = float(value)
+        now = self._clock() if now is None else now
+        fired = []
+        with self._lock:
+            for rule in self.rules:
+                if rule.signal != signal:
+                    continue
+                st = self._st[rule.name]
+                st.last_value = value
+                breach = self._breach(rule, st, value)
+                if breach and detail:
+                    st.detail = detail
+                if self._advance(rule, st, breach, now):
+                    fired.append((rule, self._state_dict(rule, st)))
+        for rule, snap in fired:
+            if self._on_fire is not None:
+                self._on_fire(rule, snap)
+
+    def evaluate(self, signals: Dict[str, float],
+                 now: Optional[float] = None,
+                 details: Optional[Dict[str, str]] = None) -> None:
+        now = self._clock() if now is None else now
+        details = details or {}
+        for k, v in signals.items():
+            self.feed(k, v, now=now, detail=details.get(k, ""))
+
+    def _advance(self, rule: Rule, st: _RuleState, breach: bool,
+                 now: float) -> bool:
+        """One state-machine step; returns True on a fresh firing."""
+        if st.state == OK:
+            if breach:
+                st.state, st.since = PENDING, now
+                if rule.for_s <= 0:
+                    return self._fire(st, now)
+            return False
+        if st.state == PENDING:
+            if not breach:
+                st.state, st.since = OK, None
+                return False
+            if now - st.since >= rule.for_s:
+                return self._fire(st, now)
+            return False
+        if st.state == FIRING:
+            if not breach:
+                st.state, st.since = CLEARING, now
+                if rule.clear_s <= 0:
+                    st.state, st.since, st.detail = OK, None, ""
+            return False
+        # CLEARING: a re-breach returns to firing WITHOUT a fresh
+        # fired_total tick (the alert never resolved); a full clear
+        # dwell resolves it.
+        if breach:
+            st.state, st.since = FIRING, now
+        elif now - st.since >= rule.clear_s:
+            st.state, st.since, st.detail = OK, None, ""
+        return False
+
+    @staticmethod
+    def _fire(st: _RuleState, now: float) -> bool:
+        st.state, st.since = FIRING, now
+        st.fired_total += 1
+        return True
+
+    # -- surfaces ------------------------------------------------------
+
+    def active(self) -> List[str]:
+        """Names of rules currently ACTIVE (firing or in their clear
+        dwell) — what /healthz names in its degraded reasons."""
+        with self._lock:
+            return [r.name for r in self.rules
+                    if self._st[r.name].state in ACTIVE_STATES]
+
+    def active_reasons(self) -> List[str]:
+        """``name(detail)`` strings for health surfaces."""
+        with self._lock:
+            out = []
+            for r in self.rules:
+                st = self._st[r.name]
+                if st.state in ACTIVE_STATES:
+                    out.append(f"{r.name}({st.detail})" if st.detail
+                               else r.name)
+            return out
+
+    def _state_dict(self, rule: Rule, st: _RuleState) -> Dict:
+        d = {
+            "rule": rule.name,
+            "signal": rule.signal,
+            "kind": rule.kind,
+            "value": rule.value,
+            "for_s": rule.for_s,
+            "clear_s": rule.clear_s,
+            "state": st.state,
+            "active": st.state in ACTIVE_STATES,
+            "fired_total": st.fired_total,
+            "last_value": st.last_value,
+        }
+        if rule.hint:
+            d["hint"] = rule.hint
+        if st.detail:
+            d["detail"] = st.detail
+        if rule.kind == "z":
+            d["ewma_mean"] = round(st.ewma_mean, 6)
+            d["last_z"] = (round(st.last_z, 3)
+                           if st.last_z is not None else None)
+        return d
+
+    def snapshot(self) -> Dict:
+        """The /alerts payload."""
+        with self._lock:
+            rules = [self._state_dict(r, self._st[r.name])
+                     for r in self.rules]
+        return {"active": [r["rule"] for r in rules if r["active"]],
+                "rules": rules}
+
+    def firing(self, hint: Optional[str] = None) -> List[Rule]:
+        """Rules currently FIRING (not merely holding through their
+        clear dwell), optionally filtered by hint tag — the rollback
+        consumer reads this."""
+        with self._lock:
+            return [r for r in self.rules
+                    if self._st[r.name].state == FIRING
+                    and (hint is None or r.hint == hint)]
+
+    def prom_families(self, labels: str = ""):
+        """``dsod_alert_active`` / ``dsod_alert_fired_total`` /
+        ``dsod_alert_value`` with one ``rule=`` sample per rule,
+        rendered unconditionally so the family inventory is stable."""
+        with self._lock:
+            rows = [(r.name, self._st[r.name].state in ACTIVE_STATES,
+                     self._st[r.name].fired_total,
+                     self._st[r.name].last_value)
+                    for r in self.rules]
+        pre = f"{labels}," if labels else ""
+        active, fired, value = [], [], []
+        for name, act, n, v in rows:
+            lbl = f'{pre}rule="{name}"'
+            active.append('dsod_alert_active{%s} %d' % (lbl, 1 if act else 0))
+            fired.append('dsod_alert_fired_total{%s} %d' % (lbl, n))
+            value.append('dsod_alert_value{%s} %g'
+                         % (lbl, v if v is not None else 0.0))
+        return [("dsod_alert_active", "gauge", active),
+                ("dsod_alert_fired_total", "counter", fired),
+                ("dsod_alert_value", "gauge", value)]
+
+
+def values_from_families(families, signals: Sequence[str]
+                         ) -> Dict[str, float]:
+    """Extract scalar signal values from a prom family list — the
+    bridge that lets a rule watch ANY registered family.
+
+    A signal spec is a family name (first sample wins) or
+    ``family{k="v",...}`` (first sample whose label set CONTAINS every
+    given pair).  Histogram families resolve through their ``_count``
+    sample.  Missing signals are simply absent from the result (the
+    engine skips them)."""
+    out: Dict[str, float] = {}
+    wanted = []
+    for spec in signals:
+        fam, _, label_part = spec.partition("{")
+        labels = []
+        if label_part:
+            for frag in label_part.rstrip("}").split(","):
+                frag = frag.strip()
+                if frag:
+                    labels.append(frag)
+        wanted.append((spec, fam, labels))
+    for name, _typ, samples in families:
+        for spec, fam, labels in wanted:
+            if spec in out or name != fam:
+                continue
+            for line in samples:
+                head, _, rest = line.partition(" ")
+                bare = head.partition("{")[0]
+                # Plain families: the sample named exactly ``fam``.
+                # Histograms: resolve through the ``_count`` sample.
+                if bare not in (fam, fam + "_count"):
+                    continue
+                if labels:
+                    lhead = head.partition("{")[2].rstrip("}")
+                    if not all(lbl in lhead for lbl in labels):
+                        continue
+                try:
+                    out[spec] = float(rest.split()[0])
+                except (ValueError, IndexError):
+                    continue
+                break
+    return out

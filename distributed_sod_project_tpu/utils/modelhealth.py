@@ -1,0 +1,311 @@
+"""Training numerics telemetry (docs/OBSERVABILITY.md "Model health").
+
+PR 9 made training *latency* observable (spans, sidecar /metrics);
+this module makes training *numerics* observable: a run that is
+silently diverging — exploding gradients, a parameter group gone NaN,
+an update/weight ratio drifting out of the stable band — should be a
+scraped gauge and a named alert, not a post-mortem.
+
+Two halves, one seam:
+
+- **In-program** (:func:`health_step_metrics`, called by all three
+  step builders — DP ``train/step.py``, GSPMD ``parallel/tp.py``, SP
+  ``parallel/sp.py`` — behind the ``health_numerics`` knob): per
+  parameter-group gradient norms, the group index that FIRST went
+  non-finite this step (provenance — ``optim.skip_nonfinite`` counts
+  skips but cannot attribute them), and the update-to-weight ratio.
+  All scalars, computed inside the compiled step (one extra pass over
+  the grads/params trees); with the knob off the step program is
+  byte-for-byte the historical one.
+- **On-host** (:class:`HealthMonitor`): aggregates the per-step values
+  the loop reads back at its normal metric cadence into the
+  ``dsod_health_*`` Prometheus families the PR-9 trainer sidecar
+  serves, and derives the scalar signals the alert engine
+  (utils/alerts.py) watches.
+
+Parameter groups are the TOP-LEVEL modules of the params tree (sorted
+— e.g. ``backbone``, ``decoder``, ``head``): coarse enough to stay
+cheap, fine enough that "which part of the model diverged first" has
+an answer.  The grouping is a pure function of the tree structure, so
+the in-program index and the host-side name list agree by
+construction.
+
+Observation cadence honesty: the loop feeds the monitor whenever it
+fetches metrics — every chunk under ``steps_per_dispatch>1``, the
+logging cadence at k=1 (fetching per step would add the host↔device
+sync the chunked loop exists to avoid).  The carried
+``notfinite_count`` (optax ``apply_if_finite``) still counts every
+skip regardless; only the *attribution* is sampled at the fetch
+cadence.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .alerts import Rule
+
+# Host metric keys that are NOT loss components (everything else in
+# the device metric dict at fetch time is one).
+_NON_LOSS_KEYS = frozenset(("grad_norm", "lr", "notfinite_count",
+                            "epoch", "imgs_per_sec"))
+
+PREFIX = "health/"
+NONFINITE_KEY = PREFIX + "nonfinite_group"
+UPDATE_RATIO_KEY = PREFIX + "update_weight_ratio"
+WEIGHT_NORM_KEY = PREFIX + "weight_norm"
+GROUP_PREFIX = PREFIX + "grad_group_norm/"
+
+
+def param_group_names(params) -> Tuple[str, ...]:
+    """Sorted top-level module names of a params tree — the shared
+    group order for the in-program provenance index and the host-side
+    name mapping.  A non-mapping tree is one group, ``params``."""
+    try:
+        keys = sorted(str(k) for k in params.keys())
+    except AttributeError:
+        return ("params",)
+    return tuple(keys) if keys else ("params",)
+
+
+def _group_subtrees(tree) -> List[Tuple[str, object]]:
+    try:
+        keys = sorted(str(k) for k in tree.keys())
+    except AttributeError:
+        keys = []
+    if not keys:
+        return [("params", tree)]
+    return [(k, tree[k]) for k in keys]
+
+
+def health_step_metrics(params, grads, new_params) -> Dict[str, object]:
+    """The in-program numerics scalars for one step (call with the
+    POST-reduction grads so every replica logs identical values):
+
+    - ``health/grad_group_norm/<group>`` — per-group gradient global
+      norm (NaN when that group's grads are non-finite — the raw
+      truth rides the metric stream; the host monitor sanitizes for
+      Prometheus).
+    - ``health/nonfinite_group`` — index (in sorted group order) of
+      the FIRST group with a non-finite gradient this step, −1 when
+      all finite.
+    - ``health/update_weight_ratio`` — ‖params′ − params‖ / ‖params‖
+      (0 when the update was skipped by ``apply_if_finite``).
+    - ``health/weight_norm`` — ‖params‖.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    groups = _group_subtrees(grads)
+    metrics: Dict[str, object] = {}
+    flags = []
+    for name, sub in groups:
+        leaves = jax.tree_util.tree_leaves(sub)
+        metrics[GROUP_PREFIX + name] = optax.global_norm(sub)
+        if leaves:
+            nf = jnp.any(jnp.stack(
+                [jnp.any(~jnp.isfinite(leaf)) for leaf in leaves]))
+        else:
+            nf = jnp.asarray(False)
+        flags.append(nf)
+    flags = jnp.stack(flags)
+    metrics[NONFINITE_KEY] = jnp.where(
+        jnp.any(flags), jnp.argmax(flags), -1).astype(jnp.float32)
+    upd = optax.global_norm(jax.tree_util.tree_map(
+        lambda a, b: a - b, new_params, params))
+    wn = optax.global_norm(params)
+    metrics[WEIGHT_NORM_KEY] = wn
+    metrics[UPDATE_RATIO_KEY] = upd / (wn + 1e-12)
+    return metrics
+
+
+def default_numerics_rules(for_s: float = 0.0, clear_s: float = 30.0
+                           ) -> List[Rule]:
+    """The built-in training alert set (custom rules ride
+    ``health_alert_rules``):
+
+    - ``numerics_nonfinite`` — any step in the observed interval
+      produced a non-finite gradient (fires immediately, provenance
+      group in the detail; ``hint="rollback"`` for the opt-in
+      supervisor hand-off).
+    - ``grad_norm_spike`` / ``loss_spike`` — EWMA z-score > 6 on the
+      gradient norm / total loss (the slow-divergence shape a plain
+      threshold cannot know the scale of in advance).
+    """
+    return [
+        Rule("numerics_nonfinite", "nonfinite_interval", "gt", 0.0,
+             for_s=0.0, clear_s=clear_s, hint="rollback"),
+        Rule("grad_norm_spike", "grad_norm", "z", 6.0,
+             for_s=for_s, clear_s=clear_s),
+        Rule("loss_spike", "loss_total", "z", 6.0,
+             for_s=for_s, clear_s=clear_s),
+    ]
+
+
+def _finite(v: Optional[float]) -> float:
+    """NaN/None → 0.0 for Prometheus gauge rendering (the raw value
+    still rides snapshot()/the metric stream)."""
+    if v is None or v != v or v in (float("inf"), float("-inf")):
+        return 0.0
+    return float(v)
+
+
+class HealthMonitor:
+    """Host-side aggregation of the in-program numerics metrics.
+
+    Thread-safe: the train loop writes at its metric cadence while the
+    telemetry sidecar renders ``prom_families`` concurrently (the same
+    concurrent-reader contract PipelineStats honors).
+    """
+
+    def __init__(self, group_names: Tuple[str, ...]):
+        if not group_names:
+            raise ValueError("HealthMonitor needs at least one group")
+        self.group_names = tuple(group_names)
+        self._lock = threading.Lock()
+        self._steps = 0
+        self._nonfinite_total = 0
+        self._nonfinite_by_group = {g: 0 for g in self.group_names}
+        self._recent_nonfinite = 0          # since the last signals() read
+        self._last_nonfinite_group = ""
+        self._grad_norm: Optional[float] = None
+        self._update_ratio: Optional[float] = None
+        self._weight_norm: Optional[float] = None
+        self._group_norms: Dict[str, Optional[float]] = {
+            g: None for g in self.group_names}
+        self._loss: Dict[str, float] = {}
+        self._notfinite_consec = 0.0
+
+    # -- ingest --------------------------------------------------------
+
+    def observe(self, metrics_host: Dict) -> None:
+        """Feed one fetched device-metric dict.  Leaves may be
+        (k,)-stacked under step chunking: counters sweep EVERY entry
+        (a mid-chunk NaN must not hide behind a clean last step);
+        gauges keep the last entry — exactly the value a k=1 loop
+        would report at this boundary."""
+        import numpy as np
+
+        def flat(v):
+            return np.asarray(v, dtype=np.float64).reshape(-1)
+
+        nf = metrics_host.get(NONFINITE_KEY)
+        with self._lock:
+            if nf is not None:
+                idxs = flat(nf)
+                self._steps += len(idxs)
+                for i in idxs:
+                    if i >= 0:
+                        g = self.group_names[min(int(i),
+                                                 len(self.group_names) - 1)]
+                        self._nonfinite_total += 1
+                        self._nonfinite_by_group[g] += 1
+                        self._recent_nonfinite += 1
+                        self._last_nonfinite_group = g
+            for key, v in metrics_host.items():
+                if not key.startswith(GROUP_PREFIX):
+                    continue
+                g = key[len(GROUP_PREFIX):]
+                if g in self._group_norms:
+                    self._group_norms[g] = float(flat(v)[-1])
+            for key, attr in ((UPDATE_RATIO_KEY, "_update_ratio"),
+                              (WEIGHT_NORM_KEY, "_weight_norm"),
+                              ("grad_norm", "_grad_norm")):
+                v = metrics_host.get(key)
+                if v is not None:
+                    setattr(self, attr, float(flat(v)[-1]))
+            v = metrics_host.get("notfinite_count")
+            if v is not None:
+                self._notfinite_consec = float(flat(v)[-1])
+            for key, v in metrics_host.items():
+                if (key.startswith(PREFIX) or key in _NON_LOSS_KEYS
+                        or key.startswith("data_")):
+                    continue
+                arr = flat(v)
+                if arr.size:
+                    self._loss[key] = float(arr[-1])
+
+    # -- alert signals -------------------------------------------------
+
+    def signals(self) -> Tuple[Dict[str, float], Dict[str, str]]:
+        """``(signals, details)`` for the alert engine.
+        ``nonfinite_interval`` is the count of non-finite steps
+        observed since the previous read (reset on read — the alert's
+        clear dwell, not this counter, provides the hold)."""
+        with self._lock:
+            recent = self._recent_nonfinite
+            self._recent_nonfinite = 0
+            sigs = {
+                "nonfinite_interval": float(recent),
+                "notfinite_consecutive": self._notfinite_consec,
+            }
+            if self._grad_norm is not None:
+                sigs["grad_norm"] = self._grad_norm
+            if self._update_ratio is not None:
+                sigs["update_weight_ratio"] = self._update_ratio
+            if "total" in self._loss:
+                sigs["loss_total"] = self._loss["total"]
+            detail = (f"group={self._last_nonfinite_group}"
+                      if self._last_nonfinite_group else "")
+        details = {"nonfinite_interval": detail,
+                   "notfinite_consecutive": detail} if detail else {}
+        return sigs, details
+
+    # -- surfaces ------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "steps_observed": self._steps,
+                "nonfinite_total": self._nonfinite_total,
+                "nonfinite_by_group": dict(self._nonfinite_by_group),
+                "last_nonfinite_group": self._last_nonfinite_group,
+                "grad_norm": self._grad_norm,
+                "update_weight_ratio": self._update_ratio,
+                "weight_norm": self._weight_norm,
+                "grad_group_norms": dict(self._group_norms),
+                "loss": dict(self._loss),
+                "notfinite_consecutive": self._notfinite_consec,
+            }
+
+    def prom_families(self, labels: str = ""):
+        """The ``dsod_health_*`` families (trainer sidecar /metrics).
+        Every family renders unconditionally — zero-valued while idle —
+        so the inventory (tools/metrics_lint.py) is run-independent."""
+        with self._lock:
+            steps = self._steps
+            nft = self._nonfinite_total
+            by_group = dict(self._nonfinite_by_group)
+            gnorms = dict(self._group_norms)
+            gauges = [
+                ("dsod_health_grad_norm", _finite(self._grad_norm)),
+                ("dsod_health_update_weight_ratio",
+                 _finite(self._update_ratio)),
+                ("dsod_health_weight_norm", _finite(self._weight_norm)),
+                ("dsod_health_notfinite_consecutive",
+                 _finite(self._notfinite_consec)),
+            ]
+            loss = dict(self._loss)
+        sb = f"{{{labels}}}" if labels else ""
+        pre = f"{labels}," if labels else ""
+        fams = [
+            ("dsod_health_steps_observed_total", "counter",
+             [f"dsod_health_steps_observed_total{sb} {steps}"]),
+            ("dsod_health_nonfinite_total", "counter",
+             [f"dsod_health_nonfinite_total{sb} {nft}"]),
+            ("dsod_health_nonfinite_group_total", "counter",
+             ['dsod_health_nonfinite_group_total{%sgroup="%s"} %d'
+              % (pre, g, by_group[g]) for g in self.group_names]),
+            ("dsod_health_grad_group_norm", "gauge",
+             ['dsod_health_grad_group_norm{%sgroup="%s"} %g'
+              % (pre, g, _finite(gnorms[g])) for g in self.group_names]),
+        ]
+        for name, v in gauges:
+            fams.append((name, "gauge", [f"{name}{sb} {v:g}"]))
+        fams.append(("dsod_health_loss", "gauge", [
+            'dsod_health_loss{%scomponent="%s"} %g'
+            % (pre, k, _finite(v)) for k, v in sorted(loss.items())]
+            or ['dsod_health_loss{%scomponent="total"} 0' % pre]))
+        return fams

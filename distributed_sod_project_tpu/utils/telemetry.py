@@ -125,10 +125,11 @@ class TrainerTelemetry:
                  host: str = "127.0.0.1", port: int = 0,
                  port_file: Optional[str] = None, watchdog=None,
                  tracer: Optional[Tracer] = None,
-                 profile_dir: Optional[str] = None):
+                 profile_dir: Optional[str] = None, alerts=None):
         self.registry = registry
         self.watchdog = watchdog
         self.tracer = tracer
+        self.alerts = alerts  # utils/alerts.AlertEngine | None
         self.profile_dir = profile_dir or "."
         self._host = host
         self._port = int(port)
@@ -208,26 +209,41 @@ class TrainerTelemetry:
                                          "worst": {}})
             else:
                 handler._send_json(200, self.tracer.snapshot(n))
+        elif path == "/alerts":
+            if self.alerts is None:
+                handler._send_json(200, {"active": [], "rules": []})
+            else:
+                handler._send_json(200, self.alerts.snapshot())
         elif path == "/debug/profile":
             self._handle_profile(handler, split.query)
         else:
             handler._send_json(404, {"error": f"no route {path}"})
 
     def _health(self):
+        # Active model-health alerts DEGRADE the verdict (200 with the
+        # rules named — the run lives, the model may not) and never
+        # mask the watchdog's 503 (a wedged dispatch outranks a
+        # quality worry).
+        active = self.alerts.active_reasons() if self.alerts else []
         wd = self.watchdog
         if wd is None:
             # No watchdog armed: the sidecar answering at all proves
             # the process lives; say so honestly instead of inventing
             # a liveness signal the loop is not feeding.
-            return 200, {"status": "ok", "watchdog": "off"}
+            body = {"status": "ok", "watchdog": "off"}
+            if active:
+                body.update(status="degraded", alerts=active)
+            return 200, body
         if wd.fired:
             return 503, {"status": "stalled", "watchdog": "fired",
                          "last_step": wd.last_step}
         age = wd.seconds_since_beat()
-        return 200, {"status": "ok",
-                     "last_beat_s": round(age, 3) if age is not None
-                     else None,
-                     "last_step": wd.last_step}
+        body = {"status": "ok",
+                "last_beat_s": round(age, 3) if age is not None else None,
+                "last_step": wd.last_step}
+        if active:
+            body.update(status="degraded", alerts=active)
+        return 200, body
 
     def _handle_profile(self, handler, query: str) -> None:
         import urllib.parse
@@ -291,10 +307,16 @@ class TrainerTelemetry:
 def build_trainer_telemetry(cfg, *, data_stats, timer, writer,
                             watchdog=None, tracer=None, workdir=None,
                             step_fn=None, port: Optional[int] = None,
-                            port_file: Optional[str] = None
+                            port_file: Optional[str] = None,
+                            health=None, alerts=None
                             ) -> Optional[TrainerTelemetry]:
     """fit()'s one-call bring-up: None when telemetry is off
-    (``cfg.telemetry_port < 0`` and no explicit ``port``)."""
+    (``cfg.telemetry_port < 0`` and no explicit ``port``).
+
+    ``health`` (utils/modelhealth.HealthMonitor) and ``alerts``
+    (utils/alerts.AlertEngine) — both optional — add the
+    ``dsod_health_*`` / ``dsod_alert_*`` families to /metrics and back
+    the /alerts endpoint + the degraded /healthz verdict."""
     eff_port = cfg.telemetry_port if port is None else port
     if eff_port is None or eff_port < 0:
         return None
@@ -304,6 +326,11 @@ def build_trainer_telemetry(cfg, *, data_stats, timer, writer,
             batch_size=cfg.global_batch_size,
             writer_backend=writer.backend, step_fn=step_fn,
             tracer=tracer))
+    if health is not None:
+        registry.register("health", health.prom_families)
+    if alerts is not None:
+        registry.register("alerts", alerts.prom_families)
     return TrainerTelemetry(
         registry, host="127.0.0.1", port=eff_port, port_file=port_file,
-        watchdog=watchdog, tracer=tracer, profile_dir=workdir).start()
+        watchdog=watchdog, tracer=tracer, profile_dir=workdir,
+        alerts=alerts).start()

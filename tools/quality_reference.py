@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Build/refresh the checked-in drift reference
+(``tools/quality_reference.json``) for the online quality monitors
+(serve/quality.py; docs/OBSERVABILITY.md "Model health").
+
+The PSI drift gauges compare LIVE traffic's input/output histograms
+against a reference distribution captured under known-good conditions.
+This tool IS that capture: it runs the fixed synthetic eval set (the
+same deterministic per-(seed, index) pixels every box renders —
+tools/precision_gate.py's posture) through the real preprocess +
+serving f32 forward and writes the resulting histograms keyed by model
+name.  Re-run with ``--update`` after an intentional distribution or
+model change — the precision_gate/hlo_guard ledger discipline: the
+reference is an artifact you re-seed deliberately, never implicitly.
+
+Usage:
+    python tools/quality_reference.py                    # print, no write
+    python tools/quality_reference.py --update           # write the file
+    python tools/quality_reference.py --ckpt-dir runs/m --update
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DEFAULT_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "quality_reference.json")
+
+
+def denormalize_uint8(img, mean, std):
+    """Synthetic sample (normalized float) → the uint8 request image a
+    client would send — the reference must histogram REQUEST-shaped
+    inputs, the thing the live monitor sees."""
+    import numpy as np
+
+    raw = np.clip(img * std + mean, 0.0, 1.0)
+    return (raw * 255.0).round().astype(np.uint8)
+
+
+def build_counts(cfg, model, variables, *, num_images: int,
+                 image_size: int):
+    """Run the synthetic set through preprocess + the f32 serving
+    forward, accumulating through the REAL QualityMonitor code path —
+    the reference and the live histograms cannot disagree on binning."""
+    import dataclasses
+
+    import numpy as np
+
+    from distributed_sod_project_tpu.data.folder import resolve_dataset
+    from distributed_sod_project_tpu.eval.inference import pad_to_batch
+    from distributed_sod_project_tpu.serve.engine import preprocess_image
+    from distributed_sod_project_tpu.serve.precision import \
+        make_precision_forward
+    from distributed_sod_project_tpu.serve.quality import (QualityMonitor,
+                                                           input_mean01)
+
+    data_cfg = dataclasses.replace(
+        cfg.data, dataset="synthetic", root=None,
+        synthetic_size=num_images,
+        image_size=(image_size, image_size))
+    dataset = resolve_dataset(data_cfg)
+    mean = np.asarray(cfg.data.normalize_mean, np.float32)
+    std = np.asarray(cfg.data.normalize_std, np.float32)
+    fwd = make_precision_forward(model, "f32")
+    monitor = QualityMonitor(cfg.model.name)
+    for i in range(len(dataset)):
+        raw = denormalize_uint8(dataset[i]["image"], mean, std)
+        monitor.observe_input(input_mean01(raw))
+        tensor = preprocess_image(raw, image_size, mean, std)
+        batch = pad_to_batch({"image": tensor[None]}, 1)
+        probs = np.asarray(fwd(variables, batch))[0]
+        monitor.observe_output(probs)
+    return monitor.reference_counts()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="minet_vgg16_ref")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="reference a trained checkpoint instead of the "
+                        "random-init posture (config sidecar aware)")
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--num-images", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--model-name", default=None,
+                   help="JSON key (default: the config's model name — "
+                        "the serve engine looks itself up by "
+                        "cfg.model.name)")
+    p.add_argument("--out", default=_DEFAULT_OUT)
+    p.add_argument("--update", action="store_true",
+                   help="write/merge the entry into --out (without "
+                        "this the counts only print)")
+    p.add_argument("--device", default="cpu", choices=["tpu", "cpu"])
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="PATH=VALUE", help="dotted config override")
+    args = p.parse_args(argv)
+
+    from distributed_sod_project_tpu.utils.platform import select_platform
+
+    select_platform(args.device)
+
+    import jax
+    import numpy as np
+
+    from distributed_sod_project_tpu.configs import (apply_overrides,
+                                                     get_config)
+
+    hw = args.image_size
+    if args.ckpt_dir:
+        from distributed_sod_project_tpu.eval.inference import \
+            restore_for_eval
+
+        cfg, model, state = restore_for_eval(
+            args.ckpt_dir, config_name=None,
+            overrides=[f"data.image_size={hw},{hw}"]
+            + list(args.overrides))
+        variables = state.eval_variables()
+    else:
+        from distributed_sod_project_tpu.models import build_model
+        from distributed_sod_project_tpu.train import (build_optimizer,
+                                                       create_train_state)
+
+        cfg = apply_overrides(
+            get_config(args.config),
+            [f"data.image_size={hw},{hw}", f"seed={args.seed}"]
+            + list(args.overrides))
+        model = build_model(cfg.model)
+        tx, _ = build_optimizer(cfg.optim, 1)
+        probe = {"image": np.zeros((1, hw, hw, 3), np.float32)}
+        if cfg.data.use_depth:
+            probe["depth"] = np.zeros((1, hw, hw, 1), np.float32)
+        state = create_train_state(jax.random.key(cfg.seed), model, tx,
+                                   probe, ema=cfg.optim.ema_decay > 0)
+        variables = state.eval_variables()
+
+    counts = build_counts(cfg, model, variables,
+                          num_images=args.num_images, image_size=hw)
+    key = args.model_name or cfg.model.name
+    summary = {"metric": f"quality_reference[{key}]",
+               "num_images": args.num_images, "image_size": hw,
+               "counts": counts}
+    if args.update:
+        data = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                data = json.load(f)
+        data[key] = counts
+        with open(args.out, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+        summary["recorded"] = True
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,6 +37,9 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/precision_gate.py \
 echo "== metrics-family inventory lint: fleet + trainer /metrics surfaces vs tools/metrics_inventory.json (recorded, non-gating) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_lint.py \
   || echo "metrics lint failed (non-gating; --update-baseline re-seeds after an INTENDED surface change)"
+echo "== model-health smoke: real trainer sidecar under an injected NaN (provenance-attributed alert fire/clear) + real server with quality monitors, shadow scoring, injected drift alert (recorded, non-gating) =="
+timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/health_smoke.py \
+  || echo "health smoke failed (non-gating; tests/test_modelhealth.py + tests/test_quality_monitor.py below gate the in-process side)"
 echo "== fleet smoke: real-process router + remote replica, mixed-tenant loadgen, SIGKILL-mid-fleet degraded health, fleet accounting, clean SIGTERM drain (recorded, non-gating) =="
 timeout -k 10 720 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py \
   || echo "fleet smoke failed (non-gating; tests/test_fleet.py below gates the in-process side)"

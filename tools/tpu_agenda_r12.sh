@@ -1,0 +1,140 @@
+#!/bin/bash
+# Round-12 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh fires this automatically; default agenda since
+# round 12).  Round 12 landed the MODEL-HEALTH layer: training
+# numerics telemetry (utils/modelhealth.py → dsod_health_* on the
+# trainer sidecar), online serving quality/drift monitors + shadow
+# scoring (serve/quality.py → dsod_quality_*), and the alert engine
+# (utils/alerts.py → /alerts + dsod_alert_*) — docs/OBSERVABILITY.md
+# "Model health".  Correctness is proven on CPU
+# (tests/test_modelhealth.py, tests/test_quality_monitor.py,
+# tools/health_smoke.py: provenance-attributed NaN alerts fire/clear,
+# shadow disagreement ≡ offline gate, fake-clock alert determinism);
+# what only hardware can answer is the OVERHEAD of the monitors where
+# the forwards they ride are ~100× faster than CPU:
+#
+#   1. canonical b128 headline refresh (comparison anchor)
+#   2. MONITOR-OVERHEAD serve A/B: the same closed-loop serve bench
+#      with quality monitors off vs on (output stats + drift
+#      histograms, shadow at the default-off 0 and at 10% sampling).
+#   3. MONITOR-OVERHEAD train A/B: one training window with
+#      health_numerics off vs on (the per-group norm pass rides the
+#      compiled step — its cost is a device number, not a host one).
+#   4. live quality leg: loadgen --quality against the monitored
+#      server records shadow-disagreement + PSI gauges next to the
+#      latency curve, and the live /alerts + metrics_lint --url check
+#      the surface end-to-end.
+#
+# Predictions on record (docs/OBSERVABILITY.md "Model health"):
+# (a) serve p50 tax with monitors on, shadow OFF: < 2% (one subsampled
+#     numpy pass + one histogram bump per request — CPU measured the
+#     bound; TPU device time shrinks, host stats cost is unchanged
+#     but so is the host's share of e2e);
+# (b) serve p50 tax at shadow_sample=0.1: < 2% p50 — shadows ride a
+#     bounded side lane and DROP rather than queue, so the tax shows
+#     up in dsod_quality_shadow_dropped_total, not in p50; throughput
+#     cost bounded by ~10% extra forwards at full occupancy;
+# (c) train step-time tax with health_numerics on: < 2% (one extra
+#     pass over grads/params inside the step; XLA overlaps it).
+#
+# Serve legs talk to processes started here (ephemeral ports,
+# --port-file); loadgen itself never imports jax.
+cd "$(dirname "$0")/.." || exit 1
+R=${R:-tpu_results12}
+mkdir -p "$R"
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+done_ok() {
+  [ -f "$R"/results.jsonl ] || return 1
+  local rec
+  rec=$(grep "\"step\": \"$1\", \"rc\": 0" "$R"/results.jsonl | tail -1)
+  [ -n "$rec" ] || return 1
+  ! printf '%s' "$rec" | grep -q '"error"'
+}
+
+tunnel_computes() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('computes')" 2>/dev/null | grep -q computes
+}
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  if done_ok "$name"; then
+    echo "[$name] skip: succeeded in a previous window" | tee -a "$R"/agenda.log
+    return 0
+  fi
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+  if { [ "$rc" -ne 0 ] || printf '%s' "$line" | grep -Eq 'wedged|unavailable'; } \
+      && ! tunnel_computes; then
+    echo "[$name] tunnel no longer computes — aborting firing (watcher will re-fire)" \
+      | tee -a "$R"/agenda.log
+    exit 2
+  fi
+}
+
+# -- 1. canonical headline refresh (the r5-r11 key replays unchanged)
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2. monitor-overhead serve A/B: off / monitors-on-shadow-off /
+#       monitors-on-shadow-10%.  Compare p50/p99 across the three
+#       legs; predictions (a)/(b) above.
+run serve_health_off 1500 $BENCH --config minet_r50_dp --mode serve \
+    --steps 300 --set "serve.batch_buckets=1,4,8,16"
+run serve_health_on 1500 $BENCH --config minet_r50_dp --mode serve \
+    --steps 300 --set "serve.batch_buckets=1,4,8,16" \
+    --set serve.quality_monitor=true
+run serve_health_shadow10 1500 $BENCH --config minet_r50_dp --mode serve \
+    --steps 300 --set "serve.batch_buckets=1,4,8,16" \
+    --set serve.quality_monitor=true \
+    --set "serve.precision_arms=f32,bf16" --set serve.precision=bf16 \
+    --set serve.quality_shadow_sample=0.1
+
+# -- 3. monitor-overhead train A/B: one window each, health off vs on.
+#       Compare imgs_per_sec / step_time_ms; prediction (c).
+run train_health_off 1200 $BENCH --config minet_r50_dp
+run train_health_on 1200 $BENCH --config minet_r50_dp \
+    --set health_numerics=true
+
+# -- 4. live quality leg: a monitored server + loadgen --quality, the
+#       live /alerts surface, and the live-inventory lint.
+SPORT_FILE="$R/serve_health.port"
+rm -f "$SPORT_FILE"
+python tools/serve.py --config minet_r50_dp --init-random --device tpu \
+  --port 0 --port-file "$SPORT_FILE" \
+  --set "serve.batch_buckets=1,4,8,16" \
+  --set "serve.precision_arms=f32,bf16" --set serve.precision=bf16 \
+  --set serve.quality_monitor=true \
+  --set serve.quality_shadow_sample=0.1 \
+  > "$R"/serve_health.out 2> "$R"/serve_health.err &
+SERVE_PID=$!
+for _ in $(seq 1 240); do [ -f "$SPORT_FILE" ] && break; sleep 2; done
+if [ -f "$SPORT_FILE" ]; then
+  SURL="http://127.0.0.1:$(cat "$SPORT_FILE")"
+  run quality_loadgen 900 python tools/loadgen.py --url "$SURL" \
+      --mode open --rps 50 --duration 30 --wait-ready 120 \
+      --precision bf16 --quality
+  run quality_alerts 60 curl -sf "$SURL/alerts"
+  run quality_lint 120 python tools/metrics_lint.py --url "$SURL"
+  kill -TERM "$SERVE_PID" 2>/dev/null
+  wait "$SERVE_PID"
+  echo "{\"step\": \"serve_health_exit\", \"rc\": $?, \"result\": null}" >> "$R"/results.jsonl
+else
+  echo "monitored server never bound a port — skipping quality legs" | tee -a "$R"/agenda.log
+  kill -9 "$SERVE_PID" 2>/dev/null
+fi
+
+# Host-side window report (touches no TPU).
+timeout 120 python tools/window_report.py "$R"/results.jsonl \
+    > "$R"/window_report.md 2> "$R"/window_report.err || true
+tail -20 "$R"/window_report.md | tee -a "$R"/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
