@@ -315,6 +315,37 @@ class ServeConfig:
     # — comma-free so --set tuple coercion passes them through); they
     # join the built-in quality rules when the monitor is on.
     alert_rules: Tuple[str, ...] = ()
+    # -- capacity & SLO observability (utils/capacity.py, utils/slo.py;
+    #    docs/OBSERVABILITY.md "Capacity & SLO").  Both OFF by default:
+    #    /metrics stays byte-identical to the ledger-less rendering.
+    # Live per-compiled-program cost ledger: at AOT warmup every cached
+    # executable's cost_analysis()/memory_analysis() is recorded, and
+    # the per-(res,batch,arm) EWMA device time turns it into live
+    # MFU / roofline-utilization / HBM gauges (dsod_capacity_*), plus a
+    # device-vs-queue-vs-host stage-share attribution gauge derived
+    # from the PR-9 stage splits — the scale-out-vs-futile signal.
+    capacity_ledger: bool = False
+    # Declarative SLO objectives, colon DSL (comma-free):
+    #   name:scope:kind:goal:window_s[:latency_ms]
+    #   scope = all | model=NAME | tenant=NAME
+    #   kind  = availability (good = served ok)
+    #         | latency      (good = served ok within latency_ms)
+    # e.g. "avail:all:availability:0.999:3600"
+    #      "fast:all:latency:0.95:3600:250"
+    # Empty = off.  Non-empty arms sliding-window error-budget
+    # accounting + multi-window burn rates (dsod_slo_* families, the
+    # /slo endpoint) fed by the server's own terminal outcomes;
+    # burn-rate/budget rules ride the alert engine and degrade
+    # /healthz on budget exhaustion.
+    slo_objectives: Tuple[str, ...] = ()
+    # Burn-rate alert threshold: the rule fires when BOTH the fast
+    # (window/12) and slow (full-window) burn rates exceed it (the
+    # multi-window AND — min of the two windows is the signal).
+    slo_burn_threshold: float = 10.0
+    # Hysteresis dwells of the built-in SLO rules (alert-engine
+    # semantics: breach for_s before firing, clear clear_s to resolve).
+    slo_alert_for_s: float = 5.0
+    slo_alert_clear_s: float = 60.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -437,6 +468,38 @@ class FleetConfig:
     trace_capacity: int = 256
     trace_worst_n: int = 4
 
+    # -- capacity & SLO observability (utils/slo.py, serve/prober.py;
+    #    docs/OBSERVABILITY.md "Capacity & SLO") -----------------------
+    # Router-tier SLO objectives (same colon DSL as
+    # serve.slo_objectives; scope model=/tenant= keys match the fleet's
+    # routing keys and tenant classes).  Fed by the ROUTER'S OWN exact
+    # terminal book — every counted submission feeds its matching
+    # objectives with its one terminal outcome, so /slo reconciles
+    # against /stats' fleet identity.  Empty = off (byte-identical
+    # /metrics).
+    slo_objectives: Tuple[str, ...] = ()
+    slo_burn_threshold: float = 10.0
+    slo_alert_for_s: float = 5.0
+    slo_alert_clear_s: float = 60.0
+    # Synthetic canary prober (serve/prober.py): > 0 starts a
+    # background thread pushing one known-ground-truth synthetic probe
+    # through the FULL router→engine path every this-many seconds,
+    # round-robin over the fleet's models, under the reserved
+    # prober_tenant (auto-registered at the LOWEST priority so probes
+    # shed first under overload — and the prober itself DROPS, counted,
+    # rather than queue when its previous probe is still in flight).
+    # Probe latency/quality/availability export as dsod_probe_*;
+    # because probes ride the real door they also feed the router book
+    # and any model-scoped SLO — outages fire burn-rate alerts even at
+    # zero live traffic.  0 = off.
+    prober_interval_s: float = 0.0
+    prober_tenant: str = "_probe"
+    # Square pixel size of the synthetic probe images (resized into the
+    # target model's resolution buckets like any request).
+    prober_px: int = 64
+    # Per-probe HTTP timeout.
+    prober_timeout_s: float = 10.0
+
 
 def fleet_config_from_dict(d: Dict) -> FleetConfig:
     """Build + validate a FleetConfig from its JSON dict (the
@@ -542,11 +605,49 @@ def validate_fleet_config(fc: FleetConfig) -> FleetConfig:
         raise ValueError(
             "fleet trace_capacity must be >= 1 and trace_worst_n >= 0, "
             f"got {fc.trace_capacity}/{fc.trace_worst_n}")
+    if fc.slo_objectives:
+        # Loud parse at config time, not first scrape (utils/slo.py).
+        from ..utils.slo import parse_slos
+
+        parse_slos(fc.slo_objectives)
+    if fc.slo_burn_threshold <= 0:
+        raise ValueError(
+            f"fleet slo_burn_threshold must be > 0, got "
+            f"{fc.slo_burn_threshold}")
+    if fc.slo_alert_for_s < 0 or fc.slo_alert_clear_s < 0:
+        raise ValueError(
+            "fleet slo_alert_for_s/slo_alert_clear_s must be >= 0")
+    if fc.prober_interval_s < 0:
+        raise ValueError(
+            f"fleet prober_interval_s must be >= 0 (0 = off), got "
+            f"{fc.prober_interval_s}")
+    if fc.prober_interval_s > 0:
+        if not fc.prober_tenant:
+            raise ValueError(
+                "fleet prober_tenant must be non-empty when the prober "
+                "is on")
+        if fc.prober_px < 8:
+            raise ValueError(
+                f"fleet prober_px must be >= 8, got {fc.prober_px}")
+        if fc.prober_timeout_s <= 0:
+            raise ValueError(
+                f"fleet prober_timeout_s must be > 0, got "
+                f"{fc.prober_timeout_s}")
     if fc.default_tenant not in tseen:
         low = min((t.priority for t in fc.tenants), default=0)
         fc = dataclasses.replace(
             fc, tenants=fc.tenants + (FleetTenantConfig(
                 name=fc.default_tenant, priority=low),))
+        tseen.add(fc.default_tenant)
+    if fc.prober_interval_s > 0 and fc.prober_tenant not in tseen:
+        # Reserved probe tenant, registered AFTER the default tenant so
+        # it lands STRICTLY below every class (default included): under
+        # overload probes are the FIRST thing the router sheds —
+        # synthetic traffic must never displace a real request.
+        low = min(t.priority for t in fc.tenants) - 1
+        fc = dataclasses.replace(
+            fc, tenants=fc.tenants + (FleetTenantConfig(
+                name=fc.prober_tenant, priority=low),))
     return fc
 
 
@@ -625,6 +726,26 @@ class ExperimentConfig:
     # policy recognizes — the alert engine becomes a rollback hint,
     # not just a dashboard.  Off: alerts only report.
     health_rollback_hint: bool = False
+    # -- capacity & SLO observability, trainer side (utils/capacity.py,
+    #    utils/slo.py; docs/OBSERVABILITY.md "Capacity & SLO").  Both
+    #    OFF by default: the step program, the metric stream, and the
+    #    sidecar /metrics are byte-for-byte the historical ones.
+    # Live train-step cost ledger: each step program is additionally
+    # AOT-compiled ONCE for its cost_analysis()/memory_analysis()
+    # (one extra compile per static shape, paid only when opted in)
+    # and the StepTimer's measured step time turns it into live
+    # MFU/roofline gauges on the telemetry sidecar.
+    capacity_ledger: bool = False
+    # Goodput SLO on train steps (same colon DSL as
+    # serve.slo_objectives; kind=latency over per-step wall time is
+    # the meaningful form — every completed step feeds one event):
+    # e.g. "goodput:all:latency:0.99:600:2000" = 99% of steps under
+    # 2 s over any 10-minute window.  Surfaces as dsod_slo_* + /slo on
+    # the sidecar; burn/budget alerts degrade the sidecar /healthz.
+    slo_objectives: Tuple[str, ...] = ()
+    slo_burn_threshold: float = 10.0
+    slo_alert_for_s: float = 5.0
+    slo_alert_clear_s: float = 60.0
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

@@ -166,6 +166,45 @@ class InferenceEngine:
             self.telemetry.register("quality", self.quality.prom_families)
             self.telemetry.register("alerts", self.alerts.prom_families)
 
+        # Capacity ledger + SLO tracker (utils/capacity.py, utils/slo.py;
+        # docs/OBSERVABILITY.md "Capacity & SLO").  Both None unless
+        # their knobs are on — every touch guards, and with them off
+        # the registry keeps its historical providers, so /metrics is
+        # byte-identical to the ledger-less rendering.
+        self.capacity = None
+        self.slo = None
+        self._next_slo_eval = 0.0
+        if sc.capacity_ledger:
+            from ..utils.capacity import CapacityLedger
+
+            def _stage_shares():
+                # Device-vs-queue-vs-host attribution from the stage
+                # splits the histograms already hold (PR-9 seams):
+                # deep queues + high device share → scale out; deep
+                # queues + low device share → host-bound, scaling out
+                # is futile (ROADMAP item 2's signal).
+                e2e = self.stats.e2e_ms.sum_ms
+                if e2e <= 0:
+                    return {"device": 0.0, "queue": 0.0, "host": 0.0}
+                dev = self.stats.device_ms.sum_ms / e2e
+                q = self.stats.queue_ms.sum_ms / e2e
+                return {"device": min(dev, 1.0), "queue": min(q, 1.0),
+                        "host": max(1.0 - dev - q, 0.0)}
+
+            self.capacity = CapacityLedger(share_fn=_stage_shares)
+            self.telemetry.register("capacity",
+                                    self.capacity.prom_families)
+        if sc.slo_objectives:
+            from ..utils.slo import build_tracker
+
+            self.slo = build_tracker(
+                sc.slo_objectives, burn_threshold=sc.slo_burn_threshold,
+                alert_for_s=sc.slo_alert_for_s,
+                alert_clear_s=sc.slo_alert_clear_s, clock=clock)
+            self.telemetry.register("slo", self.slo.prom_families)
+            self.telemetry.register("slo_alerts",
+                                    self.slo.alerts.prom_families)
+
         self._template = state if hasattr(state, "eval_variables") else None
         variables = (state.eval_variables()
                      if self._template is not None else state)
@@ -316,7 +355,21 @@ class InferenceEngine:
                     self._log.info(
                         "serve: warmed program %s in %.1fs", key,
                         time.perf_counter() - t0)
+                    if self.capacity is not None:
+                        # The live half of tools/roofline.py: ask the
+                        # executable itself what it costs, once, here
+                        # at warmup (cost_analysis on the cached AOT
+                        # program — no extra compile).
+                        self.capacity.record(
+                            self._capacity_key(res, bb, arm),
+                            self.programs[key])
         return len(self.programs)
+
+    def _capacity_key(self, res: int, bb: int, arm: str) -> str:
+        """One compiled program's ledger key (the cache key, rendered
+        label-safe)."""
+        return (f"{self.cfg.model.name}/r{res}b{bb}/"
+                f"{self.cfg.model.resample_impl}/{arm}")
 
     def stop(self) -> None:
         if not self._running:
@@ -516,6 +569,14 @@ class InferenceEngine:
                 self._next_alert_eval = now + 1.0
                 sigs, details = self.quality.signals()
                 self.alerts.evaluate(sigs, now=now, details=details)
+        if self.slo is not None:
+            # Same cadence for the SLO burn rules: window decay must
+            # CLEAR a burn alert even when no new requests arrive to
+            # trigger an ingest-side evaluation.
+            now = self._clock()
+            if now >= self._next_slo_eval:
+                self._next_slo_eval = now + 1.0
+                self.slo.evaluate(now)
         return depth
 
     def _dispatch_once(self, blocking: bool = True) -> bool:
@@ -683,6 +744,15 @@ class InferenceEngine:
                         attrs={"batch_bucket": meta["batch_bucket"]})
                     self.tracer.record(r.trace_id, "fetch", t_f0, t_f1,
                                        parent_id=dev_sid)
+            if self.capacity is not None and not meta.get("tta"):
+                # Per-program measured time → live MFU.  TTA responses
+                # are skipped: flip_tta runs the program twice, which
+                # would halve the reported utilization of a program
+                # that ran at full tilt.
+                self.capacity.observe(
+                    self._capacity_key(meta["res_bucket"],
+                                       meta["batch_bucket"],
+                                       meta["precision"]), dev_ms)
             est_key = (meta["res_bucket"], meta["precision"])
             with self._est_lock:
                 old = self._est_s.get(est_key)
@@ -812,6 +882,10 @@ class InferenceEngine:
             out["quality"] = self.quality.snapshot()
         if self.alerts is not None:
             out["alerts"] = self.alerts.active()
+        if self.capacity is not None:
+            out["capacity"] = self.capacity.snapshot()
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
         return out
 
     def _trace_end(self, r: Request, outcome: str,

@@ -497,6 +497,28 @@ class Fleet:
         self.retry_policy = RetryPolicy(
             cfg.retry_max_attempts, cfg.retry_backoff_ms,
             cfg.retry_backoff_max_ms, clock=clock)
+        # Capacity & SLO observability (utils/slo.py, serve/prober.py;
+        # docs/OBSERVABILITY.md "Capacity & SLO").  Both None/off by
+        # default — the aggregated /metrics stays byte-identical.  The
+        # SLO tracker is fed by the ROUTER'S OWN terminal book
+        # (serve/router.py calls observe_slo at every booking point);
+        # ProbeStats is written by the SyntheticProber the serving CLI
+        # arms against the router's own bound address
+        # (serve/router.py::serve_fleet_forever).
+        self.slo = None
+        if cfg.slo_objectives:
+            from ..utils.slo import build_tracker
+
+            self.slo = build_tracker(
+                cfg.slo_objectives,
+                burn_threshold=cfg.slo_burn_threshold,
+                alert_for_s=cfg.slo_alert_for_s,
+                alert_clear_s=cfg.slo_alert_clear_s, clock=clock)
+        self.probe_stats = None
+        if cfg.prober_interval_s > 0:
+            from .prober import ProbeStats
+
+            self.probe_stats = ProbeStats()
         self.dispatcher = FleetDispatcher(
             [b.engine for b in backends if b.kind == "engine"])
         self._started = False
@@ -574,6 +596,16 @@ class Fleet:
         if g is not None:
             g.tail.observe(ms)
 
+    def observe_slo(self, model: Optional[str], tenant: Optional[str],
+                    outcome: str, ms: float) -> None:
+        """One SLO event per router terminal — called at the SAME
+        points the router book terminates a counted submission, so
+        /slo reconciles against /stats exactly (client-fault terminals
+        excluded inside; no-op with the tracker off)."""
+        if self.slo is not None:
+            self.slo.observe_outcome(outcome, ms, model=model,
+                                     tenant=tenant)
+
     # -- aggregation ---------------------------------------------------
 
     def _replica_label(self, group: ReplicaSet, rid: str) -> str:
@@ -618,8 +650,15 @@ class Fleet:
                     alerts.setdefault(name, []).extend(reasons)
         if alerts:
             body["alerts"] = alerts
+        # Router-tier SLO burn/budget alerts degrade the fleet verdict
+        # the same way ("Capacity & SLO"): the fleet still routes, the
+        # error budget says it should not be trusted blindly.
+        slo_active = (self.slo.active_reasons()
+                      if self.slo is not None else [])
+        if slo_active:
+            body["slo_alerts"] = slo_active
         if not down:
-            if alerts:
+            if alerts or slo_active:
                 return 200, dict(body, status="degraded")
             return 200, dict(body, status="ok")
         if len(down) < len(per):
@@ -648,6 +687,14 @@ class Fleet:
         groups.append([("dsod_fleet_replica_up", "gauge", up),
                        ("dsod_fleet_breaker_state", "gauge", bstate),
                        ("dsod_fleet_breaker_open_total", "counter", bopen)])
+        if self.slo is not None:
+            # Router-tier SLO families + their alert rules (the
+            # replica-level dsod_alert_* families merge into the same
+            # family groups — TYPE once, samples labeled per rule).
+            groups.append(self.slo.prom_families())
+            groups.append(self.slo.alerts.prom_families())
+        if self.probe_stats is not None:
+            groups.append(self.probe_stats.prom_families())
         groups.extend(self._gather_replicas(
             lambda g, rid, b: b.prom_families(
                 self._replica_label(g, rid))))
@@ -709,8 +756,13 @@ class Fleet:
         fleet["terminal"] = (fleet["served"] + fleet["shed"]
                              + fleet["expired"] + fleet["errors"])
         fleet["consistent"] = fleet["terminal"] == fleet["submitted"]
-        return {"router": router, "models": models, "fleet": fleet,
-                "breakers": breakers}
+        out = {"router": router, "models": models, "fleet": fleet,
+               "breakers": breakers}
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
+        if self.probe_stats is not None:
+            out["probes"] = self.probe_stats.snapshot()
+        return out
 
     def alerts(self) -> Dict:
         """The router's /alerts payload: every replica's alert-engine
@@ -722,6 +774,10 @@ class Fleet:
                                 if hasattr(b, "alerts_snapshot")
                                 else None))
         models = {rid: s for rid, s in snaps if s}
+        if self.slo is not None:
+            # The router's own SLO rules ride the same payload under a
+            # reserved key (":" is not a valid replica id).
+            models["router:slo"] = self.slo.alerts.snapshot()
         active = sorted({a for s in models.values()
                          for a in s.get("active", [])})
         return {"active": active, "models": models}

@@ -161,6 +161,7 @@ def run_loadgen(
     mix=None,
     slowest: int = 0,
     quality: bool = False,
+    slo: bool = False,
 ) -> Dict[str, float]:
     """Drive ``base_url`` and return a summary dict (see module doc for
     the open/closed semantics).  Closed loop sends exactly ``requests``
@@ -184,6 +185,13 @@ def run_loadgen(
     leg records model quality alongside its latency curve from the
     same command.  Omitted when the endpoint exports none (monitors
     off).
+
+    ``slo=True``: the summary ends with one /slo scrape
+    (:func:`scrape_slo`) under ``"slo"`` — per-objective (per-model/
+    per-tenant scoped) budget-remaining and fast/slow burn rates next
+    to the latency summary, the PR-10 ``--quality`` pattern for the
+    error-budget surface.  Omitted when the endpoint has no objectives
+    (knob off).
 
     ``slowest > 0``: every request carries a generated ``X-Request-ID``
     and the summary reports the N slowest OK responses with their
@@ -384,12 +392,56 @@ def run_loadgen(
         q = scrape_quality(base_url)
         if q:
             out["quality"] = q
+    if slo:
+        s = scrape_slo(base_url)
+        if s:
+            out["slo"] = s
     return out
 
 
 def fetch_stats(base_url: str, timeout_s: float = 10.0) -> Dict[str, float]:
     with urllib.request.urlopen(base_url + "/stats", timeout=timeout_s) as r:
         return json.loads(r.read().decode())
+
+
+def scrape_slo(base_url: str, timeout_s: float = 10.0) -> Dict:
+    """End-of-run /slo scrape, condensed per objective (the objective's
+    scope IS the per-model/per-tenant key — the router tracks one book,
+    so unlike the quality gauges there are no replica-labeled series to
+    disambiguate):
+
+        {name: {"scope", "kind", "budget_remaining",
+                "burn_fast", "burn_slow", "good", "bad", "active"}}
+
+    Empty when the endpoint is unreachable or exports no objectives —
+    an agenda leg records error-budget state exactly when there is an
+    SLO to record."""
+    try:
+        with urllib.request.urlopen(base_url.rstrip("/") + "/slo",
+                                    timeout=timeout_s) as r:
+            snap = json.loads(r.read().decode())
+    except (urllib.error.URLError, OSError, ValueError):
+        return {}
+    active = set(snap.get("active", []))
+    out = {}
+    for o in snap.get("objectives", []):
+        burns = o.get("burn_rate", {})
+        out[o["name"]] = {
+            "scope": o.get("scope"),
+            "kind": o.get("kind"),
+            "budget_remaining": o.get("budget_remaining"),
+            "burn_fast": burns.get("fast"),
+            "burn_slow": burns.get("slow"),
+            "good": o.get("good"),
+            "bad": o.get("bad"),
+            # Exact rule-name membership (utils/slo.py names them
+            # slo_<name>_burn / slo_<name>_budget): a prefix match
+            # would cross-attribute when one objective's name prefixes
+            # another's.
+            "active": sorted(active & {f"slo_{o['name']}_burn",
+                                       f"slo_{o['name']}_budget"}),
+        }
+    return out
 
 
 # Quality gauges worth carrying into a load summary (serve/quality.py;

@@ -1,0 +1,324 @@
+"""Synthetic canary prober (docs/OBSERVABILITY.md "Capacity & SLO").
+
+Every sensor so far is fed by LIVE traffic — which means at zero
+traffic an outage is invisible (no requests, no errors, no burn), and
+a quality cliff after a hot reload waits for the first real user to
+find it.  The prober closes that hole with black-box canaries:
+
+- a background thread pushes ONE low-rate synthetic probe through the
+  **full router→engine HTTP path** per tick, round-robin over the
+  fleet's models, under a reserved tenant (registered at the lowest
+  priority so probes are the first thing the router sheds under
+  overload);
+- probe inputs come from the deterministic SyntheticSOD generator WITH
+  their ground-truth masks, so the returned prediction is *scored*
+  (MAE + IoU@0.5 against GT) — a model serving garbage after a bad
+  reload moves the probe-quality gauges even when latency looks fine;
+- probe latency / availability / quality export as ``dsod_probe_*``
+  families on the router's /metrics.
+
+Accounting: probes ride the real door, so they are counted in the
+router's terminal book under the probe tenant (the fleet identity
+holds WITH them), they feed any model-scoped SLO objective (that is
+the point — a dead replica set burns the SLO budget with zero live
+traffic), and they can never touch another tenant's token bucket
+(their tenant is their own).  The prober itself never queues: if the
+previous probe is still in flight at the next tick, the tick is
+DROPPED and counted (``dsod_probe_dropped_total``) — synthetic load
+must not pile onto an already-overloaded fleet.
+"""
+
+from __future__ import annotations
+
+import http.client
+import io
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+from ..utils.observability import LatencyHistogram
+
+# Windowed probe gauges: small — probes are low-rate by design, and a
+# cliff should move the gauge within a handful of probes.
+_WINDOW = 32
+
+_TRANSPORT_ERRORS = (urllib.error.URLError, OSError,
+                     http.client.HTTPException)
+
+
+def make_probe_set(n: int = 4, px: int = 64, seed: int = 1234
+                   ) -> List[Tuple[bytes, np.ndarray]]:
+    """``n`` deterministic ``(request_body, ground_truth_mask)`` pairs:
+    SyntheticSOD samples denormalized to the uint8 request shape (the
+    same in-distribution posture tools/health_smoke.py probes with),
+    masks float32 (px, px) in {0, 1}."""
+    from ..data.synthetic import SyntheticSOD
+
+    ds = SyntheticSOD(size=max(n, 1), image_size=(px, px), seed=seed)
+    out = []
+    for i in range(n):
+        s = ds[i]
+        raw = np.clip(s["image"] * ds.std + ds.mean, 0.0, 1.0)
+        img = (raw * 255.0).round().astype(np.uint8)
+        buf = io.BytesIO()
+        np.save(buf, img)
+        out.append((buf.getvalue(), s["mask"][..., 0].astype(np.float32)))
+    return out
+
+
+def score_probe(pred: np.ndarray, gt: np.ndarray
+                ) -> Tuple[float, float]:
+    """``(mae, iou@0.5)`` of one probe prediction against its ground
+    truth (resized to the prediction's shape when the server answered
+    at a different resolution)."""
+    p = np.asarray(pred, np.float32)
+    g = np.asarray(gt, np.float32)
+    if p.shape != g.shape:
+        # Nearest-neighbor GT resize: masks are {0,1}, interpolation
+        # would invent soft edges the scorer then penalizes.
+        yi = (np.arange(p.shape[0]) * g.shape[0] // p.shape[0])
+        xi = (np.arange(p.shape[1]) * g.shape[1] // p.shape[1])
+        g = g[yi][:, xi]
+    mae = float(np.mean(np.abs(p - g)))
+    pb, gb = p > 0.5, g > 0.5
+    union = float(np.logical_or(pb, gb).sum())
+    iou = float(np.logical_and(pb, gb).sum()) / union if union else 1.0
+    return mae, iou
+
+
+class _Ring:
+    """Fixed-window mean (the serve/quality.py idiom)."""
+
+    __slots__ = ("_buf", "_i", "_cap")
+
+    def __init__(self, cap: int = _WINDOW):
+        self._buf: List[float] = []
+        self._i = 0
+        self._cap = cap
+
+    def add(self, v: float) -> None:
+        if len(self._buf) < self._cap:
+            self._buf.append(float(v))
+        else:
+            self._buf[self._i] = float(v)
+            self._i = (self._i + 1) % self._cap
+
+    def mean(self) -> float:
+        return (sum(self._buf) / len(self._buf)) if self._buf else 0.0
+
+
+class _ModelProbeStats:
+    __slots__ = ("sent", "ok", "failed", "latency_ms", "mae", "iou",
+                 "avail")
+
+    def __init__(self):
+        self.sent = 0
+        self.ok = 0
+        self.failed = 0
+        self.latency_ms = LatencyHistogram()
+        self.mae = _Ring()
+        self.iou = _Ring()
+        self.avail = _Ring()  # 1/0 per probe, windowed availability
+
+
+class ProbeStats:
+    """Thread-safe probe telemetry, owned by the Fleet (so the router's
+    /metrics and /stats render it) and written by the prober thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: Dict[str, _ModelProbeStats] = {}
+        self._dropped = 0
+
+    def _model(self, name: str) -> _ModelProbeStats:
+        st = self._models.get(name)
+        if st is None:
+            st = self._models[name] = _ModelProbeStats()
+        return st
+
+    def record(self, model: str, ok: bool, latency_ms: float,
+               mae: Optional[float] = None,
+               iou: Optional[float] = None) -> None:
+        with self._lock:
+            st = self._model(model)
+            st.sent += 1
+            st.avail.add(1.0 if ok else 0.0)
+            if ok:
+                st.ok += 1
+                st.latency_ms.observe(latency_ms)
+                if mae is not None:
+                    st.mae.add(mae)
+                if iou is not None:
+                    st.iou.add(iou)
+            else:
+                st.failed += 1
+
+    def record_dropped(self) -> None:
+        with self._lock:
+            self._dropped += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            out = {"dropped": self._dropped, "models": {}}
+            for name, st in sorted(self._models.items()):
+                out["models"][name] = {
+                    "sent": st.sent, "ok": st.ok, "failed": st.failed,
+                    "availability": round(st.avail.mean(), 4),
+                    "mae_avg": round(st.mae.mean(), 6),
+                    "iou_avg": round(st.iou.mean(), 6),
+                    **{f"latency_{k}": v
+                       for k, v in st.latency_ms.snapshot().items()},
+                }
+            return out
+
+    def prom_families(self, labels: str = ""):
+        """``dsod_probe_*`` families under ``model=`` labels (the
+        per-arm ServeStats idiom: one TYPE per family, every model's
+        sample in the one group)."""
+        with self._lock:
+            dropped = self._dropped
+            rows = sorted(self._models.items())
+            counts = [(n, st.sent, st.ok, st.failed, st.avail.mean(),
+                       st.mae.mean(), st.iou.mean()) for n, st in rows]
+        pre = f"{labels}," if labels else ""
+        sb = f"{{{labels}}}" if labels else ""
+
+        def lbl(n):
+            return f'{pre}model="{n}"'
+
+        fams = [("dsod_probe_dropped_total", "counter",
+                 [f"dsod_probe_dropped_total{sb} {dropped}"])]
+        series = (("dsod_probe_sent_total", "counter", 1),
+                  ("dsod_probe_ok_total", "counter", 2),
+                  ("dsod_probe_failed_total", "counter", 3),
+                  ("dsod_probe_availability", "gauge", 4),
+                  ("dsod_probe_mae_avg", "gauge", 5),
+                  ("dsod_probe_iou_avg", "gauge", 6))
+        for name, typ, idx in series:
+            samples = ['%s{%s} %g' % (name, lbl(r[0]), r[idx])
+                       for r in counts]
+            if samples:
+                fams.append((name, typ, samples))
+        lat = []
+        for n, st in rows:
+            lat += st.latency_ms.prom_lines(
+                "dsod_probe_latency_ms", labels=f'{pre}model="{n}"',
+                include_type=False)
+        if lat:
+            fams.append(("dsod_probe_latency_ms", "histogram", lat))
+        return fams
+
+
+class SyntheticProber:
+    """The canary thread.  ``base_url`` is the ROUTER'S OWN bound
+    address (loopback) so probes traverse the full front door —
+    tenancy, routing, failover, accounting — exactly like a client."""
+
+    def __init__(self, base_url: str, models: List[str], *,
+                 stats: ProbeStats, interval_s: float,
+                 tenant: str = "_probe", px: int = 64,
+                 timeout_s: float = 10.0, n_probes: int = 4):
+        if interval_s <= 0:
+            raise ValueError(
+                f"prober interval_s must be > 0, got {interval_s}")
+        if not models:
+            raise ValueError("prober needs at least one model")
+        self.base_url = base_url.rstrip("/")
+        self.models = list(models)
+        self.stats = stats
+        self.interval_s = float(interval_s)
+        self.tenant = tenant
+        self.timeout_s = float(timeout_s)
+        self.probes = make_probe_set(n_probes, px=px)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._worker: Optional[threading.Thread] = None
+        # Drop-not-queue: one probe in flight, ever.  A busy lane at
+        # tick time is a DROP (counted), never a backlog.
+        self._busy = threading.Semaphore(1)
+        self._i = 0
+        self._log = get_logger()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "SyntheticProber":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-prober", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s + 5.0)
+            self._thread = None
+        if self._worker is not None:
+            self._worker.join(timeout=self.timeout_s + 5.0)
+            self._worker = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.tick()
+
+    # -- one probe -----------------------------------------------------
+
+    def tick(self) -> bool:
+        """Fire one probe (round-robin model × probe sample) on the
+        worker lane, or DROP when the previous probe is still in
+        flight.  Returns True when a probe was dispatched."""
+        if not self._busy.acquire(blocking=False):
+            self.stats.record_dropped()
+            return False
+        i = self._i
+        self._i += 1
+        model = self.models[i % len(self.models)]
+        body, gt = self.probes[i % len(self.probes)]
+
+        def run():
+            try:
+                self.probe_once(model, body, gt)
+            finally:
+                self._busy.release()
+
+        self._worker = threading.Thread(
+            target=run, name="serve-probe", daemon=True)
+        self._worker.start()
+        return True
+
+    def probe_once(self, model: str, body: bytes, gt: np.ndarray) -> bool:
+        """One synchronous probe round trip, scored and recorded."""
+        headers = {"Content-Type": "application/x-npy",
+                   "X-Tenant": self.tenant, "X-Model": model}
+        req = urllib.request.Request(self.base_url + "/predict",
+                                     data=body, headers=headers,
+                                     method="POST")
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as r:
+                payload = r.read()
+                ok = r.status == 200
+        except urllib.error.HTTPError as e:
+            e.read()
+            ok, payload = False, b""
+        except _TRANSPORT_ERRORS:
+            ok, payload = False, b""
+        ms = (time.monotonic() - t0) * 1000.0
+        mae = iou = None
+        if ok:
+            try:
+                pred = np.load(io.BytesIO(payload), allow_pickle=False)
+                mae, iou = score_probe(pred, gt)
+            except Exception:  # noqa: BLE001 — an unscorable 200 is a
+                self._log.exception("prober: could not score probe")
+                ok = False  # quality outage, not a success
+        self.stats.record(model, ok, ms, mae=mae, iou=iou)
+        return ok

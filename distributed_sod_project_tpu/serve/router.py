@@ -355,6 +355,13 @@ class RouterHandler(JsonHTTPHandler):
             # "Model health"): per-replica rule states + the fleet-wide
             # active union.
             self._send_json(200, self.fleet.alerts())
+        elif path == "/slo":
+            # Router-tier error-budget accounting (utils/slo.py;
+            # "Capacity & SLO"): fed by the router's own terminal book,
+            # empty objective list when the knob is off.
+            slo = self.fleet.slo
+            self._send_json(200, slo.snapshot() if slo is not None
+                            else {"objectives": [], "active": []})
         elif path == "/debug/traces":
             q = urllib.parse.urlsplit(self.path).query
             self._send_json(200, self.fleet.debug_traces(
@@ -421,6 +428,20 @@ class RouterHandler(JsonHTTPHandler):
         # a client that disconnects mid-request (the final except
         # records the pre-dispatch abort as a router reject).
         fleet.rstats.inc_submitted(tenant.name)
+
+        # Terminal booking rides with its SLO event: every counted
+        # submission feeds the tracker exactly once, at the instant its
+        # outcome is decided, so /slo and the router book reconcile
+        # (utils/slo.py excludes the client-fault terminals itself).
+        def book_response(outcome: str) -> None:
+            fleet.rstats.inc_response(tenant.name, outcome)
+            fleet.observe_slo(group.name, tenant.name, outcome,
+                              (fleet._clock() - t_door) * 1000.0)
+
+        def book_shed(reason: str) -> None:
+            fleet.rstats.inc_shed(tenant.name, reason)
+            fleet.observe_slo(group.name, tenant.name, "shed",
+                              (fleet._clock() - t_door) * 1000.0)
         root = fleet.tracer.begin(
             "request", req_id, t0=t_door, root=True,
             attrs={"model": group.name, "tenant": tenant.name})
@@ -440,7 +461,7 @@ class RouterHandler(JsonHTTPHandler):
                 except ValueError:
                     # Malformed deadline: pre-dispatch reject at the
                     # ROUTER (the budget math below needs the number).
-                    fleet.rstats.inc_response(tenant.name, "rejected")
+                    book_response("rejected")
                     end_root("rejected")
                     terminal = True
                     self.close_connection = True
@@ -452,8 +473,7 @@ class RouterHandler(JsonHTTPHandler):
             if picked is None:
                 # Every replica is dead, probe-flagged, or breaker-
                 # open: terminal at the router, no timeout paid.
-                fleet.rstats.inc_response(tenant.name,
-                                          "no_healthy_replica")
+                book_response("no_healthy_replica")
                 end_root("no_healthy_replica")
                 terminal = True
                 self.close_connection = True
@@ -473,7 +493,7 @@ class RouterHandler(JsonHTTPHandler):
                 # request must not stall a recovered replica's
                 # re-admission.
                 picked[2].release_probe()
-                fleet.rstats.inc_shed(tenant.name, reason)
+                book_shed(reason)
                 end_root(f"shed_{reason}")
                 terminal = True
                 self.close_connection = True
@@ -487,7 +507,7 @@ class RouterHandler(JsonHTTPHandler):
             body = read_predict_body(self)
             if body is None:  # bad Content-Length, 400 already sent
                 picked[2].release_probe()  # never dispatched
-                fleet.rstats.inc_response(tenant.name, "rejected")
+                book_response("rejected")
                 end_root("rejected")
                 terminal = True
                 return
@@ -496,7 +516,7 @@ class RouterHandler(JsonHTTPHandler):
             outcome = self._dispatch(group, picked, body, echo, slo_ms,
                                      slo_hdr is not None, t_door,
                                      req_id, root)
-            fleet.rstats.inc_response(tenant.name, outcome)
+            book_response(outcome)
             end_root(outcome)
             terminal = True
         except Exception:  # noqa: BLE001 — dead client / broken pipe
@@ -506,9 +526,9 @@ class RouterHandler(JsonHTTPHandler):
                 picked[2].release_probe()  # claimed but never used
             if not terminal:
                 # No backend outcome was booked (every dispatch path
-                # books through the single inc_response above): close
+                # books through the single book_response above): close
                 # the book as a router reject, not a silent leak.
-                fleet.rstats.inc_response(tenant.name, "rejected")
+                book_response("rejected")
                 end_root("rejected")
 
     # -- failover dispatch ---------------------------------------------
@@ -854,6 +874,21 @@ def serve_fleet_forever(fleet, host: str, port: int,
     srv = make_fleet_server(fleet, host, port)
     bound = srv.server_address[1]
     publish_port(port_file, bound)
+    prober = None
+    if fleet.cfg.prober_interval_s > 0:
+        # Synthetic canary prober (serve/prober.py): probes loop back
+        # through the router's OWN bound address, so they traverse the
+        # full front door — tenancy, routing, failover, accounting —
+        # exactly like a client request.
+        from .prober import SyntheticProber
+
+        probe_host = host if host not in ("", "0.0.0.0") else "127.0.0.1"
+        prober = SyntheticProber(
+            f"http://{probe_host}:{bound}", sorted(fleet.groups),
+            stats=fleet.probe_stats,
+            interval_s=fleet.cfg.prober_interval_s,
+            tenant=fleet.cfg.prober_tenant, px=fleet.cfg.prober_px,
+            timeout_s=fleet.cfg.prober_timeout_s).start()
     stop = threading.Event()
 
     def _sig(signum, frame):
@@ -875,6 +910,9 @@ def serve_fleet_forever(fleet, host: str, port: int,
         while not stop.wait(0.2):
             pass
     finally:
+        if prober is not None:
+            prober.stop()  # before the server: a probe mid-flight may
+            #   hold a connection the shutdown would otherwise wait on
         srv.shutdown()
         srv.server_close()
         fleet.stop()

@@ -339,9 +339,13 @@ class ServeHandler(JsonHTTPHandler):
                 # with the rules named: the engine still serves, the
                 # MODEL may be drifting — a fronting LB must not drain
                 # a replica over a quality worry, an operator must see
-                # it).  docs/OBSERVABILITY.md "Model health".
+                # it).  docs/OBSERVABILITY.md "Model health".  Active
+                # SLO burn/budget alerts join the same degraded list
+                # ("Capacity & SLO").
                 alerts = self.engine.alerts
                 active = alerts.active_reasons() if alerts else []
+                if self.engine.slo is not None:
+                    active = active + self.engine.slo.active_reasons()
                 if active:
                     self._send_json(200, {"status": "degraded",
                                           "alerts": active})
@@ -361,9 +365,24 @@ class ServeHandler(JsonHTTPHandler):
         elif path == "/stats":
             self._send_json(200, self.engine.stats_snapshot())
         elif path == "/alerts":
-            alerts = self.engine.alerts
-            self._send_json(200, alerts.snapshot() if alerts
-                            else {"active": [], "rules": []})
+            # Quality + SLO rule states merged into one payload (two
+            # engines, disjoint rule names — utils/slo.py prefixes
+            # slo_).
+            snap = {"active": [], "rules": []}
+            for eng in (self.engine.alerts,
+                        self.engine.slo.alerts
+                        if self.engine.slo is not None else None):
+                if eng is not None:
+                    s = eng.snapshot()
+                    snap["active"] += s["active"]
+                    snap["rules"] += s["rules"]
+            self._send_json(200, snap)
+        elif path == "/slo":
+            # Error-budget accounting (utils/slo.py): empty objective
+            # list when the knob is off, so scrapers need no probe.
+            slo = self.engine.slo
+            self._send_json(200, slo.snapshot() if slo is not None
+                            else {"objectives": [], "active": []})
         elif path == "/debug/traces":
             self._send_json(200, self.engine.tracer.snapshot(
                 n=_query_int(split.query, "n", 50)))
@@ -390,10 +409,19 @@ class ServeHandler(JsonHTTPHandler):
         # X-Request-ID (client-supplied or minted) doubles as the
         # trace id; X-Timing carries the stage split on every 200.
         rid = resolve_request_id(self.headers.get("X-Request-ID"))
-        run_predict(self, self.engine, body, request_id=rid,
-                    extra_headers=[
-                        ("X-Model", str(self.engine.cfg.model.name)),
-                        ("X-Request-ID", rid)])
+        t0 = time.monotonic()
+        outcome = run_predict(self, self.engine, body, request_id=rid,
+                              extra_headers=[
+                                  ("X-Model",
+                                   str(self.engine.cfg.model.name)),
+                                  ("X-Request-ID", rid)])
+        if self.engine.slo is not None:
+            # One SLO event per terminal outcome, at the same seam the
+            # outcome was decided (client-fault terminals excluded
+            # inside — utils/slo.py).
+            self.engine.slo.observe_outcome(
+                outcome, (time.monotonic() - t0) * 1000.0,
+                model=str(self.engine.cfg.model.name))
 
 
 class SODServer(ThreadingHTTPServer):

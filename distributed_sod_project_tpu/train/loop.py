@@ -230,6 +230,35 @@ def fit(
             default_numerics_rules(clear_s=cfg.health_alert_clear_s)
             + parse_rules(cfg.health_alert_rules))
 
+    # Capacity ledger + goodput SLO (utils/capacity.py, utils/slo.py;
+    # docs/OBSERVABILITY.md "Capacity & SLO").  Both None when off —
+    # every touch below guards, so the default loop pays nothing and
+    # the sidecar surface is byte-identical.
+    capacity = None
+    slo_tracker = None
+    t_run0 = time.monotonic()
+    if cfg.capacity_ledger:
+        from ..utils.capacity import CapacityLedger
+
+        def _train_shares():
+            # Host-vs-device attribution for the train loop: the
+            # starved counter is exactly "device idle waiting on the
+            # host data plane" — the futile-to-scale share.
+            wall_ms = max((time.monotonic() - t_run0) * 1000.0, 1e-9)
+            starved = data_stats.snapshot().get("data_starved_ms", 0.0)
+            host = min(starved / wall_ms, 1.0)
+            return {"device": max(1.0 - host, 0.0), "queue": 0.0,
+                    "host": host}
+
+        capacity = CapacityLedger(share_fn=_train_shares)
+    if cfg.slo_objectives:
+        from ..utils.slo import build_tracker
+
+        slo_tracker = build_tracker(
+            cfg.slo_objectives, burn_threshold=cfg.slo_burn_threshold,
+            alert_for_s=cfg.slo_alert_for_s,
+            alert_clear_s=cfg.slo_alert_clear_s)
+
     def _observe_health(metrics_host) -> None:
         """Feed one fetched metric dict to the health monitor + alert
         engine.  Under ``health_rollback_hint`` a FIRING rollback-
@@ -397,6 +426,44 @@ def fit(
     # At k=1 this reduces exactly to the historical per-step cycling.
     train_step_at = lambda i: step_for_size[ms_cycle[(i // k) % len(ms_cycle)]]  # noqa: E731
 
+    # Capacity/SLO feed points (both no-ops when the knobs are off).
+    # The ledger key names the static program (size × chunk factor);
+    # observations are gated past the StepTimer's warmup so compile
+    # time never poisons the EWMA the MFU gauge divides by.
+    _cap_recorded = set()
+    _cap_t_last = [None]
+
+    def _cap_key(at_step: int) -> str:
+        hw = ms_cycle[(at_step // k) % len(ms_cycle)]
+        return f"train/{hw[0]}x{hw[1]}/k{k}"
+
+    def _maybe_record_capacity(at_step, train_step, state, batch) -> None:
+        if capacity is None:
+            return
+        ck = _cap_key(at_step)
+        if ck not in _cap_recorded:
+            _cap_recorded.add(ck)
+            # One extra AOT compile per static shape, paid only with
+            # the ledger opted in — the cost_analysis()/
+            # memory_analysis() of the REAL step program.
+            capacity.record_jit(ck, train_step, state, batch)
+
+    def _observe_capacity_slo(chunk_start_step: int) -> None:
+        """Per completed chunk: fold the measured per-step time into
+        the ledger EWMA and feed one goodput SLO event per step."""
+        if capacity is None and slo_tracker is None:
+            return
+        now = time.monotonic()
+        prev, _cap_t_last[0] = _cap_t_last[0], now
+        if prev is None or timer.ticks <= timer.warmup:
+            return  # compile-time interval: not a measured step
+        per_step_ms = (now - prev) * 1000.0 / k
+        if capacity is not None:
+            capacity.observe(_cap_key(chunk_start_step), per_step_ms)
+        if slo_tracker is not None:
+            slo_tracker.observe(True, latency_ms=per_step_ms,
+                                model=cfg.model.name, n=k)
+
     # SP shards image rows over ``seq`` in addition to batch over
     # ``data``; every other path uses the default batch-only sharding.
     # Chunked batches carry a new leading k axis, unsharded.
@@ -440,7 +507,8 @@ def fit(
         watchdog=watchdog, tracer=tracer, workdir=workdir,
         step_fn=lambda: step, port=telemetry_port,
         port_file=telemetry_port_file,
-        health=health_monitor, alerts=health_alerts)
+        health=health_monitor, alerts=health_alerts,
+        capacity=capacity, slo=slo_tracker)
     # A restore means this step's checkpoint already exists on disk — a
     # zero-progress run must not force-save over it (orbax raises).
     last_saved = resumed_from
@@ -623,6 +691,7 @@ def fit(
                           time.monotonic(),
                           parent_id=trace["root"].span_id)
         timer.tick(steps=k)
+        _observe_capacity_slo(at_step - k)
         # Health observes EVERY fetched chunk (a mid-interval NaN must
         # reach the provenance counters even off the logging cadence).
         _observe_health(metrics_host)
@@ -699,6 +768,7 @@ def fit(
                                           t_prev_end, t_now,
                                           parent_id=root.span_id)
                 train_step = train_step_at(step)
+                _maybe_record_capacity(step, train_step, state, batch)
                 if plan is not None:
                     batch = plan.maybe_poison_batch(step + 1, batch)
                 t_d0 = time.monotonic() if chunk_tr else 0.0
@@ -732,6 +802,7 @@ def fit(
                     # step is still in flight, like a wedged dispatch.
                     plan.maybe_stall(step)
                 timer.tick()
+                _observe_capacity_slo(step - 1)
                 if plan is not None:
                     plan.maybe_sigterm(step)
                 stop = _poll_stop(guard, step, sync_every)

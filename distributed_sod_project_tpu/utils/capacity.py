@@ -1,0 +1,275 @@
+"""Live per-compiled-program capacity ledger
+(docs/OBSERVABILITY.md "Capacity & SLO").
+
+``tools/roofline.py`` prices the flagship step OFFLINE (closed-form
+FLOPs/bytes, ``--xla-check`` against XLA's cost model).  This module
+makes those numbers a LIVE surface: every AOT-compiled executable the
+serve engine caches (and, opted in, the train step program) is asked
+for its own ``cost_analysis()`` / ``memory_analysis()`` at warmup, and
+the measured device time the stacks already track (the engine's
+per-(res, batch, arm) EWMA; the trainer's StepTimer) turns static cost
+into live utilization:
+
+- ``MFU = flops / measured_s / peak_flops`` per program — the
+  model-FLOPs-utilization dial, continuously, per compiled program;
+- ``roofline utilization = max(flop util, bandwidth util)`` — how close
+  the program runs to ITS binding roofline (the tools/roofline.py
+  ``t >= max(F/peak, B/bw)`` bound, inverted);
+- HBM: each program's analyzed peak working set plus the device's live
+  ``memory_stats`` headroom (``bytes_limit − bytes_in_use``);
+- a stage-share attribution gauge (device / queue / host fractions of
+  the measured end-to-end, from the PR-9 stage splits) — the
+  scale-out-vs-futile signal ROADMAP item 2 names: deep queues with a
+  high device share mean the device is the bottleneck (scale out);
+  deep queues with a low device share mean the host is (scaling out is
+  futile).
+
+Off by default (``serve.capacity_ledger`` / ``capacity_ledger``):
+nothing records, nothing renders, /metrics is byte-identical.  The
+peak numbers default to the same v5e constants as tools/roofline.py —
+on other hardware override at construction (MFU is then reported
+against the configured peak, like every MFU number in this repo).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from .logging import get_logger
+
+# v5e per-chip peaks — the SAME constants tools/roofline.py predicts
+# against, so live MFU and the offline roofline share a denominator.
+PEAK_FLOPS = 197e12  # dense bf16 MACs*2
+HBM_BW = 819e9       # bytes/s
+
+
+def program_cost(compiled) -> Dict[str, float]:
+    """``{flops, bytes, peak_hbm_bytes}`` from one compiled executable's
+    own analyses.  Backends that omit a key (or the whole API) report
+    0 — the ledger renders what XLA actually said, never a guess."""
+    flops = bytes_ = 0.0
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost:
+            flops = float(cost.get("flops", 0.0) or 0.0)
+            bytes_ = float(cost.get("bytes accessed", 0.0) or 0.0)
+    except Exception:  # noqa: BLE001 — analysis is best-effort telemetry
+        pass
+    peak = 0.0
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            peak = float(
+                getattr(mem, "argument_size_in_bytes", 0)
+                + getattr(mem, "output_size_in_bytes", 0)
+                + getattr(mem, "temp_size_in_bytes", 0))
+    except Exception:  # noqa: BLE001
+        pass
+    return {"flops": flops, "bytes": bytes_, "peak_hbm_bytes": peak}
+
+
+def device_hbm_gauges():
+    """Per-device ``(label, in_use, headroom)`` from jax
+    ``memory_stats()``; one zero row when the platform reports none
+    (CPU) so the family set is platform-stable."""
+    rows = []
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats() or {}
+            except Exception:  # noqa: BLE001 — platform without the API
+                ms = {}
+            in_use = int(ms.get("bytes_in_use", 0))
+            limit = int(ms.get("bytes_limit", 0))
+            rows.append((str(d.id), in_use,
+                         max(limit - in_use, 0) if limit else 0))
+    except Exception:  # noqa: BLE001 — no backend at all
+        rows = []
+    return rows or [("0", 0, 0)]
+
+
+class CapacityLedger:
+    """Cost/memory analysis per compiled program + measured-time EWMA →
+    live utilization gauges.  Thread-safe; renders through the standard
+    ``prom_families(labels)`` provider contract."""
+
+    def __init__(self, *, peak_flops: float = PEAK_FLOPS,
+                 hbm_bw: float = HBM_BW,
+                 share_fn: Optional[Callable[[], Dict[str, float]]] = None,
+                 device_memory: bool = True):
+        if peak_flops <= 0 or hbm_bw <= 0:
+            raise ValueError("peak_flops/hbm_bw must be > 0")
+        self.peak_flops = float(peak_flops)
+        self.hbm_bw = float(hbm_bw)
+        self._share_fn = share_fn
+        self._device_memory = device_memory
+        self._lock = threading.Lock()
+        # key → {flops, bytes, peak_hbm_bytes, ewma_ms (None until
+        # observed)}
+        self._programs: Dict[str, Dict[str, float]] = {}
+        self._log = get_logger()
+
+    # -- ingest --------------------------------------------------------
+
+    def record(self, key: str, compiled) -> Dict[str, float]:
+        """Record one AOT-compiled executable's static cost under
+        ``key`` (idempotent: a re-warm keeps the measured EWMA)."""
+        cost = program_cost(compiled)
+        with self._lock:
+            prev = self._programs.get(key)
+            if prev is not None:
+                cost["ewma_ms"] = prev.get("ewma_ms")
+            else:
+                cost["ewma_ms"] = None
+            self._programs[key] = cost
+        return cost
+
+    def record_jit(self, key: str, fn, *args) -> bool:
+        """Train-side convenience: AOT lower+compile ``fn(*args)`` just
+        for its analyses (one extra compile, paid only with the ledger
+        opted in) and record it.  False (logged) when the callable has
+        no AOT path."""
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            self._log.warning(
+                "capacity: %s has no .lower() — ledger stays empty for "
+                "this program", key)
+            return False
+        try:
+            self.record(key, lower(*args).compile())
+            return True
+        except Exception:  # noqa: BLE001 — telemetry must not kill a run
+            self._log.exception("capacity: cost analysis failed for %s",
+                                key)
+            return False
+
+    def observe(self, key: str, device_ms: float, alpha: float = 0.2
+                ) -> None:
+        """Fold one measured device time (ms) into ``key``'s EWMA —
+        the same 0.8/0.2 blend as the engine's SLO-expiry estimate."""
+        with self._lock:
+            p = self._programs.get(key)
+            if p is None:
+                return
+            old = p.get("ewma_ms")
+            p["ewma_ms"] = (float(device_ms) if old is None
+                            else (1.0 - alpha) * old
+                            + alpha * float(device_ms))
+
+    # -- derived -------------------------------------------------------
+
+    @staticmethod
+    def _util(p: Dict[str, float], peak_flops: float, hbm_bw: float
+              ) -> Dict[str, float]:
+        ms = p.get("ewma_ms")
+        if not ms:
+            return {"mfu": 0.0, "roofline": 0.0}
+        s = ms / 1000.0
+        mfu = p["flops"] / s / peak_flops if p["flops"] else 0.0
+        bwu = p["bytes"] / s / hbm_bw if p["bytes"] else 0.0
+        return {"mfu": mfu, "roofline": max(mfu, bwu)}
+
+    def mfu(self, key: str) -> float:
+        with self._lock:
+            p = self._programs.get(key)
+            return self._util(p, self.peak_flops, self.hbm_bw)["mfu"] \
+                if p else 0.0
+
+    def snapshot(self) -> Dict:
+        """The /stats capacity block."""
+        with self._lock:
+            programs = {k: dict(p) for k, p in
+                        sorted(self._programs.items())}
+        out = {}
+        for k, p in programs.items():
+            u = self._util(p, self.peak_flops, self.hbm_bw)
+            out[k] = {
+                "flops": p["flops"],
+                "bytes": p["bytes"],
+                "peak_hbm_bytes": p["peak_hbm_bytes"],
+                "device_ms_ewma": (round(p["ewma_ms"], 3)
+                                   if p["ewma_ms"] else None),
+                "mfu": round(u["mfu"], 6),
+                "roofline_util": round(u["roofline"], 6),
+            }
+        snap = {"programs": out,
+                "peak_flops": self.peak_flops, "hbm_bw": self.hbm_bw}
+        if self._share_fn is not None:
+            try:
+                snap["stage_share"] = {
+                    k: round(v, 6)
+                    for k, v in (self._share_fn() or {}).items()}
+            except Exception:  # noqa: BLE001 — telemetry must not throw
+                pass
+        return snap
+
+    # -- exposition ----------------------------------------------------
+
+    def prom_families(self, labels: str = ""):
+        """The ``dsod_capacity_*`` families: per-program static cost +
+        live utilization (one ``program=`` sample each), the stage-share
+        attribution, and per-device HBM headroom.  Core families render
+        unconditionally while the ledger exists (inventory-stable); the
+        ledger itself only exists when the knob is on."""
+        with self._lock:
+            rows = [(k, dict(p)) for k, p in
+                    sorted(self._programs.items())]
+        pre = f"{labels}," if labels else ""
+
+        def plbl(k):
+            return f'{pre}program="{k}"'
+
+        flops, bts, peak, ms, mfu, roof = [], [], [], [], [], []
+        for k, p in rows:
+            u = self._util(p, self.peak_flops, self.hbm_bw)
+            flops.append('dsod_capacity_program_flops{%s} %g'
+                         % (plbl(k), p["flops"]))
+            bts.append('dsod_capacity_program_hbm_bytes{%s} %g'
+                       % (plbl(k), p["bytes"]))
+            peak.append('dsod_capacity_program_peak_hbm_bytes{%s} %g'
+                        % (plbl(k), p["peak_hbm_bytes"]))
+            ms.append('dsod_capacity_device_ms{%s} %g'
+                      % (plbl(k), p["ewma_ms"] or 0.0))
+            mfu.append('dsod_capacity_mfu{%s} %g' % (plbl(k), u["mfu"]))
+            roof.append('dsod_capacity_roofline_util{%s} %g'
+                        % (plbl(k), u["roofline"]))
+        fams = []
+        for name, samples in (
+                ("dsod_capacity_program_flops", flops),
+                ("dsod_capacity_program_hbm_bytes", bts),
+                ("dsod_capacity_program_peak_hbm_bytes", peak),
+                ("dsod_capacity_device_ms", ms),
+                ("dsod_capacity_mfu", mfu),
+                ("dsod_capacity_roofline_util", roof)):
+            if samples:
+                fams.append((name, "gauge", samples))
+        # Stage-share attribution (device/queue/host fractions of the
+        # measured e2e): rendered whenever a share source exists, 0
+        # before traffic.
+        if self._share_fn is not None:
+            try:
+                shares = self._share_fn() or {}
+            except Exception:  # noqa: BLE001
+                shares = {}
+            fams.append(("dsod_capacity_stage_share", "gauge", [
+                'dsod_capacity_stage_share{%sstage="%s"} %g'
+                % (pre, s, shares.get(s, 0.0))
+                for s in ("device", "queue", "host")]))
+        if self._device_memory:
+            in_use, headroom = [], []
+            for dev, used, head in device_hbm_gauges():
+                dl = f'{pre}device="{dev}"'
+                in_use.append('dsod_capacity_hbm_bytes_in_use{%s} %d'
+                              % (dl, used))
+                headroom.append('dsod_capacity_hbm_headroom_bytes{%s} %d'
+                                % (dl, head))
+            fams.append(("dsod_capacity_hbm_bytes_in_use", "gauge",
+                         in_use))
+            fams.append(("dsod_capacity_hbm_headroom_bytes", "gauge",
+                         headroom))
+        return fams

@@ -248,6 +248,14 @@ class LatencyHistogram:
         with self._lock:
             return self._n
 
+    @property
+    def sum_ms(self) -> float:
+        """Total observed ms — the ``_sum`` sample, exposed for
+        stage-share attribution (utils/capacity.py divides the device
+        histogram's sum by the e2e histogram's sum)."""
+        with self._lock:
+            return self._sum
+
     def percentile(self, p: float) -> float:
         """p in [0, 1] → estimated latency ms (linear interpolation
         inside the bucket; the overflow bucket reports its lower

@@ -1,0 +1,420 @@
+"""SLO objectives, error-budget accounting, and burn-rate alerting
+(docs/OBSERVABILITY.md "Capacity & SLO").
+
+The serving stack's accounting identity (served + shed + expired +
+errors == submitted) says what HAPPENED; this module says whether that
+is *acceptable* — the SRE error-budget formulation, in-process, with no
+Prometheus deployment in the loop:
+
+- **Objectives** are declarative: availability ("goal of requests
+  terminate ok") or latency-threshold ("goal of requests terminate ok
+  within latency_ms"), scoped ``all`` / ``model=X`` / ``tenant=Y``, over
+  a sliding ``window_s``.  Colon DSL (comma-free, so ``--set`` tuple
+  coercion passes specs through — the alert-rule discipline):
+
+      name:scope:kind:goal:window_s[:latency_ms]
+      e.g.  avail:model=minet:availability:0.999:3600
+            fast:tenant=pro:latency:0.95:3600:250
+
+- **Events come from the terminal book.**  The router feeds one event
+  per counted submission at the same points it books the terminal
+  outcome (serve/router.py), the single-engine server feeds at its
+  ``run_predict`` return, the trainer feeds one event per completed
+  step (goodput: kind=latency over step time).  Client-fault terminals
+  (``rejected`` / ``bad_request`` — malformed input that no replica
+  count could have served) are EXCLUDED, the SRE 4xx convention; every
+  other terminal is good or bad exactly once, so ``good + bad``
+  reconciles against the book.
+
+- **Multi-window burn rate.**  ``burn(w) = (bad_w / total_w) / (1 -
+  goal)`` — 1.0 burns the budget exactly at the window's end.  The
+  alert signal is ``min(burn(fast), burn(slow))`` with ``fast =
+  window_s / 12`` (the 1h→5m SRE convention): the fast window detects
+  quickly, the slow window confirms, and taking the min IS the
+  two-window AND.  Budget remaining over the slow window is
+  ``1 - bad / (total * (1 - goal))`` (negative = over budget).
+
+- **Alerting is the alert engine.**  Each objective contributes a
+  burn-rate rule (``slo_<name>_burn``) and a budget-exhaustion rule
+  (``slo_<name>_budget``) to a private :class:`AlertEngine`
+  (utils/alerts.py) — hysteretic, fake-clock provable — whose active
+  rules degrade /healthz exactly like the quality/numerics alerts.
+
+Everything is clock-injectable and bucket-quantized (``window_s /
+n_buckets`` resolution), so the full fire → hold → clear ladder is
+provable in tests without sleeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .alerts import AlertEngine, Rule
+
+_KINDS = ("availability", "latency")
+_SCOPES = ("all", "model", "tenant")
+
+# Fast window = slow window / 12 (1h → 5m): quick detection, confirmed
+# by the full window before the min-of-windows signal breaches.
+FAST_FRACTION = 1.0 / 12.0
+
+# Terminal outcomes excluded from SLO events: the client's fault, not
+# the service's (the SRE 4xx convention) — a flood of malformed uploads
+# must not burn the availability budget.
+EXCLUDED_OUTCOMES = frozenset(("rejected", "bad_request"))
+
+
+@dataclasses.dataclass(frozen=True)
+class SLObjective:
+    """One declarative SLO: ``goal`` of matching events must be good
+    over any sliding ``window_s``."""
+
+    name: str
+    scope_kind: str = "all"       # all | model | tenant
+    scope_value: str = ""
+    kind: str = "availability"    # availability | latency
+    goal: float = 0.999
+    window_s: float = 3600.0
+    latency_ms: float = 0.0       # kind=latency: the good/bad line
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError(f"SLO objective needs a name: {self!r}")
+        if self.scope_kind not in _SCOPES:
+            raise ValueError(
+                f"SLO {self.name!r}: scope must be all|model=X|tenant=X, "
+                f"got {self.scope_kind!r}")
+        if self.scope_kind != "all" and not self.scope_value:
+            raise ValueError(
+                f"SLO {self.name!r}: scope {self.scope_kind}= needs a "
+                "value")
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"SLO {self.name!r}: kind must be one of {_KINDS}, got "
+                f"{self.kind!r}")
+        if not 0.0 < self.goal < 1.0:
+            raise ValueError(
+                f"SLO {self.name!r}: goal must be in (0, 1), got "
+                f"{self.goal}")
+        if self.window_s <= 0:
+            raise ValueError(
+                f"SLO {self.name!r}: window_s must be > 0, got "
+                f"{self.window_s}")
+        if self.kind == "latency" and self.latency_ms <= 0:
+            raise ValueError(
+                f"SLO {self.name!r}: kind=latency needs latency_ms > 0")
+
+    @classmethod
+    def parse(cls, spec: str) -> "SLObjective":
+        """``name:scope:kind:goal:window_s[:latency_ms]`` → objective.
+        ``scope`` is ``all`` or ``model=X`` / ``tenant=X``."""
+        parts = [p.strip() for p in str(spec).split(":")]
+        if len(parts) < 5:
+            raise ValueError(
+                f"SLO spec {spec!r} needs at least "
+                "name:scope:kind:goal:window_s")
+        if len(parts) > 6:
+            raise ValueError(f"SLO spec {spec!r}: too many fields")
+        scope = parts[1]
+        if scope == "all":
+            skind, sval = "all", ""
+        else:
+            skind, sep, sval = scope.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"SLO spec {spec!r}: scope must be all, model=X, or "
+                    f"tenant=X, got {scope!r}")
+        try:
+            goal = float(parts[3])
+            window_s = float(parts[4])
+            latency_ms = float(parts[5]) if len(parts) > 5 else 0.0
+        except ValueError as e:
+            raise ValueError(f"SLO spec {spec!r}: non-numeric field ({e})")
+        return cls(name=parts[0], scope_kind=skind, scope_value=sval,
+                   kind=parts[2], goal=goal, window_s=window_s,
+                   latency_ms=latency_ms)
+
+    def matches(self, model: Optional[str], tenant: Optional[str]) -> bool:
+        if self.scope_kind == "all":
+            return True
+        if self.scope_kind == "model":
+            return model == self.scope_value
+        return tenant == self.scope_value
+
+    @property
+    def scope(self) -> str:
+        return ("all" if self.scope_kind == "all"
+                else f"{self.scope_kind}={self.scope_value}")
+
+
+def parse_slos(specs: Sequence[str]) -> List[SLObjective]:
+    objs = [SLObjective.parse(s) for s in specs or ()]
+    names = [o.name for o in objs]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate SLO objective names in {names}")
+    return objs
+
+
+class _WindowCounts:
+    """Good/bad counts over a sliding window, quantized into
+    ``n_buckets`` time buckets (sum-over-suffix gives any horizon up to
+    the window).  Not thread-safe — the tracker's lock covers it."""
+
+    def __init__(self, window_s: float, n_buckets: int = 60):
+        self._width = float(window_s) / int(n_buckets)
+        self._n = int(n_buckets)
+        self._buckets: Dict[int, List[float]] = {}  # idx → [good, bad]
+
+    def add(self, good: float, bad: float, now: float) -> None:
+        idx = int(now / self._width)
+        b = self._buckets.get(idx)
+        if b is None:
+            b = self._buckets[idx] = [0.0, 0.0]
+            # Prune anything older than the full window (bounded size).
+            floor = idx - self._n
+            for k in [k for k in self._buckets if k <= floor]:
+                del self._buckets[k]
+        b[0] += good
+        b[1] += bad
+
+    def totals(self, horizon_s: float, now: float) -> Tuple[float, float]:
+        """(good, bad) over the trailing ``horizon_s``.  Bucket
+        quantization: a bucket counts while ANY of it overlaps the
+        horizon."""
+        lo = int((now - horizon_s) / self._width)
+        hi = int(now / self._width)
+        good = bad = 0.0
+        for idx, (g, b) in self._buckets.items():
+            if lo <= idx <= hi:
+                good += g
+                bad += b
+        return good, bad
+
+
+class _ObjState:
+    __slots__ = ("window", "good_total", "bad_total")
+
+    def __init__(self, window: _WindowCounts):
+        self.window = window
+        self.good_total = 0.0
+        self.bad_total = 0.0
+
+
+class SLOTracker:
+    """Error-budget accounting over a set of objectives, plus the
+    burn-rate/budget alert rules.  One per process front end (router,
+    single-engine server, trainer sidecar); thread-safe."""
+
+    def __init__(self, objectives: Sequence[SLObjective], *,
+                 burn_threshold: float = 10.0,
+                 alert_for_s: float = 5.0, alert_clear_s: float = 60.0,
+                 clock=time.monotonic, n_buckets: int = 60):
+        objectives = list(objectives)
+        if not objectives:
+            raise ValueError("SLOTracker needs at least one objective")
+        if burn_threshold <= 0:
+            raise ValueError(
+                f"slo_burn_threshold must be > 0, got {burn_threshold}")
+        self.objectives: Tuple[SLObjective, ...] = tuple(objectives)
+        self.burn_threshold = float(burn_threshold)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._st: Dict[str, _ObjState] = {
+            o.name: _ObjState(_WindowCounts(o.window_s, n_buckets))
+            for o in objectives}
+        rules = []
+        for o in objectives:
+            rules.append(Rule(
+                f"slo_{o.name}_burn", f"slo_burn:{o.name}", "gt",
+                self.burn_threshold, for_s=alert_for_s,
+                clear_s=alert_clear_s, hint="slo"))
+            rules.append(Rule(
+                f"slo_{o.name}_budget", f"slo_budget:{o.name}", "lt",
+                0.0, for_s=alert_for_s, clear_s=alert_clear_s,
+                hint="slo"))
+        self.alerts = AlertEngine(rules, clock=clock)
+        self._next_eval = 0.0
+
+    # -- ingest --------------------------------------------------------
+
+    def observe(self, ok: bool, latency_ms: Optional[float] = None,
+                model: Optional[str] = None,
+                tenant: Optional[str] = None, n: int = 1,
+                now: Optional[float] = None) -> None:
+        """One terminal event (``n`` identical events — the trainer
+        feeds a k-step chunk as one call).  The caller has already
+        excluded client-fault terminals (:func:`observe_outcome` does
+        both)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            for o in self.objectives:
+                if not o.matches(model, tenant):
+                    continue
+                good = bool(ok)
+                if good and o.kind == "latency":
+                    good = (latency_ms is not None
+                            and latency_ms <= o.latency_ms)
+                st = self._st[o.name]
+                if good:
+                    st.good_total += n
+                    st.window.add(n, 0.0, now)
+                else:
+                    st.bad_total += n
+                    st.window.add(0.0, n, now)
+        self._maybe_evaluate(now)
+
+    def observe_outcome(self, outcome: str, latency_ms: float,
+                        model: Optional[str] = None,
+                        tenant: Optional[str] = None,
+                        now: Optional[float] = None) -> None:
+        """Feed one terminal-book outcome string (router/server form):
+        client-fault terminals are excluded, ``ok`` is good, everything
+        else is bad."""
+        if outcome in EXCLUDED_OUTCOMES:
+            return
+        self.observe(outcome == "ok", latency_ms=latency_ms, model=model,
+                     tenant=tenant, now=now)
+
+    # -- evaluation ----------------------------------------------------
+
+    def _burns(self, o: SLObjective, st: _ObjState, now: float
+               ) -> Dict[str, float]:
+        out = {}
+        for win, horizon in (("fast", o.window_s * FAST_FRACTION),
+                             ("slow", o.window_s)):
+            good, bad = st.window.totals(horizon, now)
+            total = good + bad
+            out[win] = ((bad / total) / (1.0 - o.goal)) if total else 0.0
+        return out
+
+    def _budget_remaining(self, o: SLObjective, st: _ObjState,
+                          now: float) -> float:
+        good, bad = st.window.totals(o.window_s, now)
+        total = good + bad
+        if not total:
+            return 1.0
+        allowed = total * (1.0 - o.goal)
+        return 1.0 - bad / allowed if allowed > 0 else 1.0
+
+    def signals(self, now: Optional[float] = None) -> Dict[str, float]:
+        """The alert-engine inputs: per objective, the min-of-windows
+        burn rate and the slow-window budget remaining."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            out = {}
+            for o in self.objectives:
+                st = self._st[o.name]
+                burns = self._burns(o, st, now)
+                out[f"slo_burn:{o.name}"] = min(burns["fast"],
+                                                burns["slow"])
+                out[f"slo_budget:{o.name}"] = \
+                    self._budget_remaining(o, st, now)
+            return out
+
+    def evaluate(self, now: Optional[float] = None) -> None:
+        """Advance every rule with the current window state.  Called on
+        ingest (throttled ~1 Hz), and by the periodic observe points /
+        scrape paths so burn decay CLEARS alerts even with no traffic."""
+        now = self._clock() if now is None else now
+        self.alerts.evaluate(self.signals(now), now=now)
+
+    def _maybe_evaluate(self, now: float) -> None:
+        with self._lock:
+            due = now >= self._next_eval
+            if due:
+                self._next_eval = now + 1.0
+        if due:
+            self.evaluate(now)
+
+    def active_reasons(self) -> List[str]:
+        """Active SLO alerts for the /healthz degraded verdict (the
+        scrape itself advances the machine so exhausted-then-recovered
+        budgets clear without traffic)."""
+        self._maybe_evaluate(self._clock())
+        return self.alerts.active_reasons()
+
+    # -- surfaces ------------------------------------------------------
+
+    def snapshot(self, now: Optional[float] = None) -> Dict:
+        """The /slo payload."""
+        now = self._clock() if now is None else now
+        self._maybe_evaluate(now)
+        with self._lock:
+            objs = []
+            for o in self.objectives:
+                st = self._st[o.name]
+                good, bad = st.window.totals(o.window_s, now)
+                burns = self._burns(o, st, now)
+                entry = {
+                    "name": o.name,
+                    "scope": o.scope,
+                    "kind": o.kind,
+                    "goal": o.goal,
+                    "window_s": o.window_s,
+                    "good": good,
+                    "bad": bad,
+                    "good_total": st.good_total,
+                    "bad_total": st.bad_total,
+                    "budget_remaining": round(
+                        self._budget_remaining(o, st, now), 6),
+                    "burn_rate": {k: round(v, 4)
+                                  for k, v in burns.items()},
+                }
+                if o.kind == "latency":
+                    entry["latency_ms"] = o.latency_ms
+                objs.append(entry)
+        active = self.alerts.active()
+        return {"objectives": objs, "active": active,
+                "burn_threshold": self.burn_threshold}
+
+    def prom_families(self, labels: str = ""):
+        """``dsod_slo_*`` families, one ``slo=``-labeled sample per
+        objective (scope rides as its own label), rendered
+        unconditionally so the inventory is stable while the tracker
+        exists.  The alert engine renders its own ``dsod_alert_*``
+        families — register both providers."""
+        now = self._clock()
+        self._maybe_evaluate(now)
+        pre = f"{labels}," if labels else ""
+        with self._lock:
+            rows = []
+            for o in self.objectives:
+                st = self._st[o.name]
+                rows.append((o, st.good_total, st.bad_total,
+                             self._budget_remaining(o, st, now),
+                             self._burns(o, st, now)))
+
+        def lbl(o):
+            return f'{pre}slo="{o.name}",scope="{o.scope}"'
+
+        target, good, bad, budget, burn = [], [], [], [], []
+        for o, g, b, rem, burns in rows:
+            target.append('dsod_slo_target{%s} %g' % (lbl(o), o.goal))
+            good.append('dsod_slo_good_total{%s} %g' % (lbl(o), g))
+            bad.append('dsod_slo_bad_total{%s} %g' % (lbl(o), b))
+            budget.append('dsod_slo_budget_remaining{%s} %g'
+                          % (lbl(o), rem))
+            for win in ("fast", "slow"):
+                burn.append('dsod_slo_burn_rate{%s,window="%s"} %g'
+                            % (lbl(o), win, burns[win]))
+        return [("dsod_slo_target", "gauge", target),
+                ("dsod_slo_good_total", "counter", good),
+                ("dsod_slo_bad_total", "counter", bad),
+                ("dsod_slo_budget_remaining", "gauge", budget),
+                ("dsod_slo_burn_rate", "gauge", burn)]
+
+
+def build_tracker(specs: Sequence[str], *, burn_threshold: float,
+                  alert_for_s: float, alert_clear_s: float,
+                  clock=time.monotonic) -> Optional[SLOTracker]:
+    """Config-knob bring-up: None when ``specs`` is empty (the
+    defaults-off byte-identity contract)."""
+    objs = parse_slos(specs)
+    if not objs:
+        return None
+    return SLOTracker(objs, burn_threshold=burn_threshold,
+                      alert_for_s=alert_for_s,
+                      alert_clear_s=alert_clear_s, clock=clock)

@@ -125,11 +125,13 @@ class TrainerTelemetry:
                  host: str = "127.0.0.1", port: int = 0,
                  port_file: Optional[str] = None, watchdog=None,
                  tracer: Optional[Tracer] = None,
-                 profile_dir: Optional[str] = None, alerts=None):
+                 profile_dir: Optional[str] = None, alerts=None,
+                 slo=None):
         self.registry = registry
         self.watchdog = watchdog
         self.tracer = tracer
         self.alerts = alerts  # utils/alerts.AlertEngine | None
+        self.slo = slo        # utils/slo.SLOTracker | None
         self.profile_dir = profile_dir or "."
         self._host = host
         self._port = int(port)
@@ -210,10 +212,22 @@ class TrainerTelemetry:
             else:
                 handler._send_json(200, self.tracer.snapshot(n))
         elif path == "/alerts":
-            if self.alerts is None:
-                handler._send_json(200, {"active": [], "rules": []})
-            else:
-                handler._send_json(200, self.alerts.snapshot())
+            # Numerics + SLO rule states merged (disjoint names).
+            snap = {"active": [], "rules": []}
+            for eng in (self.alerts,
+                        self.slo.alerts if self.slo is not None
+                        else None):
+                if eng is not None:
+                    s = eng.snapshot()
+                    snap["active"] += s["active"]
+                    snap["rules"] += s["rules"]
+            handler._send_json(200, snap)
+        elif path == "/slo":
+            # Goodput error-budget accounting (utils/slo.py; the
+            # trainer's events are completed steps).
+            handler._send_json(200, self.slo.snapshot()
+                               if self.slo is not None
+                               else {"objectives": [], "active": []})
         elif path == "/debug/profile":
             self._handle_profile(handler, split.query)
         else:
@@ -223,8 +237,10 @@ class TrainerTelemetry:
         # Active model-health alerts DEGRADE the verdict (200 with the
         # rules named — the run lives, the model may not) and never
         # mask the watchdog's 503 (a wedged dispatch outranks a
-        # quality worry).
+        # quality worry).  SLO goodput alerts join the same list.
         active = self.alerts.active_reasons() if self.alerts else []
+        if self.slo is not None:
+            active = active + self.slo.active_reasons()
         wd = self.watchdog
         if wd is None:
             # No watchdog armed: the sidecar answering at all proves
@@ -308,15 +324,19 @@ def build_trainer_telemetry(cfg, *, data_stats, timer, writer,
                             watchdog=None, tracer=None, workdir=None,
                             step_fn=None, port: Optional[int] = None,
                             port_file: Optional[str] = None,
-                            health=None, alerts=None
-                            ) -> Optional[TrainerTelemetry]:
+                            health=None, alerts=None, capacity=None,
+                            slo=None) -> Optional[TrainerTelemetry]:
     """fit()'s one-call bring-up: None when telemetry is off
     (``cfg.telemetry_port < 0`` and no explicit ``port``).
 
     ``health`` (utils/modelhealth.HealthMonitor) and ``alerts``
     (utils/alerts.AlertEngine) — both optional — add the
     ``dsod_health_*`` / ``dsod_alert_*`` families to /metrics and back
-    the /alerts endpoint + the degraded /healthz verdict."""
+    the /alerts endpoint + the degraded /healthz verdict.  ``capacity``
+    (utils/capacity.CapacityLedger) adds the ``dsod_capacity_*``
+    families; ``slo`` (utils/slo.SLOTracker) adds ``dsod_slo_*``, the
+    /slo endpoint, and its burn/budget alerts to the degraded verdict
+    (docs/OBSERVABILITY.md "Capacity & SLO")."""
     eff_port = cfg.telemetry_port if port is None else port
     if eff_port is None or eff_port < 0:
         return None
@@ -330,7 +350,12 @@ def build_trainer_telemetry(cfg, *, data_stats, timer, writer,
         registry.register("health", health.prom_families)
     if alerts is not None:
         registry.register("alerts", alerts.prom_families)
+    if capacity is not None:
+        registry.register("capacity", capacity.prom_families)
+    if slo is not None:
+        registry.register("slo", slo.prom_families)
+        registry.register("slo_alerts", slo.alerts.prom_families)
     return TrainerTelemetry(
         registry, host="127.0.0.1", port=eff_port, port_file=port_file,
         watchdog=watchdog, tracer=tracer, profile_dir=workdir,
-        alerts=alerts).start()
+        alerts=alerts, slo=slo).start()

@@ -1,0 +1,514 @@
+"""SLO objectives, burn-rate alerting, and the synthetic canary prober
+(utils/slo.py + serve/prober.py — docs/OBSERVABILITY.md "Capacity &
+SLO").
+
+Invariants proven here:
+
+- the colon DSL parses/validates loudly;
+- error-budget and multi-window burn-rate math on a fake clock: the
+  fast window detects, the slow window confirms (min-of-windows is the
+  two-window AND), budget goes negative exactly when the window's
+  allowed-bad count is exceeded;
+- the built-in burn/budget rules FIRE and CLEAR through the alert
+  engine's full hysteresis ladder deterministically (no sleeps);
+- SLO events come from the terminal book and reconcile against it:
+  client-fault terminals are excluded, scopes route events to the
+  right objectives;
+- the prober's canaries ride the full router door: the fleet identity
+  holds WITH probe traffic, other tenants' budgets are untouched, and
+  the prober DROPS (counted) rather than queue when its lane is busy;
+- endpoints: /slo on the single-engine server and the router, SLO
+  families in /metrics, burn alerts degrading /healthz;
+- defaults-off byte-identity: with the capacity/SLO knobs off the
+  /metrics rendering is byte-identical to the stats-only surface.
+"""
+
+import io
+import json
+import threading
+import urllib.request
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+
+from distributed_sod_project_tpu.configs import (DataConfig,
+                                                 ExperimentConfig,
+                                                 FleetConfig,
+                                                 FleetTenantConfig,
+                                                 ModelConfig, ServeConfig,
+                                                 validate_fleet_config)
+from distributed_sod_project_tpu.configs.base import FleetModelConfig
+from distributed_sod_project_tpu.serve.engine import InferenceEngine
+from distributed_sod_project_tpu.serve.fleet import EngineBackend, Fleet
+from distributed_sod_project_tpu.serve.prober import (ProbeStats,
+                                                      SyntheticProber,
+                                                      make_probe_set,
+                                                      score_probe)
+from distributed_sod_project_tpu.serve.router import make_fleet_server
+from distributed_sod_project_tpu.serve.server import make_server
+from distributed_sod_project_tpu.utils.slo import (SLObjective, SLOTracker,
+                                                   build_tracker,
+                                                   parse_slos)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ------------------------------------------------------------- DSL
+
+
+def test_slo_dsl_parses():
+    o = SLObjective.parse("avail:model=minet:availability:0.999:3600")
+    assert o.name == "avail" and o.scope == "model=minet"
+    assert o.kind == "availability" and o.goal == 0.999
+    o = SLObjective.parse("fast:tenant=pro:latency:0.95:600:250")
+    assert o.kind == "latency" and o.latency_ms == 250.0
+    assert o.matches(None, "pro") and not o.matches(None, "free")
+    o = SLObjective.parse("g:all:latency:0.9:60:10")
+    assert o.matches("anything", None)
+
+
+@pytest.mark.parametrize("spec", [
+    "x:all:availability:0.9",              # too few fields
+    "x:all:availability:0.9:60:1:extra",   # too many
+    "x:bogus:availability:0.9:60",         # bad scope
+    "x:model=:availability:0.9:60",        # empty scope value
+    "x:all:nope:0.9:60",                   # bad kind
+    "x:all:availability:1.5:60",           # goal out of range
+    "x:all:availability:0.9:0",            # zero window
+    "x:all:latency:0.9:60",                # latency without threshold
+    "x:all:availability:zz:60",            # non-numeric
+])
+def test_slo_dsl_rejects(spec):
+    with pytest.raises(ValueError):
+        SLObjective.parse(spec)
+
+
+def test_duplicate_objective_names_rejected():
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_slos(("a:all:availability:0.9:60",
+                    "a:all:availability:0.99:60"))
+
+
+def test_build_tracker_empty_is_none():
+    assert build_tracker((), burn_threshold=1.0, alert_for_s=0,
+                         alert_clear_s=0) is None
+
+
+# ------------------------------------------- budget & burn math
+
+
+def test_budget_and_burn_math_fake_clock():
+    clk = FakeClock()
+    tr = SLOTracker(parse_slos(("a:all:availability:0.9:120",)),
+                    burn_threshold=2.0, clock=clk)
+    # 10 events, 1 bad: error rate 0.1 == 1 - goal → burn exactly 1.0,
+    # budget exactly 0 (the allowed-bad count fully spent).
+    for _ in range(9):
+        tr.observe(True, now=clk.t)
+    tr.observe(False, now=clk.t)
+    sigs = tr.signals(now=clk.t)
+    assert sigs["slo_burn:a"] == pytest.approx(1.0)
+    assert sigs["slo_budget:a"] == pytest.approx(0.0)
+    # One more bad: 2/11 bad vs 1.1 allowed → negative budget, burn
+    # ~1.82 in BOTH windows (all events inside the fast window too).
+    tr.observe(False, now=clk.t)
+    sigs = tr.signals(now=clk.t)
+    assert sigs["slo_budget:a"] < 0
+    assert sigs["slo_burn:a"] == pytest.approx((2 / 11) / 0.1, rel=1e-6)
+    # No traffic at all → burn 0, budget 1 (never invent a verdict).
+    tr2 = SLOTracker(parse_slos(("a:all:availability:0.9:120",)),
+                     burn_threshold=2.0, clock=clk)
+    sigs = tr2.signals(now=clk.t)
+    assert sigs["slo_burn:a"] == 0.0 and sigs["slo_budget:a"] == 1.0
+
+
+def test_fast_window_detects_slow_window_confirms():
+    """Old good traffic sits in the slow window only: a fresh burst of
+    bads saturates the fast window immediately, but min-of-windows
+    stays below a pure-fast burn — the two-window AND."""
+    clk = FakeClock()
+    # window 120 s → fast window 10 s, bucket width 2 s.
+    tr = SLOTracker(parse_slos(("a:all:availability:0.9:120",)),
+                    burn_threshold=2.0, clock=clk)
+    for _ in range(80):
+        tr.observe(True, now=clk.t)
+    clk.advance(60.0)  # good traffic ages out of the fast window
+    for _ in range(20):
+        tr.observe(False, now=clk.t)
+    sigs = tr.signals(now=clk.t)
+    fast_burn = (20 / 20) / 0.1   # fast window: all bad
+    slow_burn = (20 / 100) / 0.1  # slow window: diluted by the goods
+    assert sigs["slo_burn:a"] == pytest.approx(min(fast_burn, slow_burn))
+    assert sigs["slo_burn:a"] == pytest.approx(2.0)
+
+
+def test_latency_kind_good_requires_threshold():
+    clk = FakeClock()
+    tr = SLOTracker(parse_slos(("f:all:latency:0.5:60:100",)), clock=clk)
+    tr.observe(True, latency_ms=50.0, now=clk.t)    # good
+    tr.observe(True, latency_ms=500.0, now=clk.t)   # served, too slow
+    tr.observe(False, latency_ms=10.0, now=clk.t)   # failed
+    snap = tr.snapshot(now=clk.t)["objectives"][0]
+    assert snap["good"] == 1 and snap["bad"] == 2
+
+
+def test_scope_routing_and_exclusions():
+    clk = FakeClock()
+    tr = SLOTracker(parse_slos(("m:model=a:availability:0.9:60",
+                                "t:tenant=pro:availability:0.9:60")),
+                    clock=clk)
+    tr.observe_outcome("ok", 1.0, model="a", tenant="free", now=clk.t)
+    tr.observe_outcome("error", 1.0, model="b", tenant="pro", now=clk.t)
+    # Client-fault terminals never count (the SRE 4xx convention).
+    tr.observe_outcome("rejected", 1.0, model="a", tenant="pro",
+                       now=clk.t)
+    tr.observe_outcome("bad_request", 1.0, model="a", tenant="pro",
+                       now=clk.t)
+    objs = {o["name"]: o for o in tr.snapshot(now=clk.t)["objectives"]}
+    assert objs["m"]["good"] == 1 and objs["m"]["bad"] == 0
+    assert objs["t"]["good"] == 0 and objs["t"]["bad"] == 1
+
+
+# ---------------------------- burn alert: fire + clear, fake clock
+
+
+def test_burn_alert_fires_and_clears_through_hysteresis():
+    """The full ladder on a fake clock: breach → pending (for_s dwell)
+    → firing → traffic recovers + windows decay → clearing (clear_s
+    dwell) → ok.  No sleeps anywhere."""
+    clk = FakeClock()
+    # window 24 s → fast window 2 s; for 4 s, clear 6 s.
+    tr = SLOTracker(parse_slos(("a:all:availability:0.9:24",)),
+                    burn_threshold=2.0, alert_for_s=4.0,
+                    alert_clear_s=6.0, clock=clk)
+    rule = "slo_a_burn"
+
+    def state():
+        return {r["rule"]: r["state"]
+                for r in tr.alerts.snapshot()["rules"]}[rule]
+
+    # Healthy traffic: no breach.
+    for _ in range(10):
+        tr.observe(True, now=clk.t)
+    tr.evaluate(now=clk.t)
+    assert state() == "ok"
+    # Total outage: every event bad → burn 10 ≥ threshold in both
+    # windows → pending, then firing after the 4 s dwell.
+    for _ in range(10):
+        tr.observe(False, now=clk.t)
+    tr.evaluate(now=clk.t)
+    assert state() == "pending"
+    clk.advance(4.0)
+    for _ in range(5):
+        tr.observe(False, now=clk.t)
+    tr.evaluate(now=clk.t)
+    assert state() == "firing"
+    assert f"{rule}" in tr.alerts.active()
+    assert tr.active_reasons()  # the /healthz degrade hook
+    # Recovery: the bads age out of BOTH windows; burn decays to 0.
+    clk.advance(30.0)
+    for _ in range(10):
+        tr.observe(True, now=clk.t)
+    tr.evaluate(now=clk.t)
+    assert state() == "clearing"  # still ACTIVE: the hold half
+    assert rule in tr.alerts.active()
+    clk.advance(6.0)
+    tr.evaluate(now=clk.t)
+    assert state() == "ok"
+    assert rule not in tr.alerts.active()
+
+
+def test_budget_rule_fires_on_exhaustion():
+    clk = FakeClock()
+    tr = SLOTracker(parse_slos(("a:all:availability:0.9:60",)),
+                    burn_threshold=100.0,  # burn rule out of the way
+                    alert_for_s=0.0, alert_clear_s=0.0, clock=clk)
+    for _ in range(8):
+        tr.observe(True, now=clk.t)
+    tr.observe(False, now=clk.t)
+    tr.observe(False, now=clk.t)  # 2 bad of 10 > allowed 1
+    tr.evaluate(now=clk.t)
+    assert "slo_a_budget" in tr.alerts.active()
+
+
+# ----------------------------------------------- prober unit tests
+
+
+def test_score_probe_exact_and_resized():
+    gt = np.zeros((8, 8), np.float32)
+    gt[:4] = 1.0
+    mae, iou = score_probe(gt.copy(), gt)
+    assert mae == 0.0 and iou == 1.0
+    mae, iou = score_probe(1.0 - gt, gt)
+    assert mae == 1.0 and iou == 0.0
+    # Prediction at another resolution: GT resized nearest.
+    up = np.repeat(np.repeat(gt, 2, axis=0), 2, axis=1)
+    mae, iou = score_probe(up, gt)
+    assert mae == 0.0 and iou == 1.0
+
+
+def test_make_probe_set_deterministic_uint8():
+    a = make_probe_set(2, px=16)
+    b = make_probe_set(2, px=16)
+    assert a[0][0] == b[0][0]  # bytes equal
+    img = np.load(io.BytesIO(a[0][0]))
+    assert img.dtype == np.uint8 and img.shape == (16, 16, 3)
+    assert a[0][1].shape == (16, 16)
+    assert set(np.unique(a[0][1])) <= {0.0, 1.0}
+
+
+def test_probe_stats_families_and_snapshot():
+    st = ProbeStats()
+    st.record("m", True, 5.0, mae=0.1, iou=0.8)
+    st.record("m", False, 5.0)
+    st.record_dropped()
+    snap = st.snapshot()
+    assert snap["dropped"] == 1
+    assert snap["models"]["m"]["sent"] == 2
+    assert snap["models"]["m"]["availability"] == 0.5
+    fams = dict((n, (t, s)) for n, t, s in st.prom_families())
+    assert "dsod_probe_latency_ms" in fams
+    assert fams["dsod_probe_ok_total"][1] == [
+        'dsod_probe_ok_total{model="m"} 1']
+    # Labels compose under a fleet prefix.
+    fams = st.prom_families('replica="r0"')
+    assert any('replica="r0",model="m"' in s
+               for _n, _t, ss in fams for s in ss)
+
+
+# ------------------------------------- live HTTP: server + router
+
+
+class TinySOD(nn.Module):
+    @nn.compact
+    def __call__(self, image, depth=None, train=False):
+        x = nn.Conv(4, (3, 3), name="c1")(image)
+        x = nn.relu(x)
+        return (nn.Conv(1, (1, 1), name="head")(x),)
+
+
+def _cfg(**serve_kw):
+    serve_kw.setdefault("batch_buckets", (1, 2))
+    serve_kw.setdefault("resolution_buckets", (16,))
+    serve_kw.setdefault("watchdog_deadline_s", 30.0)
+    return ExperimentConfig(data=DataConfig(image_size=(16, 16)),
+                            model=ModelConfig(name="minet"),
+                            serve=ServeConfig(**serve_kw))
+
+
+@pytest.fixture(scope="module")
+def tiny_variables():
+    model = TinySOD()
+    probe = np.zeros((1, 16, 16, 3), np.float32)
+    return model, model.init(jax.random.key(0), probe, None, train=False)
+
+
+def _post_npy(base, arr):
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    req = urllib.request.Request(
+        base + "/predict", data=buf.getvalue(),
+        headers={"Content-Type": "application/x-npy"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        r.read()
+        return r.status
+
+
+def _get_json(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def test_server_slo_endpoint_and_families(tiny_variables):
+    model, variables = tiny_variables
+    cfg = _cfg(slo_objectives=("avail:all:availability:0.9:60",
+                               "fast:model=minet:latency:0.5:60:30000"))
+    eng = InferenceEngine(cfg, model, variables).start()
+    srv = make_server(eng, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        img = np.zeros((16, 16, 3), np.uint8)
+        for _ in range(3):
+            assert _post_npy(base, img) == 200
+        slo = _get_json(base, "/slo")
+        objs = {o["name"]: o for o in slo["objectives"]}
+        assert objs["avail"]["good"] == 3 and objs["avail"]["bad"] == 0
+        # The latency objective scoped to THIS model matched too.
+        assert objs["fast"]["good"] == 3
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        for fam in ("dsod_slo_target", "dsod_slo_budget_remaining",
+                    "dsod_slo_burn_rate", "dsod_alert_active"):
+            assert fam in text, fam
+        assert 'rule="slo_avail_burn"' in text
+        # /alerts merges the SLO rules; nothing fires on good traffic.
+        alerts = _get_json(base, "/alerts")
+        assert any(r["rule"] == "slo_avail_burn"
+                   for r in alerts["rules"])
+        assert alerts["active"] == []
+        assert _get_json(base, "/healthz")["status"] == "ok"
+        # /stats carries the slo block.
+        assert "slo" in _get_json(base, "/stats")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        eng.stop()
+
+
+def test_metrics_byte_identical_with_capacity_slo_off(tiny_variables):
+    """The defaults-off contract: no capacity/SLO knob → the telemetry
+    registry renders byte-for-byte the stats-only surface."""
+    model, variables = tiny_variables
+    eng = InferenceEngine(_cfg(), model, variables)
+    assert eng.capacity is None and eng.slo is None
+    assert eng.telemetry.render() == eng.stats.render_prometheus()
+
+
+def test_slo_knob_parse_is_loud(tiny_variables):
+    model, variables = tiny_variables
+    with pytest.raises(ValueError, match="SLO spec"):
+        InferenceEngine(_cfg(slo_objectives=("garbage",)), model,
+                        variables)
+
+
+# ------------------------------- prober through the real router door
+
+
+def _mk_fleet(tiny_variables, fc):
+    model, variables = tiny_variables
+    eng = InferenceEngine(_cfg(), model, variables)
+    fleet = Fleet([EngineBackend("minet", eng)], fc)
+    fleet.start()
+    srv = make_fleet_server(fleet, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return fleet, srv, f"http://127.0.0.1:{port}"
+
+
+def test_prober_accounting_identity_and_tenant_isolation(tiny_variables):
+    """Canaries are counted traffic under the reserved tenant: the
+    fleet identity holds WITH them, the configured tenant's token
+    bucket is untouched, and per-model SLO objectives are fed by
+    probes alone (the zero-live-traffic detection path)."""
+    fc = validate_fleet_config(FleetConfig(
+        models=(FleetModelConfig(name="minet", config="unused"),),
+        tenants=(FleetTenantConfig(name="pro", priority=1,
+                                   rate_rps=5.0, burst=7.0),),
+        slo_objectives=("avail:model=minet:availability:0.9:60",),
+        prober_interval_s=0.5, prober_px=16))
+    # The reserved tenant was auto-registered BELOW every class.
+    probe_t = {t.name: t for t in fc.tenants}["_probe"]
+    assert probe_t.priority < min(
+        t.priority for t in fc.tenants if t.name != "_probe")
+    fleet, srv, base = _mk_fleet(tiny_variables, fc)
+    try:
+        prober = SyntheticProber(
+            base, ["minet"], stats=fleet.probe_stats, interval_s=0.5,
+            tenant="_probe", px=16)
+        for _ in range(4):
+            assert prober.tick()
+            prober._worker.join(timeout=30)
+        snap = fleet.probe_stats.snapshot()["models"]["minet"]
+        assert snap["sent"] == 4 and snap["ok"] == 4
+        assert snap["availability"] == 1.0
+        assert 0.0 <= snap["mae_avg"] <= 1.0
+        assert 0.0 <= snap["iou_avg"] <= 1.0
+        stats = fleet.stats()
+        # Identity holds with probe traffic; all of it under _probe.
+        assert stats["fleet"]["consistent"]
+        assert stats["fleet"]["submitted"] == 4
+        assert list(stats["router"]["tenants"]) == ["_probe"]
+        # The pro tenant's bucket is provably untouched: full burst.
+        assert fleet.admission._buckets["pro"]._tokens == 7.0
+        # Probes fed the model-scoped SLO.
+        obj = stats["slo"]["objectives"][0]
+        assert obj["good"] == 4 and obj["bad"] == 0
+        # The full surface renders on the router.
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        for fam in ("dsod_probe_sent_total", "dsod_probe_availability",
+                    "dsod_probe_latency_ms", "dsod_slo_burn_rate"):
+            assert fam in text, fam
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()
+
+
+def test_prober_drops_rather_than_queue(tiny_variables):
+    """A busy probe lane at tick time is a counted DROP, never a
+    backlog: synthetic load must not pile onto an overloaded fleet."""
+    stats = ProbeStats()
+    prober = SyntheticProber("http://127.0.0.1:1", ["m"], stats=stats,
+                             interval_s=1.0, px=16)
+    assert prober._busy.acquire(blocking=False)  # wedge the lane
+    try:
+        assert not prober.tick()
+        assert not prober.tick()
+        assert stats.snapshot()["dropped"] == 2
+        assert stats.snapshot()["models"] == {}  # nothing dispatched
+    finally:
+        prober._busy.release()
+
+
+def test_prober_records_failures_as_unavailable():
+    """A dead router (connection refused) is a failed probe — the
+    availability gauge is the zero-traffic outage signal."""
+    stats = ProbeStats()
+    prober = SyntheticProber("http://127.0.0.1:1", ["m"], stats=stats,
+                             interval_s=1.0, px=16, timeout_s=2.0)
+    body, gt = prober.probes[0]
+    assert prober.probe_once("m", body, gt) is False
+    snap = stats.snapshot()["models"]["m"]
+    assert snap["failed"] == 1 and snap["availability"] == 0.0
+
+
+def test_router_feeds_slo_from_terminal_book(tiny_variables):
+    """Live-HTTP reconciliation: every router terminal (ok AND an
+    unknown-model-excluded 404, a shed) lands in /slo exactly as the
+    book classifies it."""
+    fc = FleetConfig(
+        tenants=(FleetTenantConfig(name="_probe", priority=-1),),
+        slo_objectives=("avail:model=minet:availability:0.9:60",))
+    fleet, srv, base = _mk_fleet(tiny_variables, fc)
+    try:
+        img = np.zeros((16, 16, 3), np.uint8)
+        for _ in range(2):
+            assert _post_npy(base, img) == 200
+        # Unknown model: 404, never counted anywhere — /slo unmoved.
+        buf = io.BytesIO()
+        np.save(buf, img)
+        req = urllib.request.Request(
+            base + "/predict", data=buf.getvalue(),
+            headers={"Content-Type": "application/x-npy",
+                     "X-Model": "nope"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "unknown model must 404"
+        except urllib.error.HTTPError as e:
+            e.read()
+            assert e.code == 404
+        slo = _get_json(base, "/slo")
+        obj = slo["objectives"][0]
+        stats = fleet.stats()
+        assert obj["good"] == 2 and obj["bad"] == 0
+        assert obj["good"] + obj["bad"] == stats["fleet"]["terminal"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        fleet.stop()

@@ -86,6 +86,13 @@ def parse_args(argv=None):
     p.add_argument("--server-stats", action="store_true",
                    help="append the server's /stats snapshot to the "
                         "summary line")
+    p.add_argument("--slo", action="store_true",
+                   help="scrape the server's /slo at the end of the "
+                        "run and report per-objective (per-model/"
+                        "per-tenant) budget-remaining and fast/slow "
+                        "burn rates under \"slo\" next to the latency "
+                        "summary (docs/OBSERVABILITY.md \"Capacity & "
+                        "SLO\"; needs slo_objectives on the server)")
     p.add_argument("--quality", action="store_true",
                    help="scrape the per-model shadow-disagreement and "
                         "drift gauges from /metrics at the end of the "
@@ -122,7 +129,7 @@ def main(argv=None) -> int:
         sizes=sizes, seed=args.seed, slo_ms=args.slo_ms,
         timeout_s=args.timeout, precision=args.precision,
         model=args.model, tenant=args.tenant, mix=mix,
-        slowest=args.slowest, quality=args.quality)
+        slowest=args.slowest, quality=args.quality, slo=args.slo)
     if args.server_stats:
         try:
             summary["server"] = fetch_stats(url)

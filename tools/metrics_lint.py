@@ -50,6 +50,27 @@ def _family_types(families) -> dict:
     return {name: typ for name, typ, _samples in families}
 
 
+def _populated_capacity():
+    """A CapacityLedger with one recorded + observed program, so every
+    dsod_capacity_* family (static cost, live utilization, stage
+    share, HBM gauges) renders — the inventory is a NAME check, so a
+    stub executable's analyses are as good as a warmed engine's."""
+    from distributed_sod_project_tpu.utils.capacity import CapacityLedger
+
+    class _StubCompiled:
+        def cost_analysis(self):
+            return {"flops": 1.0, "bytes accessed": 1.0}
+
+        def memory_analysis(self):
+            return None
+
+    cap = CapacityLedger(
+        share_fn=lambda: {"device": 0.5, "queue": 0.25, "host": 0.25})
+    cap.record("m/r64b1/fast/f32", _StubCompiled())
+    cap.observe("m/r64b1/fast/f32", 1.0)
+    return cap
+
+
 def fleet_inventory() -> dict:
     """Render the aggregated fleet /metrics surface from populated
     stats objects through the real Fleet aggregation path."""
@@ -92,6 +113,12 @@ def fleet_inventory() -> dict:
     alerts = AlertEngine(default_quality_rules(ServeConfig()))
     alerts.evaluate({"quality_psi_max": 0.5, "shadow_mae_max": 0.1})
 
+    # Capacity & SLO surface (utils/capacity.py, utils/slo.py,
+    # serve/prober.py): populated synthetically through the SAME
+    # prom_families providers the engine/router register, so every
+    # knob-gated family is in the inventory.
+    capacity = _populated_capacity()
+
     class _StubBackend:
         """Metric-surface stand-in for one replica: real ServeStats
         families, no engine (the inventory is a NAME check — an AOT
@@ -105,10 +132,11 @@ def fleet_inventory() -> dict:
 
         def prom_families(self, labels):
             # The EngineBackend path renders the engine's full registry
-            # (ServeStats + quality + alerts); mirror it.
+            # (ServeStats + quality + alerts + capacity); mirror it.
             return (stats.prom_families(labels)
                     + quality.prom_families(labels)
-                    + alerts.prom_families(labels))
+                    + alerts.prom_families(labels)
+                    + capacity.prom_families(labels))
 
         def stats_snapshot(self):
             return stats.snapshot()
@@ -119,7 +147,21 @@ def fleet_inventory() -> dict:
         def describe(self):
             return {"kind": self.kind}
 
-    fleet = Fleet([_StubBackend()])
+    # The fleet with the router-tier SLO tracker and prober armed:
+    # Fleet itself constructs both off the config, exactly the
+    # serve_fleet_forever path.
+    from distributed_sod_project_tpu.configs import (FleetConfig,
+                                                     FleetTenantConfig)
+
+    fleet = Fleet([_StubBackend()], FleetConfig(
+        tenants=(FleetTenantConfig(name="_probe", priority=-1),),
+        slo_objectives=("avail:model=m:availability:0.99:60",),
+        prober_interval_s=1.0))
+    fleet.slo.observe_outcome("ok", 1.0, model="m")
+    fleet.slo.observe_outcome("error", 1.0, model="m")
+    fleet.probe_stats.record("m", True, 1.0, mae=0.01, iou=0.9)
+    fleet.probe_stats.record("m", False, 1.0)
+    fleet.probe_stats.record_dropped()
     r = fleet.rstats
     r.inc_submitted("default")
     r.inc_shed("default", "budget")
@@ -175,6 +217,17 @@ def trainer_inventory() -> dict:
     sigs, details = health.signals()
     alerts.evaluate(sigs, details=details)
     fams = fams + health.prom_families() + alerts.prom_families()
+    # Capacity & goodput-SLO surface (utils/capacity.py, utils/slo.py):
+    # the sidecar registers these as extra providers when the knobs are
+    # on; populate through the same prom_families the providers are.
+    from distributed_sod_project_tpu.utils.slo import build_tracker
+
+    slo = build_tracker(("goodput:all:latency:0.99:600:2000",),
+                        burn_threshold=10.0, alert_for_s=0.0,
+                        alert_clear_s=1.0)
+    slo.observe(True, latency_ms=5.0, n=1)
+    fams = (fams + _populated_capacity().prom_families()
+            + slo.prom_families() + slo.alerts.prom_families())
     return _family_types(fams)
 
 

@@ -439,7 +439,11 @@ def measured_table(trace_dir: str, top_unmatched: int = 5):
 
 
 def xla_check(b: int = 4, hw: int = 64):
-    """Compare the ledger against XLA's cost model on the REAL step."""
+    """Compare the ledger against XLA's cost model on the REAL step —
+    and cross-check the LIVE capacity ledger (utils/capacity.py) on the
+    SAME compiled executable: the dsod_capacity_* surface must report
+    exactly what cost_analysis reports here (within 1%), or live MFU
+    and this offline roofline have diverged."""
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), ".."))
     import jax
@@ -470,7 +474,8 @@ def xla_check(b: int = 4, hw: int = 64):
     state = jax.device_put(state, replicated_sharding(mesh))
     dev_batch = jax.device_put(batch, batch_sharding(mesh))
     step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched)
-    cost = step.lower(state, dev_batch).compile().cost_analysis()
+    compiled = step.lower(state, dev_batch).compile()
+    cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]
     xla_flops = float(cost.get("flops", 0.0))
@@ -481,6 +486,20 @@ def xla_check(b: int = 4, hw: int = 64):
     print(f"ledger                                      : "
           f"{ours / 1e9:.2f} GFLOPs  "
           f"(ratio {ours / xla_flops:.3f})")
+    # Live-ledger cross-check on the SAME executable: what the
+    # capacity_ledger knob would export for this program.
+    from distributed_sod_project_tpu.utils.capacity import CapacityLedger
+
+    cap = CapacityLedger(device_memory=False)
+    rec = cap.record(f"train/{hw}x{hw}/k1", compiled)
+    live_ratio = rec["flops"] / xla_flops if xla_flops else 0.0
+    print(f"capacity ledger (live dsod_capacity_* source): "
+          f"{rec['flops'] / 1e9:.2f} GFLOPs  "
+          f"(ratio {live_ratio:.4f} — must be within 1%)")
+    if not 0.99 <= live_ratio <= 1.01:
+        print("capacity ledger DISAGREES with cost_analysis on the "
+              "same executable")
+        return 0.0  # outside every acceptance band below
     return ours / xla_flops
 
 

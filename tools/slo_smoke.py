@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Capacity & SLO smoke for tools/t1.sh (docs/OBSERVABILITY.md
+"Capacity & SLO"): the canary/error-budget loop must survive REAL
+process boundaries, not just in-process tests.  One leg, real
+subprocesses, one JSON line:
+
+- a REMOTE single-engine replica is started with an injected
+  always-500 fault (``DSOD_FAULTS=serve_500@1x100000`` — a crashed
+  worker behind a live listener);
+- a ROUTER process fronts it with the synthetic prober armed and an
+  availability SLO on the model — and receives ZERO live traffic;
+- the prober's canaries ride the full router→engine path, every one
+  terminates bad in the router book, the SLO burn rate crosses its
+  threshold, and the ``slo_avail_burn`` alert must FIRE at /alerts and
+  DEGRADE the router /healthz — the "outage detected with no users"
+  contract;
+- /slo must stay CONSISTENT with the router's own terminal book
+  (good + bad == the fleet identity's terminal count — probes are
+  counted traffic under the reserved tenant, and nothing else ran);
+- the capacity ledger rides the same smoke on the replica
+  (serve.capacity_ledger=true): its /metrics must export
+  ``dsod_capacity_mfu`` with per-program cost from the warmed
+  executables.
+
+Budget contract: every internal deadline sums under t1.sh's 600 s
+wrapper, so a stall reports its OWN diagnostic instead of dying to the
+outer timeout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _get_json(url: str, timeout: float = 5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        # /healthz answers 503 with a JSON body when the whole fleet
+        # is unroutable — that body IS the verdict under test.
+        return json.loads(e.read().decode())
+
+
+def _get_text(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _wait_port(port_file: str, proc, deadline_s: float):
+    deadline = time.monotonic() + deadline_s
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            return None, f"process died before binding (rc={proc.returncode})"
+        if time.monotonic() > deadline:
+            return None, "never bound a port"
+        time.sleep(0.25)
+    with open(port_file) as f:
+        return int(f.read().strip()), None
+
+
+def _poll(fn, deadline_s: float, poll_s: float = 0.5):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            v = fn()
+            if v:
+                return v
+        except Exception:  # noqa: BLE001 — endpoint mid-bind
+            pass
+        time.sleep(poll_s)
+    return None
+
+
+def smoke(out: dict) -> bool:
+    replica_port_file = tempfile.mktemp(prefix="dsod_slo_rport_")
+    router_port_file = tempfile.mktemp(prefix="dsod_slo_fport_")
+    common = ["--device", "cpu",
+              "--set", "data.image_size=32,32",
+              "--set", "serve.resolution_buckets=32",
+              "--set", "serve.batch_buckets=1,2",
+              "--set", "serve.precision_arms=f32"]
+    # Leg A: the sick replica — live listener, every /predict answers
+    # an injected 500 before the engine sees it.  The capacity ledger
+    # rides here so the smoke also proves the live-MFU surface on a
+    # real process.
+    replica = subprocess.Popen(
+        [sys.executable, os.path.join(TOOLS, "serve.py"),
+         "--config", "minet_vgg16_ref", "--init-random",
+         "--port", "0", "--port-file", replica_port_file,
+         "--set", "serve.capacity_ledger=true"] + common,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 DSOD_FAULTS="serve_500@1x100000"))
+    router = None
+    fleet_file = tempfile.mktemp(prefix="dsod_slo_fleet_", suffix=".json")
+    try:
+        rport, err = _wait_port(replica_port_file, replica, 240)
+        if err:
+            out["replica_error"] = err
+            return False
+        rbase = f"http://127.0.0.1:{rport}"
+        if not _poll(lambda: "ok" in _get_text(rbase + "/healthz"), 60):
+            out["replica_error"] = "replica never became healthy"
+            return False
+        metrics = _get_text(rbase + "/metrics")
+        out["replica_capacity_ok"] = (
+            "dsod_capacity_mfu" in metrics
+            and "dsod_capacity_program_flops" in metrics)
+        # Leg B: the router — prober on, availability SLO on the model,
+        # tight windows so the smoke converges in seconds (production
+        # keeps hour-scale windows).
+        with open(fleet_file, "w") as f:
+            json.dump({
+                "models": [{"name": "minet", "url": rbase}],
+                "slo_objectives": ["avail:model=minet:availability"
+                                   ":0.9:60"],
+                "slo_burn_threshold": 2.0,
+                "slo_alert_for_s": 1.0,
+                "slo_alert_clear_s": 5.0,
+                "prober_interval_s": 0.25,
+                "prober_px": 32,
+                "prober_timeout_s": 10.0,
+                "retry_max_attempts": 1,
+            }, f)
+        router = subprocess.Popen(
+            [sys.executable, os.path.join(TOOLS, "serve.py"),
+             "--fleet-config", fleet_file, "--device", "cpu",
+             "--port", "0", "--port-file", router_port_file],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        fport, err = _wait_port(router_port_file, router, 120)
+        if err:
+            out["router_error"] = err
+            return False
+        fbase = f"http://127.0.0.1:{fport}"
+
+        # ZERO live traffic from here: only canaries move.  The burn
+        # alert must fire off probe failures alone.
+        def burn_fired():
+            snap = _get_json(fbase + "/alerts")
+            return ("slo_avail_burn" in snap.get("active", [])
+                    and snap) or None
+
+        fired = _poll(burn_fired, 90)
+        if not fired:
+            out["router_error"] = "slo_avail_burn never fired (zero-" \
+                "traffic canary detection failed)"
+            return False
+        slo = _get_json(fbase + "/slo")
+        obj = slo["objectives"][0]
+        out["slo"] = {k: obj[k] for k in
+                      ("good", "bad", "budget_remaining", "burn_rate")}
+        health = _get_json(fbase + "/healthz")
+        out["router_healthz"] = health.get("status")
+        stats = _get_json(fbase + "/stats")
+        out["fleet_consistent"] = stats["fleet"]["consistent"]
+        out["probe"] = stats.get("probes", {}).get("models", {}).get(
+            "minet", {})
+        # /slo vs the router book: probes are the ONLY traffic, none of
+        # it client-fault, so SLO events must equal the router's
+        # terminal count exactly.
+        terminal = stats["fleet"]["terminal"]
+        out["slo_matches_book"] = (obj["good"] + obj["bad"]) == terminal
+        mtext = _get_text(fbase + "/metrics")
+        families_ok = all(f in mtext for f in (
+            "dsod_slo_burn_rate", "dsod_slo_budget_remaining",
+            "dsod_probe_failed_total", "dsod_probe_dropped_total"))
+        out["router_families_ok"] = families_ok
+        # The verdict may read "degraded" (breaker mid-half-open cycle:
+        # something still routable, the SLO alert degrades it) or
+        # "unhealthy" (breaker open on the only replica: nothing
+        # routable) — both are correct non-ok answers; either way the
+        # body must name the burning SLO.
+        ok = (out["replica_capacity_ok"] and out["fleet_consistent"]
+              and out["slo_matches_book"] and families_ok
+              and obj["bad"] > 0 and obj["budget_remaining"] < 0
+              and health.get("status") in ("degraded", "unhealthy")
+              and any("slo_avail" in a
+                      for a in health.get("slo_alerts", [])))
+        router.send_signal(signal.SIGTERM)
+        out["router_rc"] = router.wait(timeout=90)
+        replica.send_signal(signal.SIGTERM)
+        out["replica_rc"] = replica.wait(timeout=90)
+        return ok and out["router_rc"] == 0 and out["replica_rc"] == 0
+    finally:
+        for proc in (router, replica):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        for f in (replica_port_file, router_port_file, fleet_file):
+            if os.path.exists(f):
+                os.unlink(f)
+
+
+def main(argv=None) -> int:
+    argparse.ArgumentParser(description=__doc__).parse_args(argv)
+    out: dict = {"metric": "slo_smoke"}
+    out["ok"] = smoke(out)
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

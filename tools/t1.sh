@@ -43,6 +43,9 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/health_smoke.py \
 echo "== fleet smoke: real-process router + remote replica, mixed-tenant loadgen, SIGKILL-mid-fleet degraded health, fleet accounting, clean SIGTERM drain (recorded, non-gating) =="
 timeout -k 10 720 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py \
   || echo "fleet smoke failed (non-gating; tests/test_fleet.py below gates the in-process side)"
+echo "== slo smoke: real router + always-500 remote replica, synthetic prober detects the outage via burn-rate alert at ZERO live traffic, /slo consistent with the router book, capacity ledger live on the replica (recorded, non-gating) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/slo_smoke.py \
+  || echo "slo smoke failed (non-gating; tests/test_slo.py + tests/test_capacity.py below gate the in-process side)"
 echo "== fleet chaos: SIGKILL a replica under open-loop load — zero lost responses, exact accounting, breaker half-open re-admission (recorded, non-gating) =="
 timeout -k 10 540 env JAX_PLATFORMS=cpu python tools/fleet_chaos.py \
   || echo "fleet chaos failed (non-gating; tests/test_failover.py + tests/test_serve_chaos.py below gate the in-process side)"

@@ -1,0 +1,173 @@
+#!/bin/bash
+# Round-13 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh fires this automatically; default agenda since
+# round 13).  Round 13 landed CAPACITY & SLO observability: the live
+# per-compiled-program cost ledger (utils/capacity.py →
+# dsod_capacity_* MFU/roofline/HBM gauges from each executable's own
+# cost_analysis + the measured device EWMA), declarative SLO
+# objectives with multi-window burn-rate accounting (utils/slo.py →
+# dsod_slo_* + /slo on router/server/sidecar), and the synthetic
+# canary prober (serve/prober.py → dsod_probe_*, zero-traffic outage
+# detection) — docs/OBSERVABILITY.md "Capacity & SLO".  Correctness is
+# proven on CPU (tests/test_capacity.py, tests/test_slo.py,
+# tools/slo_smoke.py: burn alert fires at zero live traffic off
+# canaries alone, /slo ≡ router book, ledger ≡ cost_analysis on the
+# same executable); what only hardware can answer:
+#
+#   1. canonical b128 headline refresh (comparison anchor)
+#   2. LEDGER-OVERHEAD serve A/B: the same closed-loop serve bench
+#      with serve.capacity_ledger off vs on — the ledger reads
+#      cost_analysis ONCE per program at warmup and pays one EWMA
+#      fold per completed batch, so the tax should be unmeasurable.
+#   3. PROBER-OVERHEAD fleet A/B: open-loop loadgen against a
+#      router+engine fleet with the prober off vs on at 1 probe/s —
+#      probes are admitted traffic, so the cost model is "one extra
+#      b1 forward per second", amortized invisible at load.
+#   4. live capacity/SLO leg: loadgen --slo against the armed fleet
+#      records budget/burn next to the latency curve; /slo, /alerts,
+#      and metrics_lint --url check the live surface; the REAL
+#      per-program MFU numbers land in serve_capacity.json — the
+#      first measured live-MFU table for the serving stack.
+#
+# Predictions on record (docs/OBSERVABILITY.md "Capacity & SLO"):
+# (a) serve p50 tax with capacity_ledger on: < 2% (one dict EWMA fold
+#     per completed batch on the fetch thread, off the request path);
+# (b) open-loop p50/p99 tax with the prober at 1/s: < 2% (one extra
+#     batch-1 forward per second ≈ <1% device occupancy at b128-class
+#     throughput; probes shed first under overload by tenant class);
+# (c) live dsod_capacity_mfu at b128 within ±20% of bench.py's own
+#     MFU self-report for the same shapes (they share peak constants;
+#     the ledger divides by the device EWMA, bench by wall time).
+#
+# Serve legs talk to processes started here (ephemeral ports,
+# --port-file); loadgen itself never imports jax.
+cd "$(dirname "$0")/.." || exit 1
+R=${R:-tpu_results13}
+mkdir -p "$R"
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+done_ok() {
+  [ -f "$R"/results.jsonl ] || return 1
+  local rec
+  rec=$(grep "\"step\": \"$1\", \"rc\": 0" "$R"/results.jsonl | tail -1)
+  [ -n "$rec" ] || return 1
+  ! printf '%s' "$rec" | grep -q '"error"'
+}
+
+tunnel_computes() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('computes')" 2>/dev/null | grep -q computes
+}
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  if done_ok "$name"; then
+    echo "[$name] skip: succeeded in a previous window" | tee -a "$R"/agenda.log
+    return 0
+  fi
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+  if { [ "$rc" -ne 0 ] || printf '%s' "$line" | grep -Eq 'wedged|unavailable'; } \
+      && ! tunnel_computes; then
+    echo "[$name] tunnel no longer computes — aborting firing (watcher will re-fire)" \
+      | tee -a "$R"/agenda.log
+    exit 2
+  fi
+}
+
+# -- 1. canonical headline refresh (the r5-r12 key replays unchanged)
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2. ledger-overhead serve A/B (prediction (a)).
+run serve_ledger_off 1500 $BENCH --config minet_r50_dp --mode serve \
+    --steps 300 --set "serve.batch_buckets=1,4,8,16"
+run serve_ledger_on 1500 $BENCH --config minet_r50_dp --mode serve \
+    --steps 300 --set "serve.batch_buckets=1,4,8,16" \
+    --set serve.capacity_ledger=true
+
+# -- 3+4. prober-overhead fleet A/B + the live capacity/SLO surface.
+#    One fleet process per arm (prober off / on); open-loop loadgen at
+#    the same offered rate against each; the armed arm also records
+#    /slo, /alerts, live MFU, and the live-inventory lint.
+fleet_leg() { # fleet_leg NAME EXTRA_FLEET_JSON_FIELDS
+  local name=$1 extra=$2
+  local pfile="$R/${name}.port"
+  local ffile="$R/${name}.fleet.json"
+  rm -f "$pfile"
+  cat > "$ffile" <<EOF
+{"models": [{"name": "minet", "config": "minet_r50_dp",
+             "overrides": ["serve.batch_buckets=1,4,8,16",
+                           "serve.capacity_ledger=true"]}]$extra}
+EOF
+  python tools/serve.py --fleet-config "$ffile" --device tpu \
+    --port 0 --port-file "$pfile" \
+    > "$R/${name}.out" 2> "$R/${name}.err" &
+  FLEET_PID=$!
+  for _ in $(seq 1 240); do [ -f "$pfile" ] && break; sleep 2; done
+  if [ ! -f "$pfile" ]; then
+    echo "$name never bound a port — skipping" | tee -a "$R"/agenda.log
+    kill -9 "$FLEET_PID" 2>/dev/null
+    return 1
+  fi
+  FURL="http://127.0.0.1:$(cat "$pfile")"
+  return 0
+}
+
+if fleet_leg fleet_prober_off ""; then
+  run prober_off_loadgen 900 python tools/loadgen.py --url "$FURL" \
+      --mode open --rps 50 --duration 30 --wait-ready 240
+  kill -TERM "$FLEET_PID" 2>/dev/null; wait "$FLEET_PID"
+fi
+if fleet_leg fleet_prober_on ', "prober_interval_s": 1.0,
+    "slo_objectives": ["avail:model=minet:availability:0.999:3600",
+                       "fast:model=minet:latency:0.95:3600:500"]'; then
+  run prober_on_loadgen 900 python tools/loadgen.py --url "$FURL" \
+      --mode open --rps 50 --duration 30 --wait-ready 240 --slo
+  run slo_endpoint 60 curl -sf "$FURL/slo"
+  run slo_alerts 60 curl -sf "$FURL/alerts"
+  run serve_capacity 60 sh -c "curl -sf $FURL/metrics | grep dsod_capacity_ > $R/serve_capacity.json && echo '{\"metric\": \"serve_capacity\", \"recorded\": true}'"
+  run slo_lint 120 python tools/metrics_lint.py --url "$FURL"
+  kill -TERM "$FLEET_PID" 2>/dev/null; wait "$FLEET_PID"
+  echo "{\"step\": \"fleet_prober_exit\", \"rc\": $?, \"result\": null}" >> "$R"/results.jsonl
+fi
+
+# -- trainer-side capacity ledger + goodput SLO: a short REAL fit()
+#    window (bench's step-bench bypasses the loop, and the ledger/SLO
+#    live in the loop) with the sidecar up; record live train MFU and
+#    /slo, then drain.  The A/B cost of the ledger's one extra AOT
+#    compile per shape is visible in the startup gap vs train_health
+#    legs of r12 (same config, no ledger).
+TPORT_FILE="$R/train_capacity.port"
+rm -f "$TPORT_FILE"
+timeout 1200 python train.py --config minet_r50_dp --device tpu \
+  --max-steps 60 --telemetry-port 0 --telemetry-port-file "$TPORT_FILE" \
+  --workdir "$R/train_capacity_ck" \
+  --set capacity_ledger=true \
+  --set "slo_objectives=goodput:all:latency:0.99:600:2000" \
+  --set log_every_steps=20 --set checkpoint_every_steps=60 \
+  > "$R"/train_capacity.out 2> "$R"/train_capacity.err &
+TRAIN_PID=$!
+for _ in $(seq 1 300); do [ -f "$TPORT_FILE" ] && break; sleep 2; done
+if [ -f "$TPORT_FILE" ]; then
+  TURL="http://127.0.0.1:$(cat "$TPORT_FILE")"
+  sleep 60  # past compile + warmup so the MFU EWMA is fed
+  run train_capacity_metrics 60 sh -c "curl -sf $TURL/metrics | grep dsod_capacity_ > $R/train_capacity_mfu.txt && echo '{\"metric\": \"train_capacity\", \"recorded\": true}'"
+  run train_slo 60 curl -sf "$TURL/slo"
+fi
+wait "$TRAIN_PID"
+echo "{\"step\": \"train_capacity_exit\", \"rc\": $?, \"result\": null}" >> "$R"/results.jsonl
+
+# Host-side window report (touches no TPU).
+timeout 120 python tools/window_report.py "$R"/results.jsonl \
+    > "$R"/window_report.md 2> "$R"/window_report.err || true
+tail -20 "$R"/window_report.md | tee -a "$R"/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
