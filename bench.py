@@ -47,6 +47,7 @@ _PROGRAM_ENV_VARS = (
     "DSOD_STEM_IMPL",
     "DSOD_DLF_VMEM_MB",
     "DSOD_RESAMPLE_VMEM_MB",
+    "DSOD_CONV_VMEM_MB",
 )
 
 

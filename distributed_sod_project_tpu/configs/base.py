@@ -121,6 +121,22 @@ class ModelConfig:
     #           Knob-gated pending a hardware A/B win (the pre-committed
     #           non-XLA-default rule; legs in tools/tpu_agenda_r5.sh).
     resample_impl: str = "fast"  # fast | xla | convt | fused
+    # Conv-block execution strategy (minet / hdfnet / gatenet / u2net —
+    # every ConvBNAct in the four decoder families AND their VGG/ResNet
+    # backbones routes through the one models/layers.py seam):
+    #   xla   — nn.Conv + nn.BatchNorm (default; the lowered program is
+    #           byte-identical to the pre-knob tree)
+    #   fused — Pallas fused conv-stage kernel (pallas/fused_conv.py):
+    #           conv + inference-mode BN + ReLU as ONE VMEM pass per
+    #           image; list inputs convolve as their channel concat
+    #           without materializing it (decoder heads); train-mode
+    #           BN sites keep flax's BatchNorm after the fused conv;
+    #           out-of-envelope sites (stride>1, even kernels, VMEM
+    #           budget) fall back per-site.  Composes with the serve
+    #           precision arms (int8/fp8 weights dequantize in-kernel).
+    #           Knob-gated pending a hardware A/B win (the pre-committed
+    #           non-XLA-default rule; legs in tools/tpu_agenda_r14.sh).
+    conv_impl: str = "xla"  # xla | fused
     pretrained: Optional[str] = None  # .npz from tools/port_torch_weights.py
     # Structural deep supervision for models where aux heads are
     # optional add-ons (vit_sod's mid-depth head).  U²-Net/BASNet side

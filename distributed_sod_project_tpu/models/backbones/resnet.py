@@ -46,6 +46,7 @@ class BasicBlock(nn.Module):
     strides: int = 1
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -54,6 +55,7 @@ class BasicBlock(nn.Module):
         kw = dict(
             axis_name=self.axis_name,
             bn_momentum=self.bn_momentum,
+            conv_impl=self.conv_impl,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
@@ -72,6 +74,7 @@ class Bottleneck(nn.Module):
     strides: int = 1
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -80,6 +83,7 @@ class Bottleneck(nn.Module):
         kw = dict(
             axis_name=self.axis_name,
             bn_momentum=self.bn_momentum,
+            conv_impl=self.conv_impl,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
@@ -100,6 +104,7 @@ class ResNet(nn.Module):
     block: type = Bottleneck
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -108,6 +113,7 @@ class ResNet(nn.Module):
         kw = dict(
             axis_name=self.axis_name,
             bn_momentum=self.bn_momentum,
+            conv_impl=self.conv_impl,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
@@ -122,7 +128,8 @@ class ResNet(nn.Module):
             if x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
                 from ..layers import SpaceToDepthStem
 
-                x = SpaceToDepthStem(64, name="ConvBNAct_0", **kw)(x, train)
+                skw = {k: v for k, v in kw.items() if k != "conv_impl"}
+                x = SpaceToDepthStem(64, name="ConvBNAct_0", **skw)(x, train)
             else:
                 # ADVICE r3: odd H or W forces the plain-stem fallback,
                 # but bench.py tags the baseline key with the env var —

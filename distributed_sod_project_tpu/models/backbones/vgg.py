@@ -28,6 +28,7 @@ class VGG16(nn.Module):
     use_bn: bool = True
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -43,6 +44,7 @@ class VGG16(nn.Module):
                     use_bn=self.use_bn,
                     axis_name=self.axis_name,
                     bn_momentum=self.bn_momentum,
+                    conv_impl=self.conv_impl,
                     dtype=self.dtype,
                     param_dtype=self.param_dtype,
                 )(x, train=train)

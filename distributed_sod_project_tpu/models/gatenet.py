@@ -49,16 +49,20 @@ class GateUnit(nn.Module):
 
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, enc, dec, train: bool = False):
-        fused = jnp.concatenate([enc, dec], axis=-1)
+        # (enc, dec) convolve as their channel concat — the ConvBNAct
+        # seam fuses the concat away on the fused arm.
         gate = ConvBNAct(enc.shape[-1], (3, 3), act=None,
                          axis_name=self.axis_name,
-                         bn_momentum=self.bn_momentum, dtype=self.dtype,
-                         param_dtype=self.param_dtype)(fused, train=train)
+                         bn_momentum=self.bn_momentum,
+                         conv_impl=self.conv_impl, dtype=self.dtype,
+                         param_dtype=self.param_dtype)([enc, dec],
+                                                       train=train)
         return enc * nn.sigmoid(gate)
 
 
@@ -68,12 +72,14 @@ class DilatedPyramidBridge(nn.Module):
     width: int
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                  conv_impl=self.conv_impl,
                   dtype=self.dtype, param_dtype=self.param_dtype)
         branches = [
             ConvBNAct(self.width, (3, 3), dilation=d, **kw)(x, train=train)
@@ -84,8 +90,7 @@ class DilatedPyramidBridge(nn.Module):
         g = ConvBNAct(self.width, (1, 1), **kw)(g, train=train)
         branches.append(jnp.broadcast_to(
             g, x.shape[:3] + (self.width,)).astype(g.dtype))
-        y = jnp.concatenate(branches, axis=-1)
-        return ConvBNAct(self.width, (1, 1), **kw)(y, train=train)
+        return ConvBNAct(self.width, (1, 1), **kw)(branches, train=train)
 
 
 class GateNet(nn.Module):
@@ -101,6 +106,9 @@ class GateNet(nn.Module):
     # twice (gate input AND skip concat), so the fused arm runs the
     # BARE single-pass upsample kernel (no merge epilogue) here.
     resample_impl: str = "fast"
+    # Conv-block strategy (model.conv_impl): xla | fused — see
+    # layers.ConvBNAct; threaded to every conv block, backbone included.
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -110,6 +118,7 @@ class GateNet(nn.Module):
         del depth  # RGB-only member; uniform zoo signature
         x = image.astype(self.dtype)
         bkw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                   conv_impl=self.conv_impl,
                    dtype=self.dtype, param_dtype=self.param_dtype)
         if self.backbone == "vgg16":
             feats = VGG16(use_bn=self.backbone_bn, **bkw)(x, train=train)
@@ -119,6 +128,7 @@ class GateNet(nn.Module):
             raise ValueError(f"GateNet: unknown backbone {self.backbone!r}")
 
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                  conv_impl=self.conv_impl,
                   dtype=self.dtype, param_dtype=self.param_dtype)
         # Per-level transfer convs to the decoder width.
         trans = [ConvBNAct(self.width, (3, 3), **kw)(f, train=train)
@@ -137,8 +147,8 @@ class GateNet(nn.Module):
         for i in range(len(trans) - 2, -1, -1):
             up = upsample_like(d, trans[i], impl=self.resample_impl)
             gated = GateUnit(**kw)(trans[i], up, train=train)
-            d = ConvBNAct(self.width, (3, 3), **kw)(
-                jnp.concatenate([gated, up], axis=-1), train=train)
+            d = ConvBNAct(self.width, (3, 3), **kw)([gated, up],
+                                                    train=train)
             logits.append(side_logit(d))
 
         # Zoo contract: element 0 is the primary (finest) prediction.

@@ -71,12 +71,14 @@ class KernelGenUnit(nn.Module):
     ksize: int = 3
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, g, train: bool = False):
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                  conv_impl=self.conv_impl,
                   dtype=self.dtype, param_dtype=self.param_dtype)
         k = ConvBNAct(64, (3, 3), **kw)(g, train)
         k = nn.Conv(self.ksize * self.ksize, (3, 3), padding="SAME",
@@ -94,24 +96,26 @@ class DDPM(nn.Module):
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
     dlf_impl: str = "xla"
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, fused, guide, train: bool = False):
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                  conv_impl=self.conv_impl,
                   dtype=self.dtype, param_dtype=self.param_dtype)
         x = ConvBNAct(self.width, (3, 3), **kw)(fused, train)
         outs = [x]
         for rate in self.dilations:
             kern = KernelGenUnit(axis_name=self.axis_name,
                                  bn_momentum=self.bn_momentum,
+                                 conv_impl=self.conv_impl,
                                  dtype=self.dtype,
                                  param_dtype=self.param_dtype)(guide, train)
             outs.append(dynamic_local_filter(x, kern, ksize=3, dilation=rate,
                                              impl=self.dlf_impl))
-        y = jnp.concatenate(outs, axis=-1)
-        return ConvBNAct(self.width, (3, 3), **kw)(y, train)
+        return ConvBNAct(self.width, (3, 3), **kw)(outs, train)
 
 
 class HDFNet(nn.Module):
@@ -124,11 +128,16 @@ class HDFNet(nn.Module):
     # Decoder resample strategy (model.resample_impl):
     # fast | xla | convt | fused — see layers.resample_merge.
     resample_impl: str = "fast"
+    # Conv-block strategy (model.conv_impl): xla | fused — see
+    # layers.ConvBNAct; threaded to every conv block, both backbones
+    # included.
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     def _backbone(self, name_suffix: str):
         bkw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                   conv_impl=self.conv_impl,
                    dtype=self.dtype, param_dtype=self.param_dtype)
         if self.backbone == "vgg16":
             return VGG16(use_bn=self.backbone_bn, name=f"vgg_{name_suffix}", **bkw)
@@ -150,6 +159,7 @@ class HDFNet(nn.Module):
         dep_feats = self._backbone("depth")(d, train=train)
 
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                  conv_impl=self.conv_impl,
                   dtype=self.dtype, param_dtype=self.param_dtype)
 
         # Fuse the three deepest levels with dynamic filtering; the depth
@@ -157,11 +167,15 @@ class HDFNet(nn.Module):
         # gets its own DDPM).
         filtered = []
         for lvl in (2, 3, 4):
-            fused = jnp.concatenate([rgb_feats[lvl], dep_feats[lvl]], axis=-1)
+            # The two streams convolve as their channel concat inside
+            # DDPM's entry conv — the ConvBNAct seam fuses it away on
+            # the fused arm.
+            fused = [rgb_feats[lvl], dep_feats[lvl]]
             guide = ConvBNAct(self.width, (3, 3), **kw)(dep_feats[lvl], train)
             filtered.append(DDPM(self.width, axis_name=self.axis_name,
                                  bn_momentum=self.bn_momentum,
                                  dlf_impl=self.dlf_impl,
+                                 conv_impl=self.conv_impl,
                                  dtype=self.dtype,
                                  param_dtype=self.param_dtype)(
                 fused, guide, train))

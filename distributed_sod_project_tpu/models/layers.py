@@ -22,9 +22,104 @@ from flax import linen as nn
 
 Dtype = Any
 
+CONV_IMPLS = ("xla", "fused")
+
+
+def _resolve_conv_impl(impl: Optional[str]) -> str:
+    """Resolve the conv-block execution strategy (``model.conv_impl``,
+    threaded through the zoo as an explicit ``conv_impl``).  Unlike the
+    resample knob there is no env alias — the config is the only
+    selector; ``DSOD_CONV_VMEM_MB`` tunes the kernel, never selects it."""
+    if impl is None:
+        return "xla"
+    if impl not in CONV_IMPLS:
+        raise ValueError(
+            f"conv impl must be one of {CONV_IMPLS}, got {impl!r}")
+    return impl
+
+
+class _FusedConvParams(nn.Module):
+    """Parameter holder for the fused conv branch, named ``Conv_0`` so
+    the param tree is byte-for-byte what ``nn.Conv`` declares on the
+    XLA branch (same initializers, same RNG fold path) — a checkpoint
+    trained at either ``conv_impl`` restores into the other.  Also the
+    read point for the serve-precision quantized view: when the apply
+    variables carry a ``quant_scales`` collection (built by
+    ``serve/precision.fused_conv_cast_variables``), the kernel param
+    itself is the int8/fp8 leaf and the per-channel dequant scale rides
+    back alongside it."""
+
+    features: int
+    kernel: Tuple[int, int]
+    in_features: int
+    use_bias: bool
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self):
+        k = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            tuple(self.kernel) + (self.in_features, self.features),
+            self.param_dtype)
+        b = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,),
+            self.param_dtype) if self.use_bias else None
+        s = None
+        if self.has_variable("quant_scales", "kernel"):
+            s = self.get_variable("quant_scales", "kernel")
+        return k, b, s
+
+
+class _FusedBNParams(nn.Module):
+    """Inference-mode BatchNorm parameter holder, named
+    ``BatchNorm_0`` with flax's exact names/shapes/dtypes (scale/bias
+    in params at ``param_dtype``; mean/var in batch_stats at f32) so
+    the fused fold and the real ``nn.BatchNorm`` share one state."""
+
+    features: int
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self):
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (self.features,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,), self.param_dtype)
+        mean = self.variable(
+            "batch_stats", "mean",
+            lambda: jnp.zeros((self.features,), jnp.float32))
+        var = self.variable(
+            "batch_stats", "var",
+            lambda: jnp.ones((self.features,), jnp.float32))
+        return scale, bias, mean.value, var.value
+
 
 class ConvBNAct(nn.Module):
-    """Conv → (BatchNorm) → (activation), NHWC."""
+    """Conv → (BatchNorm) → (activation), NHWC.
+
+    THE conv-block seam of the zoo: every encoder/decoder block in the
+    four decoder families (and the VGG/ResNet backbones) routes here,
+    so ``model.conv_impl`` selects one execution strategy zoo-wide:
+
+    - ``xla`` (default) — ``nn.Conv`` + ``nn.BatchNorm`` exactly as
+      before the knob existed (the lowered program is byte-identical,
+      asserted in tests/test_pallas_conv.py);
+    - ``fused`` — the Pallas conv-stage kernel
+      (``pallas/fused_conv.py``): conv + inference-mode-BN + ReLU as
+      ONE VMEM pass per image, and — when ``x`` is a list/tuple of
+      same-spatial maps — conv over their channel concat WITHOUT
+      materializing the concat in HBM (the decoder-head idiom).
+      Train-mode BatchNorm needs whole-batch statistics (plus the
+      cross-replica ``axis_name`` psum), so those sites run the fused
+      conv kernel followed by the real ``nn.BatchNorm``; sites outside
+      the kernel's envelope (stride > 1, even kernels, VMEM budget —
+      ``fused_conv_available``) fall back to the XLA math PER-SITE
+      with a trace-time log line, mirroring ``resample_merge``.
+
+    Either impl accepts a list/tuple input as "concat these along
+    channels first" — on the XLA path that is a plain
+    ``jnp.concatenate`` where the caller used to do it.
+    """
 
     features: int
     kernel: Tuple[int, int] = (3, 3)
@@ -34,11 +129,18 @@ class ConvBNAct(nn.Module):
     act: Optional[Callable] = nn.relu
     axis_name: Optional[str] = None  # cross-replica BN axis (e.g. "data")
     bn_momentum: float = 0.9
+    conv_impl: Optional[str] = None  # None/"xla" | "fused"
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        impl = _resolve_conv_impl(self.conv_impl)
+        if impl == "fused":
+            parts = list(x) if isinstance(x, (list, tuple)) else [x]
+            return self._fused_branch(parts, train)
+        if isinstance(x, (list, tuple)):
+            x = x[0] if len(x) == 1 else jnp.concatenate(x, axis=-1)
         # Explicit symmetric padding (= torch's padding=k//2·dilation).
         # XLA's "SAME" pads (0,1) at stride 2 — one pixel off from the
         # torch alignment ImageNet weights were trained with, which
@@ -69,6 +171,125 @@ class ConvBNAct(nn.Module):
         if self.act is not None:
             x = self.act(x)
         return x
+
+    def _fused_branch(self, parts, train: bool):
+        """The ``conv_impl=fused`` arm: fused Pallas kernel where the
+        site fits, the same XLA math on the same (self-held) params
+        per-site otherwise."""
+        import jax.lax as lax
+
+        from ..pallas import fused_conv as fc
+
+        # Marker for the serve-precision quantized-view builder
+        # (``fused_conv_cast_variables``): a mutable 'dsod_fused_conv'
+        # collection collects the scopes whose Conv_0/kernel this seam
+        # consumes (and therefore may stay int8/fp8).  A no-op on every
+        # normal apply (the collection is immutable/absent); guarded
+        # out of init, where EVERY collection is mutable and the marker
+        # would otherwise pollute the init tree.
+        if not self.is_initializing():
+            self.sow("dsod_fused_conv", "site", jnp.zeros((), jnp.int32))
+        kh, kw = self.kernel
+        cin = sum(p.shape[-1] for p in parts)
+        kernel, bias, qscale = _FusedConvParams(
+            features=self.features, kernel=self.kernel, in_features=cin,
+            use_bias=not self.use_bn, param_dtype=self.param_dtype,
+            name="Conv_0")()
+        cd = self.dtype
+        fits = (self.strides == 1 and kh % 2 == 1 and kw % 2 == 1
+                and fc.fused_conv_available(
+                    [tuple(p.shape) for p in parts], (kh, kw),
+                    self.dilation, self.features))
+        if not fits:
+            # Out of envelope: trace-time note so a fused A/B leg knows
+            # which sites opted out (fires once per compile, not per
+            # step) — the resample_merge fallback pattern.
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "fused conv out of envelope at %s (k=%s stride=%s "
+                "dil=%s -> %dch): xla path",
+                [tuple(p.shape) for p in parts], self.kernel,
+                self.strides, self.dilation, self.features)
+            return self._xla_conv_on_params(parts, kernel, bias, qscale,
+                                            train)
+        relu_in_kernel = self.act is nn.relu
+        xs = tuple(p.astype(cd) for p in parts)
+        vecs = {}
+        if qscale is not None:
+            vecs["qscale"] = jnp.asarray(qscale, jnp.float32).reshape(-1)
+            wk = kernel  # int8/fp8 leaf: dequantized in-VMEM
+        else:
+            wk = kernel.astype(cd)  # nn.Conv's promote_dtype cast
+        mode = "none"
+        if self.use_bn and not train:
+            scale, beta, mean, var = _FusedBNParams(
+                features=self.features, param_dtype=self.param_dtype,
+                name="BatchNorm_0")()
+            # flax _normalize's exact op order (epsilon included), so
+            # the fold is the SAME f32 values BatchNorm would compute.
+            mul = lax.rsqrt(var + 1e-5)
+            mul = mul * scale
+            vecs.update(mean=mean, mul=mul, bias=beta)
+            mode = "bn"
+        elif not self.use_bn:
+            vecs["bias"] = bias.astype(cd)
+            mode = "bias"
+        y = fc.fused_conv(
+            xs, wk, vecs, kernel=self.kernel, dilation=self.dilation,
+            mode=mode, relu=(mode != "none" and relu_in_kernel))
+        if mode == "none":
+            # Train-mode BN: batch statistics (and the cross-replica
+            # psum) need the whole batch — the kernel fuses the conv,
+            # flax's BatchNorm follows it unchanged.
+            y = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=self.bn_momentum,
+                axis_name=self.axis_name if train else None,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="BatchNorm_0",
+            )(y)
+        if self.act is not None and not (mode != "none" and relu_in_kernel):
+            y = self.act(y)
+        return y
+
+    def _xla_conv_on_params(self, parts, kernel, bias, qscale,
+                            train: bool):
+        """Per-site fallback inside the fused branch: ``nn.Conv``'s
+        exact math (promote/pad/conv/bias order replicated) on the
+        branch's own params — needed because a quantized view's int8
+        kernel leaf must be dequantized densely here, which ``nn.Conv``
+        cannot do."""
+        import jax.lax as lax
+        from flax.linen.dtypes import promote_dtype
+
+        x = parts[0] if len(parts) == 1 else jnp.concatenate(parts, -1)
+        if qscale is not None:
+            kernel = kernel.astype(jnp.float32) * qscale
+        if self.kernel[0] % 2 and self.kernel[1] % 2:
+            pad = [(self.dilation * (k // 2),) * 2 for k in self.kernel]
+        else:
+            pad = "SAME"
+        x, kernel, bias = promote_dtype(x, kernel, bias, dtype=self.dtype)
+        y = lax.conv_general_dilated(
+            x, kernel, (self.strides, self.strides), pad,
+            rhs_dilation=(self.dilation, self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if bias is not None:
+            y = y + bias.reshape((1,) * (y.ndim - 1) + (-1,))
+        if self.use_bn:
+            y = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=self.bn_momentum,
+                axis_name=self.axis_name if train else None,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="BatchNorm_0",
+            )(y)
+        if self.act is not None:
+            y = self.act(y)
+        return y
 
 
 def max_pool(x, window: int = 2, stride: int = 2):

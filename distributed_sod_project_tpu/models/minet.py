@@ -35,13 +35,14 @@ class SIM(nn.Module):
     width: int
     axis_name: Optional[str] = None
     resample_impl: str = "fast"
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        kw = dict(axis_name=self.axis_name, dtype=self.dtype,
-                  param_dtype=self.param_dtype)
+        kw = dict(axis_name=self.axis_name, conv_impl=self.conv_impl,
+                  dtype=self.dtype, param_dtype=self.param_dtype)
         h = ConvBNAct(self.width, (3, 3), **kw)(x, train)
         l = max_pool(ConvBNAct(self.width // 2, (3, 3), **kw)(x, train))
         # Exchange: each branch receives the other, resampled (the
@@ -67,13 +68,14 @@ class AIM(nn.Module):
     width: int
     axis_name: Optional[str] = None
     resample_impl: str = "fast"
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, below, cur, above, train: bool = False):
-        kw = dict(axis_name=self.axis_name, dtype=self.dtype,
-                  param_dtype=self.param_dtype)
+        kw = dict(axis_name=self.axis_name, conv_impl=self.conv_impl,
+                  dtype=self.dtype, param_dtype=self.param_dtype)
         parts = [ConvBNAct(self.width, (3, 3), **kw)(cur, train)]
         if below is not None:  # finer level → downsample to cur's size
             b = ConvBNAct(self.width, (3, 3), **kw)(below, train)
@@ -82,8 +84,7 @@ class AIM(nn.Module):
         if above is not None:  # coarser level → upsample to cur's size
             a = ConvBNAct(self.width, (3, 3), **kw)(above, train)
             parts.append(upsample_like(a, cur, impl=self.resample_impl))
-        x = jnp.concatenate(parts, axis=-1)
-        return ConvBNAct(self.width, (3, 3), **kw)(x, train)
+        return ConvBNAct(self.width, (3, 3), **kw)(parts, train)
 
 
 class MINet(nn.Module):
@@ -95,6 +96,9 @@ class MINet(nn.Module):
     # Decoder resample strategy (model.resample_impl):
     # fast | xla | convt | fused — see layers.resample_merge.
     resample_impl: str = "fast"
+    # Conv-block strategy (model.conv_impl): xla | fused — see
+    # layers.ConvBNAct; threaded to every conv block, backbone included.
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -103,6 +107,7 @@ class MINet(nn.Module):
         del depth  # RGB-only model; uniform zoo signature
         x = image.astype(self.dtype)
         bkw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                   conv_impl=self.conv_impl,
                    dtype=self.dtype, param_dtype=self.param_dtype)
         if self.backbone == "vgg16":
             feats = VGG16(use_bn=self.backbone_bn, **bkw)(x, train=train)
@@ -111,8 +116,8 @@ class MINet(nn.Module):
         else:
             raise ValueError(f"MINet: unknown backbone {self.backbone!r}")
 
-        kw = dict(axis_name=self.axis_name, dtype=self.dtype,
-                  param_dtype=self.param_dtype)
+        kw = dict(axis_name=self.axis_name, conv_impl=self.conv_impl,
+                  dtype=self.dtype, param_dtype=self.param_dtype)
         rkw = dict(resample_impl=self.resample_impl, **kw)
 
         # AIM per level.

@@ -58,6 +58,14 @@ def build_model(model_cfg):
         raise ValueError(
             f"model.resample_impl={resample_impl!r} only applies to "
             f"{_RESAMPLE_USERS}, not {model_cfg.name!r}")
+    conv_impl = getattr(model_cfg, "conv_impl", "xla")
+    if conv_impl != "xla" and model_cfg.name not in _RESAMPLE_USERS:
+        # Same loudness for the conv-block seam: the four decoder
+        # families (and their backbones) thread ConvBNAct's conv_impl;
+        # elsewhere the knob would silently do nothing.
+        raise ValueError(
+            f"model.conv_impl={conv_impl!r} only applies to "
+            f"{_RESAMPLE_USERS}, not {model_cfg.name!r}")
     dtype = jnp.dtype(model_cfg.compute_dtype)
     param_dtype = jnp.dtype(model_cfg.param_dtype)
     axis_name = "data" if model_cfg.sync_bn else None
@@ -72,6 +80,7 @@ def _build_minet(cfg, *, dtype, param_dtype, axis_name):
 
     return MINet(
         resample_impl=cfg.resample_impl,
+        conv_impl=cfg.conv_impl,
         backbone=cfg.backbone,
         backbone_bn=cfg.backbone_bn,
         axis_name=axis_name,
@@ -91,6 +100,7 @@ def _build_u2net(cfg, *, dtype, param_dtype, axis_name):
             f"'small' (U²-Net†), got {cfg.backbone!r}")
     return U2Net(
         resample_impl=cfg.resample_impl,
+        conv_impl=cfg.conv_impl,
         small=cfg.backbone == "small",
         axis_name=axis_name,
         bn_momentum=cfg.bn_momentum,
@@ -129,6 +139,7 @@ def _build_gatenet(cfg, *, dtype, param_dtype, axis_name):
 
     return GateNet(
         resample_impl=cfg.resample_impl,
+        conv_impl=cfg.conv_impl,
         backbone=cfg.backbone,
         backbone_bn=cfg.backbone_bn,
         axis_name=axis_name,
@@ -161,6 +172,7 @@ def _build_hdfnet(cfg, *, dtype, param_dtype, axis_name):
 
     return HDFNet(
         resample_impl=cfg.resample_impl,
+        conv_impl=cfg.conv_impl,
         backbone=cfg.backbone,
         backbone_bn=cfg.backbone_bn,
         axis_name=axis_name,

@@ -37,12 +37,14 @@ class RSU(nn.Module):
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
     resample_impl: str = "fast"
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                  conv_impl=self.conv_impl,
                   dtype=self.dtype, param_dtype=self.param_dtype)
         xin = ConvBNAct(self.out, (3, 3), **kw)(x, train)
 
@@ -56,7 +58,7 @@ class RSU(nn.Module):
         for i in range(self.levels - 2, -1, -1):
             d = ConvBNAct(
                 self.mid if i > 0 else self.out, (3, 3), **kw
-            )(jnp.concatenate([d, enc[i]], axis=-1), train)
+            )([d, enc[i]], train)
             if i > 0:
                 d = upsample_like(d, enc[i - 1], impl=self.resample_impl)
         return d + xin
@@ -69,12 +71,14 @@ class RSU4F(nn.Module):
     out: int
     axis_name: Optional[str] = None
     bn_momentum: float = 0.9
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                  conv_impl=self.conv_impl,
                   dtype=self.dtype, param_dtype=self.param_dtype)
         xin = ConvBNAct(self.out, (3, 3), **kw)(x, train)
         e1 = ConvBNAct(self.mid, (3, 3), dilation=1, **kw)(xin, train)
@@ -82,11 +86,11 @@ class RSU4F(nn.Module):
         e3 = ConvBNAct(self.mid, (3, 3), dilation=4, **kw)(e2, train)
         b = ConvBNAct(self.mid, (3, 3), dilation=8, **kw)(e3, train)
         d3 = ConvBNAct(self.mid, (3, 3), dilation=4, **kw)(
-            jnp.concatenate([b, e3], axis=-1), train)
+            [b, e3], train)
         d2 = ConvBNAct(self.mid, (3, 3), dilation=2, **kw)(
-            jnp.concatenate([d3, e2], axis=-1), train)
+            [d3, e2], train)
         d1 = ConvBNAct(self.out, (3, 3), dilation=1, **kw)(
-            jnp.concatenate([d2, e1], axis=-1), train)
+            [d2, e1], train)
         return d1 + xin
 
 
@@ -99,6 +103,9 @@ class U2Net(nn.Module):
     # Decoder resample strategy (model.resample_impl):
     # fast | xla | convt | fused — see layers.resample_merge.
     resample_impl: str = "fast"
+    # Conv-block strategy (model.conv_impl): xla | fused — see
+    # layers.ConvBNAct; threaded to every RSU conv block.
+    conv_impl: Optional[str] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -107,6 +114,7 @@ class U2Net(nn.Module):
         del depth  # RGB-only model; uniform zoo signature
         x = image.astype(self.dtype)
         kw = dict(axis_name=self.axis_name, bn_momentum=self.bn_momentum,
+                  conv_impl=self.conv_impl,
                   dtype=self.dtype, param_dtype=self.param_dtype)
         # RSU blocks resample internally; RSU4F is resolution-fixed.
         rkw = dict(resample_impl=self.resample_impl, **kw)

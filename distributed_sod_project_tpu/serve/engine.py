@@ -206,6 +206,7 @@ class InferenceEngine:
                                     self.slo.alerts.prom_families)
 
         self._template = state if hasattr(state, "eval_variables") else None
+        self._conv_impl = getattr(cfg.model, "conv_impl", "xla")
         variables = (state.eval_variables()
                      if self._template is not None else state)
         self._var_lock = threading.Lock()
@@ -216,13 +217,16 @@ class InferenceEngine:
             int(jax.device_get(state.step))
             if self._template is not None else None)
 
-        self._fwds = {arm: make_precision_forward(model, arm)
-                      for arm in self.precision_arms}
+        self._fwds = {arm: make_precision_forward(
+            model, arm, conv_impl=self._conv_impl)
+            for arm in self.precision_arms}
         # Compiled-program cache, AOT-warmed in start().  The key spells
         # out everything that selects a distinct executable: model,
-        # static shapes, the decoder resample implementation, and the
-        # precision arm (each a different compiled program).
-        self.programs: Dict[Tuple[str, int, int, str, str], object] = {}
+        # static shapes, the decoder resample implementation, the
+        # conv-block implementation, and the precision arm (each a
+        # different compiled program).
+        self.programs: Dict[Tuple[str, int, int, str, str, str],
+                            object] = {}
 
         self.batcher = DynamicBatcher(
             self.batch_buckets, sc.max_wait_ms / 1000.0,
@@ -267,9 +271,30 @@ class InferenceEngine:
         source of truth), device-resident.  Called at construction and
         on every hot reload — the views are RE-DERIVED from the freshly
         restored f32 state, then swapped in as one dict under the swap
-        lock so no arm ever serves a different step than its siblings."""
-        return {arm: jax.device_put(cast_variables(variables, arm))
-                for arm in self.precision_arms}
+        lock so no arm ever serves a different step than its siblings.
+
+        At ``model.conv_impl=fused`` the quantized arms take the
+        fused-kernel view (``precision.fused_conv_cast_variables``):
+        conv kernels stay int8/fp8 leaves dequantized in-VMEM by the
+        Pallas kernels, with the per-channel scales riding a parallel
+        ``quant_scales`` collection."""
+        from .precision import (QUANT_ARMS, fused_conv_cast_variables,
+                                fused_conv_sites)
+
+        out = {}
+        sites = None  # site discovery is arm-independent: trace once
+        for arm in self.precision_arms:
+            if self._conv_impl == "fused" and arm in QUANT_ARMS:
+                res = self.res_buckets[0]
+                probe = {"image": np.zeros((1, res, res, 3), np.float32)}
+                if sites is None:
+                    sites = fused_conv_sites(self.model, variables, probe)
+                view = fused_conv_cast_variables(self.model, variables,
+                                                 arm, probe, sites=sites)
+            else:
+                view = cast_variables(variables, arm)
+            out[arm] = jax.device_put(view)
+        return out
 
     def _effective_arm(self, requested: str, level: int) -> str:
         """The arm a request actually serves at: the requested arm
@@ -344,7 +369,7 @@ class InferenceEngine:
         for arm in self.precision_arms:
             for res in self.res_buckets:
                 for bb in self.batch_buckets:
-                    key = (name, res, bb, impl, arm)
+                    key = (name, res, bb, impl, self._conv_impl, arm)
                     if key in self.programs:
                         continue
                     batch = {"image": np.zeros((bb, res, res, 3),
@@ -369,7 +394,7 @@ class InferenceEngine:
         """One compiled program's ledger key (the cache key, rendered
         label-safe)."""
         return (f"{self.cfg.model.name}/r{res}b{bb}/"
-                f"{self.cfg.model.resample_impl}/{arm}")
+                f"{self.cfg.model.resample_impl}/{self._conv_impl}/{arm}")
 
     def stop(self) -> None:
         if not self._running:
@@ -709,7 +734,7 @@ class InferenceEngine:
     def _forward(self, res: int, bb: int, arm: str, variables, batch,
                  tta: bool):
         key = (self.cfg.model.name, res, bb, self.cfg.model.resample_impl,
-               arm)
+               self._conv_impl, arm)
         call = self.programs.get(key, self._fwds[arm])
 
         def fn(b):

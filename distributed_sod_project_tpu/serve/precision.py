@@ -52,6 +52,15 @@ QUANT_ARMS: Tuple[str, ...] = ("int8", "fp8")
 _QMAX = {"int8": 127.0, "fp8": 448.0}  # e4m3fn max normal = 448
 
 
+def quant_dtypes():
+    """The storage dtypes of quantized weight leaves this jaxlib build
+    knows — the ONE definition (pallas/fused_conv.is_quantized_weight
+    and every dequant site key off it)."""
+    return tuple(jnp.dtype(d) for d in ("int8",)) + (
+        (jnp.dtype(jnp.float8_e4m3fn),)
+        if hasattr(jnp, "float8_e4m3fn") else ())
+
+
 def supported_arms() -> Tuple[str, ...]:
     """Arms this jaxlib build can serve (fp8 needs the float8 dtype)."""
     arms = ["f32", "bf16", "int8"]
@@ -143,9 +152,7 @@ def quantize_variables(variables, arm: str) -> Dict[str, Any]:
 def dequantize_variables(qvars: Dict[str, Any]):
     """Bundle → dense f32-ish variables (runs inside the compiled
     forward; the dtype check is static at trace time)."""
-    qdtypes = tuple(jnp.dtype(d) for d in ("int8",)
-                    ) + ((jnp.dtype(jnp.float8_e4m3fn),)
-                         if hasattr(jnp, "float8_e4m3fn") else ())
+    qdtypes = quant_dtypes()
 
     def deq(q, s):
         if jnp.asarray(q).dtype in qdtypes:
@@ -153,6 +160,104 @@ def dequantize_variables(qvars: Dict[str, Any]):
         return q
 
     return jax.tree_util.tree_map(deq, qvars["q"], qvars["s"])
+
+
+def fused_conv_sites(model, variables, probe: Dict[str, Any]):
+    """Scope paths (tuples of names) of every ConvBNAct that routes the
+    fused conv seam in this model — discovered by one ABSTRACT apply
+    (``jax.eval_shape``, no FLOPs) collecting the seam's
+    ``dsod_fused_conv`` sow markers.  Each returned scope's
+    ``Conv_0/kernel`` param is consumed by ``pallas/fused_conv.py``,
+    which dequantizes int8/fp8 leaves in-VMEM — those kernels may stay
+    quantized in the apply variables (``fused_conv_cast_variables``)."""
+
+    def _run(v):
+        return model.apply(v, probe["image"], probe.get("depth"),
+                           train=False, mutable=["dsod_fused_conv"])
+
+    # Abstract trace: ShapeDtypeStructs in and out, nothing executes.
+    _, aux = jax.eval_shape(_run, variables)
+    sites = []
+    flat = jax.tree_util.tree_flatten_with_path(
+        aux.get("dsod_fused_conv", {}))[0]
+    for path, _ in flat:
+        names = []
+        for p in path:
+            key = getattr(p, "key", None)
+            if key is None:
+                continue  # tuple index inside the sow'd value
+            names.append(str(key))
+        if names and names[-1] == "site":
+            names = names[:-1]
+        if tuple(names) not in sites:
+            sites.append(tuple(names))
+    return tuple(sites)
+
+
+def fused_conv_cast_variables(model, variables, arm: str,
+                              probe: Dict[str, Any],
+                              sites=None) -> Dict[str, Any]:
+    """The quantized weight view for a ``model.conv_impl=fused`` model:
+    apply-ready variables where every fused-seam conv kernel STAYS an
+    int8/fp8 leaf (dequantized in-VMEM by the kernel, per-channel scale
+    delivered via a parallel ``quant_scales`` collection the seam reads
+    back), and every other quantized leaf — plain head convs, dense
+    matrices — is densely dequantized up front exactly as the
+    ``dequantize_variables`` program would have produced it.
+
+    Unlike :func:`cast_variables`' ``{"q", "s"}`` bundle this view runs
+    through the UNWRAPPED canonical forward (``make_precision_forward``
+    returns ``make_forward`` itself for fused+quant), so the fused
+    kernels see 1/4-byte weights end-to-end with no dense dequantized
+    copy materialized per dispatch.
+    """
+    if arm not in QUANT_ARMS:
+        raise ValueError(f"{arm!r} is not a quantized arm ({QUANT_ARMS})")
+    if sites is None:
+        # ``sites`` lets multi-arm callers (the engine's reload path)
+        # pay the abstract discovery trace once, not once per arm.
+        sites = fused_conv_sites(model, variables, probe)
+    if not sites:
+        raise ValueError(
+            "fused_conv_cast_variables: the model routed no fused conv "
+            "sites — is model.conv_impl set to 'fused'?")
+    keep = {("params",) + s + ("Conv_0", "kernel") for s in sites}
+    bundle = quantize_variables(variables, arm)
+    qdtypes = quant_dtypes()
+
+    out: Dict[str, Any] = {}
+    scales: Dict[str, Any] = {}
+
+    def _names(path):
+        return tuple(str(getattr(p, "key")) for p in path
+                     if getattr(p, "key", None) is not None)
+
+    flat_q = jax.tree_util.tree_flatten_with_path(bundle["q"])[0]
+    flat_s = {(_names(p)): s for p, s
+              in jax.tree_util.tree_flatten_with_path(bundle["s"])[0]}
+
+    def _set(tree, names, leaf):
+        node = tree
+        for n in names[:-1]:
+            node = node.setdefault(n, {})
+        node[names[-1]] = leaf
+
+    for path, q in flat_q:
+        names = _names(path)
+        s = flat_s[names]
+        if jnp.asarray(q).dtype in qdtypes:
+            if names in keep:
+                _set(out, names, q)
+                # quant_scales mirrors the params subtree minus the
+                # leading collection name (it IS a collection).
+                _set(scales, ("quant_scales",) + names[1:], s)
+            else:
+                _set(out, names, (np.asarray(q, np.float32) * s))
+        else:
+            _set(out, names, q)
+    if scales:
+        out.update(scales)
+    return out
 
 
 def cast_variables(variables, arm: str):
@@ -178,13 +283,18 @@ def cast_variables(variables, arm: str):
 # -- forwards ----------------------------------------------------------
 
 
-def make_precision_forward(model, arm: str):
+def make_precision_forward(model, arm: str, conv_impl: str = "xla"):
     """The canonical serving forward for one arm:
     ``(arm_variables, batch) -> probs`` (sigmoid on the primary logit,
     f32, [B,H,W]) — the same contract as ``eval/inference.make_forward``
     so a served map is bitwise what a direct call at the same arm
     produces.  f32/bf16 arms run ``make_forward`` itself (plain
-    variables); quantized arms dequantize in-program first."""
+    variables); quantized arms dequantize in-program first — EXCEPT at
+    ``conv_impl='fused'``, where the arm variables are the apply-ready
+    :func:`fused_conv_cast_variables` view (conv kernels stay int8/fp8
+    into the Pallas kernels; the residual non-conv leaves were already
+    densified at view-build time), so the canonical forward runs as-is.
+    """
     from ..eval.inference import make_forward
 
     base = make_forward(model)
@@ -192,6 +302,8 @@ def make_precision_forward(model, arm: str):
         return base
     if arm not in QUANT_ARMS:
         raise ValueError(f"unknown precision arm {arm!r}")
+    if conv_impl == "fused":
+        return base
 
     # Delegate to the ONE canonical forward (inlined at trace time):
     # the quantized arms can never drift from the eval-path contract.

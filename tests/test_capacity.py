@@ -179,12 +179,12 @@ def test_engine_capacity_ledger_end_to_end():
         # Warmup recorded every (res, batch, arm) program.
         programs = eng.capacity.snapshot()["programs"]
         assert set(programs) == {
-            f"minet/r16b{b}/fast/{a}"
+            f"minet/r16b{b}/fast/xla/{a}"
             for b in (1, 2) for a in ("f32", "bf16")}
         assert all(p["flops"] > 0 for p in programs.values())
         # A served request feeds the EWMA of ITS program only.
         pred, meta = eng.predict(np.zeros((16, 16, 3), np.uint8))
-        key = f"minet/r16b{meta['batch_bucket']}/fast/f32"
+        key = f"minet/r16b{meta['batch_bucket']}/fast/xla/f32"
         snap = eng.capacity.snapshot()
         assert snap["programs"][key]["device_ms_ewma"] > 0
         assert snap["programs"][key]["mfu"] >= 0
@@ -195,7 +195,10 @@ def test_engine_capacity_ledger_end_to_end():
         shares = snap["stage_share"]
         assert set(shares) == {"device", "queue", "host"}
         assert all(0.0 <= v <= 1.0 for v in shares.values())
-        assert sum(shares.values()) == pytest.approx(1.0, abs=1e-6)
+        # snapshot() rounds each share to 6 decimals, so the three
+        # rounding errors can stack to 1.5e-6 — the bound must cover
+        # that, or the assertion flakes on unlucky measured timings.
+        assert sum(shares.values()) == pytest.approx(1.0, abs=2e-6)
         # The families ride the engine registry.
         text = eng.telemetry.render()
         for fam in ("dsod_capacity_mfu", "dsod_capacity_stage_share",

@@ -123,7 +123,8 @@ def test_hlo_guard_counts_and_invariant(tmp_path, capsys, monkeypatch):
     rc = hlo_guard.main(["--config", "minet_vgg16_ref",
                          "--image-size", "32", "--devices", "2",
                          "--out", str(tmp_path / "hlo"),
-                         "--baseline", str(baseline)])
+                         "--baseline", str(baseline),
+                         "--no-conv-arms"])
     assert rc == 0  # also asserts fast < stack internally
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["recorded"] is True
@@ -138,7 +139,7 @@ def test_hlo_guard_counts_and_invariant(tmp_path, capsys, monkeypatch):
                          "--image-size", "32", "--devices", "2",
                          "--out", str(tmp_path / "hlo2"),
                          "--baseline", str(baseline),
-                         "--fail-on-increase"])
+                         "--fail-on-increase", "--no-conv-arms"])
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "recorded" not in out
@@ -151,7 +152,7 @@ def test_hlo_guard_counts_and_invariant(tmp_path, capsys, monkeypatch):
                          "--image-size", "32", "--devices", "2",
                          "--out", str(tmp_path / "hlo3"),
                          "--baseline", str(baseline),
-                         "--fail-on-increase"])
+                         "--fail-on-increase", "--no-conv-arms"])
     capsys.readouterr()
     assert rc == 2
 
@@ -183,7 +184,8 @@ def test_hlo_guard_never_seeds_on_failed_invariant(tmp_path, capsys,
 def test_checked_in_hlo_baseline_matches_guard_arms():
     """The checked-in tools/hlo_copy_baseline.json must carry both
     interleave arms for the flagship key with the fast arm strictly
-    fewer — the invariant the t1 smoke records against."""
+    fewer — the invariant the t1 smoke records against — plus the
+    round-14 conv_impl arm rows on the conv carrier key."""
     import json
 
     path = os.path.join(os.path.dirname(__file__), "..", "tools",
@@ -192,6 +194,53 @@ def test_checked_in_hlo_baseline_matches_guard_arms():
     key = "minet_r50_dp@64px"
     assert key in base
     assert base[key]["fast"]["total"] < base[key]["fast_stack"]["total"]
+    ckey = "minet_vgg16_ref@32px-conv"
+    assert ckey in base
+    assert base[ckey]["conv_xla"]["total"] > 0
+    assert base[ckey]["conv_fused"]["total"] > 0
+
+
+def test_hlo_guard_conv_arms_record_and_gate(tmp_path, capsys,
+                                             monkeypatch):
+    """The round-14 conv_impl arms: recorded on first contact under
+    their own -conv key, delta-compared after, --fail-on-increase
+    trips on a regression.  dump paths are stubbed — the real
+    lowerings run in the t1 smoke; this covers the bookkeeping."""
+    import json
+
+    import hlo_guard
+
+    fast = {"reshape": 4, "transpose": 0, "broadcast_in_dim": 0,
+            "total": 4}
+    stack = {"reshape": 6, "transpose": 0, "broadcast_in_dim": 0,
+             "total": 6}
+    conv = {"conv_xla": {"reshape": 3, "transpose": 0,
+                         "broadcast_in_dim": 0, "total": 3},
+            "conv_fused": {"reshape": 9, "transpose": 1,
+                           "broadcast_in_dim": 0, "total": 10}}
+    monkeypatch.setattr(
+        hlo_guard, "dump_arm_counts",
+        lambda *a, **k: {"fast": dict(fast), "fast_stack": dict(stack)})
+    monkeypatch.setattr(
+        hlo_guard, "dump_conv_arm_counts",
+        lambda *a, **k: {a_: dict(c) for a_, c in conv.items()})
+    baseline = tmp_path / "baseline.json"
+    args = ["--config", "cfg", "--out", str(tmp_path / "hlo"),
+            "--baseline", str(baseline)]
+    assert hlo_guard.main(args) == 0
+    lines = [json.loads(l) for l
+             in capsys.readouterr().out.strip().splitlines()]
+    ckey = "minet_vgg16_ref@32px-conv"
+    assert lines[-1]["metric"] == f"hlo_formatting_ops[{ckey}]"
+    assert lines[-1]["recorded"] is True
+    assert json.load(open(baseline))[ckey] == conv
+    # Regression in the fused arm trips the gate.
+    conv["conv_fused"]["total"] = 11
+    conv["conv_fused"]["reshape"] = 10
+    assert hlo_guard.main(args + ["--fail-on-increase"]) == 2
+    out = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["delta_vs_baseline"]["conv_fused"] == 1
 
 
 def test_roofline_fused_resample_ledger(capsys):
@@ -224,6 +273,40 @@ def test_roofline_fused_resample_ledger(capsys):
     out = capsys.readouterr().out
     assert "fused-resample ledger" in out and "sim1.declift" in out
     assert "HBM bytes saved/step" in out
+
+
+def test_roofline_fused_conv_ledger(capsys):
+    """The per-arm fused-conv ledger (ISSUE 12 satellite): every
+    decoder ConvBNAct site claims a positive per-step saving on the
+    fused arm, the AIM merge convs additionally claim their concat
+    materialization, FLOPs are INVARIANT across arms (asserted inside
+    the tool), and the CLI renders the r14 falsifiable table."""
+    import roofline
+
+    csites: list = []
+    roofline.minet_r50_ledger(64, conv_arm="fused", conv_sites=csites)
+    # 5 AIM cur + 4 below + 4 above + 5 merge + 5x5 SIM convs + head.
+    assert len(csites) >= 30
+    assert all(saved > 0 for _, _, saved in csites)
+    by_name = {name: saved for name, _, saved in csites}
+    # Concat-merge convs save strictly more than their same-res plain
+    # siblings (the concat write+read rides on top of the epilogue).
+    assert by_name["aim0.merge"] > by_name["aim0.cur"]
+    # Fine sites dominate (the 160-bucket lever).
+    by_res = {}
+    for _, res, saved in csites:
+        by_res[res] = by_res.get(res, 0.0) + saved
+    assert by_res[160] > by_res[80] > by_res[40]
+
+    _, f_x, b_x, t_x = roofline.predict(64)
+    _, f_f, b_f, t_f = roofline.predict(64, conv="fused")
+    assert b_f < b_x and t_f < t_x
+    assert f_x == f_f  # FLOPs-invariance, exactly
+
+    assert roofline.main(["--batch", "64", "--conv", "fused"]) == 0
+    out = capsys.readouterr().out
+    assert "fused-conv ledger" in out and "aim0.merge" in out
+    assert "FLOPs invariant across arms" in out
 
 
 def test_plot_curves_writes_figures(tmp_path):

@@ -21,6 +21,16 @@ two arms of the interleave:
 - ``fast_stack``  — the historical stack+reshape form
                     (``DSOD_RESIZE_INTERLEAVE=stack``).
 
+Round 14 adds the conv-block arms on a smaller carrier (the fused arm
+lowers every Pallas kernel in interpret mode — minutes of tracing at
+flagship size):
+
+- ``conv_xla``    — model.conv_impl=xla (the default; its counts
+                    drifting is a byte-identity regression canary);
+- ``conv_fused``  — model.conv_impl=fused (the Pallas conv-stage
+                    kernels; counts pin the fused seam's lowered
+                    structure).
+
 Pre-optimization StableHLO is stable across machines (the same reason
 dump_hlo.py diffs it), so the counts are checked into
 ``tools/hlo_copy_baseline.json`` and every run prints a ONE-LINE JSON
@@ -73,6 +83,23 @@ ARMS = {
                    "DSOD_RESIZE_IMPL": None},
 }
 
+# Conv-block arms (round 14): the SAME formatting-op counts per
+# model.conv_impl arm, lowered on a smaller carrier than the flagship —
+# the fused arm lowers the Pallas kernels in interpret mode on CPU
+# (grid loops and im2col slicing all visible as countable ops), which
+# on the flagship costs ~2 min of pure tracing; the carrier keeps the
+# guard inside the t1 smoke budget while covering every seam idiom
+# (plain/concat/dilated/no-BN conv blocks).  conv_xla is lowered too —
+# its counts must track the seam's default arm, and a drift here is a
+# byte-identity regression before tests/test_pallas_conv.py says so.
+CONV_ARMS = {
+    "conv_xla": (),
+    "conv_fused": ("model.conv_impl=fused",),
+}
+# Resample env vars pinned (unset) around the conv dumps for the same
+# reason as ARMS: an inherited A/B export must not contaminate counts.
+_PINNED_ENV = ("DSOD_RESIZE_INTERLEAVE", "DSOD_RESIZE_IMPL")
+
 
 def count_formatting_ops(stablehlo_text: str) -> dict:
     """Count stablehlo data-formatting ops by kind (+ 'total')."""
@@ -119,6 +146,31 @@ def dump_arm_counts(config: str, out_dir: str, n_devices: int,
     return results
 
 
+def dump_conv_arm_counts(config: str, out_dir: str, n_devices: int,
+                         image_size: int) -> dict:
+    """Lower the conv-arm carrier once per model.conv_impl arm (config
+    overrides, not env) with the resample env pinned unset; return
+    {arm: counts}."""
+    from dump_hlo import dump  # tools/ sibling (path set above)
+
+    results = {}
+    saved = {k: os.environ.get(k) for k in _PINNED_ENV}
+    for k in _PINNED_ENV:
+        os.environ.pop(k, None)
+    try:
+        for arm, overrides in CONV_ARMS.items():
+            paths = dump(config, os.path.join(out_dir, arm),
+                         n_devices=n_devices, image_size=image_size,
+                         compile_cost=False, overrides=overrides)
+            with open(paths["stablehlo"]) as f:
+                results[arm] = count_formatting_ops(f.read())
+    finally:
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+    return results
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", default="minet_r50_dp",
@@ -134,6 +186,17 @@ def main(argv=None) -> int:
                         "step)")
     p.add_argument("--out", default=None,
                    help="dump dir (default: a temp dir)")
+    p.add_argument("--conv-config", default="minet_vgg16_ref",
+                   help="carrier for the model.conv_impl arms — "
+                        "smaller than the flagship because the fused "
+                        "arm lowers every Pallas kernel in interpret "
+                        "mode (~2 min of tracing at flagship size)")
+    p.add_argument("--conv-image-size", type=int, default=32,
+                   help="conv-arm lowering size (even, so decoder "
+                        "shapes stay exact factor-2)")
+    p.add_argument("--no-conv-arms", action="store_true",
+                   help="skip the conv_impl arm dumps (resample arms "
+                        "only — the pre-r14 behavior)")
     p.add_argument("--baseline", default=_BASELINE)
     p.add_argument("--update-baseline", action="store_true")
     p.add_argument("--fail-on-increase", action="store_true",
@@ -208,6 +271,50 @@ def main(argv=None) -> int:
         "delta_vs_baseline": delta,
         "stack_minus_fast": stack["total"] - fast["total"],
         **({"recorded": True} if recorded else {}),
+    }), flush=True)
+
+    if args.no_conv_arms:
+        return rc
+
+    # -- conv_impl arms (round 14): same recorded-delta discipline on
+    #    the conv-arm carrier; conv_xla drifting is a byte-identity
+    #    regression canary, conv_fused drifting means the fused seam's
+    #    lowered structure changed.
+    tmp2 = None
+    out_dir2 = args.out
+    if out_dir2 is None:
+        import tempfile
+
+        tmp2 = tempfile.TemporaryDirectory(prefix="hlo_guard_conv_")
+        out_dir2 = tmp2.name
+    try:
+        conv_counts = dump_conv_arm_counts(
+            args.conv_config, out_dir2, args.devices,
+            args.conv_image_size)
+    finally:
+        if tmp2 is not None:
+            tmp2.cleanup()
+    ckey = f"{args.conv_config}@{args.conv_image_size}px-conv"
+    if args.update_baseline or ckey not in baseline:
+        baseline[ckey] = conv_counts
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        crecorded = True
+        cdelta = {arm: 0 for arm in conv_counts}
+    else:
+        crecorded = False
+        cdelta = {arm: conv_counts[arm]["total"]
+                  - baseline[ckey].get(arm, {}).get("total", 0)
+                  for arm in conv_counts}
+        if args.fail_on_increase and any(d > 0 for d in cdelta.values()):
+            rc = rc or 2
+    print(json.dumps({
+        "metric": f"hlo_formatting_ops[{ckey}]",
+        "arms": {arm: c["total"] for arm, c in conv_counts.items()},
+        "detail": conv_counts,
+        "delta_vs_baseline": cdelta,
+        **({"recorded": True} if crecorded else {}),
     }), flush=True)
     return rc
 

@@ -57,17 +57,37 @@ _DELTA = {
 
 
 def arm_metrics(model, variables, dataset, arm: str,
-                batch_size: int = 4) -> dict:
+                batch_size: int = 4, conv_impl: str = "xla") -> dict:
     """One arm's eval metrics on ``dataset``: cast the f32 variables to
     the arm's weight view, run the arm's canonical serving forward
     through the standard metric sweep (max-Fβ/MAE; structure measures
-    skipped — they are per-image host work the ledger doesn't use)."""
+    skipped — they are per-image host work the ledger doesn't use).
+    At ``conv_impl='fused'`` the quantized arms take the fused-kernel
+    view (int8/fp8 conv kernels dequantized in-VMEM) — the exact
+    weights the serve engine would run, so the budget covers the
+    kernel's dequant path, not just the dense one."""
     from distributed_sod_project_tpu.eval.inference import run_inference
     from distributed_sod_project_tpu.serve.precision import (
-        cast_variables, make_precision_forward)
+        QUANT_ARMS, cast_variables, fused_conv_cast_variables,
+        make_precision_forward)
 
-    fwd = make_precision_forward(model, arm)
-    arm_vars = cast_variables(variables, arm)
+    fwd = make_precision_forward(model, arm, conv_impl=conv_impl)
+    if conv_impl == "fused" and arm in QUANT_ARMS:
+        import numpy as np
+
+        sample = dataset[0]
+        hw = np.asarray(sample["image"]).shape[:2]
+        probe = {"image": np.zeros((1,) + tuple(hw) + (3,), np.float32)}
+        if "depth" in sample:
+            # RGB-D configs: the site-discovery trace needs the depth
+            # operand.  (The metric sweep below still fails for them —
+            # run_inference has never batched depth, a PRE-EXISTING
+            # gate limitation independent of the conv arm.)
+            probe["depth"] = np.zeros((1,) + tuple(hw) + (1,),
+                                      np.float32)
+        arm_vars = fused_conv_cast_variables(model, variables, arm, probe)
+    else:
+        arm_vars = cast_variables(variables, arm)
 
     def forward(batch):
         return fwd(arm_vars, batch)
@@ -262,7 +282,8 @@ def main(argv=None) -> int:
     metrics = {}
     for arm in ["f32"] + [a for a in arms if a != "f32"]:
         metrics[arm] = arm_metrics(model, variables, dataset, arm,
-                                   batch_size=args.batch_size)
+                                   batch_size=args.batch_size,
+                                   conv_impl=cfg.model.conv_impl)
     report = build_report(metrics, expected_images=args.num_images)
 
     baseline = {}
@@ -280,6 +301,11 @@ def main(argv=None) -> int:
         tag = f"ckpt-{ckpt_name}-step{step}"
     else:
         tag = f"s{args.seed}"
+    if cfg.model.conv_impl != "xla":
+        # Fused-arm rows are their own budgets: the kernel's in-VMEM
+        # dequant path must never gate against (or silently reseed)
+        # the dense arm's recorded deltas.
+        tag += f"-conv_{cfg.model.conv_impl}"
     key = f"{cfg.name}@{hw}px-n{args.num_images}-{tag}"
     rc, new_baseline, summary = apply_baseline(
         report, baseline, key, update=args.update_baseline,

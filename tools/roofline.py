@@ -132,9 +132,39 @@ def _up_site(ops, sites, name, b, res, c, reads=1, fused=False):
     return op
 
 
+def _conv_site(ops, sites, op: Op, b: int, cout: int,
+               fused: bool = False, cat_elems: float = 0.0) -> Op:
+    """A DECODER ConvBNAct site (the fused-conv kernel's targets).
+
+    ``fused=True`` prices the ``model.conv_impl=fused`` arm: the
+    BN-normalize+ReLU epilogue runs on the conv's VMEM tile instead of
+    a second HBM round trip over the output map (the r4 reconciliation
+    shows the fine buckets do NOT get this fusion for free — 160/80 at
+    3.3x/2.1x off the ideal-fusion prediction), and a conv over a
+    materialized channel concat (``cat_elems`` = elements of that
+    concat) reads its parts directly, saving the concat's write+read.
+    FLOPs are untouched by construction (asserted by
+    ``fmt_fused_conv_ledger``); the saving is counted fwd and bwd (the
+    backward's mask+scale epilogue fuses into the dx kernel's read the
+    same way).  Conservative: backbone convs are NOT repriced even
+    though the seam routes them too — only the decoder sites the
+    roofline names are claimed.
+    """
+    n_out = float(b) * op.res * op.res * cout
+    saved = (2.0 * A * n_out + 2.0 * A * cat_elems) if fused else 0.0
+    if fused:
+        op = Op(op.name, op.res, op.flops, op.bytes - saved,
+                op.bwd_flops, op.bwd_bytes - saved, op.params)
+    ops.append(op)
+    sites.append((op.name, op.res, saved))
+    return op
+
+
 def minet_r50_ledger(b: int, hw: int = 320, s2d: bool = False,
                      resize: str = "fast",
-                     fused_sites: list | None = None) -> list:
+                     fused_sites: list | None = None,
+                     conv_arm: str = "xla",
+                     conv_sites: list | None = None) -> list:
     """Every op in one MINet-R50 train step (fwd reference: the module
     graph in models/minet.py + models/backbones/resnet.py).
 
@@ -142,10 +172,16 @@ def minet_r50_ledger(b: int, hw: int = 320, s2d: bool = False,
     upsample+merge sites as the Pallas fused-resample kernel
     (model.resample_impl=fused) — ``fused_sites`` (when passed a list)
     collects (site, res, bytes saved/step) for the per-arm ledger.
+    ``conv_arm``: 'xla'/'fused' — 'fused' prices the decoder ConvBNAct
+    sites as the Pallas fused conv-stage kernel
+    (model.conv_impl=fused; see ``_conv_site``), ``conv_sites``
+    collecting (site, res, bytes saved per direction).
     """
     ops: list[Op] = []
     sites = fused_sites if fused_sites is not None else []
+    csites = conv_sites if conv_sites is not None else []
     fused = resize == "fused"
+    cfused = conv_arm == "fused"
     r = hw // 2  # 160 for 320
 
     # ---- backbone stem ----------------------------------------------
@@ -186,35 +222,53 @@ def minet_r50_ledger(b: int, hw: int = 320, s2d: bool = False,
              (hw // 16, 1024), (hw // 32, 2048)]
     for i, (res_, c) in enumerate(feats):
         n_parts = 1 + (i > 0) + (i < 4)
-        ops.append(conv(f"aim{i}.cur", b, res_, c, 64))
+        _conv_site(ops, csites, conv(f"aim{i}.cur", b, res_, c, 64),
+                   b, 64, fused=cfused)
         if i > 0:
             rb, cb = feats[i - 1]
-            ops.append(conv(f"aim{i}.below", b, rb, cb, 64))
+            _conv_site(ops, csites, conv(f"aim{i}.below", b, rb, cb, 64),
+                       b, 64, fused=cfused)
             ops.append(eltwise(f"aim{i}.down", b, rb, 64, res=res_))
         if i < 4:
             ra, ca = feats[i + 1]
-            ops.append(conv(f"aim{i}.above", b, ra, ca, 64))
+            _conv_site(ops, csites, conv(f"aim{i}.above", b, ra, ca, 64),
+                       b, 64, fused=cfused)
             _up_site(ops, sites, f"aim{i}.up", b, res_, 64, fused=fused)
-        ops.append(conv(f"aim{i}.merge", b, res_, 64 * n_parts, 64))
+        # The merge conv's input IS a materialized concat on the XLA
+        # arm — the fused conv+concat kernel reads the parts directly.
+        _conv_site(ops, csites,
+                   conv(f"aim{i}.merge", b, res_, 64 * n_parts, 64),
+                   b, 64, fused=cfused,
+                   cat_elems=float(b) * res_ * res_ * 64 * n_parts)
 
     # ---- SIM decoder (one per level, coarsest first) ----------------
     for i, (res_, _) in enumerate(reversed(feats)):
         p = f"sim{4 - i}"
-        ops.append(conv(f"{p}.h", b, res_, 64, 64))
-        ops.append(conv(f"{p}.l0", b, res_, 64, 32))
+        _conv_site(ops, csites, conv(f"{p}.h", b, res_, 64, 64),
+                   b, 64, fused=cfused)
+        _conv_site(ops, csites, conv(f"{p}.l0", b, res_, 64, 32),
+                   b, 32, fused=cfused)
         ops.append(eltwise(f"{p}.lpool", b, res_ // 2, 32))
-        ops.append(conv(f"{p}.l2h", b, res_ // 2, 32, 64))
+        _conv_site(ops, csites, conv(f"{p}.l2h", b, res_ // 2, 32, 64),
+                   b, 64, fused=cfused)
         _up_site(ops, sites, f"{p}.hup", b, res_, 64, fused=fused)
-        ops.append(conv(f"{p}.h2", b, res_, 64, 64))
-        ops.append(conv(f"{p}.h2l", b, res_, 64, 32))
-        ops.append(conv(f"{p}.l2", b, res_ // 2, 32, 32))
-        ops.append(conv(f"{p}.merge", b, res_, 96, 64))
+        _conv_site(ops, csites, conv(f"{p}.h2", b, res_, 64, 64),
+                   b, 64, fused=cfused)
+        _conv_site(ops, csites, conv(f"{p}.h2l", b, res_, 64, 32),
+                   b, 32, fused=cfused)
+        _conv_site(ops, csites, conv(f"{p}.l2", b, res_ // 2, 32, 32),
+                   b, 32, fused=cfused)
+        # SIM's merge input concat is the fused-RESAMPLE kernel's site
+        # (resample_merge mode='concat') — claimed there, NOT here.
+        _conv_site(ops, csites, conv(f"{p}.merge", b, res_, 96, 64),
+                   b, 64, fused=cfused)
         if i < 4:  # decoder hop up to the next (finer) level
             _up_site(ops, sites, f"{p}.declift", b, res_ * 2, 64,
                      reads=2, fused=fused)
 
     # ---- head + full-res logit --------------------------------------
-    ops.append(conv("head.c1", b, hw // 2, 64, 32))
+    _conv_site(ops, csites, conv("head.c1", b, hw // 2, 64, 32),
+               b, 32, fused=cfused)
     ops.append(conv("head.logit", b, hw // 2, 32, 1, bn=False))
     if fused:  # the head's 2x logit upsample rides the kernel too
         _up_site(ops, sites, "head.resize", b, hw, 1, fused=True)
@@ -253,8 +307,9 @@ def act_capacity_gb(b, hw=320, policy: str = "none") -> float:
 
 
 def predict(b, remat=False, s2d=False, resize="fast", hw=320,
-            remat_policy="none"):
-    ops = minet_r50_ledger(b, hw=hw, s2d=s2d, resize=resize)
+            remat_policy="none", conv="xla"):
+    ops = minet_r50_ledger(b, hw=hw, s2d=s2d, resize=resize,
+                           conv_arm=conv)
     rows = {}
     tot_f = tot_b = tot_t = 0.0
     for o in ops:
@@ -281,12 +336,13 @@ def predict(b, remat=False, s2d=False, resize="fast", hw=320,
 
 
 def fmt_pred(b, remat=False, s2d=False, resize="fast",
-             remat_policy="none"):
+             remat_policy="none", conv="xla"):
     rows, tf, tb, tt = predict(b, remat=remat, s2d=s2d, resize=resize,
-                               remat_policy=remat_policy)
+                               remat_policy=remat_policy, conv=conv)
     tag = f"on[{remat_policy}]" if remat else "off"
     out = [f"## predicted  b{b}  remat={tag}  "
-           f"stem={'s2d' if s2d else 'plain'}  resize={resize}",
+           f"stem={'s2d' if s2d else 'plain'}  resize={resize}  "
+           f"conv={conv}",
            "| res | GFLOPs | HBM GB | roofline ms | bound |",
            "|---|---|---|---|---|"]
     for res in sorted(rows, reverse=True):
@@ -342,6 +398,60 @@ def fmt_fused_ledger(b: int, hw: int = 320) -> str:
                f"({(1 - t_fused / t_fast):.1%} of the ideal step) — "
                f"the A/B leg must beat noise on THIS number to flip "
                f"any default")
+    return "\n".join(out)
+
+
+def fmt_fused_conv_ledger(b: int, hw: int = 320) -> str:
+    """Per-site HBM ledger for the fused conv-stage arm
+    (``model.conv_impl=fused``): what each decoder ConvBNAct saves per
+    step vs the XLA arm, and the falsifiable total the
+    tools/tpu_agenda_r14.sh A/B legs are queued against.
+
+    Assumptions on record (the ledger's honesty contract): the XLA arm
+    is charged one extra read+write of each decoder conv's OUTPUT map
+    (the BN-normalize+ReLU epilogue the r4 trace reconciliation shows
+    is NOT riding the conv fusion at the fine buckets), and one extra
+    write+read of each materialized pre-conv CONCAT (AIM merges; SIM's
+    merge concat belongs to the fused-resample ledger and is NOT
+    double-counted).  Backbone convs route the same seam but claim
+    nothing here — decoder sites only, so the total is a floor the
+    prof_conv trace leg can only raise.  FLOPs invariance between the
+    arms is asserted, not assumed.
+    """
+    csites: list = []
+    ops_f = minet_r50_ledger(b, hw=hw, conv_arm="fused",
+                             conv_sites=csites)
+    ops_x = minet_r50_ledger(b, hw=hw)
+    fx = sum(o.flops + o.bwd_flops for o in ops_x)
+    ff = sum(o.flops + o.bwd_flops for o in ops_f)
+    if fx != ff:
+        raise AssertionError(
+            f"fused-conv arm changed ledger FLOPs: {fx} != {ff} — the "
+            "kernel computes the SAME convolution; a bytes-only arm "
+            "must not touch the FLOP column")
+    out = [f"## fused-conv ledger  b{b}@{hw}px  "
+           f"(model.conv_impl=fused vs xla)",
+           f"FLOPs invariant across arms: {fx / 1e9:.1f} GFLOPs both",
+           "| site | res | HBM bytes saved/step | ms saved (fwd+bwd) |",
+           "|---|---|---|---|"]
+    tot = 0.0
+    for name, res, saved in csites:
+        if saved <= 0:
+            continue
+        tot += saved
+        out.append(f"| {name} | {res} | {2 * saved / 1e6:.2f} MB | "
+                   f"{2 * saved / HBM_BW * 1e3:.3f} |")
+    out.append(f"| **total** | | **{2 * tot / 1e6:.2f} MB** | "
+               f"**{2 * tot / HBM_BW * 1e3:.3f}** |")
+    _, _, _, t_x = predict(b, hw=hw)
+    _, _, _, t_f = predict(b, hw=hw, conv="fused")
+    out.append(f"prediction: step roofline {t_x * 1e3:.2f} -> "
+               f"{t_f * 1e3:.2f} ms "
+               f"({(1 - t_f / t_x):.1%} of the ideal step) — the "
+               f"ledger floor; the real target is the fine buckets' "
+               f"3.3x/2.1x conv-fusion overhead, which only the "
+               f"prof_conv trace leg can price.  The r14 A/B must "
+               f"beat noise on THIS number to flip any default")
     return "\n".join(out)
 
 
@@ -521,6 +631,12 @@ def main(argv=None) -> int:
                         "xla (generic jax.image.resize), fused (the "
                         "Pallas resample-merge kernel; also prints the "
                         "per-site bytes-saved ledger)")
+    p.add_argument("--conv", choices=["xla", "fused"], default="xla",
+                   help="price the conv-block arm: xla (nn.Conv + "
+                        "BatchNorm), fused (the Pallas conv-stage "
+                        "kernel, model.conv_impl=fused; also prints "
+                        "the per-decoder-site bytes-saved ledger and "
+                        "asserts FLOPs invariance vs the xla arm)")
     p.add_argument("--trace", help="profile dir to reconcile against")
     p.add_argument("--xla-check", action="store_true")
     args = p.parse_args(argv)
@@ -533,10 +649,13 @@ def main(argv=None) -> int:
     for b in batches:
         print(fmt_pred(b, remat=args.remat, s2d=args.s2d,
                        resize=args.resize,
-                       remat_policy=args.remat_policy))
+                       remat_policy=args.remat_policy, conv=args.conv))
         print()
         if args.resize == "fused":
             print(fmt_fused_ledger(b))
+            print()
+        if args.conv == "fused":
+            print(fmt_fused_conv_ledger(b))
             print()
     if args.trace:
         print(f"## measured ({args.trace})")

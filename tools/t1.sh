@@ -18,9 +18,14 @@
 cd "$(dirname "$0")/.." || exit 1
 echo "== host data-plane smoke (recorded, non-gating) =="
 bash tools/bench_data.sh || echo "bench_data smoke failed (non-gating)"
-echo "== HLO relayout guard (recorded, non-gating) =="
-timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/hlo_guard.py \
+echo "== HLO relayout guard incl. conv_impl arms (recorded, non-gating) =="
+timeout -k 10 700 env JAX_PLATFORMS=cpu python tools/hlo_guard.py \
   || echo "hlo_guard smoke failed (non-gating)"
+echo "== fused-conv interpret exactness smoke: kernel vs XLA arm bitwise/1-ulp on CPU (recorded, non-gating; the full suite below gates it) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_pallas_conv.py -q -p no:cacheprovider \
+  -k "bitwise or one_ulp or int8_dequants" \
+  || echo "fused-conv exactness smoke failed (the main suite below still gates it)"
 echo "== roofline --xla-check (recorded, non-gating) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/roofline.py --xla-check \
   || echo "roofline xla-check smoke failed (non-gating)"

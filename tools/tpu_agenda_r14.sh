@@ -1,0 +1,122 @@
+#!/bin/bash
+# Round-14 TPU measurement agenda — run the moment the tunnel lives
+# (tools/tpu_watch.sh fires this automatically; default agenda since
+# round 14).  Round 14 landed the fused conv-stage Pallas kernels
+# (ROADMAP item 4, the kernel half of the counterweight): conv +
+# inference-mode-BN + ReLU and conv+concat decoder heads as ONE
+# VMEM-resident pass per image behind `model.conv_impl={xla,fused}`
+# (pallas/fused_conv.py, the models/layers.py ConvBNAct seam), with a
+# closed-form custom VJP, DSOD_CONV_VMEM_MB scoped-VMEM budgeting with
+# per-site fallback, and composition with the PR-6 precision arms
+# (int8/fp8 weights dequantized IN-KERNEL; the serve program cache now
+# keys (model, res, batch, resample_impl, conv_impl, precision)).
+# Correctness is proven on CPU (tests/test_pallas_conv.py: bitwise-f32
+# vs the XLA arm at the block level, 1-ulp bf16, VJP-checked, Mosaic
+# export); what only hardware can answer:
+#
+#   1. canonical b128 headline refresh (comparison anchor)
+#   2. FUSED-CONV train A/B at b64 and b128: bench --set
+#      model.conv_impl=fused vs default.  Train-mode BN keeps flax's
+#      BatchNorm after the fused conv, so this leg prices the conv
+#      kernel itself on the train step (the 160/80-bucket lever).
+#   3. FUSED-CONV eval A/B: forward-only at the serve shapes, where
+#      the whole conv+BN+ReLU chain folds into the kernel — the
+#      serving-shaped win the int8 leg builds on.
+#   4. int8-FUSED serve leg: closed-loop serve bench at the int8 arm
+#      with conv_impl=fused (in-kernel dequant, weights resident at
+#      1/4 bytes) vs the dense int8 arm — the per-chip ceiling ROADMAP
+#      item 4 names.
+#   5. prof_conv trace leg: a profiled fused-arm window so
+#      tools/roofline.py --trace can re-bucket the step and say where
+#      the 160/80 overhead went.
+#
+# Predictions on record (docs/PERFORMANCE.md "Round-14 additions",
+# tools/roofline.py --conv fused): the ledger floor is ~1.3% of the
+# ideal step at b64 (11.4 GB/step of epilogue+concat streaming); the
+# sharp prediction rides the r4 reconciliation — if the fine buckets'
+# 3.3x/2.1x overhead is conv-fusion pressure, the measured win is
+# SEVERAL-fold the floor; if the A/B lands at ~1-2%, the overhead is
+# inside XLA's conv kernels themselves and the next lever is layout/
+# tiling, not more fusion.  Per the pre-committed rule the default
+# stays conv_impl=xla until a leg here wins.
+cd "$(dirname "$0")/.." || exit 1
+R=${R:-tpu_results14}
+mkdir -p "$R"
+BENCH="python bench.py --device tpu --steps 20 --watchdog 840 --retry-budget 0 --init-retries 2"
+
+done_ok() {
+  [ -f "$R"/results.jsonl ] || return 1
+  local rec
+  rec=$(grep "\"step\": \"$1\", \"rc\": 0" "$R"/results.jsonl | tail -1)
+  [ -n "$rec" ] || return 1
+  ! printf '%s' "$rec" | grep -q '"error"'
+}
+
+tunnel_computes() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('computes')" 2>/dev/null | grep -q computes
+}
+
+run() { # run NAME TIMEOUT CMD... — bounded leg + flushed JSON record
+  local name=$1 tmo=$2; shift 2
+  if done_ok "$name"; then
+    echo "[$name] skip: succeeded in a previous window" | tee -a "$R"/agenda.log
+    return 0
+  fi
+  echo "=== $name [$(date -u +%H:%M:%S)]: $*" | tee -a "$R"/agenda.log
+  timeout "$tmo" "$@" > "$R/$name.out" 2> "$R/$name.err"
+  local rc=$?
+  local line
+  line=$(grep -E '^\{' "$R/$name.out" | tail -1)
+  echo "{\"step\": \"$name\", \"rc\": $rc, \"result\": ${line:-null}}" >> "$R"/results.jsonl
+  echo "[$name] rc=$rc ${line:-no-json}" | tee -a "$R"/agenda.log
+  if { [ "$rc" -ne 0 ] || printf '%s' "$line" | grep -Eq 'wedged|unavailable'; } \
+      && ! tunnel_computes; then
+    echo "[$name] tunnel no longer computes — aborting firing (watcher will re-fire)" \
+      | tee -a "$R"/agenda.log
+    exit 2
+  fi
+}
+
+# -- 1. canonical headline refresh (the r5-r13 key replays unchanged)
+run headline_b128 900 $BENCH --config minet_r50_dp
+
+# -- 2. fused-conv train A/B (prediction: ledger floor ~1.3% at b64;
+#    anything well past it = the fine buckets' overhead was fusion
+#    pressure, the lever is real).  b64 first — the bucket the r4
+#    reconciliation measured — then the b128 operating point.
+run conv_xla_b64 900 $BENCH --config minet_r50_dp --batch-per-chip 64
+run conv_fused_b64 1500 $BENCH --config minet_r50_dp --batch-per-chip 64 \
+    --set model.conv_impl=fused
+run conv_fused_b128 1500 $BENCH --config minet_r50_dp \
+    --set model.conv_impl=fused
+
+# -- 3. fused-conv eval A/B: forward-only, where BN folds in-kernel.
+run conv_xla_eval 900 $BENCH --config minet_r50_dp --mode eval
+run conv_fused_eval 1500 $BENCH --config minet_r50_dp --mode eval \
+    --set model.conv_impl=fused
+
+# -- 4. int8-fused serve leg vs the dense int8 arm (in-kernel dequant:
+#    weights ship to the MXU at 1/4 bytes, no dense dequantized copy).
+run serve_int8_dense 1500 $BENCH --config minet_r50_dp --mode serve \
+    --steps 300 --set "serve.batch_buckets=1,4,8,16" \
+    --set "serve.precision_arms=f32,int8" --set serve.precision=int8
+run serve_int8_fused 1800 $BENCH --config minet_r50_dp --mode serve \
+    --steps 300 --set "serve.batch_buckets=1,4,8,16" \
+    --set "serve.precision_arms=f32,int8" --set serve.precision=int8 \
+    --set model.conv_impl=fused
+
+# -- 5. prof_conv trace leg: profiled fused window for the roofline
+#    re-bucketing (tools/roofline.py --trace "$R"/prof_conv --batch 64).
+run prof_conv 1500 $BENCH --config minet_r50_dp --batch-per-chip 64 \
+    --set model.conv_impl=fused --profile-dir "$R"/prof_conv
+
+# Host-side window report (touches no TPU).
+timeout 120 python tools/window_report.py "$R"/results.jsonl \
+    > "$R"/window_report.md 2> "$R"/window_report.err || true
+tail -20 "$R"/window_report.md | tee -a "$R"/agenda.log
+
+echo "=== agenda done [$(date -u +%H:%M:%S)]" | tee -a "$R"/agenda.log
