@@ -355,7 +355,7 @@ def _reset_backends() -> None:
 def _report_error(args, reason: str, **extra) -> bool:
     if not _claim_report():
         return False  # a genuine result line already won the race
-    print(json.dumps({
+    line = {
         "metric": f"{args.mode}_throughput[{args.config}@"
                   f"{args.image_size}px,{args.device or 'auto'}]",
         "value": 0.0,
@@ -363,7 +363,12 @@ def _report_error(args, reason: str, **extra) -> bool:
         "vs_baseline": 0.0,
         "error": reason,
         **extra,
-    }), flush=True)
+    }
+    print(json.dumps(line), flush=True)
+    # Error runs are part of the trajectory too (the BENCH_r01-r03
+    # rounds were ALL error lines — their absence from any history is
+    # exactly the gap this fixes).
+    _append_history(dict(line, ts=round(time.time(), 3)))
     return True
 
 
@@ -779,15 +784,37 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
                 f.write("\n")
             extra["recorded"] = True
 
-    print(json.dumps({
+    line = {
         "metric": f"{mode}_throughput[{args.config}@"
                   f"{args.image_size}px,{platform}x{n_chips}]",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
         **extra,
-    }), flush=True)
+    }
+    print(json.dumps(line), flush=True)
+    _append_history(dict(line, ts=round(time.time(), 3), key=key))
     return rc
+
+
+def _append_history(entry: dict) -> None:
+    """Accumulate every run's one-line summary in
+    ``tools/bench_history.jsonl`` (override: DSOD_BENCH_HISTORY; empty
+    string disables) so the perf trajectory exists ACROSS rounds —
+    bench_baseline.json keeps only one number per key, which is why
+    the BENCH trajectory was empty before this file.  Append-only
+    JSONL, never raises: history must not cost a result."""
+    path = os.environ.get("DSOD_BENCH_HISTORY")
+    if path == "":
+        return
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "bench_history.jsonl")
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":

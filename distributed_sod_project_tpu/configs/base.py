@@ -362,6 +362,29 @@ class ServeConfig:
     # semantics: breach for_s before firing, clear clear_s to resolve).
     slo_alert_for_s: float = 5.0
     slo_alert_clear_s: float = 60.0
+    # -- black-box flight recorder (utils/flightrecorder.py;
+    #    docs/OBSERVABILITY.md "Flight recorder & incidents").  OFF by
+    #    default: no thread, no files, /metrics byte-identical.  On,
+    #    a background thread samples this engine's telemetry registry
+    #    every recorder_sample_s into a bounded on-disk ring of
+    #    append-only JSONL segments (recorder_dir REQUIRED — loud
+    #    ValueError otherwise), records typed events (hot reloads,
+    #    degraded-ladder moves, alert transitions, dispatch errors),
+    #    and on a trigger (alert firing, watchdog trip, SIGTERM,
+    #    dispatch crash) snapshots the last recorder_bundle_window_s of
+    #    the ring + live sections (/debug/traces, /alerts, /slo,
+    #    capacity, resolved config) into one gzip incident bundle under
+    #    <recorder_dir>/incidents/ — debounced by recorder_debounce_s
+    #    so a flapping alert cannot bundle-storm.  The ring survives
+    #    SIGKILL (torn-tail-tolerant reader; tools/fleet_chaos.py
+    #    proves the replay) and tools/incident.py post-mortems it.
+    flight_recorder: bool = False
+    recorder_dir: str = ""
+    recorder_sample_s: float = 1.0
+    recorder_segment_kb: int = 256
+    recorder_keep_segments: int = 16
+    recorder_bundle_window_s: float = 300.0
+    recorder_debounce_s: float = 30.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -515,6 +538,21 @@ class FleetConfig:
     prober_px: int = 64
     # Per-probe HTTP timeout.
     prober_timeout_s: float = 10.0
+    # Router-tier flight recorder (utils/flightrecorder.py; same knob
+    # block as serve.flight_recorder).  Samples the ROUTER'S OWN book
+    # (tenant/outcome counters, replica up + breaker gauges) — never a
+    # per-second scrape of every replica — and triggers an incident
+    # bundle on replica transport failures, SLO burn firings, and
+    # SIGTERM.  The router /incidents endpoint aggregates its own
+    # bundles with every replica's (in-process read direct, remotes
+    # scraped bounded).
+    flight_recorder: bool = False
+    recorder_dir: str = ""
+    recorder_sample_s: float = 1.0
+    recorder_segment_kb: int = 256
+    recorder_keep_segments: int = 16
+    recorder_bundle_window_s: float = 300.0
+    recorder_debounce_s: float = 30.0
 
 
 def fleet_config_from_dict(d: Dict) -> FleetConfig:
@@ -649,6 +687,18 @@ def validate_fleet_config(fc: FleetConfig) -> FleetConfig:
             raise ValueError(
                 f"fleet prober_timeout_s must be > 0, got "
                 f"{fc.prober_timeout_s}")
+    if fc.flight_recorder:
+        # Loud at config time, not first sample (the recorder knobs
+        # are re-validated by FlightRecorder itself; the dir check is
+        # the one only the config layer can make early).
+        if not fc.recorder_dir:
+            raise ValueError(
+                "fleet flight_recorder=true needs recorder_dir (the "
+                "on-disk segment-ring location)")
+        if fc.recorder_sample_s <= 0:
+            raise ValueError(
+                f"fleet recorder_sample_s must be > 0, got "
+                f"{fc.recorder_sample_s}")
     if fc.default_tenant not in tseen:
         low = min((t.priority for t in fc.tenants), default=0)
         fc = dataclasses.replace(
@@ -762,6 +812,25 @@ class ExperimentConfig:
     slo_burn_threshold: float = 10.0
     slo_alert_for_s: float = 5.0
     slo_alert_clear_s: float = 60.0
+    # -- black-box flight recorder, trainer side
+    #    (utils/flightrecorder.py; docs/OBSERVABILITY.md "Flight
+    #    recorder & incidents").  OFF by default: no thread, no files,
+    #    the loop and sidecar surface byte-identical.  On, the trainer
+    #    telemetry registry (built even when the sidecar port is off)
+    #    is sampled into an on-disk segment ring under recorder_dir
+    #    (default <workdir>/flightrec), checkpoint/eval/preemption/
+    #    rollback events are recorded, and watchdog trips / health-
+    #    alert firings / train crashes snapshot incident bundles —
+    #    evidence that survives the exit-114 the watchdog's stall
+    #    policy mandates.  resilience/supervisor.py notes each
+    #    rollback into the same ring between attempts.
+    flight_recorder: bool = False
+    recorder_dir: str = ""
+    recorder_sample_s: float = 1.0
+    recorder_segment_kb: int = 256
+    recorder_keep_segments: int = 16
+    recorder_bundle_window_s: float = 300.0
+    recorder_debounce_s: float = 30.0
 
     def replace(self, **kw) -> "ExperimentConfig":
         return dataclasses.replace(self, **kw)

@@ -29,6 +29,7 @@ the TPU window.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Dict, Optional
 
 from ..utils.logging import get_logger
@@ -132,6 +133,26 @@ def run_supervised(
             ckpt_dir = workdir or cfg.checkpoint_dir
             last_good = _quarantine_and_latest(ckpt_dir)
             lr_scale = policy.lr_scale_for(attempt)
+            if getattr(cfg, "flight_recorder", False):
+                # The rollback happens BETWEEN fit() attempts (each
+                # owns its own recorder), so the supervisor notes it
+                # into the same on-disk ring directly — the incident
+                # timeline then shows crash → rollback → resume as one
+                # sequence.  append_event never raises.
+                from ..utils.flightrecorder import append_event
+
+                rec_dir = (getattr(cfg, "recorder_dir", "")
+                           or os.path.join(ckpt_dir, "flightrec"))
+                append_event(
+                    rec_dir, "supervisor_rollback",
+                    keep_segments=getattr(cfg, "recorder_keep_segments",
+                                          16),
+                    attempt=attempt,
+                    max_retries=policy.max_retries,
+                    failure=("divergence" if is_divergence(err)
+                             else "restore_failure"),
+                    error=str(err)[:200], rollback_step=last_good,
+                    lr_scale=lr_scale)
             log.warning(
                 "supervisor: attempt %d/%d after %s: %s — rolling back "
                 "to step %s, lr_scale=%g", attempt, policy.max_retries,

@@ -107,6 +107,37 @@ class InferenceEngine:
             "serve", self.stats.prom_families)
 
         sc = cfg.serve
+
+        # Black-box flight recorder (utils/flightrecorder.py;
+        # docs/OBSERVABILITY.md "Flight recorder & incidents"): samples
+        # this registry into an on-disk segment ring and bundles
+        # incidents on alert firings / watchdog trips / dispatch
+        # crashes / SIGTERM.  None when off — no thread, no files,
+        # /metrics byte-identical (the recorder registers no families
+        # of its own).  Constructed BEFORE the alert engines so their
+        # on_transition hooks can reference it; the bundle sections are
+        # lambdas evaluated at bundle time, so attribute order is free.
+        import dataclasses as _dc
+
+        from ..utils.flightrecorder import recorder_from_knobs
+
+        self.recorder = recorder_from_knobs(
+            sc, families_fn=self.telemetry.prom_families,
+            sections={
+                "stats": lambda: self.stats_snapshot(),
+                "traces": lambda: self.tracer.snapshot(n=16),
+                "alerts": lambda: (self.alerts.snapshot()
+                                   if self.alerts is not None else {}),
+                "slo": lambda: (self.slo.snapshot()
+                                if self.slo is not None else {}),
+                "capacity": lambda: (self.capacity.snapshot()
+                                     if self.capacity is not None
+                                     else {}),
+                "config": lambda: _dc.asdict(self.cfg),
+            },
+            meta={"source": "engine", "model": cfg.model.name},
+            clock=clock)
+        self._last_rec_level = 0  # degraded-ladder move detection
         self.res_buckets = tuple(sorted(
             sc.resolution_buckets or (max(cfg.data.image_size),)))
         self.batch_buckets = tuple(sorted(sc.batch_buckets))
@@ -162,7 +193,7 @@ class InferenceEngine:
                 psi_min_count=sc.quality_psi_min_count)
             self.alerts = AlertEngine(
                 default_quality_rules(sc) + parse_rules(sc.alert_rules),
-                clock=clock)
+                clock=clock, on_transition=self._alert_transition)
             self.telemetry.register("quality", self.quality.prom_families)
             self.telemetry.register("alerts", self.alerts.prom_families)
 
@@ -200,7 +231,8 @@ class InferenceEngine:
             self.slo = build_tracker(
                 sc.slo_objectives, burn_threshold=sc.slo_burn_threshold,
                 alert_for_s=sc.slo_alert_for_s,
-                alert_clear_s=sc.slo_alert_clear_s, clock=clock)
+                alert_clear_s=sc.slo_alert_clear_s, clock=clock,
+                on_transition=self._alert_transition)
             self.telemetry.register("slo", self.slo.prom_families)
             self.telemetry.register("slo_alerts",
                                     self.slo.alerts.prom_families)
@@ -264,6 +296,12 @@ class InferenceEngine:
         self._shadow_pool = None
         self._shadow_sem = threading.BoundedSemaphore(2)
 
+    def _alert_transition(self, rule, old: str, new: str, state) -> None:
+        """Alert/SLO state changes → flight-recorder events; a fresh
+        firing also snapshots an incident bundle (debounced inside)."""
+        if self.recorder is not None:
+            self.recorder.alert_transition(rule, old, new, state)
+
     # -- precision arms ------------------------------------------------
 
     def _derive_arm_vars(self, variables) -> Dict[str, object]:
@@ -321,6 +359,8 @@ class InferenceEngine:
         sc = self.cfg.serve
         self.warm()
         self._stop.clear()
+        if self.recorder is not None:
+            self.recorder.start()
         # Deterministic serve-tier chaos (resilience/inject.py): the
         # plan is cached once here so the dispatch hot path pays a
         # None check, not an environ read, per group.
@@ -337,9 +377,17 @@ class InferenceEngine:
         if sc.watchdog_deadline_s > 0:
             from ..resilience.watchdog import StepWatchdog
 
+            def _on_stall(msg):
+                # Health first (the router's gate must flip even if the
+                # bundle write below is slow), then the incident — a
+                # wedged dispatch is exactly the post-mortem case the
+                # recorder exists for.
+                self.stats.set_health(False, msg)
+                if self.recorder is not None:
+                    self.recorder.trigger("watchdog", msg)
+
             self._watchdog = StepWatchdog(
-                deadline_s=sc.watchdog_deadline_s,
-                on_stall=lambda msg: self.stats.set_health(False, msg))
+                deadline_s=sc.watchdog_deadline_s, on_stall=_on_stall)
             self._watchdog.start()
         if self.ckpt_dir and sc.reload_poll_s > 0:
             if self._template is None:
@@ -423,6 +471,8 @@ class InferenceEngine:
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        if self.recorder is not None:
+            self.recorder.stop()
 
     @classmethod
     def from_checkpoint(cls, ckpt_dir: str, config_name: Optional[str] = None,
@@ -584,7 +634,15 @@ class InferenceEngine:
         depth = self.batcher.pending()
         self.stats.set_queue_depth(depth)
         self.admission.observe(depth)
-        self.stats.set_degraded(self.admission.level)
+        level = self.admission.level
+        self.stats.set_degraded(level)
+        if self.recorder is not None and level != self._last_rec_level:
+            # Degraded-ladder move: one typed event per rung change
+            # (the observe point runs at ms cadence; the compare is
+            # the only cost on the non-moving path).
+            self.recorder.event("degraded_level", level=level,
+                                prev=self._last_rec_level, depth=depth)
+            self._last_rec_level = level
         if self.alerts is not None:
             # Throttled quality→alert evaluation rides the dispatch
             # loop's existing observe point (the fleet loop spins this
@@ -724,6 +782,19 @@ class InferenceEngine:
                 self.stats.inc("errors")
                 self._trace_end(r, "error")
                 self._fail(r, e)
+            if self.recorder is not None:
+                # A failed device dispatch is an incident: bundle the
+                # telemetry around it (debounced — a poisoned program
+                # failing every group cannot bundle-storm).
+                self.recorder.event(
+                    "dispatch_error", res=res, arm=arm,
+                    requests=len(live),
+                    error=f"{type(e).__name__}: {e}"[:200])
+                # Background: this is the engine's ONE dispatch loop —
+                # the capture must not stall sibling batches.
+                self.recorder.trigger("dispatch_error",
+                                      f"{type(e).__name__}",
+                                      background=True)
             return True
         self.stats.observe_batch(len(live), bb, arm=arm)
         meta = {"res_bucket": res, "batch_bucket": bb, "tta": tta,
@@ -911,6 +982,8 @@ class InferenceEngine:
             out["capacity"] = self.capacity.snapshot()
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
+        if self.recorder is not None:
+            out["recorder"] = self.recorder.snapshot()
         return out
 
     def _trace_end(self, r: Request, outcome: str,
@@ -973,4 +1046,6 @@ class InferenceEngine:
             self._arm_vars = arm_vars
             self._loaded_step = step
         self.stats.inc("reloads")
+        if self.recorder is not None:
+            self.recorder.event("hot_reload", step=int(step))
         self._log.info("serve: hot-reloaded weights from step %d", step)

@@ -110,6 +110,10 @@ class EngineBackend:
     def debug_traces(self, n: int = 50) -> Dict:
         return self.engine.tracer.snapshot(n)
 
+    def incidents_snapshot(self) -> Optional[Dict]:
+        return (self.engine.recorder.snapshot()
+                if self.engine.recorder is not None else None)
+
     def describe(self) -> Dict:
         cfg = self.engine.cfg
         return {
@@ -306,6 +310,22 @@ class RemoteBackend:
                 return json.loads(r.read().decode())
         except (urllib.error.URLError, OSError, ValueError):
             return {}
+
+    def incidents_snapshot(self) -> Optional[Dict]:
+        """The remote's /incidents (bounded scrape; None on a
+        known-down replica, an unreachable one, an old remote without
+        the endpoint, or a recorder-off replica — the killed replica's
+        evidence lives in ITS ring on disk, which is the point)."""
+        if not self.healthy():
+            return None
+        try:
+            with urllib.request.urlopen(
+                    self.url + "/incidents",
+                    timeout=self.PROBE_TIMEOUT_S) as r:
+                snap = json.loads(r.read().decode())
+                return snap if snap.get("enabled") else None
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
 
     def describe(self) -> Dict:
         return {"kind": self.kind, "url": self.url}
@@ -505,6 +525,26 @@ class Fleet:
         # ProbeStats is written by the SyntheticProber the serving CLI
         # arms against the router's own bound address
         # (serve/router.py::serve_fleet_forever).
+        # Router-tier flight recorder (utils/flightrecorder.py): samples
+        # the router's OWN families — the terminal book plus replica
+        # up/breaker gauges, both local reads — never a per-second
+        # scrape of every replica.  Triggers: replica transport
+        # failures (note_replica_failure, from the router's dispatch
+        # path), SLO burn firings, SIGTERM.  None when off.  Built
+        # before the SLO tracker so burn/budget transitions hook in.
+        from ..utils.flightrecorder import recorder_from_knobs
+
+        self.recorder = recorder_from_knobs(
+            cfg, families_fn=self._router_families,
+            sections={
+                "stats": lambda: self.stats(),
+                "traces": lambda: self.tracer.snapshot(16),
+                "alerts": lambda: self.alerts(),
+                "slo": lambda: (self.slo.snapshot()
+                                if self.slo is not None else {}),
+                "health": lambda: self.health()[1],
+            },
+            meta={"source": "router"}, clock=clock)
         self.slo = None
         if cfg.slo_objectives:
             from ..utils.slo import build_tracker
@@ -513,7 +553,9 @@ class Fleet:
                 cfg.slo_objectives,
                 burn_threshold=cfg.slo_burn_threshold,
                 alert_for_s=cfg.slo_alert_for_s,
-                alert_clear_s=cfg.slo_alert_clear_s, clock=clock)
+                alert_clear_s=cfg.slo_alert_clear_s, clock=clock,
+                on_transition=(self.recorder.alert_transition
+                               if self.recorder is not None else None))
         self.probe_stats = None
         if cfg.prober_interval_s > 0:
             from .prober import ProbeStats
@@ -566,6 +608,8 @@ class Fleet:
         for b in self.backends.values():
             b.start()  # engines warm their AOT programs here
         self.dispatcher.start()
+        if self.recorder is not None:
+            self.recorder.start()
         self._started = True
         return self
 
@@ -576,6 +620,8 @@ class Fleet:
         self.dispatcher.stop()
         for b in self.backends.values():
             b.stop()
+        if self.recorder is not None:
+            self.recorder.stop()
 
     # -- routing -------------------------------------------------------
 
@@ -665,13 +711,13 @@ class Fleet:
             return 200, dict(body, status="degraded", unhealthy=down)
         return 503, dict(body, status="unhealthy", unhealthy=down)
 
-    def metrics_text(self) -> str:
-        """The aggregated fleet /metrics: router families (tenant=/
-        model= labels, incl. the retry/hedge/failover counters), a
-        per-replica up gauge, per-replica breaker state/trip families,
-        then every replica's ServeStats families relabeled under its
-        ``model=`` (+ ``replica=``) key — each family declared ONCE
-        across all replicas (utils/observability.merge_prom_families)."""
+    def _router_families(self):
+        """The router's OWN families — terminal book, per-replica
+        up/breaker gauges, SLO + probe families when armed.  All local
+        reads (cached health verdicts, in-process counters): this is
+        both the router-owned half of :meth:`metrics_text` and what the
+        flight recorder samples every second, so it must never dial a
+        replica."""
         groups = [self.rstats.prom_families()]
         up, bstate, bopen = [], [], []
         for name, g in sorted(self.groups.items()):
@@ -695,6 +741,51 @@ class Fleet:
             groups.append(self.slo.alerts.prom_families())
         if self.probe_stats is not None:
             groups.append(self.probe_stats.prom_families())
+        return merge_prom_families(groups)
+
+    def note_replica_failure(self, rid: str, model: str,
+                             reason: str) -> None:
+        """Router dispatch path: one replica just failed a transport.
+        An event always; an incident bundle debounced (a dying replica
+        under load fails many dispatches — one bundle tells the story,
+        the ring holds every event)."""
+        if self.recorder is None:
+            return
+        self.recorder.event("replica_transport_failure", replica=rid,
+                            model=model, error=str(reason)[:200])
+        # Background: this runs on the REQUEST-HANDLER thread right
+        # before its failover retry — the bundle's section scrapes
+        # (2 s-bounded replica dials) must not delay the very
+        # failover that handles the incident.
+        self.recorder.trigger(f"replica:{rid}", str(reason)[:200],
+                              background=True)
+
+    def incidents(self) -> Dict:
+        """The router's /incidents payload: its own recorder snapshot
+        plus every reachable replica's (in-process engines read direct;
+        healthy remotes scraped bounded + concurrently, dead ones
+        skipped — their rings live on THEIR disk and replay after the
+        fact, which tools/fleet_chaos.py proves)."""
+        snaps = self._gather_replicas(
+            lambda _g, rid, b: (rid, b.incidents_snapshot()
+                                if hasattr(b, "incidents_snapshot")
+                                else None))
+        replicas = {rid: s for rid, s in snaps if s}
+        return {
+            "enabled": self.recorder is not None or bool(replicas),
+            "router": (self.recorder.snapshot()
+                       if self.recorder is not None else None),
+            "replicas": replicas,
+        }
+
+    def metrics_text(self) -> str:
+        """The aggregated fleet /metrics: router families (tenant=/
+        model= labels, incl. the retry/hedge/failover counters), a
+        per-replica up gauge, per-replica breaker state/trip families,
+        then every replica's ServeStats families relabeled under its
+        ``model=`` (+ ``replica=``) key — each family declared ONCE
+        across all replicas (utils/observability.merge_prom_families)."""
+        groups = [self._router_families()]
         groups.extend(self._gather_replicas(
             lambda g, rid, b: b.prom_families(
                 self._replica_label(g, rid))))

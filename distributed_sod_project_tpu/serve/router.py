@@ -366,6 +366,10 @@ class RouterHandler(JsonHTTPHandler):
             q = urllib.parse.urlsplit(self.path).query
             self._send_json(200, self.fleet.debug_traces(
                 n=_query_int(q, "n", 50)))
+        elif path == "/incidents":
+            # Flight-recorder aggregation (utils/flightrecorder.py):
+            # the router's own ring + every reachable replica's.
+            self._send_json(200, self.fleet.incidents())
         else:
             self._send_json(404, {"error": f"no route {path}"})
 
@@ -719,6 +723,11 @@ class RouterHandler(JsonHTTPHandler):
             note = getattr(backend, "note_transport_failure", None)
             if note is not None:
                 note(str(e))
+            # Flight recorder: a replica death under load is exactly
+            # the incident the router-tier bundle exists for (event
+            # per failure, bundle debounced).
+            fleet.note_replica_failure(rid, group.name,
+                                       f"{type(e).__name__}: {e}")
             get_logger().warning(
                 "router: replica %s transport failure: %s", rid, e)
             return ("transport", f"{type(e).__name__}: {e}", rid)
@@ -893,6 +902,10 @@ def serve_fleet_forever(fleet, host: str, port: int,
 
     def _sig(signum, frame):
         log.info("fleet: signal %s — draining", signum)
+        if fleet.recorder is not None and not stop.is_set():
+            # Bundle the router's last telemetry window before the
+            # drain (debounced; the replicas bundle their own SIGTERMs).
+            fleet.recorder.trigger("sigterm", f"signal {signum}")
         stop.set()
 
     for s in (signal.SIGTERM, signal.SIGINT):

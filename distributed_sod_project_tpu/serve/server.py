@@ -386,6 +386,13 @@ class ServeHandler(JsonHTTPHandler):
         elif path == "/debug/traces":
             self._send_json(200, self.engine.tracer.snapshot(
                 n=_query_int(split.query, "n", 50)))
+        elif path == "/incidents":
+            # Flight-recorder state: ring segments + incident bundles
+            # on disk (utils/flightrecorder.py; the bundles themselves
+            # are files — tools/incident.py reads them offline).
+            rec = self.engine.recorder
+            self._send_json(200, rec.snapshot() if rec is not None
+                            else {"enabled": False})
         else:
             self._send_json(404, {"error": f"no route {path}"})
 
@@ -464,6 +471,11 @@ def serve_forever(engine, host: str, port: int,
 
     def _sig(signum, frame):
         log.info("serve: signal %s — draining", signum)
+        if engine.recorder is not None and not stop.is_set():
+            # The terminating signal IS an incident trigger: bundle the
+            # last window of telemetry before the drain tears the
+            # process down (debounced like every other trigger).
+            engine.recorder.trigger("sigterm", f"signal {signum}")
         stop.set()
 
     for s in (signal.SIGTERM, signal.SIGINT):

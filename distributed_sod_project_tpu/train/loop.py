@@ -217,6 +217,16 @@ def fit(
     # aggregates them for the sidecar, and the alert engine watches the
     # derived signals.  All None when the knob is off — every touch
     # below guards on that, so the default path pays nothing.
+    # Flight recorder (utils/flightrecorder.py): constructed AFTER the
+    # telemetry registry below; the alert engines built first hook
+    # their transitions through this cell so construction order stays
+    # linear.  None-when-off discipline throughout.
+    _recorder_cell = [None]
+
+    def _rec_transition(rule, old, new, snap):
+        if _recorder_cell[0] is not None:
+            _recorder_cell[0].alert_transition(rule, old, new, snap)
+
     health_monitor = None
     health_alerts = None
     if cfg.health_numerics:
@@ -228,7 +238,8 @@ def fit(
         health_monitor = HealthMonitor(param_group_names(state.params))
         health_alerts = AlertEngine(
             default_numerics_rules(clear_s=cfg.health_alert_clear_s)
-            + parse_rules(cfg.health_alert_rules))
+            + parse_rules(cfg.health_alert_rules),
+            on_transition=_rec_transition)
 
     # Capacity ledger + goodput SLO (utils/capacity.py, utils/slo.py;
     # docs/OBSERVABILITY.md "Capacity & SLO").  Both None when off —
@@ -257,7 +268,8 @@ def fit(
         slo_tracker = build_tracker(
             cfg.slo_objectives, burn_threshold=cfg.slo_burn_threshold,
             alert_for_s=cfg.slo_alert_for_s,
-            alert_clear_s=cfg.slo_alert_clear_s)
+            alert_clear_s=cfg.slo_alert_clear_s,
+            on_transition=_rec_transition)
 
     def _observe_health(metrics_host) -> None:
         """Feed one fetched metric dict to the health monitor + alert
@@ -487,11 +499,27 @@ def fit(
     if cfg.watchdog_deadline_s > 0:
         from ..resilience.watchdog import StepWatchdog
 
+        on_stall = None
+        if cfg.flight_recorder:
+            from ..resilience.watchdog import WATCHDOG_EXIT_CODE
+
+            def on_stall(msg):
+                # The watchdog's exit-114 contract is exactly why the
+                # recorder exists: snapshot the incident (guarded —
+                # capture failing must not change the exit), THEN die
+                # with the documented code.  Only installed with the
+                # recorder armed; the default stall path is untouched.
+                rec = _recorder_cell[0]
+                if rec is not None:
+                    rec.trigger("watchdog", msg[:200])
+                    rec.stop()
+                os._exit(WATCHDOG_EXIT_CODE)
+
         watchdog = StepWatchdog(
             cfg.watchdog_deadline_s * k,
             first_deadline_s=max(cfg.watchdog_compile_grace_s,
                                  cfg.watchdog_deadline_s * k),
-            dump_dir=workdir,
+            dump_dir=workdir, on_stall=on_stall,
         ).start()
     timer = StepTimer(on_tick=watchdog.beat if watchdog else None)
     last_metrics: Dict[str, float] = {}
@@ -499,16 +527,54 @@ def fit(
     step = start_step
     # Opt-in telemetry sidecar: READS the objects above (stats, timer,
     # watchdog heartbeat, tracer, the live ``step``) over stdlib HTTP;
-    # the loop's own behavior is identical with it on or off.
-    from ..utils.telemetry import build_trainer_telemetry
+    # the loop's own behavior is identical with it on or off.  The
+    # flight recorder samples the SAME registry onto disk, so it works
+    # with the sidecar port off — durable history needs no socket.
+    from ..utils.telemetry import (build_trainer_registry,
+                                   build_trainer_telemetry)
 
+    registry = None
+    recorder = None
+    eff_tport = cfg.telemetry_port if telemetry_port is None \
+        else telemetry_port
+    if cfg.flight_recorder or (eff_tport is not None and eff_tport >= 0):
+        registry = build_trainer_registry(
+            cfg, data_stats=data_stats, timer=timer, writer=writer,
+            step_fn=lambda: step, tracer=tracer, health=health_monitor,
+            alerts=health_alerts, capacity=capacity, slo=slo_tracker)
+    if cfg.flight_recorder:
+        import dataclasses as _dc
+
+        from ..utils.flightrecorder import recorder_from_knobs
+
+        recorder = recorder_from_knobs(
+            cfg, dir_default=os.path.join(workdir, "flightrec"),
+            families_fn=registry.prom_families,
+            sections={
+                "traces": lambda: tracer.snapshot(16),
+                "alerts": lambda: (health_alerts.snapshot()
+                                   if health_alerts is not None else {}),
+                "slo": lambda: (slo_tracker.snapshot()
+                                if slo_tracker is not None else {}),
+                "capacity": lambda: (capacity.snapshot()
+                                     if capacity is not None else {}),
+                "health": lambda: (health_monitor.snapshot()
+                                   if health_monitor is not None else {}),
+                "last_metrics": lambda: dict(last_metrics),
+                "config": lambda: _dc.asdict(cfg),
+            },
+            meta={"source": "trainer", "model": cfg.model.name,
+                  "workdir": workdir})
+        _recorder_cell[0] = recorder
+        recorder.start()
     telemetry = build_trainer_telemetry(
         cfg, data_stats=data_stats, timer=timer, writer=writer,
         watchdog=watchdog, tracer=tracer, workdir=workdir,
         step_fn=lambda: step, port=telemetry_port,
         port_file=telemetry_port_file,
         health=health_monitor, alerts=health_alerts,
-        capacity=capacity, slo=slo_tracker)
+        capacity=capacity, slo=slo_tracker, registry=registry,
+        recorder=recorder)
     # A restore means this step's checkpoint already exists on disk — a
     # zero-progress run must not force-save over it (orbax raises).
     last_saved = resumed_from
@@ -611,6 +677,10 @@ def fit(
                               parent_id=trace["root"].span_id,
                               attrs={"step": at_step})
             last_eval_step = at_step
+            if recorder is not None:
+                recorder.event("eval", step=at_step,
+                               **{k: round(float(v), 6)
+                                  for k, v in eval_metrics.items()})
             writer.scalars(at_step, {f"eval/{k}": v
                                      for k, v in eval_metrics.items()})
             if is_primary_process():
@@ -638,6 +708,8 @@ def fit(
                               time.monotonic(),
                               parent_id=trace["root"].span_id,
                               attrs={"step": at_step})
+            if recorder is not None:
+                recorder.event("checkpoint", step=at_step)
             last_saved = at_step
             if watchdog is not None:
                 watchdog.beat(at_step)
@@ -829,6 +901,12 @@ def fit(
             # ``state`` is still its boundary state — flush with state
             # events before wind-down.
             _flush_chunk(with_state=True)
+        if stop and recorder is not None:
+            # Preemption (SIGTERM/SIGINT via the guard): the graceful
+            # cousin of the replica SIGKILL — bundle the final window
+            # before the wind-down checkpoint.
+            recorder.event("preemption_stop", step=step)
+            recorder.trigger("sigterm", "preemption guard stop")
         if watchdog is not None:
             # Training is over: the final eval/force-save/close below is
             # legitimate wind-down, not a wedged step.
@@ -841,6 +919,19 @@ def fit(
                 last_eval_step = step
             mgr.save(step, state, metrics=eval_metrics or None, force=True)
     finally:
+        if recorder is not None:
+            import sys as _sys
+
+            exc = _sys.exc_info()[1]
+            if exc is not None:
+                # A crashing fit (divergence RuntimeError, restore
+                # failure, ...) bundles its last window on the way out
+                # — the supervisor's rollback decision is then
+                # post-mortemable from disk.
+                recorder.trigger(
+                    "train_crash",
+                    f"{type(exc).__name__}: {exc}"[:200])
+            recorder.stop()
         if telemetry is not None:
             telemetry.stop()
         if watchdog is not None:

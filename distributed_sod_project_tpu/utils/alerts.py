@@ -144,17 +144,23 @@ class AlertEngine:
     point, throttled).  All clock reads go through the injected
     ``clock`` so the full fire → hold → clear ladder is provable with
     a fake clock.  ``on_fire(rule, state_dict)`` is invoked (outside
-    the lock) on each ok/pending → firing transition.
+    the lock) on each ok/pending → firing transition;
+    ``on_transition(rule, old_state, new_state, state_dict)`` on EVERY
+    state change — the flight recorder (utils/flightrecorder.py) hangs
+    its alert-transition event stream here so an incident timeline
+    shows pending/clearing edges, not just firings.
     """
 
     def __init__(self, rules: Sequence[Rule], *, clock=time.monotonic,
-                 on_fire: Optional[Callable] = None):
+                 on_fire: Optional[Callable] = None,
+                 on_transition: Optional[Callable] = None):
         names = [r.name for r in rules]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate alert rule names in {names}")
         self.rules: Tuple[Rule, ...] = tuple(rules)
         self._clock = clock
         self._on_fire = on_fire
+        self._on_transition = on_transition
         self._lock = threading.Lock()
         self._st: Dict[str, _RuleState] = {r.name: _RuleState()
                                            for r in rules}
@@ -194,6 +200,7 @@ class AlertEngine:
         value = float(value)
         now = self._clock() if now is None else now
         fired = []
+        transitions = []
         with self._lock:
             for rule in self.rules:
                 if rule.signal != signal:
@@ -203,8 +210,15 @@ class AlertEngine:
                 breach = self._breach(rule, st, value)
                 if breach and detail:
                     st.detail = detail
+                old_state = st.state
                 if self._advance(rule, st, breach, now):
                     fired.append((rule, self._state_dict(rule, st)))
+                if st.state != old_state and \
+                        self._on_transition is not None:
+                    transitions.append((rule, old_state, st.state,
+                                        self._state_dict(rule, st)))
+        for rule, old, new, snap in transitions:
+            self._on_transition(rule, old, new, snap)
         for rule, snap in fired:
             if self._on_fire is not None:
                 self._on_fire(rule, snap)

@@ -1,0 +1,555 @@
+"""Black-box flight recorder: durable telemetry history + crash-safe
+incident bundles (docs/OBSERVABILITY.md "Flight recorder & incidents").
+
+PRs 9-11 built a live telemetry surface — spans, health signals,
+alerts, SLO burn rates, the capacity ledger — but all of it lives in
+process memory: a SIGKILLed replica, a wedged trainer, or a
+watchdog-114 exit takes its evidence with it.  This module is the
+missing durable layer, three pieces:
+
+- :class:`SegmentRing` — a bounded on-disk ring of append-only JSONL
+  segments.  Appends are one ``write()`` + ``flush()`` per record (a
+  SIGKILLed process loses at most the record being written — the OS
+  page cache survives process death), rotation is by segment size,
+  retention by segment count, and :func:`read_records` is the
+  torn-tail-tolerant reader: a record half-written at kill time is
+  skipped, every COMPLETE record replays.  A restarted process always
+  opens a FRESH segment — it never appends to a file whose tail may be
+  torn.
+- :class:`FlightRecorder` — a background thread sampling the process's
+  :class:`~.observability.TelemetryRegistry` families (the same
+  ``prom_families`` machinery /metrics renders) into compact
+  ``{series: value}`` sample records, plus typed ``event`` records
+  (alert transitions, SLO burn crossings, hot reloads, degraded-ladder
+  moves, supervisor rollbacks, watchdog trips) pushed by the host
+  stack.  Off by default; when off nothing is constructed and the
+  /metrics surface is byte-identical.
+- **Incident bundles** — on a trigger (alert firing, watchdog trip,
+  SIGTERM, dispatch crash) the recorder snapshots the last
+  ``bundle_window_s`` of the ring together with caller-registered live
+  sections (/debug/traces worst-N, /alerts, /slo, the capacity
+  snapshot, the resolved config) into ONE gzip-compressed JSON file
+  under ``<dir>/incidents/``.  Triggers are debounced: a flapping
+  alert cannot bundle-storm (suppressed triggers are counted and noted
+  in the next bundle's meta).
+
+``tools/incident.py`` is the offline consumer: it renders an incident
+timeline (events overlaid on metric deltas around the trigger) and
+diffs two time windows of any recorded family.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .logging import get_logger
+
+# A record larger than this cannot be appended (one poisoned section
+# must not blow a segment ring sized in KB into GB).
+MAX_RECORD_BYTES = 4 * 1024 * 1024
+
+_SEGMENT_RE = re.compile(r"^seg-(\d{8})\.jsonl$")
+_SLUG_SAFE = re.compile(r"[^a-zA-Z0-9_.-]+")
+
+
+def flatten_families(families) -> Dict[str, float]:
+    """Prometheus family list → compact ``{series: value}`` dict — the
+    flight recorder's sample payload.
+
+    Scalar families keep every sample under its full ``name{labels}``
+    key; histogram families keep only their ``_count``/``_sum`` series
+    (per-bucket lines would multiply the record size ~14x for data the
+    offline diff never needs — counts and sums are what rates and
+    means derive from)."""
+    out: Dict[str, float] = {}
+    for _name, typ, samples in families:
+        for line in samples:
+            head, _, rest = line.rpartition(" ")
+            if not head:
+                continue
+            if typ == "histogram" and "_bucket{" in head:
+                continue
+            try:
+                out[head] = float(rest)
+            except ValueError:
+                continue
+    return out
+
+
+def series_family(series: str) -> str:
+    """A sample-record series key → its metric FAMILY name (labels
+    stripped, histogram ``_count``/``_sum`` suffixes folded back) —
+    what tools/metrics_lint.py checks against the inventory."""
+    name = series.partition("{")[0]
+    for suffix in ("_count", "_sum"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+class SegmentRing:
+    """Bounded on-disk ring of append-only JSONL segments.
+
+    One record per line; one ``write()`` + ``flush()`` per record so a
+    SIGKILL can tear at most the line in flight (the reader skips it).
+    Rotation: a segment past ``segment_bytes`` closes and a new one
+    opens; retention: at most ``keep_segments`` segments survive,
+    oldest deleted first.  Opening an existing directory CONTINUES the
+    sequence with a fresh segment — the previous process's possibly-
+    torn tail is never appended to.
+    """
+
+    def __init__(self, dir_: str, *, segment_bytes: int = 256 * 1024,
+                 keep_segments: int = 16):
+        if segment_bytes < 1024:
+            raise ValueError(
+                f"segment_bytes must be >= 1024, got {segment_bytes}")
+        if keep_segments < 2:
+            raise ValueError(
+                f"keep_segments must be >= 2 (one rotating, one "
+                f"history), got {keep_segments}")
+        self.dir = str(dir_)
+        self.segment_bytes = int(segment_bytes)
+        self.keep_segments = int(keep_segments)
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+        existing = self._segment_seqs(self.dir)
+        self._seq = (existing[-1] + 1) if existing else 0
+        # Retention on OPEN too, not only on rotation: a crash-looping
+        # writer that dies before filling one segment (and every
+        # one-shot append_event) opens a fresh segment per run — prune
+        # to keep-1 here so the bound holds across restarts, not just
+        # within one process's rotations.
+        for old in existing[: max(0, len(existing)
+                                  - (self.keep_segments - 1))]:
+            try:
+                os.unlink(self._segment_path(self.dir, old))
+            except OSError:
+                pass
+        self._f = None
+        self._written = 0
+        self.records_total = 0
+        self.dropped_oversize = 0
+
+    @staticmethod
+    def _segment_seqs(dir_: str) -> List[int]:
+        try:
+            names = os.listdir(dir_)
+        except OSError:
+            return []
+        seqs = []
+        for n in names:
+            m = _SEGMENT_RE.match(n)
+            if m:
+                seqs.append(int(m.group(1)))
+        return sorted(seqs)
+
+    @staticmethod
+    def _segment_path(dir_: str, seq: int) -> str:
+        return os.path.join(dir_, f"seg-{seq:08d}.jsonl")
+
+    def _open_locked(self) -> None:
+        self._f = open(self._segment_path(self.dir, self._seq), "a",
+                       buffering=1)
+        self._written = 0
+
+    def _rotate_locked(self) -> None:
+        if self._f is not None:
+            self._f.close()
+        # Retention BEFORE opening the successor: prune to keep-1 so
+        # the count lands exactly at keep_segments after the open — a
+        # SIGKILL between the two steps leaves keep-1, never keep+1
+        # (the on-disk bound must hold at EVERY instant, not just
+        # between rotations; the chaos test kills mid-rotation).
+        seqs = self._segment_seqs(self.dir)
+        for old in seqs[: max(0, len(seqs) - (self.keep_segments - 1))]:
+            try:
+                os.unlink(self._segment_path(self.dir, old))
+            except OSError:
+                pass
+        self._seq += 1
+        self._open_locked()
+
+    def append(self, record: Dict) -> bool:
+        """Append one record; returns False when it was dropped for
+        size.  Crash-safe by construction: the line lands in the OS
+        page cache in one write before this returns."""
+        line = json.dumps(record, separators=(",", ":"),
+                          default=str) + "\n"
+        data = line.encode()
+        if len(data) > MAX_RECORD_BYTES:
+            with self._lock:
+                self.dropped_oversize += 1
+            return False
+        with self._lock:
+            if self._f is None:
+                self._open_locked()
+            elif self._written >= self.segment_bytes:
+                self._rotate_locked()
+            self._f.write(line)
+            self._f.flush()
+            self._written += len(data)
+            self.records_total += 1
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def segments(self) -> List[str]:
+        with self._lock:
+            return [self._segment_path(self.dir, s)
+                    for s in self._segment_seqs(self.dir)]
+
+
+def read_records(dir_: str, since: Optional[float] = None,
+                 until: Optional[float] = None) -> List[Dict]:
+    """Replay a segment ring from disk, tolerating a torn tail.
+
+    Reads every segment in sequence order; a line that is not complete
+    JSON (the record a SIGKILL interrupted mid-write, or a truncated
+    disk) is SKIPPED, never raised on — every complete record replays.
+    ``since``/``until`` filter on the record's wall-clock ``t``."""
+    out: List[Dict] = []
+    for seq in SegmentRing._segment_seqs(dir_):
+        path = SegmentRing._segment_path(dir_, seq)
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail / corrupt line: skip, keep going
+            if not isinstance(rec, dict):
+                continue
+            t = rec.get("t")
+            if since is not None and (t is None or t < since):
+                continue
+            if until is not None and (t is None or t > until):
+                continue
+            out.append(rec)
+    return out
+
+
+def append_event(dir_: str, kind: str, keep_segments: int = 16,
+                 **attrs) -> None:
+    """One-shot event append into a ring directory WITHOUT a live
+    recorder — the resilience supervisor notes rollbacks between
+    fit() attempts this way (each attempt owns its own recorder; the
+    rollback happens in the gap).  ``keep_segments`` must match the
+    ring owner's retention (the open path prunes to it).  Never
+    raises: a telemetry append must not turn a recovery into a
+    crash."""
+    try:
+        ring = SegmentRing(dir_, keep_segments=keep_segments)
+        ring.append(dict({"t": time.time(), "kind": "event",
+                          "event": kind, "pid": os.getpid()}, **attrs))
+        ring.close()
+    except Exception:  # noqa: BLE001 — telemetry must not throw
+        get_logger().exception("flightrecorder: append_event failed")
+
+
+class FlightRecorder:
+    """The black-box recorder: background sampler + event sink +
+    debounced incident bundling over one :class:`SegmentRing`.
+
+    ``families_fn()`` returns the prom family list to sample
+    (``TelemetryRegistry.prom_families`` for the engine/trainer, the
+    router-book families for the fleet).  ``sections`` maps a bundle
+    section name to a zero-arg callable evaluated AT BUNDLE TIME
+    (traces, alerts, slo, capacity, stats, resolved config); a section
+    that raises is captured as its error string — one broken provider
+    must not cost the bundle.  ``clock`` is injectable so the debounce
+    ladder is fake-clock provable; record timestamps are WALL time
+    (``time.time()``) so offline timelines line up across processes.
+    """
+
+    def __init__(self, dir_: str, families_fn: Optional[Callable] = None,
+                 *, sample_s: float = 1.0,
+                 segment_bytes: int = 256 * 1024, keep_segments: int = 16,
+                 bundle_window_s: float = 300.0, debounce_s: float = 30.0,
+                 sections: Optional[Dict[str, Callable]] = None,
+                 meta: Optional[Dict] = None, clock=time.monotonic):
+        if not dir_:
+            raise ValueError(
+                "flight recorder needs a directory (recorder_dir)")
+        if sample_s <= 0:
+            raise ValueError(
+                f"recorder sample_s must be > 0, got {sample_s}")
+        if bundle_window_s <= 0:
+            raise ValueError(
+                f"recorder bundle_window_s must be > 0, got "
+                f"{bundle_window_s}")
+        if debounce_s < 0:
+            raise ValueError(
+                f"recorder debounce_s must be >= 0, got {debounce_s}")
+        self.dir = str(dir_)
+        self.ring = SegmentRing(self.dir, segment_bytes=segment_bytes,
+                                keep_segments=keep_segments)
+        self.families_fn = families_fn
+        self.sample_s = float(sample_s)
+        self.bundle_window_s = float(bundle_window_s)
+        self.debounce_s = float(debounce_s)
+        self.sections = dict(sections or {})
+        self.meta = dict(meta or {})
+        self._clock = clock
+        self._log = get_logger()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_bundle: Optional[float] = None
+        self.samples_total = 0
+        self.events_total = 0
+        self.bundles_total = 0
+        self.suppressed_total = 0
+        self._suppressed_since_bundle = 0
+        os.makedirs(self.incidents_dir, exist_ok=True)
+
+    @property
+    def incidents_dir(self) -> str:
+        return os.path.join(self.dir, "incidents")
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "FlightRecorder":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self.event("recorder_start", **self.meta)
+        if self.families_fn is not None:
+            self._thread = threading.Thread(
+                target=self._sample_loop, name="flight-recorder",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.event("recorder_stop")
+        self.ring.close()
+
+    def _sample_loop(self) -> None:
+        # First sample immediately: a replica killed within one
+        # interval of starting should still leave evidence.
+        while True:
+            self.sample()
+            if self._stop.wait(self.sample_s):
+                return
+
+    # -- recording -----------------------------------------------------
+
+    def sample(self) -> Optional[Dict]:
+        """Take one telemetry sample now (the loop's body; also called
+        synchronously right before a bundle so the incident is
+        bracketed by fresh numbers)."""
+        if self.families_fn is None:
+            return None
+        try:
+            values = flatten_families(self.families_fn())
+        except Exception:  # noqa: BLE001 — telemetry must not throw
+            self._log.exception("flightrecorder: sample failed")
+            return None
+        rec = {"t": time.time(), "kind": "sample", "v": values}
+        if self.ring.append(rec):
+            with self._lock:
+                self.samples_total += 1
+        return rec
+
+    def event(self, kind: str, **attrs) -> None:
+        """Record one typed event (hot reload, degraded move, alert
+        transition, ...).  Never raises."""
+        try:
+            rec = dict({"t": time.time(), "kind": "event",
+                        "event": str(kind)}, **attrs)
+            if self.ring.append(rec):
+                with self._lock:
+                    self.events_total += 1
+        except Exception:  # noqa: BLE001 — telemetry must not throw
+            self._log.exception("flightrecorder: event failed")
+
+    def alert_transition(self, rule, old: str, new: str, state: Dict
+                         ) -> None:
+        """The AlertEngine ``on_transition`` hook: every transition is
+        an event; a fresh FIRING additionally triggers an incident
+        (debounced — a flapping rule cannot bundle-storm)."""
+        self.event("alert_transition", rule=rule.name, old=old, new=new,
+                   value=state.get("last_value"),
+                   detail=state.get("detail", ""))
+        if new == "firing":
+            # Background: transitions fire from ingest/observe points
+            # (the engine dispatch loop, the router's booking seam) —
+            # the capture must not stall them.
+            self.trigger(f"alert:{rule.name}",
+                         detail=state.get("detail", ""),
+                         background=True)
+
+    # -- incident bundling ---------------------------------------------
+
+    def trigger(self, reason: str, detail: str = "",
+                background: bool = False) -> Optional[str]:
+        """Snapshot an incident bundle; returns its path, or None when
+        the trigger was debounced (or handed to the background
+        writer).  Never raises — an incident capture failing must not
+        worsen the incident.
+
+        ``background=True`` is for callers ON A SERVING HOT PATH (the
+        router's request-handler thread, the engine's dispatch loop):
+        the debounce claim stays synchronous — a storm is still one
+        bundle — but the expensive part (section evaluation may scrape
+        replicas with 2 s timeouts; the ring read + gzip write are
+        file I/O) moves to a daemon thread so a failing replica's
+        incident capture never delays the very failover that handles
+        it.  Exit paths (SIGTERM, watchdog, train crash) keep the
+        default synchronous write — the process is about to die and
+        must not race its own capture."""
+        now = self._clock()
+        with self._lock:
+            if (self._last_bundle is not None
+                    and now - self._last_bundle < self.debounce_s):
+                self.suppressed_total += 1
+                self._suppressed_since_bundle += 1
+                suppressed = True
+            else:
+                self._last_bundle = now
+                suppressed = False
+        if suppressed:
+            self.event("incident_suppressed", reason=reason)
+            return None
+
+        def write():
+            try:
+                return self._write_bundle(reason, detail)
+            except Exception:  # noqa: BLE001 — capture must not throw
+                self._log.exception("flightrecorder: bundle failed (%s)",
+                                    reason)
+                return None
+
+        if background:
+            threading.Thread(target=write, name="flight-bundle",
+                             daemon=True).start()
+            return None
+        return write()
+
+    def _write_bundle(self, reason: str, detail: str) -> str:
+        # The incident event lands in the RING first (so a later
+        # bundle, or the ring alone, still shows it), then a fresh
+        # sample brackets the trigger.
+        self.event("incident", reason=reason, detail=detail)
+        self.sample()
+        t_wall = time.time()
+        sections = {}
+        for name, fn in self.sections.items():
+            try:
+                sections[name] = fn()
+            except Exception as e:  # noqa: BLE001 — capture all it can
+                sections[name] = {"error": f"{type(e).__name__}: {e}"}
+        with self._lock:
+            suppressed = self._suppressed_since_bundle
+            self._suppressed_since_bundle = 0
+        bundle = {
+            "meta": dict(self.meta, reason=reason, detail=detail,
+                         t=t_wall, pid=os.getpid(),
+                         host=socket.gethostname(),
+                         window_s=self.bundle_window_s,
+                         suppressed_since_last=suppressed),
+            "records": read_records(self.dir,
+                                    since=t_wall - self.bundle_window_s),
+            "sections": sections,
+        }
+        slug = _SLUG_SAFE.sub("-", reason)[:48] or "incident"
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(t_wall))
+        path = os.path.join(
+            self.incidents_dir,
+            f"incident-{stamp}-{int((t_wall % 1) * 1000):03d}-{slug}"
+            ".json.gz")
+        tmp = path + ".tmp"
+        with gzip.open(tmp, "wt") as f:
+            json.dump(bundle, f, default=str)
+        os.replace(tmp, path)  # atomic: a reader never sees half a bundle
+        with self._lock:
+            self.bundles_total += 1
+        self._log.warning("flightrecorder: incident bundle %s (%s)",
+                          path, reason)
+        return path
+
+    # -- surfaces ------------------------------------------------------
+
+    def list_bundles(self) -> List[Dict]:
+        out = []
+        try:
+            names = sorted(os.listdir(self.incidents_dir))
+        except OSError:
+            return out
+        for n in names:
+            if not n.endswith(".json.gz"):
+                continue
+            p = os.path.join(self.incidents_dir, n)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            out.append({"file": n, "path": p, "bytes": st.st_size,
+                        "mtime": st.st_mtime})
+        return out
+
+    def snapshot(self) -> Dict:
+        """The /incidents payload for one process."""
+        with self._lock:
+            counts = {
+                "samples_total": self.samples_total,
+                "events_total": self.events_total,
+                "bundles_total": self.bundles_total,
+                "suppressed_total": self.suppressed_total,
+            }
+        return {
+            "enabled": True,
+            "dir": self.dir,
+            "sample_s": self.sample_s,
+            "segments": [os.path.basename(s)
+                         for s in self.ring.segments()],
+            "bundles": self.list_bundles(),
+            **counts,
+        }
+
+
+def recorder_from_knobs(knobs, *, dir_default: str = "",
+                        families_fn=None, sections=None, meta=None,
+                        clock=time.monotonic) -> Optional[FlightRecorder]:
+    """Config-knob bring-up shared by all three stacks (ServeConfig /
+    FleetConfig / ExperimentConfig carry the same ``flight_recorder`` +
+    ``recorder_*`` fields).  Returns None when the knob is off — the
+    defaults-off byte-identity contract; raises the loud ValueError
+    when it is on without a resolvable directory."""
+    if not getattr(knobs, "flight_recorder", False):
+        return None
+    dir_ = getattr(knobs, "recorder_dir", "") or dir_default
+    if not dir_:
+        raise ValueError(
+            "flight_recorder=true needs recorder_dir (no default "
+            "directory in this context) — set recorder_dir to the "
+            "on-disk ring location")
+    return FlightRecorder(
+        dir_, families_fn,
+        sample_s=knobs.recorder_sample_s,
+        segment_bytes=knobs.recorder_segment_kb * 1024,
+        keep_segments=knobs.recorder_keep_segments,
+        bundle_window_s=knobs.recorder_bundle_window_s,
+        debounce_s=knobs.recorder_debounce_s,
+        sections=sections, meta=meta, clock=clock)

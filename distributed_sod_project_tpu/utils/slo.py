@@ -211,7 +211,8 @@ class SLOTracker:
     def __init__(self, objectives: Sequence[SLObjective], *,
                  burn_threshold: float = 10.0,
                  alert_for_s: float = 5.0, alert_clear_s: float = 60.0,
-                 clock=time.monotonic, n_buckets: int = 60):
+                 clock=time.monotonic, n_buckets: int = 60,
+                 on_transition=None):
         objectives = list(objectives)
         if not objectives:
             raise ValueError("SLOTracker needs at least one objective")
@@ -235,7 +236,8 @@ class SLOTracker:
                 f"slo_{o.name}_budget", f"slo_budget:{o.name}", "lt",
                 0.0, for_s=alert_for_s, clear_s=alert_clear_s,
                 hint="slo"))
-        self.alerts = AlertEngine(rules, clock=clock)
+        self.alerts = AlertEngine(rules, clock=clock,
+                                  on_transition=on_transition)
         self._next_eval = 0.0
 
     # -- ingest --------------------------------------------------------
@@ -409,12 +411,16 @@ class SLOTracker:
 
 def build_tracker(specs: Sequence[str], *, burn_threshold: float,
                   alert_for_s: float, alert_clear_s: float,
-                  clock=time.monotonic) -> Optional[SLOTracker]:
+                  clock=time.monotonic,
+                  on_transition=None) -> Optional[SLOTracker]:
     """Config-knob bring-up: None when ``specs`` is empty (the
-    defaults-off byte-identity contract)."""
+    defaults-off byte-identity contract).  ``on_transition`` rides
+    through to the private AlertEngine — the flight recorder's SLO
+    burn-crossing event stream."""
     objs = parse_slos(specs)
     if not objs:
         return None
     return SLOTracker(objs, burn_threshold=burn_threshold,
                       alert_for_s=alert_for_s,
-                      alert_clear_s=alert_clear_s, clock=clock)
+                      alert_clear_s=alert_clear_s, clock=clock,
+                      on_transition=on_transition)

@@ -126,12 +126,13 @@ class TrainerTelemetry:
                  port_file: Optional[str] = None, watchdog=None,
                  tracer: Optional[Tracer] = None,
                  profile_dir: Optional[str] = None, alerts=None,
-                 slo=None):
+                 slo=None, recorder=None):
         self.registry = registry
         self.watchdog = watchdog
         self.tracer = tracer
         self.alerts = alerts  # utils/alerts.AlertEngine | None
         self.slo = slo        # utils/slo.SLOTracker | None
+        self.recorder = recorder  # utils/flightrecorder.FlightRecorder
         self.profile_dir = profile_dir or "."
         self._host = host
         self._port = int(port)
@@ -228,6 +229,12 @@ class TrainerTelemetry:
             handler._send_json(200, self.slo.snapshot()
                                if self.slo is not None
                                else {"objectives": [], "active": []})
+        elif path == "/incidents":
+            # Flight-recorder state (utils/flightrecorder.py): segment
+            # ring + incident bundles on disk.
+            handler._send_json(200, self.recorder.snapshot()
+                               if self.recorder is not None
+                               else {"enabled": False})
         elif path == "/debug/profile":
             self._handle_profile(handler, split.query)
         else:
@@ -320,26 +327,15 @@ class TrainerTelemetry:
             self._profile_lock.release()
 
 
-def build_trainer_telemetry(cfg, *, data_stats, timer, writer,
-                            watchdog=None, tracer=None, workdir=None,
-                            step_fn=None, port: Optional[int] = None,
-                            port_file: Optional[str] = None,
-                            health=None, alerts=None, capacity=None,
-                            slo=None) -> Optional[TrainerTelemetry]:
-    """fit()'s one-call bring-up: None when telemetry is off
-    (``cfg.telemetry_port < 0`` and no explicit ``port``).
-
-    ``health`` (utils/modelhealth.HealthMonitor) and ``alerts``
-    (utils/alerts.AlertEngine) — both optional — add the
-    ``dsod_health_*`` / ``dsod_alert_*`` families to /metrics and back
-    the /alerts endpoint + the degraded /healthz verdict.  ``capacity``
-    (utils/capacity.CapacityLedger) adds the ``dsod_capacity_*``
-    families; ``slo`` (utils/slo.SLOTracker) adds ``dsod_slo_*``, the
-    /slo endpoint, and its burn/budget alerts to the degraded verdict
-    (docs/OBSERVABILITY.md "Capacity & SLO")."""
-    eff_port = cfg.telemetry_port if port is None else port
-    if eff_port is None or eff_port < 0:
-        return None
+def build_trainer_registry(cfg, *, data_stats, timer, writer,
+                           step_fn=None, tracer=None, health=None,
+                           alerts=None, capacity=None,
+                           slo=None) -> TelemetryRegistry:
+    """The trainer's full :class:`TelemetryRegistry` — one construction
+    shared by the sidecar (which serves it at /metrics) and the flight
+    recorder (which samples it onto disk), so a fit() with only the
+    recorder armed records exactly the families a sidecar would have
+    exposed."""
     registry = TelemetryRegistry().register(
         "trainer", lambda labels="": trainer_prom_families(
             data_stats=data_stats, timer=timer,
@@ -355,7 +351,39 @@ def build_trainer_telemetry(cfg, *, data_stats, timer, writer,
     if slo is not None:
         registry.register("slo", slo.prom_families)
         registry.register("slo_alerts", slo.alerts.prom_families)
+    return registry
+
+
+def build_trainer_telemetry(cfg, *, data_stats, timer, writer,
+                            watchdog=None, tracer=None, workdir=None,
+                            step_fn=None, port: Optional[int] = None,
+                            port_file: Optional[str] = None,
+                            health=None, alerts=None, capacity=None,
+                            slo=None, registry=None,
+                            recorder=None) -> Optional[TrainerTelemetry]:
+    """fit()'s one-call bring-up: None when telemetry is off
+    (``cfg.telemetry_port < 0`` and no explicit ``port``).
+
+    ``health`` (utils/modelhealth.HealthMonitor) and ``alerts``
+    (utils/alerts.AlertEngine) — both optional — add the
+    ``dsod_health_*`` / ``dsod_alert_*`` families to /metrics and back
+    the /alerts endpoint + the degraded /healthz verdict.  ``capacity``
+    (utils/capacity.CapacityLedger) adds the ``dsod_capacity_*``
+    families; ``slo`` (utils/slo.SLOTracker) adds ``dsod_slo_*``, the
+    /slo endpoint, and its burn/budget alerts to the degraded verdict
+    (docs/OBSERVABILITY.md "Capacity & SLO").  ``registry`` (a
+    pre-built :func:`build_trainer_registry`) lets the flight recorder
+    and the sidecar share one instance; ``recorder`` backs
+    /incidents."""
+    eff_port = cfg.telemetry_port if port is None else port
+    if eff_port is None or eff_port < 0:
+        return None
+    if registry is None:
+        registry = build_trainer_registry(
+            cfg, data_stats=data_stats, timer=timer, writer=writer,
+            step_fn=step_fn, tracer=tracer, health=health,
+            alerts=alerts, capacity=capacity, slo=slo)
     return TrainerTelemetry(
         registry, host="127.0.0.1", port=eff_port, port_file=port_file,
         watchdog=watchdog, tracer=tracer, profile_dir=workdir,
-        alerts=alerts, slo=slo).start()
+        alerts=alerts, slo=slo, recorder=recorder).start()

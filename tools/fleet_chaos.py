@@ -19,6 +19,22 @@ model each, fixed ports) behind ONE router subprocess
    health prober re-admits it, the half-open breaker probe passes, and
    the restarted replica actually serves again (its own /stats).
 
+Flight-recorder capture (PR 13, utils/flightrecorder.py): every
+process runs with the recorder armed.  After the kill the harness
+asserts the chaos-proven-capture contract from DISK:
+
+- the SIGKILLed replica's pre-kill samples REPLAY from its segment
+  ring (torn-tail-tolerant reader; last pre-kill sample carries its
+  served counter) — the evidence survives a kill no process could
+  have flushed for;
+- the router snapshots an incident bundle on the replica's transport
+  failure, whose records reconstruct the event timeline: the
+  ``replica_transport_failure`` event lands AFTER the replica's last
+  recorded sample (the kill instant is bracketed) and the bundle's
+  own samples bracket the event;
+- ``tools/incident.py`` renders both (bundle timeline + dead
+  replica's ring) with exit 0 — the post-mortem path works offline.
+
 Prints ONE JSON line (steady/kill/recovery summaries, the
 p99_kill/p99_steady ratio, the fleet book, fault counters); exits
 non-zero on any broken invariant.  The p99 ratio is RECORDED here and
@@ -63,6 +79,15 @@ REPLICA_OVERRIDES = [
     "serve.precision=f32",
 ]
 
+# Flight recorder, armed on every replica: fast sampling + small
+# segments so a few seconds of load produce rotation-worthy history.
+RECORDER_OVERRIDES = [
+    "serve.flight_recorder=true", "serve.recorder_sample_s=0.25",
+    "serve.recorder_segment_kb=64", "serve.recorder_keep_segments=8",
+    "serve.recorder_debounce_s=1.0",
+    "serve.recorder_bundle_window_s=120",
+]
+
 
 def free_port() -> int:
     s = socket.socket()
@@ -72,12 +97,17 @@ def free_port() -> int:
     return port
 
 
-def spawn_replica(port: int, port_file: str) -> subprocess.Popen:
+def spawn_replica(port: int, port_file: str,
+                  recorder_dir: str = None) -> subprocess.Popen:
     cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
            "--config", "minet_vgg16_ref", "--init-random",
            "--device", "cpu", "--port", str(port),
            "--port-file", port_file]
-    for ov in REPLICA_OVERRIDES:
+    overrides = list(REPLICA_OVERRIDES)
+    if recorder_dir:
+        overrides += RECORDER_OVERRIDES
+        overrides += [f"serve.recorder_dir={recorder_dir}"]
+    for ov in overrides:
         cmd += ["--set", ov]
     return subprocess.Popen(cmd, env=dict(os.environ, JAX_PLATFORMS="cpu"))
 
@@ -127,6 +157,12 @@ def main(argv=None) -> int:
     pfiles = [tempfile.mktemp(prefix=f"dsod_chaos_r{i}_") for i in (0, 1)]
     fleet_pfile = tempfile.mktemp(prefix="dsod_chaos_fleet_")
     fleet_cfg = tempfile.mktemp(prefix="dsod_chaos_cfg_", suffix=".json")
+    # Flight-recorder rings: one per replica + one for the router.
+    # The dead replica's dir is read from THIS process after the kill
+    # — the whole point is that the evidence outlives its writer.
+    rec_dirs = [tempfile.mkdtemp(prefix=f"dsod_chaos_rec{i}_")
+                for i in (0, 1)]
+    router_rec = tempfile.mkdtemp(prefix="dsod_chaos_recrtr_")
     out = {"rps": args.rps, "duration_s": args.duration}
     procs = {}
     failures = []
@@ -139,7 +175,8 @@ def main(argv=None) -> int:
 
     try:
         # -- bring up the replicas, then the router --------------------
-        replicas = [spawn_replica(ports[i], pfiles[i]) for i in (0, 1)]
+        replicas = [spawn_replica(ports[i], pfiles[i], rec_dirs[i])
+                    for i in (0, 1)]
         procs["replica0"], procs["replica1"] = replicas
         urls = []
         for i in (0, 1):
@@ -165,6 +202,14 @@ def main(argv=None) -> int:
                 "retry_backoff_max_ms": 100,
                 "breaker_failures": 1,
                 "breaker_reset_s": 1.0,
+                # Router-tier recorder: the replica transport failure
+                # the kill produces must snapshot an incident bundle.
+                "flight_recorder": True,
+                "recorder_dir": router_rec,
+                "recorder_sample_s": 0.25,
+                "recorder_segment_kb": 64,
+                "recorder_debounce_s": 1.0,
+                "recorder_bundle_window_s": 120,
             }, f)
         router = subprocess.Popen(
             [sys.executable, os.path.join(TOOLS, "serve.py"),
@@ -200,9 +245,11 @@ def main(argv=None) -> int:
         t = threading.Thread(target=kill_leg)
         t.start()
         time.sleep(args.kill_after)
+        t_kill = time.time()  # wall clock: the recorder's timestamps
         replicas[1].kill()  # SIGKILL: no drain, no goodbye
         replicas[1].wait(timeout=30)
         t.join(timeout=180)
+        out["t_kill"] = t_kill
         out["kill"] = kill_result
         sent, done = kill_result.get("sent", 0), kill_result.get("done", 0)
         # Zero lost responses: every request terminated somewhere.
@@ -243,10 +290,93 @@ def main(argv=None) -> int:
         out["p99_ratio"] = round(p99k / p99s, 2) if p99s else None
         # RECORDED only; the r10 TPU agenda gates the <3x prediction.
 
+        # -- flight-recorder capture (PR 13) ---------------------------
+        # 1. The SIGKILLed replica's PRE-KILL samples replay from its
+        #    on-disk ring — read by THIS process via the torn-tail-
+        #    tolerant reader, the writer being dead is the test.
+        import gzip
+
+        from distributed_sod_project_tpu.utils.flightrecorder import \
+            read_records
+
+        dead_recs = read_records(rec_dirs[1])
+        pre_kill = [r for r in dead_recs
+                    if r.get("kind") == "sample"
+                    and r.get("t", 1e18) < t_kill]
+        out["dead_replica_pre_kill_samples"] = len(pre_kill)
+        check("recorder_pre_kill_replay", len(pre_kill) >= 1,
+              f"{len(dead_recs)} records, 0 pre-kill samples")
+        last_sample = pre_kill[-1] if pre_kill else None
+        served_at_kill = (last_sample["v"].get(
+            "dsod_serve_served_total", 0.0) if last_sample else 0.0)
+        out["dead_replica_served_at_kill"] = served_at_kill
+        check("recorder_pre_kill_served", served_at_kill >= 1,
+              "last pre-kill sample shows zero served — the ring did "
+              "not capture the load")
+        # 2. The router's transport-failure trigger snapshotted an
+        #    incident bundle whose records reconstruct the timeline:
+        #    the failure event sits AFTER the dead replica's last
+        #    sample (the kill instant is bracketed from both sides)
+        #    and the bundle's own samples bracket the event.
+        bundle_path = None
+        deadline = time.monotonic() + 20
+        inc_dir = os.path.join(router_rec, "incidents")
+        while time.monotonic() < deadline:
+            bundles = sorted(
+                f for f in (os.listdir(inc_dir)
+                            if os.path.isdir(inc_dir) else [])
+                if f.endswith(".json.gz"))
+            if bundles:
+                bundle_path = os.path.join(inc_dir, bundles[-1])
+                break
+            time.sleep(0.25)
+        check("recorder_router_bundle_written", bundle_path is not None)
+        if bundle_path:
+            with gzip.open(bundle_path, "rt") as f:
+                bundle = json.load(f)
+            out["router_bundle"] = {
+                "file": os.path.basename(bundle_path),
+                "reason": bundle["meta"].get("reason"),
+                "records": len(bundle.get("records", []))}
+            check("recorder_bundle_reason",
+                  str(bundle["meta"].get("reason", "")
+                      ).startswith("replica:"), bundle["meta"])
+            ev = [r for r in bundle.get("records", [])
+                  if r.get("event") == "replica_transport_failure"]
+            check("recorder_bundle_failure_event", len(ev) >= 1)
+            if ev and last_sample:
+                t_ev = ev[0]["t"]
+                check("recorder_kill_bracketed",
+                      last_sample["t"] <= t_kill <= t_ev + 30,
+                      f"last_sample={last_sample['t']} t_kill={t_kill} "
+                      f"event={t_ev}")
+            b_samples = [r.get("t") for r in bundle.get("records", [])
+                         if r.get("kind") == "sample"]
+            if ev and b_samples:
+                t_ev = ev[0]["t"]
+                check("recorder_bundle_event_bracketed",
+                      min(b_samples) <= t_ev <= max(b_samples),
+                      f"samples=[{min(b_samples)}, {max(b_samples)}] "
+                      f"event={t_ev}")
+            # 3. The offline analyzer renders both artifacts (the
+            #    post-mortem path works with every writer dead).
+            an1 = subprocess.run(
+                [sys.executable, os.path.join(TOOLS, "incident.py"),
+                 "--bundle", bundle_path], capture_output=True)
+            an2 = subprocess.run(
+                [sys.executable, os.path.join(TOOLS, "incident.py"),
+                 "--ring", rec_dirs[1]], capture_output=True)
+            check("recorder_analyzer_bundle", an1.returncode == 0,
+                  an1.stdout[-200:].decode(errors="replace"))
+            check("recorder_analyzer_dead_ring", an2.returncode == 0,
+                  an2.stdout[-200:].decode(errors="replace"))
+
         # -- leg 3: restart replica 1, breaker re-admission ------------
         if os.path.exists(pfiles[1]):
             os.unlink(pfiles[1])
-        replicas[1] = spawn_replica(ports[1], pfiles[1])
+        # Same recorder dir on purpose: a restart CONTINUES the ring
+        # with a fresh segment (never appending to the torn tail).
+        replicas[1] = spawn_replica(ports[1], pfiles[1], rec_dirs[1])
         procs["replica1b"] = replicas[1]
         _url, err = wait_port_file(pfiles[1], replicas[1], 150,
                                    "restarted replica 1")
@@ -299,6 +429,10 @@ def main(argv=None) -> int:
         for f in pfiles + [fleet_pfile, fleet_cfg]:
             if os.path.exists(f):
                 os.unlink(f)
+        import shutil
+
+        for d in rec_dirs + [router_rec]:
+            shutil.rmtree(d, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Offline incident post-mortem analyzer (docs/OBSERVABILITY.md
+"Flight recorder & incidents").
+
+Consumes what the flight recorder (utils/flightrecorder.py) leaves on
+disk — a segment ring of JSONL sample/event records and/or a gzip
+incident bundle — with the process that produced them long dead.  Two
+modes:
+
+- **timeline** (default): render the incident timeline — every typed
+  event (alert transitions, hot reloads, degraded-ladder moves,
+  replica failures, the incident trigger itself) ordered in time,
+  overlaid on the metric deltas around the trigger (per family: the
+  value just before vs just after, from the sample records bracketing
+  it).  Reads ``--bundle FILE.json.gz`` or ``--ring DIR`` (the
+  SIGKILL-survivor form: a killed replica's ring replays from disk via
+  the torn-tail-tolerant reader).
+- **diff**: compare two time windows of any recorded family — the
+  regression-hunting tool.  ``--diff=A,B`` (ONE comma-joined argument
+  — separate args trip argparse's option detection on negative
+  offsets) where each window is ``start:end`` in unix seconds, or
+  negative offsets relative to the newest record
+  (``--diff=-600:-300,-300:0`` = "the 5 minutes before vs the last 5
+  minutes").  Per series: first/last/delta per window plus the
+  per-second rate, so counters diff as rates and gauges as levels.
+
+One JSON line by default (the repo's tool discipline); ``--human``
+adds a readable rendering after it.  Exit 0 on success, 1 on
+unreadable input.
+
+Usage:
+    python tools/incident.py --ring /data/flightrec --human
+    python tools/incident.py --bundle incident-...-watchdog.json.gz
+    python tools/incident.py --ring DIR --diff=-600:-300,-300:0 \\
+        --family dsod_serve_e2e_latency_ms
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_sod_project_tpu.utils.flightrecorder import (  # noqa: E402
+    read_records, series_family)
+
+
+def load_bundle(path: str) -> dict:
+    with gzip.open(path, "rt") as f:
+        return json.load(f)
+
+
+def split_records(records):
+    samples = [r for r in records if r.get("kind") == "sample"]
+    events = [r for r in records if r.get("kind") == "event"]
+    samples.sort(key=lambda r: r.get("t", 0.0))
+    events.sort(key=lambda r: r.get("t", 0.0))
+    return samples, events
+
+
+def find_trigger(events, bundle_meta=None):
+    """The anchor instant the timeline pivots on: the bundle's own
+    trigger when analyzing a bundle, else the LAST ``incident`` event
+    in the ring, else the newest record."""
+    if bundle_meta is not None and "t" in bundle_meta:
+        return float(bundle_meta["t"]), bundle_meta.get("reason", "?")
+    incidents = [e for e in events if e.get("event") == "incident"]
+    if incidents:
+        e = incidents[-1]
+        return float(e["t"]), e.get("reason", "?")
+    if events:
+        return float(events[-1]["t"]), events[-1].get("event", "?")
+    return None, None
+
+
+def series_values(samples, wanted_families=None):
+    """sample records → {series: [(t, value), ...]}, optionally
+    filtered to the given family names."""
+    out = {}
+    for rec in samples:
+        t = rec.get("t")
+        for series, v in (rec.get("v") or {}).items():
+            if wanted_families is not None \
+                    and series_family(series) not in wanted_families:
+                continue
+            out.setdefault(series, []).append((t, v))
+    return out
+
+
+def delta_around(points, t_anchor, window_s):
+    """(value just before the anchor, value at/after anchor+window end,
+    delta) from one series' (t, v) points; None fields when a side has
+    no sample."""
+    before = [v for t, v in points if t <= t_anchor]
+    after = [v for t, v in points if t_anchor < t <= t_anchor + window_s]
+    b = before[-1] if before else None
+    a = after[-1] if after else None
+    d = (a - b) if (a is not None and b is not None) else None
+    return {"before": b, "after": a,
+            "delta": round(d, 6) if d is not None else None}
+
+
+def _top_changed(values, t_anchor, window_s, n=12):
+    """The n series with the largest |delta| around the anchor — the
+    default family set when the caller names none.  A flat incident
+    (nothing moved) falls back to the first n series so the timeline
+    still shows the levels the trigger fired amid."""
+    scored = []
+    for series, pts in values.items():
+        d = delta_around(pts, t_anchor, window_s)["delta"]
+        if d:
+            scored.append((abs(d), series))
+    scored.sort(reverse=True)
+    if not scored:
+        return sorted(values)[:n]
+    return [s for _d, s in scored[:n]]
+
+
+def timeline(records, families, window_s, bundle_meta=None):
+    samples, events = split_records(records)
+    t_trig, reason = find_trigger(events, bundle_meta)
+    out = {
+        "mode": "timeline",
+        "records": len(records),
+        "samples": len(samples),
+        "n_events": len(events),  # "events" is always the LIST below
+    }
+    if samples:
+        out["span_s"] = round(samples[-1]["t"] - samples[0]["t"], 3)
+    if t_trig is None:
+        out["error"] = "no events or trigger found"
+        return out
+    out["trigger"] = {"t": t_trig, "reason": reason,
+                      "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime(t_trig))}
+    out["events"] = [
+        dict({k: v for k, v in e.items() if k not in ("kind", "t")},
+             dt_s=round(e["t"] - t_trig, 3))
+        for e in events if "t" in e]
+    values = series_values(samples,
+                           set(families) if families else None)
+    if not families:
+        keep = set(_top_changed(values, t_trig, window_s))
+        values = {s: p for s, p in values.items() if s in keep}
+    out["deltas"] = {
+        s: delta_around(pts, t_trig, window_s)
+        for s, pts in sorted(values.items())}
+    return out
+
+
+def parse_window(spec: str, t_newest: float):
+    """``start:end`` → (t0, t1) unix seconds.  Negative/zero values are
+    offsets from the newest record (``-300:0`` = the last 5 min)."""
+    a, sep, b = spec.partition(":")
+    if not sep:
+        raise ValueError(f"window {spec!r} is not start:end")
+    t0, t1 = float(a), float(b)
+    if t0 <= 0:
+        t0 = t_newest + t0
+    if t1 <= 0:
+        t1 = t_newest + t1
+    if t1 <= t0:
+        raise ValueError(f"window {spec!r}: end <= start after "
+                         "resolution")
+    return t0, t1
+
+
+def window_stats(points, t0, t1):
+    """first/last/delta/rate of one series over [t0, t1]."""
+    win = [(t, v) for t, v in points if t0 <= t <= t1]
+    if not win:
+        return None
+    first, last = win[0][1], win[-1][1]
+    span = max(win[-1][0] - win[0][0], 1e-9)
+    return {"n": len(win), "first": first, "last": last,
+            "delta": round(last - first, 6),
+            "rate_per_s": round((last - first) / span, 6)}
+
+
+def diff(records, families, win_a: str, win_b: str):
+    samples, _events = split_records(records)
+    if not samples:
+        return {"mode": "diff", "error": "no sample records"}
+    t_newest = samples[-1]["t"]
+    a0, a1 = parse_window(win_a, t_newest)
+    b0, b1 = parse_window(win_b, t_newest)
+    values = series_values(samples,
+                           set(families) if families else None)
+    out = {"mode": "diff", "a": [a0, a1], "b": [b0, b1], "series": {}}
+    for series, pts in sorted(values.items()):
+        sa, sb = window_stats(pts, a0, a1), window_stats(pts, b0, b1)
+        if sa is None and sb is None:
+            continue
+        entry = {"a": sa, "b": sb}
+        if sa and sb:
+            entry["rate_ratio"] = (
+                round(sb["rate_per_s"] / sa["rate_per_s"], 4)
+                if sa["rate_per_s"] else None)
+            entry["last_delta"] = round(sb["last"] - sa["last"], 6)
+        out["series"][series] = entry
+    return out
+
+
+def render_human(out) -> str:
+    lines = []
+    if out.get("mode") == "timeline":
+        trig = out.get("trigger") or {}
+        lines.append(f"== incident timeline — trigger "
+                     f"{trig.get('reason')!r} @ {trig.get('iso')} ==")
+        for e in out.get("events", []):
+            attrs = {k: v for k, v in e.items()
+                     if k not in ("event", "dt_s")}
+            lines.append(f"  {e['dt_s']:+9.3f}s  {e.get('event', '?'):<26}"
+                         f" {json.dumps(attrs) if attrs else ''}")
+        lines.append("-- metric deltas around the trigger --")
+        for s, d in out.get("deltas", {}).items():
+            lines.append(f"  {s}: {d['before']} -> {d['after']} "
+                         f"(delta {d['delta']})")
+    elif out.get("mode") == "diff":
+        lines.append(f"== window diff A={out.get('a')} B={out.get('b')} ==")
+        for s, e in out.get("series", {}).items():
+            sa, sb = e.get("a"), e.get("b")
+            ra = sa["rate_per_s"] if sa else None
+            rb = sb["rate_per_s"] if sb else None
+            lines.append(f"  {s}: rate {ra} -> {rb} "
+                         f"(x{e.get('rate_ratio')}), last "
+                         f"{sa['last'] if sa else None} -> "
+                         f"{sb['last'] if sb else None}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ring", help="segment-ring directory to replay "
+                                  "(torn-tail tolerant)")
+    p.add_argument("--bundle", help="incident bundle (.json.gz)")
+    p.add_argument("--family", action="append", default=[],
+                   help="metric family to analyze (repeatable; default "
+                        "timeline auto-picks the top movers, diff "
+                        "covers everything)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="timeline: seconds after the trigger the "
+                        "'after' value is read from")
+    p.add_argument("--diff", metavar="A,B",
+                   help="diff two comma-separated windows, each "
+                        "start:end (unix seconds, or <=0 offsets from "
+                        "the newest record) — one argument so negative "
+                        "offsets survive argparse, e.g. "
+                        "--diff=-600:-300,-300:0")
+    p.add_argument("--human", action="store_true",
+                   help="pretty rendering after the JSON line")
+    args = p.parse_args(argv)
+
+    if not args.ring and not args.bundle:
+        p.error("need --ring DIR and/or --bundle FILE")
+    records = []
+    bundle_meta = None
+    try:
+        if args.bundle:
+            bundle = load_bundle(args.bundle)
+            bundle_meta = bundle.get("meta", {})
+            records.extend(bundle.get("records", []))
+        if args.ring:
+            records.extend(read_records(args.ring))
+    except (OSError, ValueError) as e:
+        print(json.dumps({"error": f"unreadable input: {e}"}),
+              flush=True)
+        return 1
+
+    if args.diff:
+        windows = args.diff.split(",")
+        if len(windows) != 2:
+            p.error(f"--diff needs exactly two comma-separated "
+                    f"windows, got {args.diff!r}")
+        out = diff(records, args.family, windows[0], windows[1])
+    else:
+        out = timeline(records, args.family, args.window,
+                       bundle_meta=bundle_meta)
+    print(json.dumps(out), flush=True)
+    if args.human:
+        print(render_human(out), flush=True)
+    return 0 if "error" not in out else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,10 +26,20 @@ This tool renders the full family surface of BOTH stacks and diffs the
 their families against the union inventory — the form the TPU agenda
 runs against a real fleet + trainer sidecar.
 
+``--ring DIR`` (repeatable) lints a flight-recorder segment ring
+(utils/flightrecorder.py): every family a sample record carries must
+be in the inventory union — the recorder's ON-DISK schema is the same
+family surface /metrics exposes, and a renamed family would otherwise
+silently break every archived ring tools/incident.py diffs against.
+``--ring-selftest`` builds a synthetic ring from the same populated
+surfaces the inventory render uses and lints it (the t1.sh leg).
+
 Usage:
     python tools/metrics_lint.py                     # print delta line
     python tools/metrics_lint.py --update-baseline   # re-seed the file
     python tools/metrics_lint.py --url http://127.0.0.1:8080
+    python tools/metrics_lint.py --ring /data/flightrec
+    python tools/metrics_lint.py --ring-selftest
 """
 
 from __future__ import annotations
@@ -173,7 +183,10 @@ def fleet_inventory() -> dict:
     from distributed_sod_project_tpu.utils.observability import \
         parse_prom_text
 
-    return _family_types(parse_prom_text(fleet.metrics_text()))
+    global _SELFTEST_FLEET_TEXT
+    text = fleet.metrics_text()
+    _SELFTEST_FLEET_TEXT = text  # the ring selftest samples this too
+    return _family_types(parse_prom_text(text))
 
 
 def trainer_inventory() -> dict:
@@ -228,6 +241,8 @@ def trainer_inventory() -> dict:
     slo.observe(True, latency_ms=5.0, n=1)
     fams = (fams + _populated_capacity().prom_families()
             + slo.prom_families() + slo.alerts.prom_families())
+    global _SELFTEST_TRAINER_FAMS
+    _SELFTEST_TRAINER_FAMS = fams  # the ring selftest samples this too
     return _family_types(fams)
 
 
@@ -240,6 +255,81 @@ def scrape_inventory(url: str) -> dict:
         return _family_types(parse_prom_text(r.read().decode()))
 
 
+def ring_inventory(ring_dir: str) -> dict:
+    """Family names present in a flight-recorder ring's sample records
+    (the on-disk schema).  Types are unknowable from a flat sample —
+    every family maps to ``"recorded"`` and the type check is skipped
+    for ring sections (name presence is the contract)."""
+    from distributed_sod_project_tpu.utils.flightrecorder import \
+        read_records
+
+    fams = {}
+    for rec in read_records(ring_dir):
+        if rec.get("kind") != "sample":
+            continue
+        for series in (rec.get("v") or {}):
+            fams[series.partition("{")[0]] = "recorded"
+    return fams
+
+
+def _ring_documented(name: str, base: dict) -> bool:
+    """A ring series name is documented if the inventory has it
+    verbatim, or (histogram ``_sum``/``_count`` series) has the family
+    it derives from — tried second, so a counter family whose name
+    itself ends in ``_sum`` (dsod_serve_batch_occupancy_sum) matches
+    verbatim first."""
+    if name in base:
+        return True
+    for suf in ("_sum", "_count"):
+        if name.endswith(suf) and name[: -len(suf)] in base:
+            return True
+    return False
+
+
+def selftest_ring_dir() -> str:
+    """Build a synthetic ring in a temp dir: one FlightRecorder sample
+    of the SAME populated fleet + trainer surfaces the inventory render
+    uses — so the on-disk schema lint exercises the real
+    flatten-families path end-to-end without a live process."""
+    import tempfile
+
+    from distributed_sod_project_tpu.utils.flightrecorder import \
+        FlightRecorder
+    from distributed_sod_project_tpu.utils.observability import \
+        parse_prom_text
+    from distributed_sod_project_tpu.utils.telemetry import \
+        trainer_prom_families  # noqa: F401 — imported via inventories
+
+    fleet_fams = parse_prom_text(_selftest_fleet_text())
+    trainer_fams = _selftest_trainer_families()
+    d = tempfile.mkdtemp(prefix="dsod_lint_ring_")
+    rec = FlightRecorder(d, lambda: fleet_fams + trainer_fams,
+                         sample_s=1.0)
+    rec.sample()
+    rec.ring.close()
+    return d
+
+
+# The populated surfaces, kept as module state so fleet_inventory() /
+# trainer_inventory() and the ring selftest render the SAME text.
+_SELFTEST_FLEET_TEXT = None
+_SELFTEST_TRAINER_FAMS = None
+
+
+def _selftest_fleet_text() -> str:
+    global _SELFTEST_FLEET_TEXT
+    if _SELFTEST_FLEET_TEXT is None:
+        fleet_inventory()
+    return _SELFTEST_FLEET_TEXT
+
+
+def _selftest_trainer_families():
+    global _SELFTEST_TRAINER_FAMS
+    if _SELFTEST_TRAINER_FAMS is None:
+        trainer_inventory()
+    return _SELFTEST_TRAINER_FAMS
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--baseline", default=_BASELINE)
@@ -248,13 +338,43 @@ def main(argv=None) -> int:
                    help="scrape a live /metrics instead of the "
                         "in-process synthetic render (repeatable; "
                         "lints against the union inventory)")
+    p.add_argument("--ring", action="append", default=[],
+                   help="lint a flight-recorder segment ring's on-disk "
+                        "sample schema against the union inventory "
+                        "(repeatable; name check only — samples carry "
+                        "no TYPE lines)")
+    p.add_argument("--ring-selftest", action="store_true",
+                   help="build a synthetic ring from the populated "
+                        "fleet+trainer surfaces and lint it (the "
+                        "non-gating t1.sh leg)")
     args = p.parse_args(argv)
 
-    if args.url:
+    rings = list(args.ring)
+    if args.ring_selftest:
+        rings.append(selftest_ring_dir())
+    if args.url or rings:
+        sections = {}
         live = {}
         for u in args.url:
             live.update(scrape_inventory(u))
-        sections = {"live": live}
+        if live:
+            sections["live"] = live
+        ring = {}
+        for r in rings:
+            inv = ring_inventory(r)
+            if not inv:
+                # A lint that read zero sample records must not report
+                # success — a typo'd/empty --ring dir would otherwise
+                # pass green without checking anything.
+                print(json.dumps({
+                    "metric": "metrics_inventory",
+                    "error": f"ring {r!r} has no readable sample "
+                             "records"}), flush=True)
+                return 1
+            ring.update(inv)
+        if rings:
+            ring.pop("", None)
+            sections["ring"] = ring
     else:
         sections = {"fleet": fleet_inventory(),
                     "trainer": trainer_inventory()}
@@ -265,10 +385,11 @@ def main(argv=None) -> int:
             baseline = json.load(f)
 
     if args.update_baseline or baseline is None:
-        if args.url:
+        if args.url or rings:
             print("metrics_lint: refusing to seed the baseline from a "
-                  "live scrape (the synthetic render is the canonical "
-                  "surface; run without --url)", file=sys.stderr)
+                  "live scrape or recorded ring (the synthetic render "
+                  "is the canonical surface; run without --url/--ring)",
+                  file=sys.stderr)
             return 1
         with open(args.baseline, "w") as f:
             json.dump(sections, f, indent=2, sort_keys=True)
@@ -288,18 +409,27 @@ def main(argv=None) -> int:
               "families": {s: len(v) for s, v in sections.items()}}
     undocumented, vanished, retyped = {}, {}, {}
     for sec, inv in sections.items():
-        base = base_union if args.url else baseline.get(sec, {})
-        extra = sorted(set(inv) - set(base))
+        # "live" and "ring" sections lint against the UNION inventory
+        # (a scrape/ring sees one deployment's subset — absence is not
+        # drift); only the synthetic render checks vanished families.
+        union_based = sec in ("live", "ring")
+        base = base_union if union_based else baseline.get(sec, {})
+        if sec == "ring":
+            extra = sorted(n for n in inv
+                           if not _ring_documented(n, base))
+        else:
+            extra = sorted(set(inv) - set(base))
         if extra:
             undocumented[sec] = extra
-        if not args.url:
+        if not union_based:
             gone = sorted(set(base) - set(inv))
             if gone:
                 vanished[sec] = gone
-        changed = sorted(n for n in set(inv) & set(base)
-                         if inv[n] != base[n])
-        if changed:
-            retyped[sec] = changed
+        if sec != "ring":  # ring samples carry no TYPE lines
+            changed = sorted(n for n in set(inv) & set(base)
+                             if inv[n] != base[n])
+            if changed:
+                retyped[sec] = changed
     if undocumented:
         report["undocumented"] = undocumented
         rc = 2

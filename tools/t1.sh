@@ -4,18 +4,21 @@
 # pytest's status, so CI and humans invoke the exact same command the
 # roadmap promises (the pytest line below is verbatim ROADMAP.md).
 #
-# Before the suite, the host data-plane smoke (tools/bench_data.sh)
-# prints one JSON throughput line and compares it against the
-# checked-in tools/data_baseline.json — recorded, never a hard gate
-# here (shared CI boxes are noisy-neighbor machines; see
-# docs/PERFORMANCE.md "Host data plane").
-# Two more recorded, non-gating smokes ride along (same posture):
-# the HLO relayout guard (tools/hlo_guard.py vs the checked-in
-# tools/hlo_copy_baseline.json — prints a one-line JSON delta of
-# data-formatting op counts per interleave arm) and the roofline
-# ledger's --xla-check self-test (hand-math vs XLA's cost model on the
-# real jitted step; drift past ±25% exits non-zero and is echoed).
+# Smoke-budget audit (PR 13): the non-gating smokes below carry their
+# own wrappers (420+700+420+300+420+420+420+300+900+720+600+540 ≈ 103
+# min worst case) — far past the 870 s the GATING pytest line gets.
+# Each wrapper deliberately EXCEEDS its tool's documented internal
+# budget contract (serve_smoke sums to ~300 s under its 420 s wrapper,
+# health 900, fleet 720, slo 600, chaos 540): a stalled smoke must die
+# to its OWN deadline with its own JSON diagnostic, never to the outer
+# timeout — so the wrappers must not be trimmed below the contracts.
+# The starvation fix is the gate instead: set DSOD_T1_FAST=1 and every
+# non-gating smoke is skipped, so a machine that wants only the 870 s
+# gating wrapper runs exactly it.
 cd "$(dirname "$0")/.." || exit 1
+if [ -n "${DSOD_T1_FAST:-}" ]; then
+  echo "== DSOD_T1_FAST set: skipping all non-gating smokes =="
+else
 echo "== host data-plane smoke (recorded, non-gating) =="
 bash tools/bench_data.sh || echo "bench_data smoke failed (non-gating)"
 echo "== HLO relayout guard incl. conv_impl arms (recorded, non-gating) =="
@@ -39,8 +42,9 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/serve_smoke.py --precision 
 echo "== precision quality gate: per-arm max-Fbeta/MAE deltas vs f32 on the tiny synthetic set (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/precision_gate.py \
   || echo "precision gate smoke failed (non-gating; --fail-on-increase gates locally)"
-echo "== metrics-family inventory lint: fleet + trainer /metrics surfaces vs tools/metrics_inventory.json (recorded, non-gating) =="
+echo "== metrics-family inventory lint: fleet + trainer /metrics surfaces + flight-recorder ring schema vs tools/metrics_inventory.json (recorded, non-gating) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_lint.py \
+  && timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/metrics_lint.py --ring-selftest \
   || echo "metrics lint failed (non-gating; --update-baseline re-seeds after an INTENDED surface change)"
 echo "== model-health smoke: real trainer sidecar under an injected NaN (provenance-attributed alert fire/clear) + real server with quality monitors, shadow scoring, injected drift alert (recorded, non-gating) =="
 timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/health_smoke.py \
@@ -51,7 +55,8 @@ timeout -k 10 720 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py \
 echo "== slo smoke: real router + always-500 remote replica, synthetic prober detects the outage via burn-rate alert at ZERO live traffic, /slo consistent with the router book, capacity ledger live on the replica (recorded, non-gating) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/slo_smoke.py \
   || echo "slo smoke failed (non-gating; tests/test_slo.py + tests/test_capacity.py below gate the in-process side)"
-echo "== fleet chaos: SIGKILL a replica under open-loop load — zero lost responses, exact accounting, breaker half-open re-admission (recorded, non-gating) =="
+echo "== fleet chaos: SIGKILL a replica under open-loop load — zero lost responses, exact accounting, breaker half-open re-admission, flight-recorder pre-kill segments replay + router incident bundle (recorded, non-gating) =="
 timeout -k 10 540 env JAX_PLATFORMS=cpu python tools/fleet_chaos.py \
-  || echo "fleet chaos failed (non-gating; tests/test_failover.py + tests/test_serve_chaos.py below gate the in-process side)"
+  || echo "fleet chaos failed (non-gating; tests/test_failover.py + tests/test_serve_chaos.py + tests/test_flightrecorder.py below gate the in-process side)"
+fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
