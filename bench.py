@@ -38,7 +38,10 @@ DEFAULT_BATCH = 32
 # they must be part of the baseline key, or an A/B leg run with one of
 # these set seeds the canonical key with the slow variant and every
 # later run reports a bogus vs_baseline (the exact failure class the
-# round-2 remat fix documented — see _report()).
+# round-2 remat fix documented — see _report()).  Kept as an explicit
+# literal on purpose: tools/dsodlint.py (env-coherence) cross-checks it
+# against utils/envvars.py's program_affecting rows BOTH ways, so a new
+# program-affecting knob that forgets either side fails lint.
 _PROGRAM_ENV_VARS = (
     "DSOD_RESIZE_IMPL",
     "DSOD_RESIZE_INTERLEAVE",
@@ -725,7 +728,9 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
     _claim_report()
     mode = mode or args.mode
     per_chip = imgs_per_sec / n_chips
-    base_path = (os.environ.get("DSOD_BENCH_BASELINE")
+    from distributed_sod_project_tpu.utils import envvars
+
+    base_path = (envvars.read("DSOD_BENCH_BASELINE")
                  or os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json"))
     # Batch, --set overrides, AND program-affecting env vars are in the
@@ -741,7 +746,7 @@ def _report(args, imgs_per_sec: float, platform: str, n_chips: int,
         key += "-" + ",".join(sorted(args.overrides))
     env_tags = []
     for k in _PROGRAM_ENV_VARS:
-        v = os.environ.get(k)
+        v = envvars.read(k)
         if not v:
             continue
         if k == "DSOD_STEM_IMPL" and v == "s2d" and args.image_size % 2:
@@ -804,7 +809,9 @@ def _append_history(entry: dict) -> None:
     bench_baseline.json keeps only one number per key, which is why
     the BENCH trajectory was empty before this file.  Append-only
     JSONL, never raises: history must not cost a result."""
-    path = os.environ.get("DSOD_BENCH_HISTORY")
+    from distributed_sod_project_tpu.utils import envvars
+
+    path = envvars.read("DSOD_BENCH_HISTORY")
     if path == "":
         return
     if path is None:

@@ -33,7 +33,11 @@ def load_library() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        path = os.environ.get("DSOD_NATIVE_LIB", _lib_path())
+        from ..utils import envvars
+
+        path = envvars.read("DSOD_NATIVE_LIB")
+        if path is None:  # '' stays '' — the empty-string-disables idiom
+            path = _lib_path()
         if not os.path.exists(path):
             return None
         try:

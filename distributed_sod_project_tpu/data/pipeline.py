@@ -379,7 +379,6 @@ class HostDataLoader:
                 "process) — decoding in-thread instead")
             return
         import multiprocessing as mp
-        import os
 
         try:
             # spawn, not fork: the pool starts lazily from a worker
@@ -387,7 +386,9 @@ class HostDataLoader:
             # process, where fork can inherit held locks and deadlock
             # children.  Workers import only numpy-level modules, so
             # spawn startup is cheap and paid once per run.
-            ctx = mp.get_context(os.environ.get("DSOD_DECODE_MP", "spawn"))
+            from ..utils import envvars
+
+            ctx = mp.get_context(envvars.read("DSOD_DECODE_MP"))
             self._proc_pool = cf.ProcessPoolExecutor(
                 max_workers=self.decode_procs, mp_context=ctx,
                 initializer=_proc_init, initargs=(self.dataset,))

@@ -122,9 +122,9 @@ class ResNet(nn.Module):
         # conv (layers.SpaceToDepthStem) — same arithmetic, same param
         # tree, TPU-friendlier tiling.  Env-knob A/B like
         # DSOD_RESIZE_IMPL (bench.py keys baselines on it).
-        import os
+        from ...utils import envvars
 
-        if os.environ.get("DSOD_STEM_IMPL") == "s2d":
+        if envvars.read("DSOD_STEM_IMPL") == "s2d":
             if x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
                 from ..layers import SpaceToDepthStem
 

@@ -394,8 +394,6 @@ def _upsample_axis(x, axis: int, s: int):
     way; ``DSOD_RESIZE_INTERLEAVE=stack`` keeps the old form as the A/B
     arm ``tools/hlo_guard.py`` diffs against.
     """
-    import os
-
     import jax.lax as lax
 
     n = x.shape[axis]
@@ -415,8 +413,10 @@ def _upsample_axis(x, axis: int, s: int):
         f = jnp.asarray(f, x.dtype)
         phases.append(a * (1 - f) + b * f)
     out_shape = x.shape[:axis] + (n * s,) + x.shape[axis + 1:]
+    from ..utils import envvars
+
     if (axis + 1 >= x.ndim
-            or os.environ.get("DSOD_RESIZE_INTERLEAVE") == "stack"):
+            or envvars.read("DSOD_RESIZE_INTERLEAVE") == "stack"):
         y = jnp.stack(phases, axis=axis + 1)  # historical form
     else:
         y = jnp.concatenate(phases, axis=axis + 1)  # layout-stable
@@ -509,11 +509,10 @@ def _resolve_resample_impl(impl: Optional[str]) -> str:
     A/B legs (``rsz_convt`` etc. in tools/tpu_agenda_r4.sh) and the
     BASELINE.md measurement commands keep working unchanged.
     """
-    import os
+    from ..utils import envvars
 
     if impl in (None, "fast"):
-        env = os.environ.get("DSOD_RESIZE_IMPL")
-        impl = env or "fast"
+        impl = envvars.read("DSOD_RESIZE_IMPL") or "fast"
     if impl not in RESAMPLE_IMPLS:
         raise ValueError(
             f"resample impl must be one of {RESAMPLE_IMPLS}, got {impl!r}")

@@ -343,10 +343,9 @@ def _env_block(name: str, default: int) -> int:
     (``DSOD_FLASH_BLOCK_Q`` / ``DSOD_FLASH_BLOCK_KV`` — the knob
     ``tools/bench_flash.py`` sweeps; round-2 v5e measurement showed the
     128/128 default leaves >2x on the table at short N)."""
-    import os
+    from ..utils import envvars
 
-    v = os.environ.get(name)
-    return int(v) if v else default
+    return envvars.read_int(name, default)
 
 
 def flash_attention(q, k, v, *, block_q: int | None = None,

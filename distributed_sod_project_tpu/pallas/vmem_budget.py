@@ -14,7 +14,6 @@ its own env-var escape hatch (0 = compiler default).
 
 from __future__ import annotations
 
-import os
 import re
 
 import jax
@@ -29,8 +28,11 @@ CompilerParams = getattr(pltpu, "CompilerParams", None) or \
 
 def scoped_vmem_params(env_var: str) -> "CompilerParams":
     """The per-kernel scoped-VMEM ceiling, overridable via ``env_var``
-    (MB; 0 or negative = compiler default)."""
-    env = os.environ.get(env_var)
+    (MB; 0 or negative = compiler default; must be a registered
+    program-affecting knob — utils/envvars.py)."""
+    from ..utils import envvars
+
+    env = envvars.read(env_var)
     if env is not None:
         mb = int(env)
         return (CompilerParams() if mb <= 0

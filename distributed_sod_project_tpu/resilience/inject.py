@@ -213,7 +213,9 @@ _PLANS: Dict[str, FaultPlan] = {}
 
 
 def plan_from_env(env: Optional[dict] = None) -> Optional[FaultPlan]:
-    spec = (env if env is not None else os.environ).get(ENV_VAR, "").strip()
+    from ..utils import envvars
+
+    spec = (envvars.read(ENV_VAR, env=env) or "").strip()
     if not spec:
         return None
     if spec not in _PLANS:

@@ -241,6 +241,12 @@ class SyntheticProber:
         # Drop-not-queue: one probe in flight, ever.  A busy lane at
         # tick time is a DROP (counted), never a backlog.
         self._busy = threading.Semaphore(1)
+        # Guards the tick↔stop handoff of the worker handle: stop()'s
+        # loop-thread join can TIME OUT (a probe wedged in urlopen),
+        # after which a bare self._worker swap would race a concurrent
+        # tick — losing a live worker handle (never joined) or
+        # clobbering it with None mid-spawn.
+        self._worker_lock = threading.Lock()
         self._i = 0
         self._log = get_logger()
 
@@ -260,9 +266,10 @@ class SyntheticProber:
         if self._thread is not None:
             self._thread.join(timeout=self.timeout_s + 5.0)
             self._thread = None
-        if self._worker is not None:
-            self._worker.join(timeout=self.timeout_s + 5.0)
-            self._worker = None
+        with self._worker_lock:
+            worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.join(timeout=self.timeout_s + 5.0)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -288,9 +295,25 @@ class SyntheticProber:
             finally:
                 self._busy.release()
 
-        self._worker = threading.Thread(
-            target=run, name="serve-probe", daemon=True)
-        self._worker.start()
+        worker = threading.Thread(target=run, name="serve-probe",
+                                  daemon=True)
+        with self._worker_lock:
+            if self._stop.is_set():
+                # stop() is (or has been) draining: a worker spawned
+                # now would never be joined — drop the tick instead.
+                self._busy.release()
+                self.stats.record_dropped()
+                return False
+            # Start BEFORE publishing, both under the lock: stop()
+            # must never join a not-yet-started handle (RuntimeError),
+            # and a failed start must not leak the probe lane.
+            try:
+                worker.start()
+            except RuntimeError:  # thread resources exhausted
+                self._busy.release()
+                self.stats.record_dropped()
+                return False
+            self._worker = worker
         return True
 
     def probe_once(self, model: str, body: bytes, gt: np.ndarray) -> bool:

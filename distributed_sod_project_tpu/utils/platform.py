@@ -37,7 +37,9 @@ def maybe_enable_compilation_cache(path: str | None = None) -> None:
     post-init still covers every program the process compiles."""
     import os
 
-    if os.environ.get("DSOD_NO_COMPILE_CACHE"):
+    from . import envvars
+
+    if envvars.read("DSOD_NO_COMPILE_CACHE"):
         return
     import jax
 

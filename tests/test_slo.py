@@ -466,6 +466,36 @@ def test_prober_drops_rather_than_queue(tiny_variables):
         prober._busy.release()
 
 
+def test_prober_stop_tick_worker_handoff_is_guarded():
+    """Regression for the dsodlint lock-discipline finding: stop()'s
+    loop-thread join can TIME OUT (a probe wedged in urlopen), after
+    which its bare ``self._worker`` swap raced a concurrent tick — a
+    live worker handle could be clobbered with None (never joined), or
+    a worker spawned after stop() began could outlive the prober.  The
+    handoff now goes through ``_worker_lock``, and a tick that loses
+    the race is a counted DROP that hands its lane back."""
+    stats = ProbeStats()
+    prober = SyntheticProber("http://127.0.0.1:1", ["m"], stats=stats,
+                             interval_s=99.0, px=16, timeout_s=2.0)
+    # stop() already engaged (the drain flag is set): a racing tick
+    # must not spawn a worker nobody will ever join.
+    prober._stop.set()
+    assert prober.tick() is False
+    assert stats.snapshot()["dropped"] == 1
+    assert prober._worker is None
+    # ...and the single-probe lane was handed back, not leaked.
+    assert prober._busy.acquire(blocking=False)
+    prober._busy.release()
+    # A normal tick → stop sequence joins the worker exactly once and
+    # clears the handle under the lock.
+    prober._stop.clear()
+    assert prober.tick() is True
+    prober.stop()
+    assert prober._worker is None
+    assert prober._busy.acquire(blocking=False)  # worker released it
+    prober._busy.release()
+
+
 def test_prober_records_failures_as_unavailable():
     """A dead router (connection refused) is a failed probe — the
     availability gauge is the zero-traffic outage signal."""

@@ -16,6 +16,12 @@
 # non-gating smoke is skipped, so a machine that wants only the 870 s
 # gating wrapper runs exactly it.
 cd "$(dirname "$0")/.." || exit 1
+echo "== dsodlint: AST invariant lint — traced-purity / lock-discipline / env + metrics coherence / accounting seams (GATING; pure-CPU, runs under DSOD_T1_FAST too) =="
+timeout -k 10 120 python tools/dsodlint.py --fail-on-new
+dsodlint_rc=$?
+if [ "$dsodlint_rc" -ne 0 ]; then
+  echo "dsodlint FAILED (rc=$dsodlint_rc) — fix the finding, add a reasoned pragma, or (for an INTENDED new invariant surface) --update-baseline; see docs/STATIC_ANALYSIS.md"
+fi
 if [ -n "${DSOD_T1_FAST:-}" ]; then
   echo "== DSOD_T1_FAST set: skipping all non-gating smokes =="
 else
@@ -59,4 +65,4 @@ echo "== fleet chaos: SIGKILL a replica under open-loop load — zero lost respo
 timeout -k 10 540 env JAX_PLATFORMS=cpu python tools/fleet_chaos.py \
   || echo "fleet chaos failed (non-gating; tests/test_failover.py + tests/test_serve_chaos.py + tests/test_flightrecorder.py below gate the in-process side)"
 fi
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); if [ "$dsodlint_rc" -ne 0 ]; then echo "t1: FAILING on dsodlint rc=$dsodlint_rc (gating leg)"; exit "$dsodlint_rc"; fi; exit $rc
