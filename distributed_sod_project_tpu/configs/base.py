@@ -554,6 +554,90 @@ class FleetConfig:
     recorder_bundle_window_s: float = 300.0
     recorder_debounce_s: float = 30.0
 
+    # -- closed-loop fleet controller (serve/controller.py;
+    #    docs/SERVING.md "Fleet control plane") -----------------------
+    # False (default): no controller thread, no dsod_ctrl_* families —
+    # /metrics stays byte-identical.  True: a sensor-driven control
+    # loop heals dead replicas, scales the fleet out on queue-bound SLO
+    # burn (and REFUSES, recording why, when the stage-share
+    # attribution says the bottleneck is host- or device-side — more
+    # replicas on the same device would not help), and scales in with
+    # drain-then-retire, never killing in-flight work.
+    controller: bool = False
+    # Seconds between controller policy evaluations (one tick).
+    ctrl_interval_s: float = 5.0
+    # Healing/scaling floor per replica set; 0 = the group's configured
+    # member count (heal back to what the config promised).
+    ctrl_target_replicas: int = 0
+    # Scale-out ceiling per replica set (supervised members included).
+    ctrl_max_replicas: int = 4
+    # Scale-out trigger: SLO burn at or past this rate...
+    ctrl_scale_out_burn: float = 2.0
+    # ...AND the replicas' queue stage share at or past this fraction
+    # (queue-bound — the one bottleneck another replica absorbs).
+    ctrl_queue_share: float = 0.5
+    # Scale-in trigger: burn at or below this rate while the set holds
+    # more members than the target.
+    ctrl_scale_in_burn: float = 0.1
+    # Hysteresis: a trigger must hold this long before the controller
+    # acts (fake-clock-provable, the degraded-ladder dwell idiom)...
+    ctrl_dwell_s: float = 10.0
+    # ...and after any scale action the policy holds off this long.
+    ctrl_cooldown_s: float = 30.0
+    # Drain-then-retire grace: a draining replica leaves routing
+    # immediately; its process is retired (SIGTERM first — the
+    # replica's own clean drain) only after this many seconds.
+    ctrl_drain_grace_s: float = 5.0
+    # Replica spawn argv template for scale-out/heal, with ``{port}``
+    # and ``{port_file}`` placeholders (e.g. the tools/serve.py
+    # single-engine command line).  Empty = the controller can
+    # drain/retire and refuse, but never spawn.
+    ctrl_spawn_cmd: Tuple[str, ...] = ()
+    # Seconds a spawned replica gets to bind its port and turn healthy
+    # before the supervisor books the attempt as a crash-loop failure.
+    ctrl_spawn_deadline_s: float = 150.0
+    # Crash-loop backoff between supervised spawn attempts (base,
+    # doubled per consecutive failure, capped).
+    ctrl_backoff_s: float = 2.0
+    ctrl_backoff_max_s: float = 60.0
+    # True: arm a PreemptionGuard (utils/observability.py) inside the
+    # controller — a SIGTERM-style preemption notice drains supervised
+    # replicas instead of letting them die with work in flight, and
+    # scale-out is refused while the notice stands.
+    ctrl_spot_guard: bool = False
+
+    # -- progressive checkpoint delivery (serve/rollout.py;
+    #    docs/SERVING.md "Fleet control plane") -----------------------
+    # Non-empty: watch this checkpoint directory and deliver new steps
+    # progressively — canary ONE replica, score it, then promote
+    # fleet-wide or auto-roll-back and denylist the step — instead of
+    # every replica hot-reloading at once.  Empty (default): off,
+    # byte-identical /metrics.
+    rollout_ckpt_dir: str = ""
+    # Replica set the rollout drives (default: the fleet's single
+    # model; required when the fleet serves several).
+    rollout_model: str = ""
+    # Seconds between checkpoint-directory polls / state-machine ticks.
+    rollout_poll_s: float = 5.0
+    # Seconds the canary bakes on live + probe traffic before the
+    # verdict is taken.
+    rollout_bake_s: float = 10.0
+    # Ground-truth canary probes per verdict (serve/prober.py probe
+    # set), sent DIRECTLY to the canary replica and to a stable
+    # baseline replica for the relative comparison.
+    rollout_probes: int = 6
+    rollout_probe_px: int = 64
+    # Verdict fails when canary probe MAE exceeds the baseline
+    # replica's by more than this...
+    rollout_mae_degrade: float = 0.1
+    # ...or exceeds this absolute ceiling (0 = no absolute ceiling)...
+    rollout_mae_max: float = 0.0
+    # ...or the canary's drift PSI (serve/quality.py, when the quality
+    # monitors are armed) exceeds this (0 = PSI not consulted)...
+    rollout_psi_max: float = 0.0
+    # ...or fewer than this fraction of canary probes answered.
+    rollout_min_avail: float = 1.0
+
 
 def fleet_config_from_dict(d: Dict) -> FleetConfig:
     """Build + validate a FleetConfig from its JSON dict (the
@@ -588,6 +672,8 @@ def fleet_config_from_dict(d: Dict) -> FleetConfig:
     unknown = set(d) - known
     if unknown:
         raise ValueError(f"unknown fleet config key(s) {sorted(unknown)}")
+    if "ctrl_spawn_cmd" in d:
+        d["ctrl_spawn_cmd"] = tuple(d["ctrl_spawn_cmd"])
     fc = FleetConfig(models=tuple(models), tenants=tuple(tenants), **d)
     return validate_fleet_config(fc)
 
@@ -699,6 +785,76 @@ def validate_fleet_config(fc: FleetConfig) -> FleetConfig:
             raise ValueError(
                 f"fleet recorder_sample_s must be > 0, got "
                 f"{fc.recorder_sample_s}")
+    if fc.controller:
+        if fc.ctrl_interval_s <= 0:
+            raise ValueError(
+                f"fleet ctrl_interval_s must be > 0, got "
+                f"{fc.ctrl_interval_s}")
+        if fc.ctrl_target_replicas < 0:
+            raise ValueError(
+                f"fleet ctrl_target_replicas must be >= 0 (0 = the "
+                f"group's configured size), got {fc.ctrl_target_replicas}")
+        if fc.ctrl_max_replicas < 1:
+            raise ValueError(
+                f"fleet ctrl_max_replicas must be >= 1, got "
+                f"{fc.ctrl_max_replicas}")
+        if fc.ctrl_scale_out_burn <= 0 or fc.ctrl_scale_in_burn < 0:
+            raise ValueError(
+                "fleet ctrl_scale_out_burn must be > 0 and "
+                "ctrl_scale_in_burn >= 0, got "
+                f"{fc.ctrl_scale_out_burn}/{fc.ctrl_scale_in_burn}")
+        if not 0.0 <= fc.ctrl_queue_share <= 1.0:
+            raise ValueError(
+                f"fleet ctrl_queue_share must be in [0, 1], got "
+                f"{fc.ctrl_queue_share}")
+        if fc.ctrl_dwell_s < 0 or fc.ctrl_cooldown_s < 0 \
+                or fc.ctrl_drain_grace_s < 0:
+            raise ValueError(
+                "fleet ctrl_dwell_s/ctrl_cooldown_s/ctrl_drain_grace_s "
+                "must be >= 0")
+        if fc.ctrl_spawn_cmd:
+            joined = " ".join(fc.ctrl_spawn_cmd)
+            if "{port}" not in joined or "{port_file}" not in joined:
+                raise ValueError(
+                    "fleet ctrl_spawn_cmd must contain both {port} and "
+                    "{port_file} placeholders (the supervisor needs to "
+                    "assign the port and learn when the replica bound "
+                    "it) — got " + repr(fc.ctrl_spawn_cmd))
+        if fc.ctrl_spawn_deadline_s <= 0 or fc.ctrl_backoff_s <= 0 \
+                or fc.ctrl_backoff_max_s < fc.ctrl_backoff_s:
+            raise ValueError(
+                "fleet ctrl_spawn_deadline_s/ctrl_backoff_s must be > 0 "
+                "and ctrl_backoff_max_s >= ctrl_backoff_s, got "
+                f"{fc.ctrl_spawn_deadline_s}/{fc.ctrl_backoff_s}/"
+                f"{fc.ctrl_backoff_max_s}")
+    if fc.rollout_ckpt_dir:
+        if fc.rollout_model:
+            if fc.rollout_model not in seen:
+                raise ValueError(
+                    f"fleet rollout_model {fc.rollout_model!r} is not a "
+                    f"configured model (have {sorted(seen)})")
+        elif len(fc.models) != 1:
+            raise ValueError(
+                "fleet rollout_model is required when the fleet serves "
+                "more than one model (the rollout drives ONE replica "
+                "set)")
+        if fc.rollout_poll_s <= 0 or fc.rollout_bake_s < 0:
+            raise ValueError(
+                "fleet rollout_poll_s must be > 0 and rollout_bake_s "
+                f">= 0, got {fc.rollout_poll_s}/{fc.rollout_bake_s}")
+        if fc.rollout_probes < 1 or fc.rollout_probe_px < 8:
+            raise ValueError(
+                "fleet rollout_probes must be >= 1 and rollout_probe_px "
+                f">= 8, got {fc.rollout_probes}/{fc.rollout_probe_px}")
+        if fc.rollout_mae_degrade < 0 or fc.rollout_mae_max < 0 \
+                or fc.rollout_psi_max < 0:
+            raise ValueError(
+                "fleet rollout_mae_degrade/rollout_mae_max/"
+                "rollout_psi_max must be >= 0")
+        if not 0.0 <= fc.rollout_min_avail <= 1.0:
+            raise ValueError(
+                f"fleet rollout_min_avail must be in [0, 1], got "
+                f"{fc.rollout_min_avail}")
     if fc.default_tenant not in tseen:
         low = min((t.priority for t in fc.tenants), default=0)
         fc = dataclasses.replace(

@@ -1,0 +1,772 @@
+"""Closed-loop fleet controller — sensor-driven autoscaling with a
+spot-aware replica lifecycle (docs/SERVING.md "Fleet control plane").
+
+Five observability PRs built the sensors: the router's exact terminal
+book, SLO burn rates, the capacity ledger's stage-share attribution
+(queue vs host vs device), per-replica health/breaker gauges, and the
+flight recorder.  This module is the ACTUATOR that consumes them:
+
+- **Heal.**  A replica set below its target count (a member SIGKILLed,
+  its process crash-looped) gets a new supervised subprocess — spawned
+  from ``ctrl_spawn_cmd``, crash-loop backoff per set, admitted into
+  the router's :class:`~.fleet.ReplicaSet` only after its /healthz
+  answers (breaker/health-gated admission: a corpse never enters
+  routing).
+- **Scale out — but only when it would help.**  SLO burn at or past
+  ``ctrl_scale_out_burn`` AND the replicas' queue stage share at or
+  past ``ctrl_queue_share`` (queue-bound: another replica absorbs the
+  backlog) spawns a member, dwell-gated (``ctrl_dwell_s``) with a
+  post-action cooldown — the degraded ladder's fake-clock-provable
+  hysteresis idiom.  Burn WITHOUT queue share means the bottleneck is
+  host- or device-side; the controller REFUSES and records which,
+  because a second replica on the same device just splits the same
+  roofline.
+- **Scale in / preemption: drain, never kill.**  Scale-in (and a spot
+  preemption notice, via :class:`~..utils.observability.
+  PreemptionGuard` or :meth:`FleetController.notify_preemption`) flips
+  the victim to DRAINING — out of routing immediately, in-flight work
+  completes — and only after ``ctrl_drain_grace_s`` is the process
+  retired (SIGTERM first: the replica's own clean drain).
+
+Every decision — spawn, restart, refusal (with why), drain, retire —
+is booked through :meth:`FleetController._record`, THE controller
+accounting seam (tools/dsodlint.py ``BOOKING_SEAMS``): one typed
+flight-recorder event plus one ``dsod_ctrl_decisions_total`` sample
+per decision.  All of it is off by default (``controller=false``) and
+/metrics stays byte-identical while it is.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+
+
+class CtrlStats:
+    """Thread-safe controller telemetry: decision counters keyed
+    ``(action, reason)``, per-model restart counters, a supervised-
+    replica state gauge.  Rendered into the router's /metrics by
+    ``Fleet._router_families`` while the controller is armed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._decisions: Dict[Tuple[str, str], int] = {}
+        self._restarts: Dict[str, int] = {}
+        self._supervised: Dict[Tuple[str, str], int] = {}
+
+    def inc_decision(self, action: str, reason: str = "") -> None:
+        with self._lock:
+            k = (action, reason)
+            self._decisions[k] = self._decisions.get(k, 0) + 1
+
+    def inc_restart(self, model: str) -> None:
+        with self._lock:
+            self._restarts[model] = self._restarts.get(model, 0) + 1
+
+    def set_supervised(self, model: str, state: str, n: int) -> None:
+        """Gauge: supervised replicas of ``model`` in ``state``
+        (``running`` / ``draining``)."""
+        with self._lock:
+            self._supervised[(model, state)] = int(n)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "decisions": {f"{a}:{r}" if r else a: n for (a, r), n
+                              in sorted(self._decisions.items())},
+                "restarts": dict(sorted(self._restarts.items())),
+                # "supervised_gauge", not "supervised": the
+                # controller's own snapshot() reserves "supervised"
+                # for the rid → url map (which processes we own and
+                # where) and merges this dict over its own keys.
+                "supervised_gauge": {f"{m}:{s}": n for (m, s), n
+                                     in sorted(self._supervised.items())},
+            }
+
+    def prom_families(self):
+        """``dsod_ctrl_*`` families (counters only once non-empty —
+        the RouterStats conditional-render idiom; the supervised gauge
+        always while armed so a scrape can tell "armed, zero
+        supervised" from "off")."""
+        with self._lock:
+            dec = sorted(self._decisions.items())
+            res = sorted(self._restarts.items())
+            sup = sorted(self._supervised.items())
+        fams = []
+        if dec:
+            fams.append((
+                "dsod_ctrl_decisions_total", "counter",
+                ['dsod_ctrl_decisions_total{action="%s",reason="%s"} %d'
+                 % (a, r, n) for (a, r), n in dec]))
+        if res:
+            fams.append((
+                "dsod_ctrl_restarts_total", "counter",
+                ['dsod_ctrl_restarts_total{model="%s"} %d' % (m, n)
+                 for m, n in res]))
+        fams.append((
+            "dsod_ctrl_supervised_replicas", "gauge",
+            ['dsod_ctrl_supervised_replicas{model="%s",state="%s"} %d'
+             % (m, s, n) for (m, s), n in sup]))
+        return fams
+
+
+class SupervisedReplica:
+    """One subprocess the supervisor owns: its process handle, bound
+    port, and base URL.  ``backend`` is a test seam — a fake
+    supervisor pre-wires the backend so fake-clock tests never touch
+    HTTP."""
+
+    __slots__ = ("model", "port", "url", "proc", "port_file", "backend")
+
+    def __init__(self, model: str, port: int, url: str, proc,
+                 port_file: str, backend=None):
+        self.model = model
+        self.port = port
+        self.url = url
+        self.proc = proc
+        self.port_file = port_file
+        self.backend = backend
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+class ReplicaSupervisor:
+    """Spawns and retires real replica subprocesses from an argv
+    template with ``{port}``/``{port_file}`` placeholders — the
+    tools/fleet_chaos.py harness pattern, generalized and owned by the
+    control plane.
+
+    Crash-loop discipline per model: consecutive spawn failures double
+    a backoff (``backoff_s`` → ``backoff_max_s``) the controller must
+    wait out (:meth:`can_spawn`) before the next attempt — a replica
+    that dies on arrival must not be respawned in a hot loop.  The
+    backoff clock is injectable, so the discipline is fake-clock
+    provable; the spawn itself (process + port-file wait) uses real
+    time because it IS real.
+    """
+
+    def __init__(self, spawn_cmd, *, deadline_s: float = 150.0,
+                 backoff_s: float = 2.0, backoff_max_s: float = 60.0,
+                 clock=time.monotonic):
+        self.spawn_cmd = tuple(spawn_cmd)
+        if self.spawn_cmd:
+            joined = " ".join(self.spawn_cmd)
+            if "{port}" not in joined or "{port_file}" not in joined:
+                raise ValueError(
+                    "spawn_cmd needs {port} and {port_file} "
+                    f"placeholders, got {self.spawn_cmd!r}")
+        self.deadline_s = float(deadline_s)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._fails: Dict[str, int] = {}
+        self._next_ok: Dict[str, float] = {}
+        self._procs: Dict[str, SupervisedReplica] = {}
+        # Spawns that launched but have not bound yet.  stop() must see
+        # them: a replica can take tens of seconds to warm and publish
+        # its port, and a controller torn down inside that window would
+        # otherwise orphan a process that is in no one's books.
+        self._inflight: List[subprocess.Popen] = []
+        self._closing = threading.Event()
+        self._log = get_logger()
+
+    def can_spawn(self, model: str) -> bool:
+        """False while ``model`` is inside its crash-loop backoff."""
+        if not self.spawn_cmd or self._closing.is_set():
+            return False
+        with self._lock:
+            return self._clock() >= self._next_ok.get(model, 0.0)
+
+    def backoff_remaining(self, model: str) -> float:
+        with self._lock:
+            return max(0.0, self._next_ok.get(model, 0.0) - self._clock())
+
+    def _book_failure(self, model: str) -> None:
+        with self._lock:
+            fails = self._fails.get(model, 0) + 1
+            self._fails[model] = fails
+            delay = min(self.backoff_s * (2.0 ** (fails - 1)),
+                        self.backoff_max_s)
+            self._next_ok[model] = self._clock() + delay
+
+    def spawn(self, model: str) -> Optional[SupervisedReplica]:
+        """Spawn one replica subprocess and wait for it to publish its
+        port.  Returns None (with the backoff booked) when the process
+        dies or misses the deadline — the caller records the decision;
+        this owns only the lifecycle."""
+        port = _free_port()
+        fd, port_file = tempfile.mkstemp(prefix=f"ctrl-{model}-",
+                                         suffix=".port")
+        os.close(fd)
+        os.unlink(port_file)  # the replica's atomic publish creates it
+        cmd = [a.replace("{port}", str(port))
+                .replace("{port_file}", port_file)
+               for a in self.spawn_cmd]
+        try:
+            proc = subprocess.Popen(
+                cmd, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True)
+        except OSError as e:
+            self._log.error("supervisor: spawn failed for %s: %s",
+                            model, e)
+            self._book_failure(model)
+            return None
+        with self._lock:
+            self._inflight.append(proc)
+        deadline = time.monotonic() + self.deadline_s
+        bound: Optional[int] = None
+        while time.monotonic() < deadline and not self._closing.is_set():
+            if proc.poll() is not None:
+                break  # died before binding
+            try:
+                with open(port_file) as f:
+                    bound = int(f.read().strip())
+                break
+            except (OSError, ValueError):
+                time.sleep(0.1)
+        with self._lock:
+            if proc in self._inflight:
+                self._inflight.remove(proc)
+        if self._closing.is_set():
+            # Shutdown mid-spawn: not the step's fault, no backoff —
+            # just make sure nothing outlives the supervisor.
+            self._kill(proc)
+            return None
+        if bound is None:
+            self._log.error(
+                "supervisor: replica for %s never published its port "
+                "(rc=%s)", model, proc.poll())
+            self._kill(proc)
+            self._book_failure(model)
+            return None
+        with self._lock:
+            self._fails[model] = 0
+        rep = SupervisedReplica(model, bound,
+                                f"http://127.0.0.1:{bound}", proc,
+                                port_file)
+        return rep
+
+    def adopt(self, rid: str, rep: SupervisedReplica) -> None:
+        """Track an admitted replica under its fleet replica id."""
+        if self._closing.is_set():
+            # stop() already swept _procs; a late adopt would escape
+            # the sweep.  Kill instead of track.
+            if rep.proc is not None:
+                self._kill(rep.proc)
+            return
+        with self._lock:
+            self._procs[rid] = rep
+
+    def owns(self, rid: str) -> bool:
+        with self._lock:
+            return rid in self._procs
+
+    def owned(self) -> Dict[str, SupervisedReplica]:
+        with self._lock:
+            return dict(self._procs)
+
+    def poll(self) -> List[str]:
+        """Reap exited supervised replicas; returns their rids (the
+        controller detaches them from routing and heals)."""
+        dead = []
+        with self._lock:
+            for rid, rep in list(self._procs.items()):
+                if rep.proc is not None and rep.proc.poll() is not None:
+                    dead.append(rid)
+                    del self._procs[rid]
+        return dead
+
+    def retire(self, rid: str, grace_s: float = 10.0) -> None:
+        """SIGTERM (the replica's own clean drain) → wait → SIGKILL."""
+        with self._lock:
+            rep = self._procs.pop(rid, None)
+        if rep is None or rep.proc is None:
+            return
+        self._kill(rep.proc, grace_s=grace_s)
+
+    def stop(self, grace_s: float = 10.0) -> None:
+        self._closing.set()  # wakes in-flight spawn waits
+        with self._lock:
+            procs, self._procs = self._procs, {}
+            inflight, self._inflight = self._inflight, []
+        for rep in procs.values():
+            if rep.proc is not None:
+                self._kill(rep.proc, grace_s=grace_s)
+        for proc in inflight:
+            self._kill(proc, grace_s=grace_s)
+
+    @staticmethod
+    def _kill(proc, grace_s: float = 5.0) -> None:
+        try:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+        except OSError:
+            pass
+
+
+def default_spawn_cmd(config: str,
+                      extra: Tuple[str, ...] = ()) -> Tuple[str, ...]:
+    """The tools/serve.py single-engine argv for supervised replicas
+    (what tools/fleet_chaos.py arms the controller with).  The model
+    identity comes from ``config``; the fleet group a spawned replica
+    joins is the controller's business, not the argv's."""
+    tools = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tools", "serve.py")
+    return (sys.executable, tools, "--config", config, "--init-random",
+            "--device", "cpu", "--port", "{port}",
+            "--port-file", "{port_file}") + tuple(extra)
+
+
+class FleetController:
+    """The policy loop.  One background thread ticks every
+    ``ctrl_interval_s``; every shared-state mutation happens under
+    ``_lock`` (the tick thread, :meth:`notify_preemption` from a
+    signal path, and the HTTP stats reader all touch it).
+
+    Injectable seams, all for fake-clock provability
+    (tests/test_controller.py): ``clock`` drives dwell/cooldown/
+    backoff; ``signals_fn(name, group) -> (burn, stage_shares)``
+    replaces the live SLO/stats scrape; ``supervisor`` replaces real
+    subprocess spawning; ``guard`` replaces the real
+    :class:`PreemptionGuard` (whose SIGTERM handler would collide with
+    the serving CLI's own drain handler — the controller only ever
+    POLLS ``guard.should_stop``, so any object with that attribute
+    works)."""
+
+    def __init__(self, fleet, cfg=None, *, supervisor=None,
+                 clock=time.monotonic, guard=None, signals_fn=None):
+        cfg = cfg if cfg is not None else fleet.cfg
+        self.fleet = fleet
+        self.cfg = cfg
+        self._clock = clock
+        self.stats = CtrlStats()
+        self.supervisor = supervisor
+        if self.supervisor is None and cfg.ctrl_spawn_cmd:
+            self.supervisor = ReplicaSupervisor(
+                cfg.ctrl_spawn_cmd,
+                deadline_s=cfg.ctrl_spawn_deadline_s,
+                backoff_s=cfg.ctrl_backoff_s,
+                backoff_max_s=cfg.ctrl_backoff_max_s, clock=clock)
+        self.guard = guard
+        self._own_guard = None
+        self._signals_fn = signals_fn or self._live_signals
+        self._lock = threading.RLock()
+        # Per-group policy state (all clock-stamped: dwell/cooldown are
+        # provable with an injected clock).
+        self._initial: Dict[str, int] = {
+            name: len(g) for name, g in fleet.groups.items()}
+        self._pending: Dict[str, Tuple[str, float]] = {}
+        self._cooldown_until: Dict[str, float] = {}
+        self._refused_until: Dict[str, float] = {}
+        # rid → (group, retire-at, supervised?)
+        self._draining: Dict[str, Tuple[str, float, bool]] = {}
+        self._preempted = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._log = get_logger()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "FleetController":
+        if self._thread is not None:
+            return self
+        if self.guard is None and self.cfg.ctrl_spot_guard:
+            from ..utils.observability import PreemptionGuard
+
+            self._own_guard = PreemptionGuard()
+            self._own_guard.__enter__()
+            self.guard = self._own_guard
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-controller", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        if self.supervisor is not None:
+            # Supervised replicas die with their controller — they are
+            # scale-out capacity, not config members, and an orphaned
+            # subprocess outliving the fleet is a leak.
+            self.supervisor.stop(grace_s=self.cfg.ctrl_drain_grace_s)
+        if self._own_guard is not None:
+            self._own_guard.__exit__(None, None, None)
+            if self.guard is self._own_guard:
+                self.guard = None
+            self._own_guard = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.ctrl_interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                self._log.exception(
+                    "controller: tick failed; retrying next interval")
+
+    # -- external notifications ---------------------------------------
+
+    def notify_preemption(self, rid: Optional[str] = None) -> None:
+        """A spot/maintenance notice landed: drain ``rid`` (or every
+        supervised replica when None) out of routing now, retire after
+        the grace — and refuse scale-out while the notice stands."""
+        with self._lock:
+            self._preempted = True
+            if rid is not None:
+                self._begin_drain(rid, reason="preemption")
+                return
+            if self.supervisor is not None:
+                for srid in self.supervisor.owned():
+                    self._begin_drain(srid, reason="preemption")
+
+    # -- booking seam --------------------------------------------------
+
+    def _record(self, action: str, reason: str = "", *,
+                model: str = "", **attrs) -> None:
+        """THE controller booking seam (tools/dsodlint.py
+        ``BOOKING_SEAMS``): every decision increments its counter here
+        and leaves a typed flight-recorder event."""
+        self.stats.inc_decision(action, reason)
+        if action == "restart":
+            self.stats.inc_restart(model)
+        rec = self.fleet.recorder
+        if rec is not None:
+            kw = dict(attrs)
+            if reason:
+                kw["reason"] = reason
+            if model:
+                kw["model"] = model
+            rec.event("ctrl_" + action, **kw)
+
+    # -- sensors -------------------------------------------------------
+
+    def _live_signals(self, name: str, group
+                      ) -> Tuple[float, Dict[str, float]]:
+        """(worst SLO burn over the group's objectives, mean stage
+        shares over reporting members).  Remote /stats scrapes are
+        bounded by PROBE_TIMEOUT_S and skipped for known-down
+        replicas — a tick can cost a couple of dials, never a hang."""
+        burn = 0.0
+        if self.fleet.slo is not None:
+            for key, v in self.fleet.slo.signals().items():
+                if key.startswith("slo_burn:"):
+                    burn = max(burn, float(v))
+        shares: Dict[str, List[float]] = {}
+        for _rid, b in group.members:
+            try:
+                snap = b.stats_snapshot()
+            except Exception:  # noqa: BLE001 — a corpse reports nothing
+                continue
+            ss = (snap.get("capacity") or {}).get("stage_share") or {}
+            for k, v in ss.items():
+                if isinstance(v, (int, float)):
+                    shares.setdefault(k, []).append(float(v))
+        mean = {k: sum(v) / len(v) for k, v in shares.items() if v}
+        return burn, mean
+
+    # -- the policy tick ----------------------------------------------
+
+    def tick(self) -> None:
+        """One policy evaluation over every replica set.  Order
+        matters: reap exited supervised processes first (their group
+        counts must reflect reality), finish due drains, then
+        heal/scale each group."""
+        now = self._clock()
+        if (self.guard is not None
+                and getattr(self.guard, "should_stop", False)):
+            with self._lock:
+                already = self._preempted
+            if not already:
+                self._record("preemption_notice")
+                self.notify_preemption()
+        if self.supervisor is not None:
+            for rid in self.supervisor.poll():
+                self._record("replica_exit", model=self._group_of(rid),
+                             replica=rid)
+                self._forget_drain(rid)
+                self.fleet.detach_replica(rid)
+        self._finish_due_drains(now)
+        for name, group in list(self.fleet.groups.items()):
+            try:
+                self._tick_group(name, group, now)
+            except Exception:  # noqa: BLE001 — one group's fault
+                self._log.exception(
+                    "controller: policy failed for group %s", name)
+        self._publish_supervised_gauge()
+
+    def _tick_group(self, name: str, group, now: float) -> None:
+        cfg = self.cfg
+        target = cfg.ctrl_target_replicas or self._initial.get(name, 1)
+        with self._lock:
+            draining = {rid for rid, (g, _t, _s)
+                        in self._draining.items() if g == name}
+            preempted = self._preempted
+        members = [(rid, b) for rid, b in group.members
+                   if rid not in draining]
+        healthy = sum(1 for _rid, b in members if b.healthy())
+        # Heal first, dwell-free: a dead replica is not a trend to be
+        # smoothed, it is a hole in the fleet.
+        if healthy < target:
+            self._heal(name, now, healthy=healthy, target=target,
+                       preempted=preempted)
+            return
+        burn, shares = self._signals_fn(name, group)
+        queue_share = shares.get("queue", 0.0)
+        if burn >= cfg.ctrl_scale_out_burn:
+            if queue_share >= cfg.ctrl_queue_share:
+                if len(members) >= cfg.ctrl_max_replicas:
+                    self._refuse(name, now, "at_max_replicas",
+                                 burn=round(burn, 3))
+                elif preempted:
+                    self._refuse(name, now, "preempted",
+                                 burn=round(burn, 3))
+                else:
+                    self._act_after_dwell(
+                        name, "scale_out", now,
+                        lambda: self._heal(
+                            name, now, healthy=healthy, target=target,
+                            preempted=preempted, reason="scale_out",
+                            burn=burn))
+            else:
+                # Burn without queue depth: the bottleneck is wherever
+                # the largest non-queue share sits — another replica
+                # on the same device would not absorb it.
+                host = shares.get("host", 0.0)
+                device = shares.get("device", 0.0)
+                why = "host_bound" if host >= device else "device_bound"
+                self._refuse(name, now, why, burn=round(burn, 3),
+                             queue_share=round(queue_share, 3))
+            return
+        self._clear_pending(name, "scale_out")
+        if burn <= cfg.ctrl_scale_in_burn and len(members) > target:
+            self._act_after_dwell(
+                name, "scale_in", now,
+                lambda: self._scale_in(name, group, now, burn))
+        else:
+            self._clear_pending(name, "scale_in")
+
+    # -- actions -------------------------------------------------------
+
+    def _heal(self, name: str, now: float, *, healthy: int,
+              target: int, preempted: bool, reason: str = "heal",
+              burn: float = 0.0) -> None:
+        if preempted:
+            self._refuse(name, now, "preempted", model=name)
+            return
+        if self.supervisor is None or not self.supervisor.spawn_cmd:
+            self._refuse(name, now, "no_spawn_cmd", model=name)
+            return
+        if not self.supervisor.can_spawn(name):
+            self._refuse(
+                name, now, "backoff", model=name,
+                retry_in_s=round(
+                    self.supervisor.backoff_remaining(name), 3))
+            return
+        self._record("spawn", reason, model=name, healthy=healthy,
+                     target=target, burn=round(burn, 3))
+        with self._lock:
+            self._cooldown_until[name] = now + self.cfg.ctrl_cooldown_s
+        rep = self.supervisor.spawn(name)
+        if rep is None:
+            self._record("spawn_failed", reason, model=name)
+            return
+        backend = rep.backend
+        if backend is None:
+            backend = self._admit_remote(name, rep)
+        if backend is None:
+            self._kill_spawned(rep)
+            self._record("spawn_failed", "never_healthy", model=name)
+            return
+        rid = self.fleet.attach_replica(name, backend)
+        self.supervisor.adopt(rid, rep)
+        self._record("restart" if reason == "heal" else "scale_out",
+                     reason, model=name, replica=rid, url=rep.url)
+
+    @staticmethod
+    def _kill_spawned(rep) -> None:
+        try:
+            if rep.proc is not None:
+                ReplicaSupervisor._kill(rep.proc)
+        except Exception:  # noqa: BLE001 — cleanup best-effort
+            pass
+
+    def _admit_remote(self, name: str, rep):
+        """Health-gated admission: the spawned replica enters routing
+        only once its /healthz actually answers (within the spawn
+        deadline's budget) — the breaker then guards it like any other
+        member."""
+        from .fleet import RemoteBackend
+
+        backend = RemoteBackend(
+            name, rep.url, timeout_s=self.cfg.request_timeout_s,
+            health_poll_s=self.cfg.health_poll_s)
+        deadline = time.monotonic() + self.cfg.ctrl_spawn_deadline_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            if backend.probe_now():
+                backend.start()
+                return backend
+            time.sleep(0.25)
+        return None
+
+    def _scale_in(self, name: str, group, now: float,
+                  burn: float) -> None:
+        victim = None
+        if self.supervisor is not None:
+            owned = self.supervisor.owned()
+            # Newest supervised member drains first (LIFO): config-
+            # declared replicas are never the controller's to retire.
+            for rid, _b in reversed(group.members):
+                if rid in owned:
+                    victim = rid
+                    break
+        if victim is None:
+            self._refuse(name, now, "no_supervised_member",
+                         burn=round(burn, 3))
+            return
+        with self._lock:
+            self._cooldown_until[name] = now + self.cfg.ctrl_cooldown_s
+            self._begin_drain(victim, reason="scale_in")
+
+    def _begin_drain(self, rid: str, *, reason: str) -> None:
+        """Flip ``rid`` out of routing NOW; schedule the retire for
+        after the grace (``_lock`` is reentrant — callers may already
+        hold it)."""
+        with self._lock:
+            if rid in self._draining:
+                return
+            name = self._group_of(rid)
+            group = self.fleet.groups.get(name)
+            if group is None:
+                return
+            supervised = (self.supervisor is not None
+                          and self.supervisor.owns(rid))
+            group.set_draining(rid, True)
+            self._draining[rid] = (
+                name, self._clock() + self.cfg.ctrl_drain_grace_s,
+                supervised)
+        self._record("drain", reason, model=name, replica=rid,
+                     grace_s=self.cfg.ctrl_drain_grace_s)
+
+    def _finish_due_drains(self, now: float) -> None:
+        with self._lock:
+            due = [(rid, g, sup) for rid, (g, t, sup)
+                   in self._draining.items() if now >= t]
+            for rid, _g, _sup in due:
+                del self._draining[rid]
+        for rid, name, supervised in due:
+            if supervised and self.supervisor is not None:
+                self.supervisor.retire(
+                    rid, grace_s=self.cfg.ctrl_drain_grace_s)
+            self.fleet.detach_replica(rid)
+            self._record("retire", model=name, replica=rid,
+                         supervised=supervised)
+
+    def _forget_drain(self, rid: str) -> None:
+        with self._lock:
+            entry = self._draining.pop(rid, None)
+        if entry is not None:
+            group = self.fleet.groups.get(entry[0])
+            if group is not None:
+                group.set_draining(rid, False)
+
+    # -- hysteresis helpers -------------------------------------------
+
+    def _act_after_dwell(self, name: str, action: str, now: float,
+                         act) -> None:
+        with self._lock:
+            if now < self._cooldown_until.get(name, 0.0):
+                return
+            pending = self._pending.get(name)
+            if pending is None or pending[0] != action:
+                self._pending[name] = (action, now)
+                return
+            if now - pending[1] < self.cfg.ctrl_dwell_s:
+                return
+            del self._pending[name]
+        act()
+
+    def _clear_pending(self, name: str, action: str) -> None:
+        with self._lock:
+            if self._pending.get(name, ("", 0.0))[0] == action:
+                del self._pending[name]
+
+    def _refuse(self, name: str, now: float, why: str,
+                **attrs) -> None:
+        """Record a refusal (refusals are decisions too — 'we saw the
+        burn and did NOT scale, because X' is the half of the story
+        operators page on) — debounced to once per cooldown window so
+        a sustained bottleneck is one event, not one per tick."""
+        with self._lock:
+            if now < self._refused_until.get(name, 0.0):
+                return
+            self._refused_until[name] = now + self.cfg.ctrl_cooldown_s
+        attrs.setdefault("model", name)
+        self._record("refuse_scale_out", why, **attrs)
+
+    # -- misc ----------------------------------------------------------
+
+    def _group_of(self, rid: str) -> str:
+        for name, g in self.fleet.groups.items():
+            if any(r == rid for r, _b in g.members):
+                return name
+        return rid.split("#", 1)[0]
+
+    def _publish_supervised_gauge(self) -> None:
+        counts: Dict[Tuple[str, str], int] = {}
+        if self.supervisor is not None:
+            with self._lock:
+                draining = set(self._draining)
+            for rid, rep in self.supervisor.owned().items():
+                state = "draining" if rid in draining else "running"
+                counts[(rep.model, state)] = \
+                    counts.get((rep.model, state), 0) + 1
+        for name in self.fleet.groups:
+            for state in ("running", "draining"):
+                self.stats.set_supervised(
+                    name, state, counts.get((name, state), 0))
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            out = {
+                "preempted": self._preempted,
+                "draining": sorted(self._draining),
+                "pending": {n: a for n, (a, _t)
+                            in self._pending.items()},
+                "targets": {
+                    n: (self.cfg.ctrl_target_replicas
+                        or self._initial.get(n, 1))
+                    for n in self.fleet.groups},
+            }
+        if self.supervisor is not None:
+            out["supervised"] = {
+                rid: rep.url for rid, rep
+                in sorted(self.supervisor.owned().items())}
+        out.update(self.stats.snapshot())
+        return out

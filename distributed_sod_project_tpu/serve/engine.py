@@ -974,6 +974,11 @@ class InferenceEngine:
         on — the quality snapshot and the active alerts (the full rule
         states live at /alerts)."""
         out = self.stats.snapshot()
+        if self._loaded_step is not None:
+            # Which checkpoint is actually serving — the rollout control
+            # plane (serve/rollout.py) reads this per replica to confirm
+            # a canary/promote landed where it was sent.
+            out["loaded_step"] = int(self._loaded_step)
         if self.quality is not None:
             out["quality"] = self.quality.snapshot()
         if self.alerts is not None:
@@ -1032,10 +1037,24 @@ class InferenceEngine:
             mgr.close()
 
     def _maybe_reload(self, mgr) -> None:
-        step = mgr.latest_step()  # newest VALID (integrity-gated)
+        # Newest VALID (integrity-gated) step that the rollout denylist
+        # (serve/rollout.py) has not pinned bad: a step that canaried
+        # badly and was rolled back must never be re-picked by the
+        # background poll, or the rollback would undo itself one poll
+        # later.
+        from .rollout import read_step_denylist
+
+        mgr.reload()  # steps (and denylist verdicts) land between scans
+        deny = read_step_denylist(self.ckpt_dir)
+        steps = [s for s in mgr.valid_steps() if s not in deny]
+        step = max(steps) if steps else None
         if step is None or step == self._loaded_step:
             return
-        mgr.reload()  # the step landed after the manager's last scan
+        self._reload_step(mgr, step)
+
+    def _reload_step(self, mgr, step: int) -> None:
+        """Restore ``step`` and swap it in (the shared tail of the
+        background poll and :meth:`reload_to`)."""
         state = mgr.restore(self._template, step)
         # Re-derive EVERY arm's weight view off-lock (cast + quantize
         # are the slow part), then swap the whole dict in one motion —
@@ -1049,3 +1068,34 @@ class InferenceEngine:
         if self.recorder is not None:
             self.recorder.event("hot_reload", step=int(step))
         self._log.info("serve: hot-reloaded weights from step %d", step)
+
+    def reload_to(self, step: int) -> int:
+        """Synchronously load checkpoint ``step`` — the rollout control
+        plane's targeted reload (serve/rollout.py drives ONE canary
+        replica to the candidate step, everyone else on promote).
+        Returns the loaded step; raises on a missing/invalid/denylisted
+        step or an engine without a checkpoint source."""
+        from ..ckpt import CheckpointManager
+
+        from .rollout import read_step_denylist
+
+        if not self.ckpt_dir or self._template is None:
+            raise RuntimeError(
+                "reload_to: engine has no checkpoint source (started "
+                "from random init without ckpt_dir)")
+        step = int(step)
+        if step in read_step_denylist(self.ckpt_dir):
+            raise ValueError(
+                f"reload_to: step {step} is denylisted (it canaried "
+                "badly and was rolled back)")
+        mgr = CheckpointManager(self.ckpt_dir, async_save=False)
+        try:
+            if step not in mgr.valid_steps():
+                raise ValueError(
+                    f"reload_to: step {step} is not a VALID checkpoint "
+                    f"in {self.ckpt_dir} (have {mgr.valid_steps()})")
+            if step != self._loaded_step:
+                self._reload_step(mgr, step)
+        finally:
+            mgr.close()
+        return step

@@ -233,6 +233,27 @@ class RemoteBackend:
             self._healthy = False
             self._reason = f"transport failure: {reason}"
 
+    def admin_reload(self, step: int, timeout_s: Optional[float] = None
+                     ) -> int:
+        """POST /admin/reload on the remote — the rollout control
+        plane's targeted reload (serve/rollout.py).  Returns the
+        loaded step; raises on transport failure or a non-200 answer
+        (the remote refuses denylisted/invalid steps with a 409)."""
+        body = json.dumps({"step": int(step)}).encode()
+        req = urllib.request.Request(
+            self.url + "/admin/reload", data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        timeout = self._timeout if timeout_s is None else float(timeout_s)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                payload = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:200]
+            raise RuntimeError(
+                f"{self.name}: /admin/reload {e.code}: {detail}")
+        return int(payload.get("step", step))
+
     def predict_raw(self, body: bytes, headers: Dict[str, str],
                     timeout_s: Optional[float] = None
                     ) -> Tuple[int, List[Tuple[str, str]], bytes]:
@@ -351,11 +372,72 @@ class ReplicaSet:
         self.breakers: Dict[str, CircuitBreaker] = {
             rid: breaker_factory() for rid, _ in members}
         self.tail = TailEstimator()  # router-observed e2e ms (hedging)
+        self._breaker_factory = breaker_factory
+        self._draining: Set[str] = set()
         self._rr = 0
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self.members)
+
+    # -- membership dynamics (serve/controller.py) ---------------------
+    # The members list is COPY-ON-WRITE: mutators build a NEW list and
+    # swap it under the lock, so the handler paths that iterate
+    # ``self.members`` without the lock (health, metrics, stats
+    # gathers) always see one consistent roster — old or new, never a
+    # list mutating under their feet.
+
+    def add_member(self, rid: str, backend,
+                   breaker: Optional[CircuitBreaker] = None) -> None:
+        """Admit a new replica into routing (the controller's scale-
+        out/heal admission — caller has already health-gated it)."""
+        with self._lock:
+            if any(r == rid for r, _b in self.members):
+                raise ValueError(
+                    f"replica set {self.name!r}: duplicate replica id "
+                    f"{rid!r}")
+            self.breakers = dict(self.breakers)
+            self.breakers[rid] = (breaker if breaker is not None
+                                  else self._breaker_factory())
+            self.members = self.members + [(rid, backend)]
+            self._draining.discard(rid)
+
+    def remove_member(self, rid: str):
+        """Drop a replica from routing; returns its backend (caller
+        owns the backend/process teardown) or None if unknown.  The
+        set may go EMPTY — pick()/healthy() answer None/False and the
+        controller heals it back."""
+        with self._lock:
+            backend = None
+            kept = []
+            for r, b in self.members:
+                if r == rid:
+                    backend = b
+                else:
+                    kept.append((r, b))
+            if backend is None:
+                return None
+            self.members = kept
+            self.breakers = {r: brk for r, brk in self.breakers.items()
+                             if r != rid}
+            self._draining.discard(rid)
+            self._rr = self._rr % max(len(kept), 1)
+            return backend
+
+    def set_draining(self, rid: str, draining: bool = True) -> None:
+        """Flip a member out of (or back into) routing WITHOUT
+        touching its process: a draining replica finishes its in-
+        flight work but :meth:`pick` never offers it new work — the
+        drain-then-retire half of spot-aware scale-in."""
+        with self._lock:
+            if draining:
+                self._draining.add(rid)
+            else:
+                self._draining.discard(rid)
+
+    def draining(self) -> Set[str]:
+        with self._lock:
+            return set(self._draining)
 
     def pick(self, exclude: Optional[Set[str]] = None
              ) -> Optional[Tuple[str, object, CircuitBreaker]]:
@@ -370,7 +452,7 @@ class ReplicaSet:
             for i in range(n):
                 j = (start + i) % n
                 rid, backend = self.members[j]
-                if rid in exclude:
+                if rid in exclude or rid in self._draining:
                     continue
                 # Health BEFORE the breaker: allow() on an open-but-
                 # rested breaker grants its single half-open probe, and
@@ -390,17 +472,30 @@ class ReplicaSet:
         verdict is good AND its breaker would admit a dispatch now or
         imminently — a live listener whose /predict 5xxes keeps its
         probe verdict but trips the breaker, and /healthz must tell
-        the fronting LB the truth about routability, not liveness."""
-        return any(b.healthy() and self.breakers[rid].would_allow()
-                   for rid, b in self.members)
+        the fronting LB the truth about routability, not liveness.
+        Draining members are NOT routable by definition."""
+        with self._lock:
+            members = list(self.members)
+            breakers = dict(self.breakers)
+            draining = set(self._draining)
+        return any(rid not in draining and b.healthy()
+                   and rid in breakers and breakers[rid].would_allow()
+                   for rid, b in members)
 
     def member_state(self, rid: str) -> str:
         """One member's routability verdict for health surfaces."""
-        backend = dict(self.members)[rid]
+        with self._lock:
+            backend = dict(self.members).get(rid)
+            breaker = self.breakers.get(rid)
+            draining = rid in self._draining
+        if backend is None or breaker is None:
+            return "removed"
+        if draining:
+            return "draining"
         if not backend.healthy():
             return backend.health_reason() or "unhealthy"
-        if not self.breakers[rid].would_allow():
-            snap = self.breakers[rid].snapshot()
+        if not breaker.would_allow():
+            snap = breaker.snapshot()
             return ("breaker open "
                     f"({snap['consecutive_failures']} consecutive "
                     "failures)")
@@ -495,6 +590,10 @@ class Fleet:
             return CircuitBreaker(cfg.breaker_failures,
                                   cfg.breaker_reset_s, clock=clock)
 
+        # The controller mints breakers for replicas it admits at
+        # runtime — same policy knobs as construction-time members.
+        self._breaker_factory = breaker_factory
+        self._rid_counter: Dict[str, int] = {}
         for name, members in grouped.items():
             ids = ([name] if len(members) == 1
                    else [f"{name}#{i}" for i in range(len(members))])
@@ -503,6 +602,7 @@ class Fleet:
             self.groups[name] = ReplicaSet(
                 name, list(zip(ids, members)),
                 breaker_factory=breaker_factory)
+            self._rid_counter[name] = len(members)
         self.admission = TenantAdmission(
             cfg.tenants, default_tenant=cfg.default_tenant,
             strict=cfg.strict_tenants, clock=clock)
@@ -561,6 +661,22 @@ class Fleet:
             from .prober import ProbeStats
 
             self.probe_stats = ProbeStats()
+        # Closed-loop control plane (docs/SERVING.md "Fleet control
+        # plane"): the controller heals/scales replica sets, the
+        # rollout manager delivers checkpoints canary-first.  Both
+        # None/off by default — constructed (no threads yet) here so
+        # their metric families render the moment the fleet is built,
+        # started/stopped with the fleet's own lifecycle.
+        self.controller = None
+        if cfg.controller:
+            from .controller import FleetController
+
+            self.controller = FleetController(self, cfg, clock=clock)
+        self.rollout = None
+        if cfg.rollout_ckpt_dir:
+            from .rollout import RolloutManager
+
+            self.rollout = RolloutManager(self, cfg, clock=clock)
         self.dispatcher = FleetDispatcher(
             [b.engine for b in backends if b.kind == "engine"])
         self._started = False
@@ -610,6 +726,10 @@ class Fleet:
         self.dispatcher.start()
         if self.recorder is not None:
             self.recorder.start()
+        if self.controller is not None:
+            self.controller.start()
+        if self.rollout is not None:
+            self.rollout.start()
         self._started = True
         return self
 
@@ -617,6 +737,13 @@ class Fleet:
         if not self._started:
             return
         self._started = False
+        # Control plane first: the controller must retire its
+        # supervised subprocesses (and the rollout finish its tick)
+        # while the routing/backend layer is still alive under them.
+        if self.rollout is not None:
+            self.rollout.stop()
+        if self.controller is not None:
+            self.controller.stop()
         self.dispatcher.stop()
         for b in self.backends.values():
             b.stop()
@@ -634,6 +761,71 @@ class Fleet:
                 return next(iter(self.groups.values()))
             return None
         return self.groups.get(model)
+
+    # -- membership dynamics (serve/controller.py) ---------------------
+
+    def attach_replica(self, name: str, backend) -> str:
+        """Admit an already-health-gated backend into ``name``'s
+        replica set (the controller's scale-out/heal admission path).
+        Returns the minted replica id.  Replica ids are monotonic per
+        group (``name#N``) — an id is never reused after a detach, so
+        flight-recorder timelines and metric series stay unambiguous."""
+        group = self.groups.get(name)
+        if group is None:
+            raise ValueError(f"attach_replica: unknown model {name!r}")
+        n = self._rid_counter.get(name, len(group))
+        self._rid_counter[name] = n + 1
+        rid = f"{name}#{n}"
+        group.add_member(rid, backend,
+                         breaker=self._breaker_factory())
+        # COW swap: handler threads iterating self.backends see the
+        # old or the new dict, never one mutating under them.
+        new = dict(self.backends)
+        new[rid] = backend
+        self.backends = new
+        if self._started:
+            backend.start()
+        if self.recorder is not None:
+            self.recorder.event("replica_attached", replica=rid,
+                                model=name,
+                                url=getattr(backend, "url", ""))
+        return rid
+
+    def detach_replica(self, rid: str):
+        """Remove a replica from routing and the flat backend map;
+        returns its backend (stopped) or None if unknown.  The caller
+        (controller) owns the PROCESS teardown for supervised
+        replicas — this only unhooks the router's view."""
+        backend = None
+        for name, g in self.groups.items():
+            if any(r == rid for r, _b in g.members):
+                backend = g.remove_member(rid)
+                break
+        if backend is None:
+            return None
+        new = dict(self.backends)
+        new.pop(rid, None)
+        self.backends = new
+        try:
+            backend.stop()
+        except Exception:  # noqa: BLE001 — a dead remote's prober
+            pass
+        if self.recorder is not None:
+            self.recorder.event("replica_detached", replica=rid)
+        return backend
+
+    def reload_replica(self, rid: str, step: int) -> int:
+        """Targeted checkpoint reload of ONE replica (the rollout
+        manager's canary/promote actuator): in-process engines load
+        synchronously, remotes via POST /admin/reload.  Returns the
+        loaded step; raises when the replica is unknown, has no
+        checkpoint source, or refuses the step (denylisted/invalid)."""
+        backend = self.backends.get(rid)
+        if backend is None:
+            raise ValueError(f"reload_replica: unknown replica {rid!r}")
+        if backend.kind == "engine":
+            return backend.engine.reload_to(step)
+        return backend.admin_reload(step)
 
     def observe_latency(self, model: str, ms: float) -> None:
         """Router-observed e2e per successful attempt — feeds the
@@ -722,10 +914,16 @@ class Fleet:
         up, bstate, bopen = [], [], []
         for name, g in sorted(self.groups.items()):
             for rid, b in g.members:
+                # .get: membership is dynamic (attach/detach under the
+                # group lock, COW member lists) — a reader holding the
+                # pre-detach roster must skip, not KeyError.
+                breaker = g.breakers.get(rid)
+                if breaker is None:
+                    continue
                 labels = self._replica_label(g, rid)
                 up.append('dsod_fleet_replica_up{%s} %d'
                           % (labels, 1 if b.healthy() else 0))
-                snap = g.breakers[rid].snapshot()
+                snap = breaker.snapshot()
                 bstate.append('dsod_fleet_breaker_state{%s} %d'
                               % (labels, STATE_GAUGE[snap["state"]]))
                 bopen.append('dsod_fleet_breaker_open_total{%s} %d'
@@ -733,6 +931,10 @@ class Fleet:
         groups.append([("dsod_fleet_replica_up", "gauge", up),
                        ("dsod_fleet_breaker_state", "gauge", bstate),
                        ("dsod_fleet_breaker_open_total", "counter", bopen)])
+        if self.controller is not None:
+            groups.append(self.controller.stats.prom_families())
+        if self.rollout is not None:
+            groups.append(self.rollout.stats.prom_families())
         if self.slo is not None:
             # Router-tier SLO families + their alert rules (the
             # replica-level dsod_alert_* families merge into the same
@@ -853,6 +1055,10 @@ class Fleet:
             out["slo"] = self.slo.snapshot()
         if self.probe_stats is not None:
             out["probes"] = self.probe_stats.snapshot()
+        if self.controller is not None:
+            out["controller"] = self.controller.snapshot()
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.snapshot()
         return out
 
     def alerts(self) -> Dict:

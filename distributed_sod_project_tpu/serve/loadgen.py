@@ -144,6 +144,51 @@ def _normalize_mix(mix) -> List[Dict]:
     return out
 
 
+def _profile_offsets(rps: float, duration_s: float,
+                     ramp: Optional[Tuple[float, float, float]],
+                     bursts) -> Tuple[List[float], float]:
+    """Arrival offsets (seconds from t0) for a shaped open-loop run →
+    ``(offsets, duration)``.  ``ramp=(r0, r1, T)`` sweeps the base rate
+    linearly from r0 to r1 over T seconds (holding r1 after); with no
+    ramp the base rate is flat ``rps``.  Each ``(extra, start, dur)``
+    burst adds ``extra`` rps inside its window on top of the base.  The
+    run covers ``max(duration_s, T, last burst end)`` so a ramp or a
+    late burst is never truncated by the default duration.  Offsets
+    come from integrating rate(t) in 5 ms slices and emitting an
+    arrival per accumulated unit — exact arrival COUNT under any shape
+    (a 1/rate(t) stepper overshoots wildly when a ramp starts near
+    zero), with arrival times quantized to the slice, which is noise
+    next to network jitter at any rate worth sweeping."""
+    bursts = tuple(bursts or ())
+    dur = float(duration_s)
+    if ramp is not None:
+        dur = max(dur, float(ramp[2]))
+    for _extra, b0, bdur in bursts:
+        dur = max(dur, float(b0) + float(bdur))
+
+    def rate(t: float) -> float:
+        if ramp is not None:
+            r0, r1, T = ramp
+            r = (float(r1) if T <= 0
+                 else float(r0) + (float(r1) - float(r0)) * min(t / T, 1.0))
+        else:
+            r = float(rps)
+        for extra, b0, bdur in bursts:
+            if float(b0) <= t < float(b0) + float(bdur):
+                r += float(extra)
+        return r
+
+    offsets: List[float] = []
+    t, credit, dt = 0.0, 0.0, 0.005
+    while t < dur:
+        credit += rate(t) * dt
+        while credit >= 1.0:
+            offsets.append(t)
+            credit -= 1.0
+        t += dt
+    return (offsets or [0.0]), dur
+
+
 def run_loadgen(
     base_url: str,
     mode: str = "closed",
@@ -162,6 +207,8 @@ def run_loadgen(
     slowest: int = 0,
     quality: bool = False,
     slo: bool = False,
+    ramp: Optional[Tuple[float, float, float]] = None,
+    bursts=None,
 ) -> Dict[str, float]:
     """Drive ``base_url`` and return a summary dict (see module doc for
     the open/closed semantics).  Closed loop sends exactly ``requests``
@@ -198,16 +245,34 @@ def run_loadgen(
     request/trace ids and the SERVER-side stage breakdown parsed from
     ``X-Timing`` (queue/device/resize/e2e ms) — "which requests were
     slow and WHERE" without a server round trip; when a row's trace
-    was sampled, its id keys straight into /debug/traces."""
+    was sampled, its id keys straight into /debug/traces.
+
+    **Shaped load** (open mode only): ``ramp=(r0, r1, seconds)`` sweeps
+    the offered rate linearly from r0 to r1 rps over the window;
+    ``bursts=[(extra_rps, start_s, dur_s), ...]`` adds step bursts on
+    top of the base rate.  Shaped runs append a ``"curve"`` — per
+    time-bucket offered/done/ok counts and p99 next to the overall
+    latency summary — the response curve an autoscaler leg reads to see
+    the controller catch up with (or shed) a moving offered rate, and
+    ``offered_rps`` becomes the profile's true average."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
+    if mode == "closed" and (ramp is not None or bursts):
+        raise ValueError("ramp/bursts are open-loop shapes (mode='open')")
     rng = np.random.RandomState(seed)
     # Pre-encode a body pool: the generator must never bottleneck on
     # numpy/npy encoding while it is supposed to be offering load.
     pool = [encode_image(rng, h, w)
             for h, w in (sizes * ((16 // max(len(sizes), 1)) + 1))[:16]]
-    n_total = (int(requests) if mode == "closed"
-               else max(int(float(duration_s) * float(rps)), 1))
+    offsets: Optional[List[float]] = None
+    profile_dur = float(duration_s)
+    if mode == "open" and (ramp is not None or bursts):
+        offsets, profile_dur = _profile_offsets(rps, duration_s, ramp,
+                                                bursts)
+        n_total = len(offsets)
+    else:
+        n_total = (int(requests) if mode == "closed"
+                   else max(int(float(duration_s) * float(rps)), 1))
     if mix is not None:
         entries = _normalize_mix(mix)
         w = np.asarray([e["weight"] for e in entries], np.float64)
@@ -234,6 +299,23 @@ def run_loadgen(
     # seq breaks latency ties (dicts don't compare).
     slow_rows: List[Tuple[float, int, Dict]] = []
     slow_seq = [0]
+    # Response-curve buckets for shaped runs: each request books into
+    # the bucket of its SCHEDULED offset (offered time, not completion
+    # time), so a bucket's offered count is exact even when responses
+    # straggle past its edge.
+    curve: Optional[List[Dict]] = None
+    bucket_of: List[int] = []
+    if offsets is not None:
+        n_buckets = min(8, max(1, int(profile_dur)))
+        width = profile_dur / n_buckets
+        curve = [{"t0": round(k * width, 2),
+                  "t1": round((k + 1) * width, 2),
+                  "offered": 0, "done": 0, "ok": 0, "_ms": []}
+                 for k in range(n_buckets)]
+        for off in offsets:
+            k = min(int(off / width), n_buckets - 1)
+            bucket_of.append(k)
+            curve[k]["offered"] += 1
 
     def record(out: str, ms: float, info=None, sent_model=None) -> None:
         info = info or {}
@@ -265,10 +347,17 @@ def run_loadgen(
         # slowest-N rows key into the server's /debug/traces; ids do
         # not perturb the seeded (model, tenant) draws above.
         rid = mint_trace_id() if slowest > 0 else None
-        record(*_one(base_url, pool[i % len(pool)], slo_ms or None,
-                     timeout_s, precision=precision, model=a["model"],
-                     tenant=a.get("tenant") or tenant, request_id=rid),
-               sent_model=a["model"])
+        res = _one(base_url, pool[i % len(pool)], slo_ms or None,
+                   timeout_s, precision=precision, model=a["model"],
+                   tenant=a.get("tenant") or tenant, request_id=rid)
+        record(*res, sent_model=a["model"])
+        if curve is not None:
+            b = curve[bucket_of[i]]
+            with lock:
+                b["done"] += 1
+                if res[0] == "ok":
+                    b["ok"] += 1
+                    b["_ms"].append(res[1])
 
     t_start = time.monotonic()
     if mode == "closed":
@@ -299,19 +388,29 @@ def run_loadgen(
         # up in latency — the open-loop signal, not a generator stall.
         from concurrent.futures import ThreadPoolExecutor
 
-        interval = 1.0 / max(float(rps), 1e-6)
-        n = n_total
-        workers = min(256, max(8, int(float(rps) * min(timeout_s, 10.0))))
+        if offsets is None:
+            interval = 1.0 / max(float(rps), 1e-6)
+            offsets = [i * interval for i in range(n_total)]
+            peak_rps = float(rps)
+        else:
+            # Size the pool for the PEAK of the shaped profile, not the
+            # flat rps knob — a burst that outruns the pool would queue
+            # in the generator and smear the very step it measures.
+            peak_rps = ((max(float(ramp[0]), float(ramp[1]))
+                         if ramp is not None else float(rps))
+                        + max((float(b[0]) for b in (bursts or ())),
+                              default=0.0))
+        workers = min(256, max(8, int(peak_rps * min(timeout_s, 10.0))))
         futures = []
         with ThreadPoolExecutor(max_workers=workers) as ex:
-            for i in range(n):
-                delay = (t_start + i * interval) - time.monotonic()
+            for i, off in enumerate(offsets):
+                delay = (t_start + off) - time.monotonic()
                 if delay > 0:
                     time.sleep(delay)
                 futures.append(ex.submit(fire, i))
             for f in futures:
                 f.result()
-        sent = n
+        sent = n_total
     elapsed = time.monotonic() - t_start
 
     ok_ms.sort()
@@ -387,7 +486,20 @@ def run_loadgen(
             })
         out["slowest"] = rows
     if mode == "open":
-        out["offered_rps"] = round(float(rps), 2)
+        if curve is not None:
+            out["offered_rps"] = (round(n_total / profile_dur, 2)
+                                  if profile_dur else 0.0)
+            rendered = []
+            for b in curve:
+                ms = sorted(b.pop("_ms"))
+                b["p99_ms"] = round(_percentile(ms, 0.99), 2)
+                rendered.append(b)
+            # The response curve: offered vs completed vs ok per time
+            # bucket with the bucket's p99 — "did the fleet keep up as
+            # the rate moved", readable without replaying the run.
+            out["curve"] = rendered
+        else:
+            out["offered_rps"] = round(float(rps), 2)
     if quality:
         q = scrape_quality(base_url)
         if q:

@@ -1,0 +1,517 @@
+"""Progressive checkpoint delivery — canary-gated rollout with
+auto-rollback (docs/SERVING.md "Fleet control plane").
+
+The engine's hot reload (serve/engine.py) is all-replicas-at-once: a
+new VALID step lands and every replica's next poll swaps to it.  That
+is exactly the deployment posture the prober was built to distrust — a
+checkpoint can be bit-exact on disk and still predict garbage, and
+with simultaneous swap the first scorer to notice is a user.  The
+:class:`RolloutManager` replaces the swap with a state machine::
+
+    idle ──new candidate step──▶ canary ──verdict──▶ promoting ─▶ idle
+                                   │
+                                   └──verdict fails──▶ rolled_back
+                                        (step denylisted, canary
+                                         reloaded to last-good)
+
+ONE replica (the canary) reloads the candidate, bakes, and is scored
+with the prober's ground-truth probe set (serve/prober.py) sent
+DIRECTLY to it — plus the same probes against a stable baseline
+replica, so the verdict is relative (a hard input set degrades both)
+— and, when the quality monitors are armed, the canary's drift PSI.
+Pass → every other replica reloads (promote).  Fail → the step is
+pinned in the on-disk **denylist** (``reload_denylist.json`` next to
+the checkpoints, honored by the engine's own reload poll and
+``reload_to`` — the rollback cannot undo itself one poll later), the
+canary reloads back to the last-good step, and the flight recorder
+cuts an incident bundle.
+
+Every verdict is booked through :meth:`RolloutManager._record` — THE
+rollout accounting seam (tools/dsodlint.py ``BOOKING_SEAMS``) — and
+surfaces as ``dsod_ctrl_rollout_*`` families on the router's /metrics
+(rendered only while armed: ``rollout_ckpt_dir`` empty keeps /metrics
+byte-identical).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+# Rollout state gauge encoding (the breaker STATE_GAUGE idiom:
+# documented enum, stable across releases).
+ROLLOUT_STATE_GAUGE = {"idle": 0, "canary": 1, "promoting": 2,
+                       "rolled_back": 3}
+
+_DENYLIST_NAME = "reload_denylist.json"
+
+
+def _denylist_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, _DENYLIST_NAME)
+
+
+def read_step_denylist(ckpt_dir: str) -> Dict[int, Dict]:
+    """``{step: verdict_record}`` of steps pinned bad for ``ckpt_dir``
+    (empty on a missing/empty/corrupt file — a torn denylist must not
+    stop serving; the rollout rewrites it on the next verdict)."""
+    if not ckpt_dir:
+        return {}
+    try:
+        with open(_denylist_path(ckpt_dir)) as f:
+            raw = json.load(f)
+        return {int(k): dict(v) for k, v in raw.get("steps", {}).items()}
+    except (OSError, ValueError, AttributeError):
+        return {}
+
+
+def deny_step(ckpt_dir: str, step: int, reason: str, **extra) -> None:
+    """Pin ``step`` in the denylist (atomic tmp+rename, the
+    publish_port idiom — a reader never sees a torn file)."""
+    steps = {str(k): v for k, v in read_step_denylist(ckpt_dir).items()}
+    steps[str(int(step))] = dict(extra, reason=reason,
+                                 denied_at=time.time())
+    path = _denylist_path(ckpt_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"steps": steps}, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+class RolloutStats:
+    """Thread-safe rollout telemetry: per-model state gauge, verdict
+    counters, denylist depth, last canary score.  Owned by the
+    :class:`RolloutManager`; rendered into the router's /metrics by
+    ``Fleet._router_families`` while the rollout is armed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: Dict[str, str] = {}
+        self._verdicts: Dict[Tuple[str, str], int] = {}
+        self._denylisted: Dict[str, int] = {}
+        self._canary_mae: Dict[str, float] = {}
+
+    def set_state(self, model: str, state: str) -> None:
+        if state not in ROLLOUT_STATE_GAUGE:
+            raise ValueError(f"unknown rollout state {state!r}")
+        with self._lock:
+            self._state[model] = state
+
+    def set_denylisted(self, model: str, n: int) -> None:
+        with self._lock:
+            self._denylisted[model] = int(n)
+
+    def set_canary_mae(self, model: str, mae: float) -> None:
+        with self._lock:
+            self._canary_mae[model] = float(mae)
+
+    def inc_verdict(self, model: str, verdict: str) -> None:
+        with self._lock:
+            k = (model, verdict)
+            self._verdicts[k] = self._verdicts.get(k, 0) + 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "state": dict(self._state),
+                "verdicts": {f"{m}:{v}": n for (m, v), n
+                             in sorted(self._verdicts.items())},
+                "denylisted": dict(self._denylisted),
+                "canary_mae": {m: round(v, 6) for m, v
+                               in self._canary_mae.items()},
+            }
+
+    def prom_families(self):
+        """``dsod_ctrl_rollout_*`` + denylist/canary families (state
+        gauge always while armed; counters only once non-empty — the
+        conditional-render idiom of RouterStats)."""
+        with self._lock:
+            state = sorted(self._state.items())
+            verdicts = sorted(self._verdicts.items())
+            deny = sorted(self._denylisted.items())
+            mae = sorted(self._canary_mae.items())
+        fams = [("dsod_ctrl_rollout_state", "gauge",
+                 ['dsod_ctrl_rollout_state{model="%s"} %d'
+                  % (m, ROLLOUT_STATE_GAUGE[s]) for m, s in state])]
+        if verdicts:
+            fams.append((
+                "dsod_ctrl_rollout_verdicts_total", "counter",
+                ['dsod_ctrl_rollout_verdicts_total'
+                 '{model="%s",verdict="%s"} %d' % (m, v, n)
+                 for (m, v), n in verdicts]))
+        fams.append((
+            "dsod_ctrl_denylisted_steps", "gauge",
+            ['dsod_ctrl_denylisted_steps{model="%s"} %d' % (m, n)
+             for m, n in deny]))
+        if mae:
+            fams.append((
+                "dsod_ctrl_canary_mae", "gauge",
+                ['dsod_ctrl_canary_mae{model="%s"} %g' % (m, v)
+                 for m, v in mae]))
+        return fams
+
+
+class RolloutManager:
+    """The checkpoint-delivery actuator for ONE replica set.
+
+    Construction is side-effect free (no threads, no disk) so the
+    Fleet can build it whenever ``rollout_ckpt_dir`` is set and the
+    metrics surface is renderable without a running loop;
+    :meth:`start` arms the poll thread, :meth:`tick` is one complete
+    state-machine evaluation (tests drive it directly with
+    ``rollout_bake_s=0``).
+
+    Replicas under rollout management should serve with their OWN
+    reload poll off (``serve.reload_poll_s=0``) — two actuators moving
+    the same weights is the race this class exists to end — but even a
+    replica that keeps polling cannot resurrect a rolled-back step:
+    the denylist gates its poll too.
+    """
+
+    def __init__(self, fleet, cfg=None, clock=time.monotonic):
+        cfg = cfg if cfg is not None else fleet.cfg
+        if not cfg.rollout_ckpt_dir:
+            raise ValueError("RolloutManager needs rollout_ckpt_dir")
+        self.fleet = fleet
+        self.cfg = cfg
+        self.ckpt_dir = cfg.rollout_ckpt_dir
+        self.model = cfg.rollout_model or next(iter(fleet.groups))
+        self._clock = clock
+        self.stats = RolloutStats()
+        self.stats.set_state(self.model, "idle")
+        self._lock = threading.Lock()
+        self._state = "idle"
+        self._last_good: Optional[int] = None
+        self._adopted = False  # bootstrapped last_good from the fleet?
+        # A canary that ERRORED (reload refused/transport died) is not
+        # evidence against the STEP — no denylist, but back off before
+        # retrying so a permanently unloadable replica set does not
+        # hot-loop the canary dance every poll.
+        self._error_step: Optional[int] = None
+        self._error_at = 0.0
+        self._mgr = None
+        self._probes: Optional[List[Tuple[bytes, np.ndarray]]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._log = get_logger()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "RolloutManager":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-rollout", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.cfg.rollout_bake_s + 30.0)
+            self._thread = None
+        with self._lock:
+            mgr, self._mgr = self._mgr, None
+        if mgr is not None:
+            try:
+                mgr.close()
+            except Exception:  # noqa: BLE001 — shutdown best-effort
+                pass
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.rollout_poll_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — keep delivering
+                self._log.exception(
+                    "rollout: tick failed; retrying next poll")
+
+    # -- state ---------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def last_good(self) -> Optional[int]:
+        with self._lock:
+            return self._last_good
+
+    def _set_state(self, state: str) -> None:
+        with self._lock:
+            self._state = state
+        self.stats.set_state(self.model, state)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            out = {"state": self._state, "model": self.model,
+                   "last_good": self._last_good,
+                   "ckpt_dir": self.ckpt_dir}
+        out["denylist"] = {str(k): v.get("reason", "")
+                           for k, v in sorted(
+                               read_step_denylist(self.ckpt_dir).items())}
+        out.update(self.stats.snapshot())
+        return out
+
+    # -- booking seam --------------------------------------------------
+
+    def _record(self, action: str, **attrs) -> None:
+        """THE rollout booking seam (tools/dsodlint.py
+        ``BOOKING_SEAMS``): every verdict counter increments here, and
+        every decision leaves a typed flight-recorder event."""
+        if action == "verdict":
+            self.stats.inc_verdict(self.model, attrs.get("verdict", ""))
+        rec = self.fleet.recorder
+        if rec is not None:
+            rec.event("rollout_" + action, model=self.model, **attrs)
+
+    # -- the machine ---------------------------------------------------
+
+    def tick(self) -> Optional[str]:
+        """One complete state-machine evaluation; returns the action
+        taken ("canary_promote" / "canary_rollback" / "canary_error")
+        or None when there was nothing to deliver."""
+        group = self.fleet.groups.get(self.model)
+        if group is None:
+            return None
+        with self._lock:
+            if self._mgr is None:
+                from ..ckpt import CheckpointManager
+
+                self._mgr = CheckpointManager(self.ckpt_dir,
+                                              async_save=False)
+            mgr = self._mgr
+        mgr.reload()  # steps/denials land between polls
+        deny = read_step_denylist(self.ckpt_dir)
+        self.stats.set_denylisted(self.model, len(deny))
+        steps = [s for s in mgr.valid_steps() if s not in deny]
+        if not steps:
+            return None
+        cand = max(steps)
+        if not self._adopted:
+            # Bootstrap: replicas restored the newest step at startup —
+            # adopt it as last-good instead of canarying what is
+            # already serving fleet-wide.
+            loaded = [self._member_step(b) for _rid, b in group.members]
+            known = [s for s in loaded if s is not None]
+            if known and all(s == cand for s in known):
+                with self._lock:
+                    self._last_good = cand
+            self._adopted = True
+        with self._lock:
+            last_good = self._last_good
+        if cand == last_good:
+            if self.state not in ("idle",):
+                self._set_state("idle")
+            return None
+        if cand == self._error_step and (
+                self._clock() - self._error_at
+                < 10.0 * self.cfg.rollout_poll_s):
+            return None
+        return self._run_canary(group, cand, steps)
+
+    def _run_canary(self, group, step: int,
+                    steps: List[int]) -> Optional[str]:
+        cfg = self.cfg
+        canary = None
+        for rid, b in group.members:
+            if b.healthy():
+                canary = (rid, b)
+                break
+        if canary is None:
+            return None  # nothing routable to canary on; next poll
+        rid, backend = canary
+        prev = self._member_step(backend)
+        self._set_state("canary")
+        self._record("canary", step=step, replica=rid)
+        try:
+            self.fleet.reload_replica(rid, step)
+        except Exception as e:  # noqa: BLE001 — replica fault, not step
+            self._error_step, self._error_at = step, self._clock()
+            self._record("verdict", verdict="canary_error", step=step,
+                         replica=rid, error=str(e)[:200])
+            self._set_state("idle")
+            return "canary_error"
+        self._stop.wait(cfg.rollout_bake_s)
+        mae, avail = self._probe_member(backend)
+        self.stats.set_canary_mae(
+            self.model, mae if math.isfinite(mae) else -1.0)
+        base_mae = None
+        for orid, ob in group.members:
+            if orid != rid and ob.healthy():
+                base_mae, _base_avail = self._probe_member(ob)
+                break
+        psi = self._canary_psi(backend)
+        reasons = []
+        if avail < cfg.rollout_min_avail:
+            reasons.append(f"availability {avail:.2f} < "
+                           f"{cfg.rollout_min_avail:.2f}")
+        if not math.isfinite(mae):
+            reasons.append("unscorable predictions")
+        elif cfg.rollout_mae_max > 0 and mae > cfg.rollout_mae_max:
+            reasons.append(f"mae {mae:.4f} > ceiling "
+                           f"{cfg.rollout_mae_max:.4f}")
+        if (base_mae is not None and math.isfinite(base_mae)
+                and math.isfinite(mae)
+                and mae - base_mae > cfg.rollout_mae_degrade):
+            reasons.append(f"mae {mae:.4f} degrades baseline "
+                           f"{base_mae:.4f} by more than "
+                           f"{cfg.rollout_mae_degrade:.4f}")
+        if (cfg.rollout_psi_max > 0 and psi is not None
+                and psi > cfg.rollout_psi_max):
+            reasons.append(f"psi {psi:.4f} > {cfg.rollout_psi_max:.4f}")
+        if reasons:
+            return self._rollback(group, step, rid, prev, steps,
+                                  reasons, mae, base_mae)
+        return self._promote(group, step, rid, mae, base_mae)
+
+    def _promote(self, group, step: int, canary_rid: str,
+                 mae: float, base_mae: Optional[float]) -> str:
+        self._set_state("promoting")
+        self._record("verdict", verdict="promote", step=step,
+                     replica=canary_rid, mae=round(mae, 6),
+                     baseline_mae=(round(base_mae, 6)
+                                   if base_mae is not None else -1.0))
+        failed = []
+        for orid, ob in group.members:
+            if orid == canary_rid:
+                continue
+            try:
+                self.fleet.reload_replica(orid, step)
+            except Exception as e:  # noqa: BLE001 — promote the rest
+                failed.append(orid)
+                self._record("promote_error", step=step, replica=orid,
+                             error=str(e)[:200])
+        with self._lock:
+            self._last_good = step
+        self._record("promote", step=step,
+                     failed_replicas=",".join(failed))
+        self._set_state("idle")
+        return "canary_promote"
+
+    def _rollback(self, group, step: int, canary_rid: str,
+                  prev: Optional[int], steps: List[int],
+                  reasons: List[str], mae: float,
+                  base_mae: Optional[float]) -> str:
+        reason = "; ".join(reasons)
+        self._record("verdict", verdict="rollback", step=step,
+                     replica=canary_rid, reason=reason,
+                     mae=(round(mae, 6) if math.isfinite(mae) else -1.0),
+                     baseline_mae=(round(base_mae, 6)
+                                   if base_mae is not None else -1.0))
+        deny_step(self.ckpt_dir, step, reason,
+                  mae=(mae if math.isfinite(mae) else None),
+                  replica=canary_rid)
+        self.stats.set_denylisted(
+            self.model, len(read_step_denylist(self.ckpt_dir)))
+        with self._lock:
+            last_good = self._last_good
+        others = [s for s in steps if s != step]
+        target = last_good if last_good is not None else prev
+        if target is None and others:
+            target = max(others)
+        if target is not None:
+            try:
+                self.fleet.reload_replica(canary_rid, target)
+            except Exception as e:  # noqa: BLE001 — evidence anyway
+                self._record("rollback_error", step=step, target=target,
+                             replica=canary_rid, error=str(e)[:200])
+        self._record("rollback", step=step, replica=canary_rid,
+                     target=(target if target is not None else -1),
+                     reason=reason)
+        rec = self.fleet.recorder
+        if rec is not None:
+            # The incident bundle: the ring around the verdict plus
+            # every section snapshot — the rollback's evidence package.
+            rec.trigger(f"rollout:{self.model}",
+                        f"step {step} rolled back: {reason}"[:200],
+                        background=True)
+        self._set_state("rolled_back")
+        return "canary_rollback"
+
+    # -- replica IO ----------------------------------------------------
+
+    def _member_step(self, backend) -> Optional[int]:
+        """Which checkpoint step a replica is serving (None when
+        unknown: random-init engine, unreachable remote, old remote)."""
+        try:
+            if backend.kind == "engine":
+                return backend.engine._loaded_step
+            step = backend.stats_snapshot().get("loaded_step")
+            return int(step) if step is not None else None
+        except Exception:  # noqa: BLE001 — unknown, not fatal
+            return None
+
+    def _probe_set(self) -> List[Tuple[bytes, np.ndarray]]:
+        if self._probes is None:
+            from .prober import make_probe_set
+
+            self._probes = make_probe_set(self.cfg.rollout_probes,
+                                          px=self.cfg.rollout_probe_px)
+        return self._probes
+
+    def _probe_member(self, backend) -> Tuple[float, float]:
+        """Score ONE replica directly against the ground-truth probe
+        set: ``(mean mae over answered probes, availability)``.
+        Direct-to-replica on purpose — the router would round-robin
+        the probes over the whole set and the verdict must isolate the
+        canary."""
+        import io
+
+        from .prober import score_probe
+
+        probes = self._probe_set()
+        maes: List[float] = []
+        answered = 0
+        for body, gt in probes:
+            try:
+                if backend.kind == "engine":
+                    img = np.load(io.BytesIO(body), allow_pickle=False)
+                    pred, _meta = backend.engine.predict(
+                        img, timeout=self.cfg.prober_timeout_s)
+                else:
+                    status, _hdrs, payload = backend.predict_raw(
+                        body, {"Content-Type": "application/x-npy"},
+                        timeout_s=self.cfg.prober_timeout_s)
+                    if status != 200:
+                        continue
+                    pred = np.load(io.BytesIO(payload),
+                                   allow_pickle=False)
+                m, _iou = score_probe(np.asarray(pred, np.float32), gt)
+            except Exception:  # noqa: BLE001 — an unanswered probe
+                continue
+            answered += 1
+            if math.isfinite(m):
+                maes.append(m)
+            else:
+                # A non-finite score is an answered-but-garbage probe:
+                # it must sink the MAE verdict, not vanish from it.
+                maes.append(float("inf"))
+        avail = answered / len(probes) if probes else 0.0
+        mae = (sum(maes) / len(maes)) if maes else float("inf")
+        return mae, avail
+
+    def _canary_psi(self, backend) -> Optional[float]:
+        """Worst drift PSI on the canary (best-effort; None when the
+        quality monitors are off or the remote predates them)."""
+        try:
+            if backend.kind == "engine":
+                q = backend.engine.quality
+                vals = q.psi_values() if q is not None else {}
+            else:
+                snap = backend.stats_snapshot().get("quality") or {}
+                vals = snap.get("psi") or {}
+            nums = [float(v) for v in vals.values()
+                    if isinstance(v, (int, float))]
+            return max(nums) if nums else None
+        except Exception:  # noqa: BLE001 — telemetry, not policy
+            return None

@@ -396,9 +396,38 @@ class ServeHandler(JsonHTTPHandler):
         else:
             self._send_json(404, {"error": f"no route {path}"})
 
+    def _admin_reload(self) -> None:
+        """POST /admin/reload ``{"step": N}`` — the rollout control
+        plane's targeted reload (serve/rollout.py drives ONE canary
+        replica to a candidate step; RemoteBackend.admin_reload is the
+        client).  400 on a bad body; 409 when the engine has no
+        checkpoint source or refuses the step (invalid/denylisted) —
+        a refusal is an answer, not a server fault."""
+        try:
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            payload = json.loads(self.rfile.read(length).decode()
+                                 if length else "{}")
+            step = int(payload["step"])
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_json(400, {
+                "error": f'body must be {{"step": N}}: {e}'})
+            return
+        try:
+            loaded = self.engine.reload_to(step)
+        except (RuntimeError, ValueError) as e:
+            self._send_json(409, {"error": str(e), "step": step})
+            return
+        except Exception as e:  # noqa: BLE001 — a torn checkpoint
+            self._send_json(500, {"error": str(e), "step": step})
+            return
+        self._send_json(200, {"ok": True, "step": loaded})
+
     # -- POST ----------------------------------------------------------
 
     def do_POST(self):  # noqa: N802 — http.server API
+        if self.path == "/admin/reload":
+            self._admin_reload()
+            return
         if self.path != "/predict":
             self._send_json(404, {"error": f"no route {self.path}"})
             return

@@ -802,3 +802,44 @@ def test_prometheus_model_labels_and_type_once_across_series():
     rendered = render_prom_families(fams)
     assert 'dsod_serve_submitted_total{model="r1"} 2' in rendered
     assert rendered.count("# TYPE dsod_serve_e2e_latency_ms ") == 1
+
+
+def test_loadgen_profile_offsets_track_the_rate_integral():
+    """The shaped open-loop scheduler (PR 16 autoscaler leg): arrival
+    counts must track the offered-rate integral — the naive
+    1/rate(t) stepping undersamples ramps that start near zero."""
+    from distributed_sod_project_tpu.serve.loadgen import \
+        _profile_offsets
+
+    # Flat 10 rps for 6 s: integral 60.
+    offs, dur = _profile_offsets(10.0, 6.0, None, None)
+    assert dur == 6.0
+    assert abs(len(offs) - 60) <= 1
+    assert offs == sorted(offs) and offs[0] < 0.5
+
+    # Ramp 0 → 10 over 6 s: integral 30, and arrivals must DENSIFY —
+    # more in the last third than the first.
+    offs, dur = _profile_offsets(10.0, 6.0, (0.0, 10.0, 6.0), None)
+    assert abs(len(offs) - 30) <= 1
+    first = sum(1 for t in offs if t < 2.0)
+    last = sum(1 for t in offs if t >= 4.0)
+    assert last > first
+
+    # A burst window adds its own integral on top and can extend the
+    # profile duration past duration_s.
+    offs, dur = _profile_offsets(2.0, 4.0, None, [(10.0, 5.0, 2.0)])
+    assert dur == 7.0  # last burst ends at 5 + 2
+    base = 2.0 * 7.0
+    assert abs(len(offs) - (base + 20.0)) <= 2
+    in_burst = sum(1 for t in offs if 5.0 <= t < 7.0)
+    assert in_burst > 20  # 2 rps base + 10 rps extra over 2 s
+
+
+def test_loadgen_rejects_shapes_in_closed_mode():
+    # Raises before any request is dialed — the URL is never touched.
+    with pytest.raises(ValueError, match="open"):
+        run_loadgen("http://127.0.0.1:9", mode="closed",
+                    requests=1, ramp=(1.0, 2.0, 1.0))
+    with pytest.raises(ValueError, match="open"):
+        run_loadgen("http://127.0.0.1:9", mode="closed",
+                    requests=1, bursts=[(5.0, 0.0, 1.0)])

@@ -653,7 +653,14 @@ def test_transport_failure_attempt_span_and_trace_outcome():
             urllib.request.urlopen(req, timeout=10)
         ei.value.read()
         assert ei.value.code == 502
-        t = fleet.tracer.get_trace(rid)
+        # The 502 is flushed from inside the dispatch loop; the root
+        # span lands just after the response: wait it out.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            t = fleet.tracer.get_trace(rid)
+            if t is not None and t["done"]:
+                break
+            time.sleep(0.02)
         assert t is not None and t["done"]
         att = [s for s in t["spans"] if s["name"] == "attempt"]
         assert att and att[0]["attrs"]["result"] == "transport"
@@ -714,7 +721,14 @@ def test_faulted_retry_and_hedge_share_trace_end_to_end(tiny):
                                    timeout=30)
         assert status == 200
         assert fleet.rstats.snapshot()["retries_total"] >= 1
-        t = fleet.tracer.get_trace(rid_retry)
+        # The root span lands just after the response is flushed (the
+        # hedge test below already waits this race out): poll briefly.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            t = fleet.tracer.get_trace(rid_retry)
+            if t is not None and t["done"]:
+                break
+            time.sleep(0.02)
         assert t is not None and t["done"]
         attempts = [s for s in t["spans"] if s["name"] == "attempt"]
         assert len(attempts) >= 2  # the faulted try + the winner

@@ -1,0 +1,370 @@
+#!/usr/bin/env python
+"""Rollout control-plane smoke: canary-gated progressive checkpoint
+delivery against REAL replica subprocesses and REAL checkpoints
+(docs/SERVING.md "Fleet control plane").
+
+tests/test_controller.py proves the state machine on fakes; this tool
+proves the delivery loop end to end, across process boundaries, with
+orbax on disk:
+
+Topology: one shared checkpoint directory; TWO replica subprocesses
+(tools/serve.py --ckpt-dir, their OWN reload poll off — the
+RolloutManager is the only actuator moving weights) behind ONE router
+subprocess with ``rollout_ckpt_dir`` armed.  Phases:
+
+1. **adopt** — both replicas restore step 1 at startup; the rollout
+   bootstraps ``last_good=1`` (what is already serving fleet-wide is
+   not re-canaried) and settles idle.
+2. **rollback** — step 2 lands with every float leaf NaN: bit-exact on
+   disk, VALID to the checkpoint manager, garbage to serve — exactly
+   the checkpoint the all-replicas-at-once hot reload would have
+   swapped in fleet-wide.  Asserts: ONE replica (the canary) reloads
+   it, the probe verdict fails (unscorable predictions), the step is
+   pinned in ``reload_denylist.json``, the canary reloads BACK to step
+   1, the baseline replica NEVER serves step 2, and the flight
+   recorder cuts a ``rollout:*`` incident bundle.
+3. **promote** — step 3 lands with a tiny finite weight bump.
+   Asserts: canary → promote, EVERY replica serves step 3,
+   ``last_good`` advances, step 2 stays denylisted (a later good step
+   does not unpin a bad one), and the verdict counters render as
+   ``dsod_ctrl_rollout_*`` on the router's /metrics.
+
+Prints ONE JSON line; exits non-zero on any broken invariant.
+
+Budget contract: internal deadlines (150 s per replica bind + 30 s
+router + 60 s adopt + 120 s rollback + 120 s promote + 45 s drains)
+sum under the t1.sh wrapper's 600 s, so a stall reports its own JSON
+diagnostic instead of dying to the outer timeout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+CONFIG = "minet_vgg16_ref"
+
+# Small shapes so CPU warmup and probes stay cheap; f32 single-arm so
+# precision stepping never muddies the canary verdict.
+OVERRIDES = [
+    "data.image_size=64,64", "serve.resolution_buckets=64",
+    "serve.batch_buckets=1,2", "serve.precision_arms=f32",
+    "serve.precision=f32", "serve.reload_poll_s=0",
+]
+
+
+def fetch_json(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def fetch_text(url: str, timeout: float = 10.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def wait_port_file(path: str, proc: subprocess.Popen, deadline_s: float,
+                   what: str):
+    deadline = time.monotonic() + deadline_s
+    while not os.path.exists(path):
+        if proc.poll() is not None:
+            return None, f"{what} died before binding (rc={proc.returncode})"
+        if time.monotonic() > deadline:
+            return None, f"{what} never bound a port"
+        time.sleep(0.25)
+    with open(path) as f:
+        return f"http://127.0.0.1:{int(f.read().strip())}", None
+
+
+def write_checkpoints(ckpt_dir: str) -> None:
+    """Three real orbax checkpoints for CONFIG: step 1 good, step 2
+    NaN-poisoned (valid on disk, unservable), step 3 a finite bump.
+    ``state.step`` mirrors the directory step label — the engine's
+    ``loaded_step`` watermark (and so the rollout's bootstrap
+    adoption) reads the state, not the path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_sod_project_tpu.ckpt import CheckpointManager
+    from distributed_sod_project_tpu.configs import (apply_overrides,
+                                                     get_config)
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.train import (build_optimizer,
+                                                   create_train_state)
+
+    cfg = apply_overrides(get_config(CONFIG), list(OVERRIDES))
+    model = build_model(cfg.model)
+    tx, _ = build_optimizer(cfg.optim, 1)
+    h, w = cfg.data.image_size
+    probe = {"image": np.zeros((1, h, w, 3), np.float32)}
+    state = create_train_state(jax.random.key(cfg.seed), model, tx,
+                               probe, ema=cfg.optim.ema_decay > 0)
+
+    def at_step(s, step):
+        return s.replace(step=s.step * 0 + step)
+
+    def remap(s, fn):
+        # Float leaves only: touching an int leaf would change its
+        # dtype and break the restore template.
+        return s.replace(params=jax.tree_util.tree_map(
+            lambda x: fn(x) if jnp.issubdtype(x.dtype, jnp.floating)
+            else x, s.params))
+
+    good1 = at_step(state, 1)
+    bad2 = at_step(remap(state, lambda x: x * jnp.float32("nan")), 2)
+    good3 = at_step(remap(state, lambda x: x + 1e-3), 3)
+    mgr = CheckpointManager(ckpt_dir, async_save=False)
+    try:
+        mgr.save(1, good1, force=True)
+        mgr.save(2, bad2, force=True)
+        mgr.save(3, good3, force=True)
+        mgr.wait()
+    finally:
+        mgr.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--keep", action="store_true",
+                   help="keep temp dirs for post-mortem")
+    args = p.parse_args(argv)
+
+    ckpt_dir = tempfile.mkdtemp(prefix="dsod_ctrl_ckpt_")
+    stage_dir = tempfile.mkdtemp(prefix="dsod_ctrl_stage_")
+    router_rec = tempfile.mkdtemp(prefix="dsod_ctrl_recrtr_")
+    pfiles = [tempfile.mktemp(prefix=f"dsod_ctrl_r_{i}_") for i in (0, 1)]
+    fleet_pfile = tempfile.mktemp(prefix="dsod_ctrl_fleet_")
+    fleet_cfg = tempfile.mktemp(prefix="dsod_ctrl_cfg_", suffix=".json")
+    out = {}
+    procs = {}
+    failures = []
+
+    def check(name: str, ok: bool, detail=None) -> None:
+        out.setdefault("checks", {})[name] = bool(ok)
+        if not ok:
+            failures.append(name if detail is None
+                            else f"{name}: {detail}")
+
+    def rollout_of(url):
+        return fetch_json(url + "/stats").get("rollout", {})
+
+    def loaded_step(url):
+        return fetch_json(url + "/stats").get("loaded_step")
+
+    try:
+        # Steps 2/3 are STAGED: checkpoints are delivered one at a
+        # time so each phase observes one transition.  All three are
+        # written up front (one jax bring-up), then moved into the
+        # live dir when their phase starts — os.rename of a step dir
+        # is atomic, which is exactly how a training job publishes.
+        write_checkpoints(stage_dir)
+        step_dirs = {}
+        for name in os.listdir(stage_dir):
+            src = os.path.join(stage_dir, name)
+            if os.path.isdir(src) and name.isdigit() and name != "1":
+                step_dirs[int(name)] = src
+            else:
+                os.rename(src, os.path.join(ckpt_dir, name))
+        out["staged_steps"] = sorted(step_dirs)
+        check("ckpts_staged", sorted(step_dirs) == [2, 3])
+
+        def deliver(step: int) -> None:
+            os.rename(step_dirs[step],
+                      os.path.join(ckpt_dir, str(step)))
+
+        replicas = []
+        for i in (0, 1):
+            cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
+                   "--ckpt-dir", ckpt_dir, "--config", CONFIG,
+                   "--device", "cpu", "--port", "0",
+                   "--port-file", pfiles[i]]
+            for ov in OVERRIDES:
+                cmd += ["--set", ov]
+            replicas.append(subprocess.Popen(
+                cmd, env=dict(os.environ, JAX_PLATFORMS="cpu")))
+            procs[f"replica{i}"] = replicas[i]
+        urls = []
+        for i in (0, 1):
+            url, err = wait_port_file(pfiles[i], replicas[i], 150,
+                                      f"replica {i}")
+            if err:
+                print(json.dumps(dict(out, error=err)), flush=True)
+                return 1
+            urls.append(url)
+
+        with open(fleet_cfg, "w") as f:
+            json.dump({
+                "models": [{"name": "m", "urls": urls}],
+                "health_poll_s": 0.5,
+                "request_timeout_s": 60,
+                "flight_recorder": True,
+                "recorder_dir": router_rec,
+                "recorder_sample_s": 0.25,
+                "recorder_segment_kb": 64,
+                "recorder_debounce_s": 1.0,
+                "recorder_bundle_window_s": 120,
+                "rollout_ckpt_dir": ckpt_dir,
+                "rollout_poll_s": 1.0,
+                "rollout_bake_s": 0.5,
+                "rollout_probes": 4,
+                "rollout_probe_px": 64,
+                # The smoke gates the MACHINERY (canary isolation,
+                # denylist, rollback target), not model quality: a
+                # random-init model's probe MAE is meaningless, so
+                # only an unservable checkpoint may fail the verdict.
+                "rollout_mae_degrade": 10.0,
+            }, f)
+        router = subprocess.Popen(
+            [sys.executable, os.path.join(TOOLS, "serve.py"),
+             "--fleet-config", fleet_cfg, "--device", "cpu",
+             "--port", "0", "--port-file", fleet_pfile],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        procs["router"] = router
+        rurl, err = wait_port_file(fleet_pfile, router, 30, "router")
+        if err:
+            print(json.dumps(dict(out, error=err)), flush=True)
+            return 1
+
+        # -- phase 1: adopt --------------------------------------------
+        deadline = time.monotonic() + 60
+        ro = {}
+        while time.monotonic() < deadline:
+            ro = rollout_of(rurl)
+            if ro.get("last_good") == 1:
+                break
+            time.sleep(0.5)
+        out["adopt"] = ro
+        check("adopt_last_good", ro.get("last_good") == 1, ro)
+        check("adopt_idle", ro.get("state", {}).get("m") == "idle"
+              if isinstance(ro.get("state"), dict)
+              else ro.get("state") == "idle", ro)
+        check("adopt_no_verdicts", not ro.get("verdicts"), ro)
+        check("adopt_steps", [loaded_step(u) for u in urls] == [1, 1])
+
+        # -- phase 2: rollback -----------------------------------------
+        deliver(2)
+        deadline = time.monotonic() + 120
+        baseline_saw = set()
+        while time.monotonic() < deadline:
+            baseline_saw.add(loaded_step(urls[1]))
+            ro = rollout_of(rurl)
+            if ro.get("verdicts", {}).get("m:rollback", 0) >= 1:
+                break
+            time.sleep(0.5)
+        out["rollback"] = ro
+        check("rollback_verdict",
+              ro.get("verdicts", {}).get("m:rollback", 0) >= 1, ro)
+        check("rollback_denylist_stats",
+              ro.get("denylist", {}).get("2", "") != "", ro)
+        deny_file = os.path.join(ckpt_dir, "reload_denylist.json")
+        try:
+            with open(deny_file) as f:
+                deny = json.load(f).get("steps", {})
+        except OSError:
+            deny = {}
+        check("rollback_denylist_disk", "2" in deny, deny)
+        check("rollback_unscorable",
+              "unscorable" in deny.get("2", {}).get("reason", ""), deny)
+        # The canary reloads BACK; give it a beat to settle.
+        deadline = time.monotonic() + 30
+        steps = []
+        while time.monotonic() < deadline:
+            steps = [loaded_step(u) for u in urls]
+            if steps == [1, 1]:
+                break
+            time.sleep(0.5)
+        out["post_rollback_steps"] = steps
+        check("rollback_restored", steps == [1, 1], steps)
+        check("baseline_never_served_bad",
+              2 not in baseline_saw, sorted(baseline_saw))
+        bundles = glob.glob(os.path.join(
+            router_rec, "incidents", "*rollout*"))
+        check("rollback_incident_bundle", len(bundles) >= 1,
+              os.listdir(os.path.join(router_rec, "incidents"))
+              if os.path.isdir(os.path.join(router_rec, "incidents"))
+              else "no incidents dir")
+
+        # -- phase 3: promote ------------------------------------------
+        deliver(3)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            ro = rollout_of(rurl)
+            if ro.get("last_good") == 3:
+                break
+            time.sleep(0.5)
+        out["promote"] = ro
+        check("promote_last_good", ro.get("last_good") == 3, ro)
+        check("promote_verdict",
+              ro.get("verdicts", {}).get("m:promote", 0) >= 1, ro)
+        deadline = time.monotonic() + 30
+        steps = []
+        while time.monotonic() < deadline:
+            steps = [loaded_step(u) for u in urls]
+            if steps == [3, 3]:
+                break
+            time.sleep(0.5)
+        out["post_promote_steps"] = steps
+        check("promote_fleet_wide", steps == [3, 3], steps)
+        check("promote_keeps_denylist",
+              rollout_of(rurl).get("denylist", {}).get("2", "") != "")
+        prom = fetch_text(rurl + "/metrics")
+        check("rollout_metrics_render",
+              'dsod_ctrl_rollout_verdicts_total{model="m",'
+              'verdict="rollback"} 1' in prom
+              and "dsod_ctrl_denylisted_steps" in prom)
+
+        # -- drain ------------------------------------------------------
+        for name in ("router", "replica0", "replica1"):
+            procs[name].send_signal(signal.SIGTERM)
+        rcs = {name: procs[name].wait(timeout=45)
+               for name in ("router", "replica0", "replica1")}
+        out["rcs"] = rcs
+        check("clean_drain", all(rc == 0 for rc in rcs.values()), rcs)
+    except Exception as e:  # noqa: BLE001 — report, then fail
+        import traceback
+
+        out["error"] = f"{type(e).__name__}: {e}"
+        traceback.print_exc(file=sys.stderr)
+        failures.append(out["error"])
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 30
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=max(0.1,
+                                      deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if not args.keep:
+            import shutil
+
+            for d in (ckpt_dir, stage_dir, router_rec):
+                shutil.rmtree(d, ignore_errors=True)
+            for f in pfiles + [fleet_pfile, fleet_cfg]:
+                try:
+                    os.unlink(f)
+                except OSError:
+                    pass
+
+    out["failures"] = failures
+    out["ok"] = not failures
+    print(json.dumps(out), flush=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

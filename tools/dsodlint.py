@@ -99,13 +99,22 @@ BOOKING_SEAMS: Set[Tuple[str, str]] = {
     (f"{PKG}/serve/engine.py", "InferenceEngine._complete"),
     (f"{PKG}/serve/engine.py", "InferenceEngine._finish"),
     (f"{PKG}/serve/router.py", "RouterHandler.do_POST"),
+    # Control-plane decision seams: every autoscale/rollout counter
+    # moves through ONE _record per plane, which also emits the
+    # flight-recorder event — book and evidence cannot drift apart.
+    (f"{PKG}/serve/controller.py", "FleetController._record"),
+    (f"{PKG}/serve/rollout.py", "RolloutManager._record"),
 }
 
 # Terminal-counter families (the accounting identity's terms).
 TERMINAL_COUNTERS = {"submitted", "served", "shed", "expired", "errors"}
 # Router-book / arm-stat booking methods that move a terminal counter.
+# The ctrl/rollout trio are the control-plane decision books — a stray
+# inc_decision/inc_verdict outside the _record seams is exactly the
+# book-without-evidence drift the seam exists to prevent.
 TERMINAL_BOOKING_CALLS = {"inc_submitted", "inc_shed", "inc_response",
-                          "inc_served"}
+                          "inc_served", "inc_decision", "inc_restart",
+                          "inc_verdict"}
 
 # Functions that open a traced scope when a function object is passed
 # to them (matched on the callee's terminal name: jax.jit, pl.jit,

@@ -18,6 +18,17 @@ model each, fixed ports) behind ONE router subprocess
 3. **recovery** — replica #1 restarts on its old port; asserts the
    health prober re-admits it, the half-open breaker probe passes, and
    the restarted replica actually serves again (its own /stats).
+4. **autoscale** — a SECOND router over the same replicas with the
+   closed-loop controller ARMED (serve/controller.py) and a shaped
+   (ramped) open-loop offered rate; mid-load, replica #1 takes SIGKILL
+   again.  Asserts: failover still absorbs the death in-flight (done ==
+   sent, book exact, response curve recorded), the controller notices
+   the hole and RESTORES capacity — a fresh supervised replica
+   subprocess spawned from ``ctrl_spawn_cmd``, health-gate-admitted,
+   actually serving (its own /stats) — every decision lands as a typed
+   ``ctrl_*`` flight-recorder event replayable from the router's ring
+   with every writer dead (tools/incident.py exit 0), and the
+   supervised replica DIES WITH its controller on drain (no orphan).
 
 Flight-recorder capture (PR 13, utils/flightrecorder.py): every
 process runs with the recorder armed.  After the kill the harness
@@ -47,9 +58,10 @@ interrupted fit) applies to serving chaos too: a killed replica is
 replaced by a NEW process, never revived in-process.
 
 Budget contract: internal deadlines (150 s replica binds + 30 s router
-+ ~25 s load legs + 90 s recovery + 60 s drain) sum under the t1.sh
-wrapper's 540 s, so a stall reports its own JSON diagnostic instead of
-dying to the outer timeout.
++ ~25 s load legs + 90 s recovery + 30 s router2 + ~20 s autoscale
+load + 180 s heal wait + 90 s drains) sum under the t1.sh wrapper's
+780 s, so a stall reports its own JSON diagnostic instead of dying to
+the outer timeout.
 """
 
 from __future__ import annotations
@@ -157,12 +169,16 @@ def main(argv=None) -> int:
     pfiles = [tempfile.mktemp(prefix=f"dsod_chaos_r{i}_") for i in (0, 1)]
     fleet_pfile = tempfile.mktemp(prefix="dsod_chaos_fleet_")
     fleet_cfg = tempfile.mktemp(prefix="dsod_chaos_cfg_", suffix=".json")
+    fleet_pfile2 = tempfile.mktemp(prefix="dsod_chaos_fleet2_")
+    fleet_cfg2 = tempfile.mktemp(prefix="dsod_chaos_cfg2_",
+                                 suffix=".json")
     # Flight-recorder rings: one per replica + one for the router.
     # The dead replica's dir is read from THIS process after the kill
     # — the whole point is that the evidence outlives its writer.
     rec_dirs = [tempfile.mkdtemp(prefix=f"dsod_chaos_rec{i}_")
                 for i in (0, 1)]
     router_rec = tempfile.mkdtemp(prefix="dsod_chaos_recrtr_")
+    router2_rec = tempfile.mkdtemp(prefix="dsod_chaos_recrtr2_")
     out = {"rps": args.rps, "duration_s": args.duration}
     procs = {}
     failures = []
@@ -410,28 +426,189 @@ def main(argv=None) -> int:
         check("final_book_consistent",
               stats["fleet"]["consistent"] is True, stats["fleet"])
 
-        # -- drain -----------------------------------------------------
+        # The leg-1..3 router drains here; leg 4 stands up its own
+        # with the control plane armed.
         router.send_signal(signal.SIGTERM)
         out["router_rc"] = router.wait(timeout=60)
-        for name in ("replica0", "replica1b"):
-            procs[name].send_signal(signal.SIGTERM)
-            out[f"{name}_rc"] = procs[name].wait(timeout=60)
+
+        # -- leg 4: SIGKILL with the controller armed ------------------
+        # Same replicas, SECOND router, controller ON: the kill now
+        # tests the ACTUATOR — failover absorbs the death in-flight
+        # while the controller notices the hole and restores capacity
+        # by spawning a fresh SUPERVISED replica subprocess (health-
+        # gated admission), every decision a typed ctrl_* event.  The
+        # offered load is SHAPED (a ramp) so the leg also proves the
+        # loadgen's response curve next to a real fleet transition.
+        spawn_cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
+                     "--config", "minet_vgg16_ref", "--init-random",
+                     "--device", "cpu", "--port", "{port}",
+                     "--port-file", "{port_file}"]
+        for ov in REPLICA_OVERRIDES:
+            spawn_cmd += ["--set", ov]
+        with open(fleet_cfg2, "w") as f:
+            json.dump({
+                "models": [{"name": "m", "urls": urls}],
+                "health_poll_s": 0.5,
+                "request_timeout_s": 60,
+                "retry_max_attempts": 3,
+                "retry_backoff_ms": 5,
+                "retry_backoff_max_ms": 100,
+                "breaker_failures": 1,
+                "breaker_reset_s": 1.0,
+                "flight_recorder": True,
+                "recorder_dir": router2_rec,
+                "recorder_sample_s": 0.25,
+                "recorder_segment_kb": 64,
+                "recorder_debounce_s": 1.0,
+                "recorder_bundle_window_s": 120,
+                "controller": True,
+                "ctrl_interval_s": 0.5,
+                "ctrl_dwell_s": 0.0,
+                "ctrl_cooldown_s": 2.0,
+                "ctrl_drain_grace_s": 2.0,
+                "ctrl_backoff_s": 1.0,
+                "ctrl_max_replicas": 3,
+                "ctrl_spawn_cmd": spawn_cmd,
+            }, f)
+        router2 = subprocess.Popen(
+            [sys.executable, os.path.join(TOOLS, "serve.py"),
+             "--fleet-config", fleet_cfg2, "--device", "cpu",
+             "--port", "0", "--port-file", fleet_pfile2],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        procs["router2"] = router2
+        r2url, err = wait_port_file(fleet_pfile2, router2, 30, "router2")
+        if err:
+            print(json.dumps(dict(out, error=err)), flush=True)
+            return 1
+        if not wait_ready(r2url, timeout_s=30):
+            print(json.dumps(dict(
+                out, error="router2 never became healthy")), flush=True)
+            return 1
+        auto = {}
+
+        def auto_leg():
+            auto.update(run_loadgen(
+                r2url, mode="open", rps=args.rps,
+                duration_s=args.duration, sizes=((48, 56),), seed=3,
+                timeout_s=60,
+                ramp=(args.rps * 0.5, args.rps * 1.5, args.duration)))
+
+        t = threading.Thread(target=auto_leg)
+        t.start()
+        time.sleep(args.kill_after)
+        replicas[1].kill()  # SIGKILL the restarted replica, again
+        replicas[1].wait(timeout=30)
+        t.join(timeout=240)
+        out["autoscale_load"] = auto
+        sent, done = auto.get("sent", 0), auto.get("done", 0)
+        check("auto_zero_lost", done == sent and sent > 0,
+              f"done={done} sent={sent}")
+        check("auto_failover_absorbed", auto.get("ok", 0) >= sent - 1,
+              auto)
+        check("auto_response_curve", len(auto.get("curve", [])) >= 2,
+              auto.get("curve"))
+        # The controller heals the hole: a restart booked per model, a
+        # supervised replica admitted (its spawn + warmup can take a
+        # couple of minutes on a CPU box — the deadline covers the
+        # supervisor's own ctrl_spawn_deadline_s).
+        deadline = time.monotonic() + 180
+        restarts, sup_urls = 0, {}
+        while time.monotonic() < deadline:
+            st = fetch_json(r2url + "/stats")
+            ctrl = st.get("controller", {})
+            restarts = ctrl.get("restarts", {}).get("m", 0)
+            sup_urls = ctrl.get("supervised", {})
+            if restarts >= 1 and sup_urls:
+                break
+            time.sleep(1.0)
+        out["autoscale_restarts"] = restarts
+        out["autoscale_supervised"] = sup_urls
+        check("auto_controller_healed",
+              restarts >= 1 and bool(sup_urls),
+              f"restarts={restarts} supervised={sup_urls}")
+        # The healed member actually serves: router-level probe, then
+        # the supervised replica's OWN book.
+        probe = run_loadgen(r2url, mode="closed", concurrency=2,
+                            requests=8, sizes=((48, 56),), seed=4,
+                            timeout_s=60)
+        out["autoscale_probe"] = probe
+        check("auto_probe_all_ok", probe["ok"] == probe["sent"], probe)
+        served = 0
+        for u in sup_urls.values():
+            try:
+                served += int(float(fetch_json(u + "/stats")
+                                    .get("served", 0) or 0))
+            except OSError:
+                pass
+        out["supervised_served"] = served
+        check("auto_supervised_serves", served >= 1, sup_urls)
+        st = fetch_json(r2url + "/stats")
+        out["autoscale_fleet"] = st["fleet"]
+        check("auto_book_consistent",
+              st["fleet"]["consistent"] is True, st["fleet"])
+        prom2 = fetch_text(r2url + "/metrics")
+        check("auto_ctrl_metrics",
+              metric_value(prom2, "dsod_ctrl_restarts_total") >= 1)
+
+        # Drain router2: supervised replicas die WITH their controller.
+        router2.send_signal(signal.SIGTERM)
+        out["router2_rc"] = router2.wait(timeout=90)
+        check("auto_clean_drain", out["router2_rc"] == 0)
+        orphaned = False
+        for u in sup_urls.values():
+            try:
+                fetch_json(u + "/stats", timeout=2.0)
+                orphaned = True
+            except OSError:
+                pass
+        check("auto_supervised_retired", not orphaned, sup_urls)
+        # Timeline replay: the decisions are typed ctrl_* events in the
+        # dead router's ring, reconstructible offline.
+        recs2 = read_records(router2_rec)
+        ctrl_events = [str(r.get("event")) for r in recs2
+                       if str(r.get("event", "")).startswith("ctrl_")]
+        out["ctrl_events"] = sorted(set(ctrl_events))
+        check("auto_ctrl_events_replayed",
+              "ctrl_spawn" in ctrl_events
+              and "ctrl_restart" in ctrl_events, out["ctrl_events"])
+        an3 = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "incident.py"),
+             "--ring", router2_rec], capture_output=True)
+        check("auto_analyzer_ring", an3.returncode == 0,
+              an3.stdout[-200:].decode(errors="replace"))
+
+        # -- drain -----------------------------------------------------
+        procs["replica0"].send_signal(signal.SIGTERM)
+        out["replica0_rc"] = procs["replica0"].wait(timeout=60)
         check("clean_drain", out["router_rc"] == 0
-              and out["replica0_rc"] == 0 and out["replica1b_rc"] == 0)
+              and out["replica0_rc"] == 0)
         out["failures"] = failures
         print(json.dumps(out), flush=True)
         return 0 if not failures else 1
     finally:
+        # SIGTERM first, SIGKILL stragglers: router2's clean drain is
+        # what retires its supervised replicas — killing it outright on
+        # a failure path would orphan them (start_new_session).
         for proc in procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 45
+        for proc in procs.values():
+            while proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.25)
             if proc.poll() is None:
                 proc.kill()
                 proc.wait(timeout=30)
-        for f in pfiles + [fleet_pfile, fleet_cfg]:
+        for f in pfiles + [fleet_pfile, fleet_cfg, fleet_pfile2,
+                           fleet_cfg2]:
             if os.path.exists(f):
                 os.unlink(f)
         import shutil
 
-        for d in rec_dirs + [router_rec]:
+        for d in rec_dirs + [router_rec, router2_rec]:
             shutil.rmtree(d, ignore_errors=True)
 
 

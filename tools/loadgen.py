@@ -44,6 +44,18 @@ def parse_args(argv=None):
                    help="open loop: offered requests/sec")
     p.add_argument("--duration", type=float, default=5.0,
                    help="open loop: seconds of offered load")
+    p.add_argument("--ramp", default=None, metavar="START:END:SECONDS",
+                   help="open loop: sweep the offered rate linearly "
+                        "from START to END rps over SECONDS (overrides "
+                        "--rps; the summary appends a per-time-bucket "
+                        "response curve — offered/done/ok/p99 — next "
+                        "to the latency summary)")
+    p.add_argument("--burst", action="append", default=[],
+                   metavar="RPS:START:DUR",
+                   help="open loop: add RPS extra offered rate for DUR "
+                        "seconds starting at START (repeatable; stacks "
+                        "on --rps or --ramp; shaped runs report the "
+                        "response curve)")
     p.add_argument("--size", type=int, action="append", default=[],
                    help="square request image side (repeatable; "
                         "default 320)")
@@ -123,13 +135,28 @@ def main(argv=None) -> int:
             model, _, tenant = key.partition(":")
             mix.append({"model": model, "tenant": tenant or None,
                         "weight": float(weight)})
+    ramp = None
+    if args.ramp:
+        parts = args.ramp.split(":")
+        if len(parts) != 3:
+            raise SystemExit(f"--ramp {args.ramp!r} is not "
+                             "START:END:SECONDS")
+        ramp = (float(parts[0]), float(parts[1]), float(parts[2]))
+    bursts = []
+    for spec in args.burst:
+        parts = spec.split(":")
+        if len(parts) != 3:
+            raise SystemExit(f"--burst {spec!r} is not RPS:START:DUR")
+        bursts.append((float(parts[0]), float(parts[1]),
+                       float(parts[2])))
     summary = run_loadgen(
         url, mode=args.mode, concurrency=args.concurrency,
         requests=args.requests, rps=args.rps, duration_s=args.duration,
         sizes=sizes, seed=args.seed, slo_ms=args.slo_ms,
         timeout_s=args.timeout, precision=args.precision,
         model=args.model, tenant=args.tenant, mix=mix,
-        slowest=args.slowest, quality=args.quality, slo=args.slo)
+        slowest=args.slowest, quality=args.quality, slo=args.slo,
+        ramp=ramp, bursts=bursts or None)
     if args.server_stats:
         try:
             summary["server"] = fetch_stats(url)

@@ -163,10 +163,15 @@ def fleet_inventory() -> dict:
     from distributed_sod_project_tpu.configs import (FleetConfig,
                                                      FleetTenantConfig)
 
+    # Controller + rollout armed so the dsod_ctrl_* control-plane
+    # families render: both ctors are side-effect-free by design (no
+    # threads, no subprocesses, no ckpt reads until start()/tick()),
+    # so arming them here costs a name check exactly what it should.
     fleet = Fleet([_StubBackend()], FleetConfig(
         tenants=(FleetTenantConfig(name="_probe", priority=-1),),
         slo_objectives=("avail:model=m:availability:0.99:60",),
-        prober_interval_s=1.0))
+        prober_interval_s=1.0, controller=True,
+        rollout_ckpt_dir="/nonexistent-dsod-lint"))
     fleet.slo.observe_outcome("ok", 1.0, model="m")
     fleet.slo.observe_outcome("error", 1.0, model="m")
     fleet.probe_stats.record("m", True, 1.0, mae=0.01, iou=0.9)
@@ -180,6 +185,17 @@ def fleet_inventory() -> dict:
     r.inc_hedge("m")
     r.inc_failover("m")
     r.inc_response("default", "ok")
+    # Populate the lazily-labeled control-plane families (decisions /
+    # restarts / verdicts / canary-mae render only once booked).
+    c = fleet.controller.stats
+    c.inc_decision("scale_out", "queue_bound")
+    c.inc_restart("m")
+    c.set_supervised("m", "running", 1)
+    ro = fleet.rollout.stats
+    ro.set_state("m", "canary")
+    ro.set_denylisted("m", 1)
+    ro.set_canary_mae("m", 0.01)
+    ro.inc_verdict("m", "promote")
     from distributed_sod_project_tpu.utils.observability import \
         parse_prom_text
 

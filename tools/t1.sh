@@ -4,14 +4,15 @@
 # pytest's status, so CI and humans invoke the exact same command the
 # roadmap promises (the pytest line below is verbatim ROADMAP.md).
 #
-# Smoke-budget audit (PR 13): the non-gating smokes below carry their
-# own wrappers (420+700+420+300+420+420+420+300+900+720+600+540 ≈ 103
-# min worst case) — far past the 870 s the GATING pytest line gets.
-# Each wrapper deliberately EXCEEDS its tool's documented internal
-# budget contract (serve_smoke sums to ~300 s under its 420 s wrapper,
-# health 900, fleet 720, slo 600, chaos 540): a stalled smoke must die
-# to its OWN deadline with its own JSON diagnostic, never to the outer
-# timeout — so the wrappers must not be trimmed below the contracts.
+# Smoke-budget audit (PR 13, re-audited PR 16): the non-gating smokes
+# below carry their own wrappers (420+700+420+300+420+420+420+300+900+
+# 720+600+780+600 ≈ 117 min worst case) — far past the 870 s the
+# GATING pytest line gets.  Each wrapper deliberately EXCEEDS its
+# tool's documented internal budget contract (serve_smoke sums to
+# ~300 s under its 420 s wrapper, health 900, fleet 720, slo 600,
+# chaos 780, ctrl 600): a stalled smoke must die to its OWN deadline
+# with its own JSON diagnostic, never to the outer timeout — so the
+# wrappers must not be trimmed below the contracts.
 # The starvation fix is the gate instead: set DSOD_T1_FAST=1 and every
 # non-gating smoke is skipped, so a machine that wants only the 870 s
 # gating wrapper runs exactly it.
@@ -61,8 +62,11 @@ timeout -k 10 720 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py \
 echo "== slo smoke: real router + always-500 remote replica, synthetic prober detects the outage via burn-rate alert at ZERO live traffic, /slo consistent with the router book, capacity ledger live on the replica (recorded, non-gating) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/slo_smoke.py \
   || echo "slo smoke failed (non-gating; tests/test_slo.py + tests/test_capacity.py below gate the in-process side)"
-echo "== fleet chaos: SIGKILL a replica under open-loop load — zero lost responses, exact accounting, breaker half-open re-admission, flight-recorder pre-kill segments replay + router incident bundle (recorded, non-gating) =="
-timeout -k 10 540 env JAX_PLATFORMS=cpu python tools/fleet_chaos.py \
-  || echo "fleet chaos failed (non-gating; tests/test_failover.py + tests/test_serve_chaos.py + tests/test_flightrecorder.py below gate the in-process side)"
+echo "== fleet chaos: SIGKILL a replica under open-loop load — zero lost responses, exact accounting, breaker half-open re-admission, flight-recorder pre-kill segments replay + router incident bundle, controller heals the hole under ramped load + supervised replica dies with its controller (recorded, non-gating) =="
+timeout -k 10 780 env JAX_PLATFORMS=cpu python tools/fleet_chaos.py \
+  || echo "fleet chaos failed (non-gating; tests/test_failover.py + tests/test_serve_chaos.py + tests/test_controller.py + tests/test_flightrecorder.py below gate the in-process side)"
+echo "== rollout smoke: canary-gated checkpoint delivery across real subprocesses — NaN-poisoned step rolled back + denylisted + incident bundle, good step promoted fleet-wide (recorded, non-gating) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/ctrl_smoke.py \
+  || echo "rollout smoke failed (non-gating; tests/test_controller.py below gates the state-machine side)"
 fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); if [ "$dsodlint_rc" -ne 0 ]; then echo "t1: FAILING on dsodlint rc=$dsodlint_rc (gating leg)"; exit "$dsodlint_rc"; fi; exit $rc
