@@ -638,6 +638,30 @@ class FleetConfig:
     # ...or fewer than this fraction of canary probes answered.
     rollout_min_avail: float = 1.0
 
+    # -- router-door response cache (serve/cache.py; docs/SERVING.md
+    #    "Router cache") ----------------------------------------------
+    # Byte budget for the content-addressed response LRU (entries are
+    # keyed on payload hash × model × precision arm × loaded
+    # checkpoint step).  0 (default): cache fully off — no object, no
+    # threads, byte-identical /metrics.
+    cache_bytes: int = 0
+    # Fold concurrent identical payloads into ONE engine submit with N
+    # responses (each booked cache_hit).  Only meaningful with
+    # cache_bytes > 0.
+    cache_coalesce: bool = True
+    # Arm the perceptual-hash near-dup arm: resize-normalized hits for
+    # perceptually identical payloads.  Quality-gated offline by
+    # tools/cache_gate.py; arm the online shadow gate via
+    # cache_shadow_sample.
+    cache_near_dup: bool = False
+    # Near-dup match budget in Hamming bits over the 256-bit phash
+    # (0 = exact-phash matches only; ~16 tolerates typical re-encode/
+    # resize perturbations — see tools/cache_baseline.json).
+    cache_near_dup_hamming: int = 0
+    # Shadow-score every Nth near-dup hit against a fresh engine
+    # forward, off the request path (0 = no shadow scoring).
+    cache_shadow_sample: int = 0
+
 
 def fleet_config_from_dict(d: Dict) -> FleetConfig:
     """Build + validate a FleetConfig from its JSON dict (the
@@ -855,6 +879,33 @@ def validate_fleet_config(fc: FleetConfig) -> FleetConfig:
             raise ValueError(
                 f"fleet rollout_min_avail must be in [0, 1], got "
                 f"{fc.rollout_min_avail}")
+    if fc.cache_bytes < 0:
+        raise ValueError(
+            f"fleet cache_bytes must be >= 0 (0 = off), got "
+            f"{fc.cache_bytes}")
+    if fc.cache_near_dup and fc.cache_bytes <= 0:
+        raise ValueError(
+            "fleet cache_near_dup requires cache_bytes > 0 — the "
+            "near-dup arm serves out of the exact arm's LRU")
+    if fc.cache_near_dup_hamming < 0 \
+            or fc.cache_near_dup_hamming > 256:
+        raise ValueError(
+            "fleet cache_near_dup_hamming must be in [0, 256] (bits "
+            f"over the 256-bit phash), got {fc.cache_near_dup_hamming}")
+    if fc.cache_near_dup_hamming > 0 and not fc.cache_near_dup:
+        raise ValueError(
+            "fleet cache_near_dup_hamming is set but cache_near_dup is "
+            "off — a Hamming budget without the near-dup arm does "
+            "nothing (loud beats silent)")
+    if fc.cache_shadow_sample < 0:
+        raise ValueError(
+            f"fleet cache_shadow_sample must be >= 0 (every Nth "
+            f"near-dup hit; 0 = off), got {fc.cache_shadow_sample}")
+    if fc.cache_shadow_sample > 0 and not fc.cache_near_dup:
+        raise ValueError(
+            "fleet cache_shadow_sample is set but cache_near_dup is "
+            "off — only near-dup hits are shadow-scored (exact hits "
+            "are bitwise the engine's own answer)")
     if fc.default_tenant not in tseen:
         low = min((t.priority for t in fc.tenants), default=0)
         fc = dataclasses.replace(

@@ -1,0 +1,554 @@
+"""Router-tier content-addressed response cache + in-flight request
+coalescing + quality-gated near-dup serving (docs/SERVING.md "Router
+cache"; ROADMAP item 3).
+
+Real image traffic at millions-of-users scale is highly redundant —
+reposted, resized, re-encoded images — yet without this layer every
+request pays a full device forward.  The cache sits at the ROUTER door
+(`serve/router.py`), in front of every engine:
+
+- **exact arm** — a content-addressed, bounded-byte-budget LRU keyed
+  on ``(sha256(payload), model, requested precision arm, loaded
+  checkpoint step)``.  A hit returns the stored mask bytes without
+  touching any engine.  The res bucket is a pure function of the
+  payload for a fixed model config and the stored entry carries the
+  bucket the response was actually served at, so the full ISSUE key
+  (payload hash, model, res bucket, precision arm, step) is faithful.
+  The **loaded checkpoint step is part of the key**: hot reload,
+  rollout promotion, and denylist rollback all change the step, which
+  makes every old entry unreachable instantly — there is no
+  invalidation hook to forget, stale entries simply age out of the
+  LRU.  Requests routed to a remote backend (step unknown at the
+  router) BYPASS the cache entirely — staleness safety over hit rate.
+
+- **in-flight coalescing** — concurrent identical payloads fold into
+  ONE engine submit: the first becomes the *leader* and dispatches
+  normally; the rest become *followers* that wait (bounded by their
+  own residual deadline) for the leader's response and are each
+  terminal-booked as ``cache_hit``.  A follower whose leader fails,
+  times out, or produces a non-cacheable response FALLS THROUGH to its
+  own normal dispatch — coalescing can only save work, never lose a
+  request.
+
+- **optional near-dup arm** — a 16×16 block-mean luminance perceptual
+  hash (256-bit) indexes entries per (model, arm, step); a hit within
+  the configured Hamming budget serves the stored mask
+  resize-normalized (PIL bilinear) to the requester's dimensions.
+  Quality is gated the precision-arm way: offline budget via
+  ``tools/cache_gate.py`` (checked-in ``tools/cache_baseline.json``),
+  online via shadow scoring — every Nth near-dup hit re-forwards
+  through the engine off the request path (bounded in-flight, drops
+  counted) and records the MAE between the served and fresh masks.
+
+Only NON-DEGRADED 200s served at the requested arm are inserted: a
+degraded response is a load artifact, not the model's answer for that
+(payload, arm, step), and must never be replayed once the engine
+recovers.
+
+A cache hit is a **new terminal class**: ``serve/fleet.py`` extends
+the router accounting identity to
+``served + shed + expired + errors + cache_hit == submitted`` and the
+booking seam (`RouterHandler._serve_cache_hit`) is registered in
+dsodlint's BOOKING_SEAMS.  Everything here is off by default
+(``fleet.cache_bytes = 0``): when disabled the fleet never constructs
+a RouterCache, `/metrics` is byte-identical, and zero threads exist.
+
+No jax import — this module runs on the router's request threads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# Entries competing for the byte budget are body bytes plus real
+# bookkeeping (key tuple, header strings, LRU node, phash index row) —
+# charge a flat overhead so a flood of tiny payloads cannot blow the
+# budget through bookkeeping alone.
+ENTRY_OVERHEAD_BYTES = 512
+
+# A near-dup Hamming scan is O(candidates); bound it so a huge cache
+# cannot turn the miss path into a linear walk.  The exact-phash dict
+# hit (Hamming 0) is O(1) and unaffected.
+NEAR_SCAN_CAP = 512
+
+PHASH_SIDE = 16
+PHASH_BITS = PHASH_SIDE * PHASH_SIDE
+
+
+def payload_cache_key(body: bytes, model: str, precision: Optional[str],
+                      step: int) -> Tuple[str, str, str, int]:
+    """The exact-arm lookup key.  ``precision`` is the REQUESTED arm
+    ("" when the request left it to the server default); the degraded
+    ladder never pollutes the key because degraded responses are never
+    inserted."""
+    return (hashlib.sha256(body).hexdigest(), str(model),
+            str(precision or ""), int(step))
+
+
+def payload_fingerprint(body: bytes):
+    """``(phash, (h, w))`` of an x-npy request payload, or ``None``
+    when the body does not decode to a 2-D/3-D image.
+
+    The phash is a 256-bit block-mean luminance average-hash: the
+    image's channel-mean is reduced to a 16×16 grid of true block
+    means (integral-free ``np.add.reduceat`` with per-block area
+    normalization, robust across resizes), thresholded at the grid
+    mean.  Pure numpy, a few hundred microseconds at request sizes.
+    """
+    try:
+        arr = np.load(io.BytesIO(body), allow_pickle=False)
+    except Exception:  # noqa: BLE001 — malformed body: no fingerprint
+        return None
+    a = np.asarray(arr)
+    if a.ndim == 3:
+        a = a.mean(axis=2)
+    if a.ndim != 2:
+        return None
+    h, w = int(a.shape[0]), int(a.shape[1])
+    if h < PHASH_SIDE or w < PHASH_SIDE:
+        return None
+    a = a.astype(np.float32, copy=False)
+    yb = (np.arange(PHASH_SIDE) * h) // PHASH_SIDE
+    xb = (np.arange(PHASH_SIDE) * w) // PHASH_SIDE
+    sums = np.add.reduceat(np.add.reduceat(a, yb, axis=0), xb, axis=1)
+    ylen = np.diff(np.append(yb, h)).astype(np.float32)
+    xlen = np.diff(np.append(xb, w)).astype(np.float32)
+    means = sums / (ylen[:, None] * xlen[None, :])
+    bits = (means > means.mean()).ravel()
+    v = 0
+    for b in bits:
+        v = (v << 1) | int(b)
+    return v, (h, w)
+
+
+def hamming(a: int, b: int) -> int:
+    return bin(a ^ b).count("1")
+
+
+def _decode_mask(body: bytes) -> np.ndarray:
+    return np.asarray(np.load(io.BytesIO(body), allow_pickle=False),
+                      np.float32)
+
+
+def _encode_mask(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr, np.float32))
+    return buf.getvalue()
+
+
+def resize_mask_body(body: bytes, hw: Tuple[int, int]) -> bytes:
+    """Resize a stored x-npy mask body to the requester's ``(h, w)``
+    (PIL bilinear, the same resampler eval uses) — the near-dup arm's
+    resize normalization.  Returns ``body`` unchanged when the
+    dimensions already match."""
+    mask = _decode_mask(body)
+    if mask.shape == tuple(hw):
+        return body
+    from PIL import Image
+
+    im = Image.fromarray((np.clip(mask, 0.0, 1.0) * 255.0)
+                         .astype(np.uint8))
+    im = im.resize((int(hw[1]), int(hw[0])), Image.BILINEAR)
+    return _encode_mask(np.asarray(im, np.float32) / 255.0)
+
+
+@dataclass
+class CacheEntry:
+    """One cached 200: the mask bytes plus the response headers a hit
+    must reproduce.  ``step`` / ``phash`` ride along for the index
+    bookkeeping (eviction must drop the phash row it owns)."""
+
+    body: bytes
+    content_type: str
+    precision: str
+    res_bucket: str
+    model: str
+    step: int
+    phash: Optional[int] = None
+
+    @property
+    def cost(self) -> int:
+        return len(self.body) + ENTRY_OVERHEAD_BYTES
+
+
+class _Inflight:
+    """Coalescing token: the leader resolves it with its CacheEntry
+    (or ``None`` — failure / non-cacheable response) and every
+    follower wakes."""
+
+    __slots__ = ("event", "entry", "followers")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.entry: Optional[CacheEntry] = None
+        self.followers = 0
+
+
+@dataclass
+class CacheStats:
+    """Lock-guarded cache counters → /stats snapshot + dsod_cache_*
+    prom families (rendered by :meth:`RouterCache.prom_families` so
+    the gauges can read the LRU's live totals)."""
+
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    hits: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    misses: Dict[str, int] = field(default_factory=dict)
+    coalesced: Dict[str, int] = field(default_factory=dict)
+    inserts: Dict[str, int] = field(default_factory=dict)
+    evictions: int = 0
+    shadow_total: int = 0
+    shadow_dropped: int = 0
+    shadow_mae_sum: float = 0.0
+
+    def inc_hit(self, model: str, kind: str) -> None:
+        with self._lock:
+            k = (model, kind)
+            self.hits[k] = self.hits.get(k, 0) + 1
+
+    def inc_miss(self, model: str) -> None:
+        with self._lock:
+            self.misses[model] = self.misses.get(model, 0) + 1
+
+    def inc_coalesced(self, model: str) -> None:
+        with self._lock:
+            self.coalesced[model] = self.coalesced.get(model, 0) + 1
+
+    def inc_insert(self, model: str) -> None:
+        with self._lock:
+            self.inserts[model] = self.inserts.get(model, 0) + 1
+
+    def inc_evictions(self, n: int = 1) -> None:
+        with self._lock:
+            self.evictions += n
+
+    def record_shadow(self, mae: float) -> None:
+        with self._lock:
+            self.shadow_total += 1
+            self.shadow_mae_sum += float(mae)
+
+    def record_shadow_dropped(self) -> None:
+        with self._lock:
+            self.shadow_dropped += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            hits = {}
+            for (model, kind), n in self.hits.items():
+                hits.setdefault(model, {})[kind] = n
+            out = {
+                "hits": hits,
+                "misses": dict(self.misses),
+                "coalesced": dict(self.coalesced),
+                "inserts": dict(self.inserts),
+                "evictions": self.evictions,
+                "hits_total": sum(self.hits.values()),
+                "misses_total": sum(self.misses.values()),
+            }
+            if self.shadow_total or self.shadow_dropped:
+                out["shadow"] = {
+                    "total": self.shadow_total,
+                    "dropped": self.shadow_dropped,
+                    "mae_avg": (self.shadow_mae_sum / self.shadow_total
+                                if self.shadow_total else 0.0),
+                }
+            return out
+
+    def raw(self) -> Dict:
+        """One consistent copy of every counter (the prom render reads
+        this instead of reaching into the lock)."""
+        with self._lock:
+            return {
+                "hits": dict(self.hits), "misses": dict(self.misses),
+                "coalesced": dict(self.coalesced),
+                "inserts": dict(self.inserts),
+                "evictions": self.evictions,
+                "shadow_total": self.shadow_total,
+                "shadow_dropped": self.shadow_dropped,
+                "shadow_mae_sum": self.shadow_mae_sum,
+            }
+
+
+class RouterCache:
+    """The router-door cache.  Thread-safe; all request-path work is a
+    hash + dict ops under one lock (the near-dup fingerprint is pure
+    numpy computed OUTSIDE the lock).
+
+    Request-path protocol (`RouterHandler.do_POST`):
+
+    ``begin(model, body, precision, step)`` → ``(verdict, obj)``:
+
+    - ``("exact", entry)`` / ``("near", (entry, hw))`` — serve the
+      stored bytes (near: resize-normalize to ``hw`` first), book
+      ``cache_hit``, done.  No engine is touched.
+    - ``("follower", token)`` — an identical payload is already in
+      flight; wait on ``token.event`` up to the residual deadline,
+      then ``token.entry`` is the leader's cacheable response (serve
+      it, book ``cache_hit``) or ``None`` (fall through to a normal
+      dispatch).
+    - ``("leader", handle)`` — dispatch normally, then call
+      ``complete(handle, code=..., headers=..., body=...)`` with
+      whatever was sent to the client (or ``abandon(handle)`` on any
+      non-response path) so followers wake and the LRU fills.
+    """
+
+    def __init__(self, max_bytes: int, *, coalesce: bool = True,
+                 near_dup: bool = False, near_hamming: int = 0,
+                 shadow_sample: int = 0, shadow_inflight: int = 2):
+        self.max_bytes = int(max_bytes)
+        self.coalesce = bool(coalesce)
+        self.near_dup = bool(near_dup)
+        self.near_hamming = int(near_hamming)
+        self.shadow_sample = int(shadow_sample)
+        self.stats = CacheStats()
+        self._lock = threading.Lock()
+        self._lru: "OrderedDict[Tuple, CacheEntry]" = OrderedDict()
+        self._bytes = 0
+        # (model, precision, step, phash) -> exact key, for O(1)
+        # Hamming-0 near hits; the Hamming>0 scan walks its values.
+        self._phash: Dict[Tuple, Tuple] = {}
+        self._inflight: Dict[Tuple, _Inflight] = {}
+        self._near_seen = 0
+        self._shadow_sem = threading.BoundedSemaphore(
+            max(1, int(shadow_inflight)))
+
+    # -- request path --------------------------------------------------
+
+    def begin(self, model: str, body: bytes, precision: Optional[str],
+              step: int):
+        key = payload_cache_key(body, model, precision, step)
+        with self._lock:
+            ent = self._lru.get(key)
+            if ent is not None:
+                self._lru.move_to_end(key)
+                self.stats.inc_hit(model, "exact")
+                return "exact", ent
+        ph = None
+        if self.near_dup:
+            fp = payload_fingerprint(body)
+            if fp is not None:
+                ph, hw = fp
+                ent = self._near_lookup(model, precision, step, ph)
+                if ent is not None:
+                    self.stats.inc_hit(model, "near")
+                    return "near", (ent, hw)
+        self.stats.inc_miss(model)
+        if not self.coalesce:
+            return "leader", (key, None, ph)
+        with self._lock:
+            tok = self._inflight.get(key)
+            if tok is not None:
+                tok.followers += 1
+                return "follower", tok
+            tok = _Inflight()
+            self._inflight[key] = tok
+            return "leader", (key, tok, ph)
+
+    def _near_lookup(self, model: str, precision: Optional[str],
+                     step: int, ph: int) -> Optional[CacheEntry]:
+        prefix = (str(model), str(precision or ""), int(step))
+        with self._lock:
+            key = self._phash.get(prefix + (ph,))
+            if key is not None:
+                ent = self._lru.get(key)
+                if ent is not None:
+                    self._lru.move_to_end(key)
+                    return ent
+            if self.near_hamming > 0:
+                for pk, key in list(self._phash.items())[:NEAR_SCAN_CAP]:
+                    if pk[:3] != prefix:
+                        continue
+                    if hamming(pk[3], ph) <= self.near_hamming:
+                        ent = self._lru.get(key)
+                        if ent is not None:
+                            self._lru.move_to_end(key)
+                            return ent
+        return None
+
+    def complete(self, handle, *, code: int, headers: Dict[str, str],
+                 body: Optional[bytes], model: str) -> None:
+        """Leader epilogue: insert the response if cacheable, then wake
+        followers.  ``headers`` are the response headers actually sent
+        (the `_send_capture` tee in serve/server.py)."""
+        key, tok, ph = handle
+        entry = None
+        if (code == 200 and body
+                and str(headers.get("X-Degraded", "0")) in ("", "0")
+                and headers.get("Content-Type") == "application/x-npy"):
+            entry = CacheEntry(
+                body=bytes(body),
+                content_type="application/x-npy",
+                precision=str(headers.get("X-Precision", "")),
+                res_bucket=str(headers.get("X-Res-Bucket", "")),
+                model=str(model), step=key[3], phash=ph)
+            self._insert(key, entry)
+            self.stats.inc_insert(model)
+        self._resolve(key, tok, entry)
+
+    def abandon(self, handle) -> None:
+        """Leader died without a response (exception, shed, expiry…):
+        wake followers empty-handed so they fall through to their own
+        dispatch."""
+        key, tok, _ph = handle
+        self._resolve(key, tok, None)
+
+    def _resolve(self, key, tok: Optional[_Inflight],
+                 entry: Optional[CacheEntry]) -> None:
+        if tok is None:
+            return
+        with self._lock:
+            if self._inflight.get(key) is tok:
+                del self._inflight[key]
+        tok.entry = entry
+        tok.event.set()
+
+    # -- store ---------------------------------------------------------
+
+    def _insert(self, key, entry: CacheEntry) -> None:
+        if self.max_bytes <= 0:
+            return
+        if entry.cost > self.max_bytes:
+            return  # larger than the whole budget: never cacheable
+        evicted = 0
+        with self._lock:
+            old = self._lru.pop(key, None)
+            if old is not None:
+                self._bytes -= old.cost
+                self._drop_phash(key, old)
+            self._lru[key] = entry
+            self._bytes += entry.cost
+            if entry.phash is not None:
+                # Index prefix mirrors the LOOKUP key components (the
+                # REQUESTED arm, not the served X-Precision) so hits
+                # and inserts agree on "" meaning server-default.
+                self._phash[(key[1], key[2], key[3],
+                             entry.phash)] = key
+            while self._bytes > self.max_bytes and self._lru:
+                k, e = self._lru.popitem(last=False)
+                self._bytes -= e.cost
+                self._drop_phash(k, e)
+                evicted += 1
+        if evicted:
+            self.stats.inc_evictions(evicted)
+
+    def _drop_phash(self, key, entry: CacheEntry) -> None:
+        if entry.phash is None:
+            return
+        pk = (key[1], key[2], key[3], entry.phash)
+        if self._phash.get(pk) == key:
+            del self._phash[pk]
+
+    # -- near-dup shadow gate ------------------------------------------
+
+    def should_shadow(self) -> bool:
+        """Deterministic every-Nth sampling of near-dup hits for the
+        online quality gate (PR 10 discipline: sampled, bounded,
+        drop-counted — never queued behind live traffic)."""
+        if self.shadow_sample <= 0:
+            return False
+        with self._lock:
+            self._near_seen += 1
+            return self._near_seen % self.shadow_sample == 0
+
+    def submit_shadow(self, body: bytes, served_body: bytes,
+                      forward) -> None:
+        """Score one near-dup hit off the request path: re-forward the
+        ACTUAL request through ``forward(image) -> (pred, meta)`` (the
+        engine's blocking predict — booked in the engine's own book
+        like any direct submit, never the router book) and record the
+        MAE between the served mask and the fresh one.  Bounded
+        in-flight; saturated → dropped and counted."""
+        if not self._shadow_sem.acquire(blocking=False):
+            self.stats.record_shadow_dropped()
+            return
+        t = threading.Thread(
+            target=self._shadow_run, args=(body, served_body, forward),
+            name="cache-shadow", daemon=True)
+        t.start()
+
+    def _shadow_run(self, body: bytes, served_body: bytes, forward):
+        try:
+            img = np.load(io.BytesIO(body), allow_pickle=False)
+            pred, _meta = forward(img)
+            served = _decode_mask(served_body)
+            fresh = np.asarray(pred, np.float32)
+            if fresh.shape != served.shape:
+                served = _decode_mask(
+                    resize_mask_body(served_body, fresh.shape[:2]))
+            self.stats.record_shadow(
+                float(np.mean(np.abs(fresh - served))))
+        except Exception:  # noqa: BLE001 — telemetry must not throw
+            self.stats.record_shadow_dropped()
+        finally:
+            self._shadow_sem.release()
+
+    # -- observability -------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        out = self.stats.snapshot()
+        with self._lock:
+            out["bytes"] = self._bytes
+            out["entries"] = len(self._lru)
+            out["max_bytes"] = self.max_bytes
+            out["inflight"] = len(self._inflight)
+        out["near_dup"] = self.near_dup
+        return out
+
+    def prom_families(self, labels: str = ""):
+        """dsod_cache_* families for the fleet /metrics render —
+        merged through the same merge_prom_families machinery as every
+        other family group, so TYPE appears once per family however
+        many groups contribute."""
+        from ..utils.observability import _merge_labels
+
+        raw = self.stats.raw()
+        with self._lock:
+            nbytes = self._bytes
+            entries = len(self._lru)
+
+        def line(name, value, extra=""):
+            lbl = _merge_labels(labels, extra)
+            if lbl:
+                return f"{name}{{{lbl}}} {value}"
+            return f"{name} {value}"
+
+        fams = [
+            ("dsod_cache_hits_total", "counter",
+             [line("dsod_cache_hits_total", n,
+                   'model="%s",kind="%s"' % (m, k))
+              for (m, k), n in sorted(raw["hits"].items())]),
+            ("dsod_cache_misses_total", "counter",
+             [line("dsod_cache_misses_total", n, 'model="%s"' % m)
+              for m, n in sorted(raw["misses"].items())]),
+            ("dsod_cache_coalesced_total", "counter",
+             [line("dsod_cache_coalesced_total", n, 'model="%s"' % m)
+              for m, n in sorted(raw["coalesced"].items())]),
+            ("dsod_cache_inserts_total", "counter",
+             [line("dsod_cache_inserts_total", n, 'model="%s"' % m)
+              for m, n in sorted(raw["inserts"].items())]),
+            ("dsod_cache_evictions_total", "counter",
+             [line("dsod_cache_evictions_total", raw["evictions"])]),
+            ("dsod_cache_bytes", "gauge",
+             [line("dsod_cache_bytes", nbytes)]),
+            ("dsod_cache_entries", "gauge",
+             [line("dsod_cache_entries", entries)]),
+        ]
+        if self.near_dup:
+            total = raw["shadow_total"]
+            mae = (raw["shadow_mae_sum"] / total) if total else 0.0
+            fams += [
+                ("dsod_cache_shadow_total", "counter",
+                 [line("dsod_cache_shadow_total", total)]),
+                ("dsod_cache_shadow_dropped_total", "counter",
+                 [line("dsod_cache_shadow_dropped_total",
+                       raw["shadow_dropped"])]),
+                ("dsod_cache_shadow_mae_avg", "gauge",
+                 [line("dsod_cache_shadow_mae_avg", round(mae, 6))]),
+            ]
+        return fams

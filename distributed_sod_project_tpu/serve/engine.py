@@ -302,6 +302,18 @@ class InferenceEngine:
         if self.recorder is not None:
             self.recorder.alert_transition(rule, old, new, state)
 
+    @property
+    def loaded_step(self) -> Optional[int]:
+        """The checkpoint step currently serving (``None`` for engines
+        started from raw variables with no checkpoint identity).  The
+        router cache (serve/cache.py) keys every entry on this, which
+        is the whole invalidation story: hot reload, rollout
+        promotion, and denylist rollback all move it, making old
+        entries unreachable.  Reads are a single atomic attribute load
+        — the reload path swaps it under ``_var_lock`` with the arm
+        views, but a reader needs one consistent int, not the pair."""
+        return self._loaded_step
+
     # -- precision arms ------------------------------------------------
 
     def _derive_arm_vars(self, variables) -> Dict[str, object]:

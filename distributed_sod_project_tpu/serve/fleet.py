@@ -677,6 +677,20 @@ class Fleet:
             from .rollout import RolloutManager
 
             self.rollout = RolloutManager(self, cfg, clock=clock)
+        # Router-door response cache (serve/cache.py; docs/SERVING.md
+        # "Router cache").  None/off by default: no cache object, zero
+        # threads, /metrics byte-identical.  The router checks it after
+        # the body read, before dispatch; a hit books the cache_hit
+        # terminal class (see :meth:`stats`).
+        self.cache = None
+        if cfg.cache_bytes > 0:
+            from .cache import RouterCache
+
+            self.cache = RouterCache(
+                cfg.cache_bytes, coalesce=cfg.cache_coalesce,
+                near_dup=cfg.cache_near_dup,
+                near_hamming=cfg.cache_near_dup_hamming,
+                shadow_sample=cfg.cache_shadow_sample)
         self.dispatcher = FleetDispatcher(
             [b.engine for b in backends if b.kind == "engine"])
         self._started = False
@@ -935,6 +949,8 @@ class Fleet:
             groups.append(self.controller.stats.prom_families())
         if self.rollout is not None:
             groups.append(self.rollout.stats.prom_families())
+        if self.cache is not None:
+            groups.append(self.cache.prom_families())
         if self.slo is not None:
             # Router-tier SLO families + their alert rules (the
             # replica-level dsod_alert_* families merge into the same
@@ -1032,25 +1048,32 @@ class Fleet:
                 breakers[rid] = g.breakers[rid].snapshot()
 
         # The router terminates every counted submission in exactly one
-        # outcome; classify those outcomes into the four identity
-        # buckets.  Engine-owned semantics map 1:1 (ok→served, …);
-        # router-only terminals (rejected, transport_error,
-        # no_healthy_replica) are errors; "timeout" joins expired (the
-        # client-visible fate — the engine's own late terminal is
-        # per-replica detail, not fleet book).
+        # outcome; classify those outcomes into the identity buckets.
+        # Engine-owned semantics map 1:1 (ok→served, …); router-only
+        # terminals (rejected, transport_error, no_healthy_replica)
+        # are errors; "timeout" joins expired (the client-visible fate
+        # — the engine's own late terminal is per-replica detail, not
+        # fleet book); "cache_hit" (serve/cache.py — exact, near-dup,
+        # and coalesced answers served from the router door without a
+        # backend forward) is its own fifth bucket, so the identity
+        # reads served + shed + expired + errors + cache_hit ==
+        # submitted.
         outcomes = router["outcomes"]
         cls = {"ok": "served", "shed": "shed", "expired": "expired",
-               "timeout": "expired"}
+               "timeout": "expired", "cache_hit": "cache_hit"}
         book = {"served": 0, "shed": router["shed_total"], "expired": 0,
-                "errors": 0}
+                "errors": 0, "cache_hit": 0}
         for outcome, n in outcomes.items():
             book[cls.get(outcome, "errors")] += n
         fleet = dict(book, submitted=router["submitted_total"])
         fleet["terminal"] = (fleet["served"] + fleet["shed"]
-                             + fleet["expired"] + fleet["errors"])
+                             + fleet["expired"] + fleet["errors"]
+                             + fleet["cache_hit"])
         fleet["consistent"] = fleet["terminal"] == fleet["submitted"]
         out = {"router": router, "models": models, "fleet": fleet,
                "breakers": breakers}
+        if self.cache is not None:
+            out["cache"] = self.cache.snapshot()
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
         if self.probe_stats is not None:

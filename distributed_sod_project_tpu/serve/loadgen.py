@@ -18,6 +18,16 @@ Either discipline can offer **mixed traffic** against a fleet router
 X-Tenant headers), with per-SERVED-model p50/p95/p99 broken out in the
 summary next to the per-arm breakdown — the fleet's mixed-model curve
 (tools/tpu_agenda_r9.sh) is one command.
+
+**Duplicate traffic** (``zipf=(s, catalog)``): instead of cycling a
+small body pool, each request draws its payload from a ``catalog`` of
+distinct pre-encoded images with Zipf popularity p(k) ∝ 1/k^s — the
+skewed repeat distribution real image traffic has, and the workload
+the router cache (serve/cache.py) is built for.  ``perturb`` sends
+that fraction of draws as a resize-perturbed re-encode of their
+catalog image (same content, different bytes/resolution — misses the
+exact arm, hits the near-dup arm).  The summary gains hit-rate and a
+per-terminal-class breakdown read from the X-Cache response header.
 """
 
 from __future__ import annotations
@@ -41,6 +51,74 @@ def encode_image(rng: np.random.RandomState, h: int, w: int) -> bytes:
     buf = io.BytesIO()
     np.save(buf, rng.randint(0, 256, size=(h, w, 3), dtype=np.uint8))
     return buf.getvalue()
+
+
+def _encode_arr(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def structured_image(rng: np.random.RandomState, h: int, w: int
+                     ) -> np.ndarray:
+    """A smooth low-frequency test image (8x8 noise upsampled
+    bilinearly).  Pure uint8 noise is the WRONG payload for near-dup
+    experiments — its perceptual hash is not resize-stable (every
+    pixel is independent, so resampling rewrites the block means);
+    natural images are dominated by low frequencies, which survive a
+    resize, and this generator keeps that property on purpose."""
+    from PIL import Image
+
+    base = rng.randint(0, 256, size=(8, 8, 3)).astype(np.uint8)
+    return np.asarray(Image.fromarray(base).resize((w, h), Image.BILINEAR))
+
+
+def _zipf_bodies(rng: np.random.RandomState, zipf, perturb: float,
+                 sizes, n_total: int) -> List[bytes]:
+    """Per-request payloads for a duplicate-traffic run: a catalog of
+    distinct structured images drawn with Zipf popularity
+    p(k) ∝ 1/k^s, plus (with probability ``perturb``) a resize-
+    perturbed re-encode of the drawn image — same content at a nearby
+    resolution, so it misses the exact cache arm and exercises the
+    near-dup arm.  All draws are seeded: two runs with the same seed
+    offer the SAME request stream."""
+    from PIL import Image
+
+    s, catalog = float(zipf[0]), int(zipf[1])
+    if catalog < 1:
+        raise ValueError(f"zipf catalog must be >= 1, got {catalog}")
+    if s < 0:
+        raise ValueError(f"zipf exponent must be >= 0, got {s}")
+    if not 0.0 <= float(perturb) <= 1.0:
+        raise ValueError(f"perturb must be in [0, 1], got {perturb}")
+    imgs = []
+    for k in range(catalog):
+        h, w = sizes[k % len(sizes)]
+        imgs.append(structured_image(rng, h, w))
+    bodies = [_encode_arr(a) for a in imgs]
+    variants: Dict[int, List[bytes]] = {}
+    if perturb > 0:
+        # Pre-encode the perturbed variants up front — the hot loop
+        # must never bottleneck on PIL while it is offering load.
+        for k, a in enumerate(imgs):
+            h, w = a.shape[:2]
+            variants[k] = [
+                _encode_arr(np.asarray(Image.fromarray(a).resize(
+                    (max(int(w * f), 8), max(int(h * f), 8)),
+                    Image.BILINEAR)))
+                for f in (0.875, 1.125)]
+    p = 1.0 / np.arange(1, catalog + 1, dtype=np.float64) ** s
+    p /= p.sum()
+    ks = rng.choice(catalog, size=n_total, p=p)
+    flips = rng.random_sample(n_total) < float(perturb)
+    out: List[bytes] = []
+    for i in range(n_total):
+        k = int(ks[i])
+        if flips[i] and variants:
+            out.append(variants[k][int(rng.randint(len(variants[k])))])
+        else:
+            out.append(bodies[k])
+    return out
 
 
 def wait_ready(base_url: str, timeout_s: float = 60.0,
@@ -93,7 +171,8 @@ def _one(base_url: str, body: bytes, slo_ms: Optional[float],
                                  headers=headers, method="POST")
     t0 = time.monotonic()
     info: Dict[str, Optional[str]] = {"arm": None, "model": None,
-                                      "rid": None, "timing": None}
+                                      "rid": None, "timing": None,
+                                      "cache": None}
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             r.read()
@@ -103,6 +182,9 @@ def _one(base_url: str, body: bytes, slo_ms: Optional[float],
                 info["model"] = r.headers.get("X-Model")
                 info["rid"] = r.headers.get("X-Request-ID")
                 info["timing"] = r.headers.get("X-Timing")
+                # exact | near | coalesced on a router-cache hit,
+                # absent on a real forward (serve/cache.py).
+                info["cache"] = r.headers.get("X-Cache")
     except urllib.error.HTTPError as e:
         e.read()
         out = {429: "shed", 504: "expired", 503: "unhealthy"}.get(
@@ -209,6 +291,8 @@ def run_loadgen(
     slo: bool = False,
     ramp: Optional[Tuple[float, float, float]] = None,
     bursts=None,
+    zipf: Optional[Tuple[float, int]] = None,
+    perturb: float = 0.0,
 ) -> Dict[str, float]:
     """Drive ``base_url`` and return a summary dict (see module doc for
     the open/closed semantics).  Closed loop sends exactly ``requests``
@@ -254,7 +338,17 @@ def run_loadgen(
     time-bucket offered/done/ok counts and p99 next to the overall
     latency summary — the response curve an autoscaler leg reads to see
     the controller catch up with (or shed) a moving offered rate, and
-    ``offered_rps`` becomes the profile's true average."""
+    ``offered_rps`` becomes the profile's true average.
+
+    **Duplicate traffic** (``zipf=(s, catalog)``): payloads draw from
+    a catalog of distinct structured images with Zipf popularity
+    p(k) ∝ 1/k^s instead of cycling the body pool; ``perturb`` sends
+    that fraction of draws as resize-perturbed re-encodes (near-dup
+    arm fodder).  The summary gains ``"cache"`` — hit count/rate and
+    per-kind (exact/near/coalesced) split from the X-Cache response
+    header — and ``"terminals"``, the client-observed mirror of the
+    router book's five terminal classes (docs/SERVING.md "Router
+    cache")."""
     if mode not in ("open", "closed"):
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     if mode == "closed" and (ramp is not None or bursts):
@@ -273,6 +367,11 @@ def run_loadgen(
     else:
         n_total = (int(requests) if mode == "closed"
                    else max(int(float(duration_s) * float(rps)), 1))
+    if perturb and zipf is None:
+        raise ValueError("perturb > 0 needs zipf duplicate traffic")
+    body_of: Optional[List[bytes]] = None
+    if zipf is not None:
+        body_of = _zipf_bodies(rng, zipf, perturb, sizes, n_total)
     if mix is not None:
         entries = _normalize_mix(mix)
         w = np.asarray([e["weight"] for e in entries], np.float64)
@@ -285,6 +384,11 @@ def run_loadgen(
                                 "unhealthy": 0, "error": 0,
                                 "transport": 0}
     ok_ms: List[float] = []
+    # OK responses per cache disposition ("forward" = no X-Cache
+    # header, i.e. a real engine forward), plus hit-path latencies so
+    # the summary can put the hit p50 next to the forward p50.
+    cache_kinds: Dict[str, int] = {}
+    cache_hit_ms: List[float] = []
     arm_ms: Dict[str, List[float]] = {}
     model_ms: Dict[str, List[float]] = {}
     model_sent: Dict[str, int] = {}
@@ -323,6 +427,10 @@ def run_loadgen(
             outcomes[out] += 1
             if out == "ok":
                 ok_ms.append(ms)
+                ck = info.get("cache") or "forward"
+                cache_kinds[ck] = cache_kinds.get(ck, 0) + 1
+                if ck != "forward":
+                    cache_hit_ms.append(ms)
                 if info.get("arm"):
                     arm_ms.setdefault(info["arm"], []).append(ms)
                 if info.get("model"):
@@ -347,7 +455,8 @@ def run_loadgen(
         # slowest-N rows key into the server's /debug/traces; ids do
         # not perturb the seeded (model, tenant) draws above.
         rid = mint_trace_id() if slowest > 0 else None
-        res = _one(base_url, pool[i % len(pool)], slo_ms or None,
+        body = body_of[i] if body_of is not None else pool[i % len(pool)]
+        res = _one(base_url, body, slo_ms or None,
                    timeout_s, precision=precision, model=a["model"],
                    tenant=a.get("tenant") or tenant, request_id=rid)
         record(*res, sent_model=a["model"])
@@ -437,6 +546,32 @@ def run_loadgen(
     if mix is not None:
         out["mix"] = [{k: v for k, v in e.items() if v is not None}
                       for e in _normalize_mix(mix)]
+    hits = sum(v for k, v in cache_kinds.items() if k != "forward")
+    if zipf is not None or hits:
+        # Cache disposition of the OK responses (X-Cache header) plus
+        # the client-observed mirror of the router book's terminal
+        # classes — served+shed+expired+errors+cache_hit is the
+        # identity /stats asserts server-side (docs/SERVING.md).
+        if zipf is not None:
+            out["zipf"] = {"s": float(zipf[0]), "catalog": int(zipf[1]),
+                           "perturb": round(float(perturb), 4)}
+        cache_hit_ms.sort()
+        out["cache"] = {
+            "hits": hits,
+            "hit_rate": (round(hits / outcomes["ok"], 4)
+                         if outcomes["ok"] else 0.0),
+            "hit_p50_ms": round(_percentile(cache_hit_ms, 0.50), 2),
+            "hit_p99_ms": round(_percentile(cache_hit_ms, 0.99), 2),
+            "kinds": {k: cache_kinds[k] for k in sorted(cache_kinds)},
+        }
+        out["terminals"] = {
+            "served": outcomes["ok"] - hits,
+            "cache_hit": hits,
+            "shed": outcomes["shed"],
+            "expired": outcomes["expired"],
+            "errors": (outcomes["error"] + outcomes["unhealthy"]
+                       + outcomes["transport"]),
+        }
     if arm_ms:
         # Per-SERVED-arm latency breakdown: under the degraded ladder a
         # single offered arm can come back as several served arms, and

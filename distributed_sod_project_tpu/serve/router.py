@@ -515,11 +515,65 @@ class RouterHandler(JsonHTTPHandler):
                 end_root("rejected")
                 terminal = True
                 return
+            # Router cache (serve/cache.py; docs/SERVING.md "Router
+            # cache").  Engine backends only: a remote replica's loaded
+            # step is unknown at the router, and a stale mask is worse
+            # than a miss — remotes BYPASS.  Hits/coalesced responses
+            # never reach a backend, so they are booked ``cache_hit``
+            # (not routed); a follower whose leader failed falls
+            # through to its own normal dispatch below.
+            cache_handle = None
+            cache = fleet.cache
+            if cache is not None and picked[1].kind == "engine":
+                step = picked[1].engine.loaded_step
+                prec = (self.headers.get("X-Precision") or "")
+                prec = prec.strip().lower() or None
+                verdict, obj = cache.begin(
+                    group.name, body, prec,
+                    -1 if step is None else int(step))
+                if verdict in ("exact", "near") \
+                        and self._serve_cache_hit(group, tenant, verdict,
+                                                  obj, body, picked,
+                                                  echo, t_door, end_root):
+                    terminal = True
+                    picked[2].release_probe()  # never dispatched
+                    return
+                if verdict == "follower":
+                    entry = self._await_leader(obj, slo_ms, t_door)
+                    if entry is not None and self._serve_cache_hit(
+                            group, tenant, "coalesced", entry, body,
+                            picked, echo, t_door, end_root):
+                        terminal = True
+                        picked[2].release_probe()
+                        return
+                elif verdict == "leader":
+                    cache_handle = obj
             fleet.rstats.inc_routed(group.name)
             dispatched = True
-            outcome = self._dispatch(group, picked, body, echo, slo_ms,
-                                     slo_hdr is not None, t_door,
-                                     req_id, root)
+            if cache_handle is None:
+                outcome = self._dispatch(group, picked, body, echo,
+                                         slo_ms, slo_hdr is not None,
+                                         t_door, req_id, root)
+            else:
+                # Coalescing leader: tee the response (whoever writes
+                # it) so followers wake with the same bytes and the
+                # LRU fills; any no-capture path abandons the token so
+                # followers can never hang on a dead leader.
+                cap = []
+                self._send_capture = cap
+                try:
+                    outcome = self._dispatch(group, picked, body, echo,
+                                             slo_ms, slo_hdr is not None,
+                                             t_door, req_id, root)
+                finally:
+                    self._send_capture = None
+                    if cap:
+                        code, rh, rbody = cap[0]
+                        cache.complete(cache_handle, code=code,
+                                       headers=rh, body=rbody,
+                                       model=group.name)
+                    else:
+                        cache.abandon(cache_handle)
             book_response(outcome)
             end_root(outcome)
             terminal = True
@@ -534,6 +588,75 @@ class RouterHandler(JsonHTTPHandler):
                 # the book as a router reject, not a silent leak.
                 book_response("rejected")
                 end_root("rejected")
+
+    # -- router cache --------------------------------------------------
+
+    def _serve_cache_hit(self, group, tenant, kind: str, obj, body,
+                         picked, echo, t_door: float, end_root) -> bool:
+        """Serve a stored mask for an ``exact`` / ``near`` /
+        ``coalesced`` hit and book the ``cache_hit`` terminal — the ONE
+        seam where a cache hit enters the router book (registered in
+        dsodlint's BOOKING_SEAMS; serve/fleet.py extends the identity
+        to served+shed+expired+errors+cache_hit == submitted).
+
+        Returns False (nothing booked, nothing sent) only when a
+        near-dup hit could not be resize-normalized — the caller falls
+        through to a normal dispatch, so a cache bug can only cost the
+        hit, never the request."""
+        fleet = self.fleet
+        cache = fleet.cache
+        if kind == "near":
+            ent, hw = obj
+            try:
+                from .cache import resize_mask_body
+
+                out_body = resize_mask_body(ent.body, hw)
+            except Exception:  # noqa: BLE001 — fall back to a forward
+                get_logger().exception(
+                    "router: near-dup resize failed — dispatching")
+                return False
+        else:
+            ent = obj
+            out_body = ent.body
+        if kind == "coalesced":
+            cache.stats.inc_coalesced(group.name)
+        # Terminal booking first, send guarded after — the same
+        # book-then-send order as every other router terminal, so an
+        # exception can never book twice or strand the submission.
+        fleet.rstats.inc_response(tenant.name, "cache_hit")
+        fleet.observe_slo(group.name, tenant.name, "cache_hit",
+                          (fleet._clock() - t_door) * 1000.0)
+        end_root("cache_hit")
+        self._guarded_send(200, out_body, ent.content_type,
+                           headers=list(echo) + [
+                               ("X-Cache", kind),
+                               ("X-Degraded", "0"),
+                               ("X-Precision", ent.precision),
+                               ("X-Res-Bucket", ent.res_bucket)])
+        if kind == "near" and cache.should_shadow():
+            # Online near-dup quality gate (PR 10 discipline): every
+            # Nth near hit re-forwards the ACTUAL request off the
+            # request path and records served-vs-fresh MAE.  The
+            # shadow forward books in the ENGINE's own book like any
+            # direct submit — never the router book.
+            cache.submit_shadow(body, out_body, picked[1].engine.predict)
+        return True
+
+    def _await_leader(self, tok, slo_ms: Optional[float],
+                      t_door: float):
+        """Follower side of in-flight coalescing: wait for the leader's
+        response, bounded by this request's OWN residual deadline (or
+        the fleet request timeout when it carries none).  ``None`` —
+        leader failed, timed out, or answered uncacheably — means the
+        caller dispatches normally."""
+        fleet = self.fleet
+        bound = fleet.cfg.request_timeout_s
+        residual = fleet.retry_policy.residual_ms(slo_ms, t_door)
+        if residual is not None:
+            bound = min(bound, max(residual, 0.0) / 1000.0)
+        if tok.event.wait(timeout=bound):
+            return tok.entry
+        return None
 
     # -- failover dispatch ---------------------------------------------
 

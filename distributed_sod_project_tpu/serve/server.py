@@ -260,6 +260,17 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
 
     def _send(self, code: int, body: bytes, content_type: str,
               headers=()) -> None:
+        cap = getattr(self, "_send_capture", None)
+        if cap is not None:
+            # Router-cache tee (serve/cache.py): a coalescing LEADER
+            # records what is about to go to the client — whoever
+            # writes it (run_predict for engines, the remote relay) —
+            # so followers can be served the same bytes and the LRU
+            # can fill.  Captured BEFORE the write: a client gone
+            # mid-response doesn't change what the backend answered.
+            h = dict(headers)
+            h["Content-Type"] = content_type
+            cap.append((code, h, body))
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))

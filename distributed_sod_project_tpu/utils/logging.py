@@ -20,6 +20,29 @@ def is_primary_process() -> bool:
         return True
 
 
+class _LiveStderrHandler(logging.StreamHandler):
+    """StreamHandler that resolves ``sys.stderr`` at EMIT time.
+
+    A handler constructed with the construction-time ``sys.stderr``
+    object keeps writing to it forever — under pytest that object is
+    one test's capture stream, closed when that test ends, and any
+    later emit (an engine warming inside a different test, a
+    background thread) raises into ``--- Logging error ---`` noise on
+    whatever stream is current.  Resolving the CURRENT stderr per
+    record follows redirections instead of outliving them."""
+
+    def __init__(self):
+        logging.StreamHandler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):  # StreamHandler.__init__/setStream write it
+        pass
+
+
 def get_logger(name: str = "dsod", log_file: Optional[str] = None) -> logging.Logger:
     logger = logging.getLogger(name)
     logger.setLevel(logging.INFO)
@@ -33,7 +56,7 @@ def get_logger(name: str = "dsod", log_file: Optional[str] = None) -> logging.Lo
     )
     if not any(isinstance(h, logging.StreamHandler) and not isinstance(h, logging.FileHandler)
                for h in logger.handlers):
-        sh = logging.StreamHandler(sys.stderr)
+        sh = _LiveStderrHandler()
         sh.setFormatter(fmt)
         logger.addHandler(sh)
     if log_file:

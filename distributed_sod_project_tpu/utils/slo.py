@@ -66,6 +66,11 @@ FAST_FRACTION = 1.0 / 12.0
 # must not burn the availability budget.
 EXCLUDED_OUTCOMES = frozenset(("rejected", "bad_request"))
 
+# Terminal outcomes that count GOOD: a served response, whether a
+# backend forward ("ok") or the router cache answering for one
+# ("cache_hit" — serve/cache.py).  Everything else counted is bad.
+GOOD_OUTCOMES = frozenset(("ok", "cache_hit"))
+
 
 @dataclasses.dataclass(frozen=True)
 class SLObjective:
@@ -273,12 +278,12 @@ class SLOTracker:
                         tenant: Optional[str] = None,
                         now: Optional[float] = None) -> None:
         """Feed one terminal-book outcome string (router/server form):
-        client-fault terminals are excluded, ``ok`` is good, everything
-        else is bad."""
+        client-fault terminals are excluded, served terminals (``ok``,
+        ``cache_hit``) are good, everything else is bad."""
         if outcome in EXCLUDED_OUTCOMES:
             return
-        self.observe(outcome == "ok", latency_ms=latency_ms, model=model,
-                     tenant=tenant, now=now)
+        self.observe(outcome in GOOD_OUTCOMES, latency_ms=latency_ms,
+                     model=model, tenant=tenant, now=now)
 
     # -- evaluation ----------------------------------------------------
 

@@ -367,6 +367,18 @@ def _post_npy(url, slo_ms=None, timeout=30.0, close_early_s=None):
 
 
 def _stats(fleet):
+    # The router books a terminal AFTER the response bytes flush, so a
+    # stats read racing the handler thread can transiently see one more
+    # submission than terminals ("eventually consistent while requests
+    # are in flight" — serve/fleet.py).  Wait out the in-flight gap;
+    # the final read is returned as-is so a REAL inconsistency still
+    # fails the caller's assertion.
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        s = fleet.stats()
+        if s["fleet"]["consistent"]:
+            return s
+        time.sleep(0.02)
     return fleet.stats()
 
 

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Near-dup cache-serving quality gate — CPU-runnable, per-PR
+(docs/SERVING.md "Router cache").
+
+The router cache's near-dup arm (`serve/cache.py`) answers a request
+with ANOTHER image's cached mask when the two payloads' perceptual
+hashes agree within a Hamming budget — a deliberate quality trade, and
+like the precision arms (`tools/precision_gate.py`) the trade is
+measurable on CPU at t1 time: serve image A's mask (resize-normalized
+exactly the way the router does) for a resize-perturbed variant of A,
+and score it against the exact forward on that variant.  This tool
+does that over a fixed synthetic set and maintains a checked-in delta
+ledger, `tools/cache_baseline.json`, in the hlo_guard/precision_gate
+discipline:
+
+- every run prints ONE JSON line with the near-arm deltas and the
+  delta against the recorded ledger;
+- `--fail-on-increase` exits 2 when the near arm's quality delta
+  exceeds its recorded budget by more than `--tolerance` (off in
+  shared CI: the t1.sh posture is recorded, non-gating);
+- `--update-baseline` re-seeds after an intentional change;
+- a run whose own invariants failed (non-finite metrics, short set, a
+  perturbed variant that would NOT actually near-hit within the
+  Hamming budget) NEVER seeds or updates the ledger.
+
+The ledger's reference row is named ``f32`` by the shared helper —
+here that is literally accurate: the reference IS the exact f32
+forward on the perturbed payload.  Deltas are signed so "worse" is
+positive (``delta_max_fbeta = exact − near``, ``delta_mae = near −
+exact``); the reference for the Fβ/MAE sweep is the exact forward
+binarized at 0.5, so the exact row scores max_fbeta 1.0 by
+construction and the near row's drop is pure near-dup serving error.
+
+Usage:
+    python tools/cache_gate.py                      # print deltas
+    python tools/cache_gate.py --update-baseline    # re-seed
+    python tools/cache_gate.py --fail-on-increase   # gate locally
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import precision_gate  # noqa: E402 — shared ledger discipline
+
+_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "cache_baseline.json")
+
+# Resize factors for the perturbed variants, alternated per image —
+# the same scales the loadgen's --perturb knob offers, one below and
+# one above the catalog resolution so both resize directions are in
+# the budget.
+_SCALES = (0.875, 1.125)
+
+
+def _npy(arr) -> bytes:
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def run_gate(model, variables, cfg, *, image_size: int, num_images: int,
+             seed: int, hamming_budget: int) -> dict:
+    """Score near-dup serving vs the exact forward on a synthetic set →
+    ``(report, extras)`` where report is the shared-ledger shape and
+    extras carries the gate's own observables (max Hamming distance
+    seen, direct served-vs-exact pixel dMAE)."""
+    import numpy as np
+
+    from distributed_sod_project_tpu.eval.inference import (_resize_pred,
+                                                            make_forward)
+    from distributed_sod_project_tpu.metrics import SODMetrics
+    from distributed_sod_project_tpu.serve.cache import (hamming,
+                                                         payload_fingerprint,
+                                                         resize_mask_body)
+    from distributed_sod_project_tpu.serve.engine import preprocess_image
+    from distributed_sod_project_tpu.serve.loadgen import structured_image
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    mean = np.asarray(cfg.data.normalize_mean, np.float32)
+    std = np.asarray(cfg.data.normalize_std, np.float32)
+    hw = image_size
+    imgs, perts, pert_hw = [], [], []
+    for i in range(num_images):
+        img = structured_image(rng, hw, hw)
+        f = _SCALES[i % len(_SCALES)]
+        side = max(int(hw * f), 8)
+        imgs.append(img)
+        perts.append(np.asarray(
+            Image.fromarray(img).resize((side, side), Image.BILINEAR)))
+        pert_hw.append((side, side))
+
+    # Both request streams forward at the catalog resolution — the
+    # engine's resolution-bucket behavior: a 56px request runs at the
+    # 64px bucket and its mask resizes back to 56px on the way out.
+    fwd = make_forward(model)
+    batch_o = np.stack([preprocess_image(a, hw, mean, std) for a in imgs])
+    batch_p = np.stack([preprocess_image(a, hw, mean, std) for a in perts])
+    masks_o = np.asarray(fwd(variables, {"image": batch_o}))
+    masks_p = np.asarray(fwd(variables, {"image": batch_p}))
+
+    agg_exact = SODMetrics(compute_structure=False)
+    agg_near = SODMetrics(compute_structure=False)
+    reasons, max_ham, dmaes = [], 0, []
+    for i in range(num_images):
+        fp_o = payload_fingerprint(_npy(imgs[i]))
+        fp_p = payload_fingerprint(_npy(perts[i]))
+        ham = (hamming(fp_o[0], fp_p[0])
+               if fp_o is not None and fp_p is not None else 257)
+        max_ham = max(max_ham, ham)
+        if ham > hamming_budget:
+            # The gate must measure what the cache would actually DO:
+            # a variant outside the budget would miss, so its score
+            # would dilute the ledger with a path the router never
+            # takes.
+            reasons.append(f"image {i}: Hamming {ham} > budget "
+                           f"{hamming_budget} — would not near-hit")
+            continue
+        exact = _resize_pred(masks_p[i], pert_hw[i])
+        served_body = resize_mask_body(
+            _npy(masks_o[i].astype(np.float32)), pert_hw[i])
+        served = np.load(io.BytesIO(served_body))
+        ref = (exact > 0.5).astype(np.float32)
+        agg_exact.add(exact, ref)
+        agg_near.add(served, ref)
+        dmaes.append(float(np.mean(np.abs(served - exact))))
+
+    report = precision_gate.build_report(
+        {"f32": agg_exact.results(), "near": agg_near.results()},
+        expected_images=num_images)
+    if reasons:
+        report["invariant_failed"] = True
+        report["reasons"] = report["reasons"] + reasons
+    extras = {
+        "hamming_budget": hamming_budget,
+        "max_hamming": max_ham,
+        "dmae_mean": round(float(np.mean(dmaes)), 6) if dmaes else None,
+        "dmae_max": round(float(np.max(dmaes)), 6) if dmaes else None,
+    }
+    return report, extras
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="minet_vgg16_ref",
+                   help="registered config (weights are random-init — "
+                        "the near-dup error is a serving-path effect "
+                        "measurable on any weights)")
+    p.add_argument("--image-size", type=int, default=64,
+                   help="catalog resolution (small keeps the CPU gate "
+                        "fast; perturbed variants resize ±12.5%%)")
+    p.add_argument("--num-images", type=int, default=12,
+                   help="fixed synthetic set size (deterministic per "
+                        "seed)")
+    p.add_argument("--hamming", type=int, default=16,
+                   help="near-dup Hamming budget under test (mirror of "
+                        "serve.cache_near_dup_hamming; part of the "
+                        "ledger key)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="catalog + weight seed (part of the ledger key)")
+    p.add_argument("--device", default="cpu", choices=["tpu", "cpu"],
+                   help="cpu by default — the gate must run at t1 time "
+                        "with no TPU window")
+    p.add_argument("--baseline", default=_BASELINE)
+    p.add_argument("--update-baseline", action="store_true")
+    p.add_argument("--fail-on-increase", action="store_true",
+                   help="exit 2 when the near arm exceeds its recorded "
+                        "quality budget by more than --tolerance (off "
+                        "in shared CI: recorded, not gating — the "
+                        "t1.sh posture)")
+    p.add_argument("--tolerance", type=float, default=0.003,
+                   help="slack on the recorded delta before a breach "
+                        "(metric units; covers CPU ulp noise)")
+    args = p.parse_args(argv)
+
+    from distributed_sod_project_tpu.utils.platform import select_platform
+
+    select_platform(args.device)
+
+    import jax
+    import numpy as np
+
+    from distributed_sod_project_tpu.configs import (apply_overrides,
+                                                     get_config)
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.train import (build_optimizer,
+                                                   create_train_state)
+
+    hw = args.image_size
+    cfg = apply_overrides(get_config(args.config),
+                          [f"data.image_size={hw},{hw}",
+                           f"seed={args.seed}"])
+    model = build_model(cfg.model)
+    tx, _ = build_optimizer(cfg.optim, 1)
+    probe = {"image": np.zeros((1, hw, hw, 3), np.float32)}
+    if cfg.data.use_depth:
+        probe["depth"] = np.zeros((1, hw, hw, 1), np.float32)
+    state = create_train_state(jax.random.key(cfg.seed), model, tx,
+                               probe, ema=cfg.optim.ema_decay > 0)
+
+    report, extras = run_gate(model, state.eval_variables(), cfg,
+                              image_size=hw, num_images=args.num_images,
+                              seed=args.seed, hamming_budget=args.hamming)
+
+    baseline = {}
+    if os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    key = f"{cfg.name}@{hw}px-n{args.num_images}-s{args.seed}-h{args.hamming}"
+    rc, new_baseline, summary = precision_gate.apply_baseline(
+        report, baseline, key, update=args.update_baseline,
+        fail_on_increase=args.fail_on_increase,
+        tolerance=args.tolerance)
+    summary["metric"] = f"cache_gate[{key}]"
+    summary["near_dup"] = extras
+    if rc == 1:
+        print(f"cache_gate: invariant failed — NOT seeding/updating "
+              f"baseline for {key}: {report['reasons']}", file=sys.stderr)
+    elif new_baseline is not baseline:
+        with open(args.baseline, "w") as f:
+            json.dump(new_baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(summary), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -99,6 +99,11 @@ BOOKING_SEAMS: Set[Tuple[str, str]] = {
     (f"{PKG}/serve/engine.py", "InferenceEngine._complete"),
     (f"{PKG}/serve/engine.py", "InferenceEngine._finish"),
     (f"{PKG}/serve/router.py", "RouterHandler.do_POST"),
+    # Router-cache booking seam (serve/cache.py): the ONE place an
+    # exact / near-dup / coalesced hit enters the router book as the
+    # cache_hit terminal class — the fifth identity bucket
+    # (served+shed+expired+errors+cache_hit == submitted).
+    (f"{PKG}/serve/router.py", "RouterHandler._serve_cache_hit"),
     # Control-plane decision seams: every autoscale/rollout counter
     # moves through ONE _record per plane, which also emits the
     # flight-recorder event — book and evidence cannot drift apart.

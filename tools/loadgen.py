@@ -59,6 +59,22 @@ def parse_args(argv=None):
     p.add_argument("--size", type=int, action="append", default=[],
                    help="square request image side (repeatable; "
                         "default 320)")
+    p.add_argument("--zipf", default=None, metavar="S:CATALOG",
+                   help="duplicate-traffic mix: draw each payload from "
+                        "a catalog of CATALOG distinct structured "
+                        "images with Zipf popularity p(k) ∝ 1/k^S "
+                        "(e.g. --zipf 1.1:64) — the skewed repeat "
+                        "distribution the router cache serves; the "
+                        "summary gains hit-rate and the per-terminal-"
+                        "class breakdown from X-Cache "
+                        "(docs/SERVING.md \"Router cache\")")
+    p.add_argument("--perturb", type=float, default=0.0,
+                   metavar="FRAC",
+                   help="with --zipf: send this fraction of draws as "
+                        "resize-perturbed re-encodes of their catalog "
+                        "image (same content, nearby resolution — "
+                        "misses the exact cache arm, exercises the "
+                        "near-dup arm)")
     p.add_argument("--slo-ms", type=float, default=0.0,
                    help="per-request deadline sent as X-SLO-MS (0=none)")
     p.add_argument("--precision", default=None,
@@ -149,6 +165,12 @@ def main(argv=None) -> int:
             raise SystemExit(f"--burst {spec!r} is not RPS:START:DUR")
         bursts.append((float(parts[0]), float(parts[1]),
                        float(parts[2])))
+    zipf = None
+    if args.zipf:
+        s, sep, cat = args.zipf.partition(":")
+        if not sep:
+            raise SystemExit(f"--zipf {args.zipf!r} is not S:CATALOG")
+        zipf = (float(s), int(cat))
     summary = run_loadgen(
         url, mode=args.mode, concurrency=args.concurrency,
         requests=args.requests, rps=args.rps, duration_s=args.duration,
@@ -156,7 +178,8 @@ def main(argv=None) -> int:
         timeout_s=args.timeout, precision=args.precision,
         model=args.model, tenant=args.tenant, mix=mix,
         slowest=args.slowest, quality=args.quality, slo=args.slo,
-        ramp=ramp, bursts=bursts or None)
+        ramp=ramp, bursts=bursts or None, zipf=zipf,
+        perturb=args.perturb)
     if args.server_stats:
         try:
             summary["server"] = fetch_stats(url)

@@ -167,11 +167,15 @@ def fleet_inventory() -> dict:
     # families render: both ctors are side-effect-free by design (no
     # threads, no subprocesses, no ckpt reads until start()/tick()),
     # so arming them here costs a name check exactly what it should.
+    # Router cache armed too (near-dup + shadow) so every dsod_cache_*
+    # family renders — the ctor is threadless by design.
     fleet = Fleet([_StubBackend()], FleetConfig(
         tenants=(FleetTenantConfig(name="_probe", priority=-1),),
         slo_objectives=("avail:model=m:availability:0.99:60",),
         prober_interval_s=1.0, controller=True,
-        rollout_ckpt_dir="/nonexistent-dsod-lint"))
+        rollout_ckpt_dir="/nonexistent-dsod-lint",
+        cache_bytes=1 << 20, cache_near_dup=True,
+        cache_near_dup_hamming=8, cache_shadow_sample=1))
     fleet.slo.observe_outcome("ok", 1.0, model="m")
     fleet.slo.observe_outcome("error", 1.0, model="m")
     fleet.probe_stats.record("m", True, 1.0, mae=0.01, iou=0.9)
@@ -196,6 +200,16 @@ def fleet_inventory() -> dict:
     ro.set_denylisted("m", 1)
     ro.set_canary_mae("m", 0.01)
     ro.inc_verdict("m", "promote")
+    # Cache families render per model/kind only once booked.
+    ca = fleet.cache.stats
+    ca.inc_hit("m", "exact")
+    ca.inc_hit("m", "near")
+    ca.inc_miss("m")
+    ca.inc_coalesced("m")
+    ca.inc_insert("m")
+    ca.inc_evictions()
+    ca.record_shadow(0.01)
+    ca.record_shadow_dropped()
     from distributed_sod_project_tpu.utils.observability import \
         parse_prom_text
 

@@ -4,9 +4,9 @@
 # pytest's status, so CI and humans invoke the exact same command the
 # roadmap promises (the pytest line below is verbatim ROADMAP.md).
 #
-# Smoke-budget audit (PR 13, re-audited PR 16): the non-gating smokes
-# below carry their own wrappers (420+700+420+300+420+420+420+300+900+
-# 720+600+780+600 ≈ 117 min worst case) — far past the 870 s the
+# Smoke-budget audit (PR 13, re-audited PR 17): the non-gating smokes
+# below carry their own wrappers (420+700+420+300+420+420+420+420+300+
+# 900+720+600+780+600 ≈ 124 min worst case) — far past the 870 s the
 # GATING pytest line gets.  Each wrapper deliberately EXCEEDS its
 # tool's documented internal budget contract (serve_smoke sums to
 # ~300 s under its 420 s wrapper, health 900, fleet 720, slo 600,
@@ -49,6 +49,9 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/serve_smoke.py --precision 
 echo "== precision quality gate: per-arm max-Fbeta/MAE deltas vs f32 on the tiny synthetic set (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/precision_gate.py \
   || echo "precision gate smoke failed (non-gating; --fail-on-increase gates locally)"
+echo "== near-dup cache-serving quality gate: near arm max-Fbeta/MAE deltas vs the exact forward on the tiny synthetic set (recorded, non-gating) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/cache_gate.py \
+  || echo "cache gate smoke failed (non-gating; --fail-on-increase gates locally)"
 echo "== metrics-family inventory lint: fleet + trainer /metrics surfaces + flight-recorder ring schema vs tools/metrics_inventory.json (recorded, non-gating) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_lint.py \
   && timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/metrics_lint.py --ring-selftest \
