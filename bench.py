@@ -488,11 +488,26 @@ def _run(args):
         # steps_per_dispatch=k (or a config default) must count images
         # and skip the cost model exactly like --steps-per-dispatch k.
         k_spd = cfg.steps_per_dispatch
-        step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
-                               remat=cfg.model.remat,
-                               remat_policy=cfg.model.remat_policy,
-                               steps_per_dispatch=k_spd,
-                               health=cfg.health_numerics)
+        if cfg.parallel.engine == "rules":
+            # The unified rules engine: same preset routing as fit()
+            # (DP / GSPMD+ZeRO / SP), so --set parallel.zero=1 /
+            # parallel.comm_bucket_mb=N sweep arms bench the REAL
+            # program.  Re-places the state (ZeRO shards the optimizer
+            # buffers over `data`); the comm plan is priced offline by
+            # tools/roofline.py --comm, not here.
+            from distributed_sod_project_tpu.parallel.engine import (
+                prepare_train_step)
+
+            state, step, _plan = prepare_train_step(
+                cfg, model, tx, mesh, sched, state,
+                steps_per_dispatch=k_spd)
+        else:
+            step = make_train_step(model, cfg.loss, tx, mesh,
+                                   schedule=sched,
+                                   remat=cfg.model.remat,
+                                   remat_policy=cfg.model.remat_policy,
+                                   steps_per_dispatch=k_spd,
+                                   health=cfg.health_numerics)
         if k_spd > 1:
             # One resident k-stacked batch; each timed "step" below is
             # one dispatch = k train steps (the A/B isolates dispatch

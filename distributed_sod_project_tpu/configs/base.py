@@ -213,6 +213,55 @@ class MeshConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """The unified partition-rule sharding engine (parallel/rules.py +
+    parallel/engine.py; docs/MULTIHOST.md "Rule presets").
+
+    ``engine='rules'`` routes training through ONE rule-driven step
+    builder: DP, TP, and SP become partition-rule presets on the same
+    traced root instead of three hand-built builders.  Bitwise (f32,
+    CPU) equivalence with the legacy builders is asserted per PR
+    (tests/test_sharding_rules.py) and re-proven every t1 round, so
+    recorded baselines replay.  'legacy' (default) keeps the historical
+    builders — the default only flips where bit-identical.
+    """
+
+    engine: str = "legacy"  # legacy | rules
+    # ZeRO-style cross-replica weight-update sharding (PAPERS.md: arXiv
+    # 2004.13336), the rules-engine generalization of optim.zero1:
+    #   0 — off (replicated optimizer state)
+    #   1 — optimizer moments + EMA shard over ``data``; grads reduce-
+    #       scatter into 1/N-sized updates, params all-gather
+    #   2 — additionally pins the gradient tree to the sharded layout
+    #       (with_sharding_constraint), so the full replicated gradient
+    #       tree is never materialized between reduce and update
+    # Routes through the GSPMD preset (needs model.sync_bn=false, same
+    # contract as optim.zero1).  Per-device HBM saving is reported via
+    # the capacity ledger (dsod_capacity_comm_zero_hbm_saved_bytes).
+    zero: int = 0
+    # Bucketed, backward-ordered gradient allreduce (DP preset only):
+    # grads partition into size-targeted buckets — latest-layer grads
+    # (first available in the backward pass) reduce first — and each
+    # bucket is its own ``lax.psum``, so early buckets' communication
+    # can overlap remaining backward compute.  0 = one monolithic
+    # reduce (the legacy program).  Per-element arithmetic is identical
+    # (psum/n exactly as lax.pmean computes it) — bitwise-asserted vs
+    # monolithic in tests/test_sharding_rules.py.  No-op on the GSPMD
+    # preset (the partitioner schedules its own collectives).
+    comm_bucket_mb: float = 25.0
+    # Gradient compression arm for the bucketed allreduce: 'bf16' casts
+    # each bucket to bfloat16 for the wire and back to f32 after —
+    # halves gradient comm bytes, NOT bitwise.  Quality-gated the
+    # precision_gate way: tools/grad_comm_gate.py keeps a checked-in
+    # delta baseline (tools/grad_comm_baseline.json).
+    grad_compression: str = "none"  # none | bf16
+    # Raise on params the rule table does not match (instead of the
+    # replicate-by-default fallback) — debugging aid when authoring
+    # rules for a new backbone.
+    rules_strict: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Online serving (serve/ subsystem — docs/SERVING.md).
 
@@ -932,6 +981,8 @@ class ExperimentConfig:
     loss: LossConfig = dataclasses.field(default_factory=LossConfig)
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    parallel: ParallelConfig = dataclasses.field(
+        default_factory=ParallelConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     global_batch_size: int = 8
     num_epochs: int = 50
@@ -1078,6 +1129,48 @@ def validate_steps_per_dispatch(cfg: ExperimentConfig,
                 f"  Pick k dividing every cadence knob or change {name}"
                 " to a multiple of k (docs/PERFORMANCE.md"
                 " \"Device-side step chunking\")")
+
+
+def validate_parallel(cfg: ExperimentConfig) -> None:
+    """Loud validation of the sharding-engine knobs (ParallelConfig).
+
+    Every knob below acts only through the rules engine, so a value set
+    with ``engine='legacy'`` would be a silent no-op — raise instead
+    (the optim.zero1 legacy knob stays the legacy path's spelling).
+    """
+    par = cfg.parallel
+    if par.engine not in ("legacy", "rules"):
+        raise ValueError(
+            f"parallel.engine must be legacy|rules, got {par.engine!r}")
+    if par.zero not in (0, 1, 2):
+        raise ValueError(f"parallel.zero must be 0|1|2, got {par.zero!r}")
+    if par.grad_compression not in ("none", "bf16"):
+        raise ValueError(
+            "parallel.grad_compression must be none|bf16, got "
+            f"{par.grad_compression!r}")
+    if par.comm_bucket_mb < 0:
+        raise ValueError(
+            f"parallel.comm_bucket_mb must be >= 0, got "
+            f"{par.comm_bucket_mb}")
+    if par.engine == "legacy":
+        if par.zero:
+            raise ValueError(
+                "parallel.zero requires parallel.engine=rules (the "
+                "legacy path spells ZeRO-1 as optim.zero1)")
+        if par.grad_compression != "none":
+            raise ValueError(
+                "parallel.grad_compression requires parallel.engine="
+                "rules (the legacy DP step has no bucketed reducer)")
+        return
+    if par.zero and cfg.optim.zero1:
+        raise ValueError(
+            "optim.zero1 and parallel.zero are both set — pick ONE "
+            "spelling (parallel.zero on the rules engine)")
+    if par.zero and cfg.model.sync_bn:
+        raise ValueError(
+            "parallel.zero routes through the GSPMD preset, which has "
+            "no named mesh axis: set model.sync_bn=false (BN stats are "
+            "global-batch there, strictly stronger)")
 
 
 _REGISTRY: Dict[str, Callable[[], ExperimentConfig]] = {}

@@ -1,0 +1,397 @@
+"""The unified rule-driven train-step builder (ROADMAP item 1).
+
+ONE builder subsumes the three hand-built ones (train/step.py DP,
+parallel/tp.py GSPMD, parallel/sp.py SP): a preset from
+``parallel/rules.py`` decides the per-preset seams — RNG fold, forward/
+loss path, gradient reduction, and trace wrapper — while every shared
+seam (steps_per_dispatch chunking, grad accumulation, EMA,
+skip_nonfinite, PR 10's ``maybe_health_metrics``, PR 11's
+capacity-ledger compile hook via ``.lower``) is threaded exactly ONCE.
+
+Bitwise contract: with ``grad_compression='none'`` the built step is
+bitwise (f32, CPU) identical to the legacy builder of the same preset —
+per-preset RNG folds, metric-dict construction order, the
+``chunked_step_fn`` k==1 identity, and the shard_map/jit wrapping are
+reproduced exactly; the bucketed reducer computes per element exactly
+what ``lax.pmean`` computes (tests/test_sharding_rules.py asserts all
+of it, tools/t1.sh re-proves a smoke every round).  Legacy stays the
+default (``parallel.engine``) for one PR; defaults only flip where
+bit-identical.
+
+Perf deliverables on top of the rule layer:
+
+- ``parallel.zero=1|2`` — ZeRO-style weight-update sharding: optimizer
+  moments + EMA shard over ``data`` (GSPMD preset; grads reduce-scatter
+  into 1/N updates, params all-gather), level 2 additionally pins the
+  gradient tree to the sharded layout.  HBM saving is priced by
+  ``comm_plan`` and reported through the capacity ledger.
+- ``parallel.comm_bucket_mb`` — bucketed, backward-ordered gradient
+  allreduce on the DP preset (``rules.bucketed_pmean``): one
+  ``lax.psum`` per size-targeted bucket so early buckets' communication
+  overlaps remaining backward compute; optional bf16 wire compression
+  (``parallel.grad_compression``) gated by tools/grad_comm_gate.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..losses import deep_supervision_loss
+from ..train.state import TrainState
+from ..train.step import (_loss_kwargs, apply_update, chunk_batch_spec,
+                          chunked_step_fn, maybe_health_metrics,
+                          maybe_remat, notfinite_count, rescale_batch,
+                          resolve_remat_policy)
+from ..utils.compat import shard_map
+from . import rules as rules_mod
+from .mesh import batch_sharding, batch_spec, replicated_sharding
+
+PRESETS = ("dp", "tp", "sp")
+
+
+def select_preset(cfg, mesh: Mesh) -> str:
+    """The rules-engine preset for a config+mesh — the SAME routing the
+    legacy loop uses: ``sp`` when the ``seq`` axis is sharded, ``tp``
+    (the GSPMD preset) when the ``model`` axis is sharded or any ZeRO
+    level is on, else ``dp``."""
+    if mesh.shape.get("seq", 1) > 1:
+        return "sp"
+    if (mesh.shape.get("model", 1) > 1 or cfg.optim.zero1
+            or cfg.parallel.zero > 0):
+        return "tp"
+    return "dp"
+
+
+def effective_zero(cfg) -> int:
+    """The ZeRO level the engine runs at: ``parallel.zero``, with the
+    legacy ``optim.zero1`` spelling mapped to level 1 (validate_parallel
+    rejects both being set)."""
+    return cfg.parallel.zero or (1 if cfg.optim.zero1 else 0)
+
+
+def make_unified_train_step(
+    model,
+    loss_cfg,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    preset: str,
+    schedule: Optional[optax.Schedule] = None,
+    donate: bool = True,
+    remat: bool = False,
+    ema_decay: float = 0.0,
+    scale_hw: Optional[Tuple[int, int]] = None,
+    donate_batch: bool = False,
+    remat_policy: str = "none",
+    steps_per_dispatch: int = 1,
+    health: bool = False,
+    sp_strategy: str = "ring",
+    state_shardings=None,
+    zero: int = 0,
+    comm_bucket_mb: float = 0.0,
+    grad_compression: str = "none",
+    _always_scan: bool = False,
+) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
+              Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Build ``(state, batch) -> (state, metrics)`` for any preset.
+
+    Sharding contracts (identical to the legacy builder per preset):
+    ``dp`` — state replicated, batch ``P('data')``, shard_map; ``sp`` —
+    state replicated, batch ``P('data', 'seq')``, shard_map (vit_sod
+    only; ``sp_strategy`` picks ring vs ulysses); ``tp`` — GSPMD jit
+    with ``state_shardings`` (required; from
+    ``rules.shard_state_by_rules``), collectives inserted by the
+    partitioner.  ``steps_per_dispatch=k > 1`` scans k steps per
+    dispatch over a new leading stacked axis (``chunked_step_fn``) —
+    the ONE chunking seam all presets share.
+    """
+    if preset not in PRESETS:
+        raise ValueError(f"preset must be one of {PRESETS}, got {preset!r}")
+    if preset == "tp" and state_shardings is None:
+        raise ValueError(
+            "the tp (GSPMD) preset needs state_shardings — build them "
+            "with rules.shard_state_by_rules(state, mesh, zero=...)")
+    if preset != "dp" and grad_compression != "none":
+        raise ValueError(
+            "grad_compression applies to the dp preset's bucketed "
+            f"reducer only (preset={preset!r}: the GSPMD partitioner / "
+            "SP reduction schedule their own collectives)")
+    if preset == "sp":
+        from .sp import validate_sp_strategy
+
+        if getattr(loss_cfg, "fused_kernel", False):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "loss.fused_kernel is a no-op on the sequence-parallel "
+                "path: the SP loss already psums sufficient statistics "
+                "inline (docs/PERFORMANCE.md)")
+        validate_sp_strategy(model, mesh, sp_strategy)
+    resolve_remat_policy(remat_policy)  # fail fast on typos, remat or not
+    lkw = _loss_kwargs(loss_cfg)
+    seq = mesh.shape.get("seq", 1)
+    bucket_bytes = int(comm_bucket_mb * 2 ** 20)
+    # ZeRO-2: the gradient tree is pinned to the buffer layout so the
+    # partitioner reduce-scatters instead of materializing the full
+    # replicated tree between reduce and update.
+    grad_constraint = None
+    if preset == "tp" and zero >= 2 and state_shardings is not None:
+        grad_constraint = jax.tree_util.tree_map(
+            lambda s: s, state_shardings.params)
+
+    def _rng(step):
+        # Per-preset RNG folds — each reproduced EXACTLY from its
+        # legacy builder so dropout draws replay bit-identically.
+        base = jax.random.fold_in(jax.random.PRNGKey(0), step)
+        if preset == "dp":
+            return jax.random.fold_in(base, lax.axis_index("data"))
+        if preset == "sp":
+            return jax.random.fold_in(
+                base,
+                lax.axis_index("data") * seq + lax.axis_index("seq"))
+        return base  # tp/GSPMD: global semantics, no named axis
+
+    def _forward_loss(state, batch, rng):
+        """(grads, comps, new_stats) for the preset's forward+loss."""
+        if preset == "sp":
+            from .sp import _sp_apply, _sp_hybrid_loss, _sp_ssim_loss
+
+            image, mask = batch["image"], batch["mask"]
+
+            def apply_fn(params, image):
+                return _sp_apply(model, {"params": params}, image,
+                                 train=True, rngs={"dropout": rng},
+                                 sp_strategy=sp_strategy)
+
+            apply_fn = maybe_remat(apply_fn, remat, remat_policy)
+
+            def loss_fn(params):
+                outs = apply_fn(params, image)
+                if not loss_cfg.deep_supervision:
+                    outs = outs[:1]  # primary head only
+                total = jnp.float32(0.0)
+                comps: Dict[str, jnp.ndarray] = {}
+                for level in outs:
+                    t, c = _sp_hybrid_loss(
+                        level, mask, bce_w=loss_cfg.bce,
+                        iou_w=loss_cfg.iou, cel_w=loss_cfg.cel)
+                    if getattr(loss_cfg, "ssim", 0.0):
+                        c["ssim"] = _sp_ssim_loss(
+                            level, mask,
+                            window_size=getattr(loss_cfg, "ssim_window",
+                                                11))
+                        t = t + loss_cfg.ssim * c["ssim"]
+                    total = total + t
+                    for k, v in c.items():
+                        if k != "total":
+                            comps[k] = comps.get(k, jnp.float32(0.0)) + v
+                comps["total"] = total
+                return total, comps
+
+            grads, comps = jax.grad(loss_fn, has_aux=True)(state.params)
+            return grads, comps, state.batch_stats
+
+        def apply_fn(params, batch_stats, image, depth):
+            return model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                image, depth, train=True,
+                mutable=["batch_stats"], rngs={"dropout": rng})
+
+        apply_fn = maybe_remat(apply_fn, remat, remat_policy)
+
+        def loss_fn(params):
+            outs, mut = apply_fn(params, state.batch_stats,
+                                 batch["image"], batch.get("depth"))
+            if not loss_cfg.deep_supervision:
+                outs = outs[:1]  # primary head only, uniform across steps
+            total, comps = deep_supervision_loss(outs, batch["mask"],
+                                                 **lkw)
+            return total, (comps, mut.get("batch_stats",
+                                          state.batch_stats))
+
+        grads, (comps, new_stats) = jax.grad(loss_fn, has_aux=True)(
+            state.params)
+        return grads, comps, new_stats
+
+    def _reduce(grads, comps):
+        """Per-preset gradient/metric reduction — the comm seam."""
+        if preset == "dp":
+            if bucket_bytes > 0:
+                grads = rules_mod.bucketed_pmean(
+                    grads, "data", bucket_bytes,
+                    compression=grad_compression)
+            else:
+                grads = lax.pmean(grads, "data")
+            comps = lax.pmean(comps, "data")
+        elif preset == "sp":
+            # SUM over seq recovered by pmean (see parallel/sp.py);
+            # data is the usual DP mean.  comps are already seq-global.
+            grads = lax.pmean(grads, ("data", "seq"))
+            comps = lax.pmean(comps, "data")
+        elif grad_constraint is not None:
+            grads = lax.with_sharding_constraint(grads, grad_constraint)
+        return grads, comps
+
+    def step_fn(state: TrainState, batch):
+        if preset != "sp":
+            batch = rescale_batch(batch, scale_hw)
+        rng = _rng(state.step)
+        grads, comps, new_stats = _forward_loss(state, batch, rng)
+        grads, comps = _reduce(grads, comps)
+        new_state = apply_update(state, grads, new_stats, tx,
+                                 ema_decay=ema_decay)
+        metrics = dict(comps)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        maybe_health_metrics(metrics, state.params, grads,
+                             new_state.params, health)
+        nfc = notfinite_count(new_state.opt_state)
+        if nfc is not None:
+            metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)
+        if schedule is not None:
+            metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
+        return new_state, metrics
+
+    body = chunked_step_fn(step_fn, steps_per_dispatch,
+                           always_scan=_always_scan)
+    donated = (0,) if donate else ()
+    if donate_batch:  # fit feeds each prefetched batch exactly once
+        donated = donated + (1,)
+    if preset == "tp":
+        batch_in = (batch_sharding(mesh) if body is step_fn
+                    else NamedSharding(mesh, chunk_batch_spec(batch_spec())))
+        replicated = NamedSharding(mesh, P())
+        return jax.jit(
+            body,
+            in_shardings=(state_shardings, batch_in),
+            out_shardings=(state_shardings, replicated),
+            donate_argnums=donated,
+        )
+    base = P("data") if preset == "dp" else P("data", "seq")
+    batch_in = base if body is step_fn else chunk_batch_spec(base)
+    sharded = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), batch_in),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=donated)
+
+
+# -- comm/ZeRO accounting (feeds the PR 11 capacity ledger) -----------
+
+def comm_plan(state, mesh: Mesh, *, preset: str, zero: int = 0,
+              comm_bucket_mb: float = 0.0,
+              grad_compression: str = "none") -> Dict[str, Any]:
+    """Price the step's gradient collectives + ZeRO HBM saving from
+    shapes alone (no tracing): per-collective payload bytes and axis
+    size, the bucket count, a structural overlap estimate, and the
+    per-device optimizer/EMA bytes ZeRO removes.  The capacity ledger
+    (``CapacityLedger.record_comm``) turns this into the
+    ``dsod_capacity_comm_*`` families; tools/roofline.py prices the
+    same plan offline against ICI bandwidth.
+
+    Overlap estimate is STRUCTURAL, not measured: with backward-ordered
+    buckets every bucket except the final one (the earliest layers,
+    reduced last) can overlap remaining backward compute, so
+    ``overlap_frac = 1 - last_bucket_bytes / total``; a monolithic
+    reduce (or the GSPMD preset, whose schedule the partitioner owns)
+    reports 0.  The measured number stays a TPU-window item
+    (tools/tpu_agenda_r17.sh).
+    """
+    leaves = jax.tree_util.tree_leaves(state.params)
+    shapes = [(g.shape, g.dtype) for g in leaves]
+    sizes = [int(np.prod(s or (1,))) * np.dtype(d).itemsize
+             for s, d in shapes]
+    wire_scale = 0.5 if grad_compression == "bf16" else 1.0
+    n_data = mesh.shape.get("data", 1)
+    collectives = []
+    if preset == "dp":
+        bucket_bytes = int(comm_bucket_mb * 2 ** 20)
+        buckets = rules_mod.grad_buckets(shapes, bucket_bytes)
+        for i, bucket in enumerate(buckets):
+            payload = sum(sizes[j] for j in bucket)
+            collectives.append({
+                "name": (f"grad_bucket_{i:02d}" if len(buckets) > 1
+                         else "grad_allreduce"),
+                "kind": "psum", "axis": "data", "axis_size": n_data,
+                "bytes": int(payload * wire_scale)})
+        last = sum(sizes[j] for j in buckets[-1]) if buckets else 0
+        overlap = (1.0 - last / max(sum(sizes), 1)
+                   if len(buckets) > 1 else 0.0)
+    elif preset == "sp":
+        n = n_data * mesh.shape.get("seq", 1)
+        collectives.append({
+            "name": "grad_allreduce", "kind": "psum",
+            "axis": "data,seq", "axis_size": n,
+            "bytes": sum(sizes)})
+        overlap = 0.0
+    else:  # tp/GSPMD: the partitioner owns the schedule; with ZeRO the
+        # reduce becomes reduce-scatter + update + param all-gather.
+        kind = "reduce_scatter+all_gather" if zero else "all_reduce"
+        collectives.append({
+            "name": "grad_allreduce", "kind": kind, "axis": "data",
+            "axis_size": n_data, "bytes": sum(sizes)})
+        overlap = 0.0
+    saved = 0
+    if zero and preset == "tp":
+        specs = rules_mod.state_specs(state, mesh, zero=zero)
+        for tree, spec in ((state.opt_state, specs.opt_state),
+                           (state.ema_params, specs.ema_params)):
+            if tree is None:
+                continue
+            saved += (rules_mod.tree_bytes(tree)
+                      - rules_mod.sharded_tree_bytes(tree, spec, mesh))
+    return {
+        "collectives": collectives,
+        "n_buckets": sum(1 for c in collectives
+                         if c["name"].startswith("grad_bucket")) or 1,
+        "overlap_frac": round(overlap, 6),
+        "zero_hbm_saved_bytes": int(saved),
+    }
+
+
+def prepare_train_step(cfg, model, tx, mesh: Mesh, schedule, state, *,
+                       steps_per_dispatch: int = 1,
+                       scale_hw: Optional[Tuple[int, int]] = None,
+                       donate: bool = True, donate_batch: bool = False):
+    """One-call routing for bench.py / tools/dump_hlo.py: select the
+    preset, place the state (replicated, or rule/ZeRO-sharded for the
+    GSPMD preset), and build the unified step.  Returns ``(state,
+    step, plan)`` where ``plan`` is ``comm_plan``'s dict.  fit() wires
+    the presets itself (it owns validation + the multi-scale factory)
+    but calls the SAME builder."""
+    from ..configs.base import validate_parallel
+
+    validate_parallel(cfg)
+    preset = select_preset(cfg, mesh)
+    zero = effective_zero(cfg)
+    kw = dict(schedule=schedule, donate=donate, remat=cfg.model.remat,
+              ema_decay=cfg.optim.ema_decay, scale_hw=scale_hw,
+              donate_batch=donate_batch,
+              remat_policy=cfg.model.remat_policy,
+              steps_per_dispatch=steps_per_dispatch,
+              health=cfg.health_numerics,
+              comm_bucket_mb=cfg.parallel.comm_bucket_mb,
+              grad_compression=cfg.parallel.grad_compression, zero=zero)
+    if preset == "tp":
+        state, shardings = rules_mod.shard_state_by_rules(
+            state, mesh, zero=zero)
+        kw["state_shardings"] = shardings
+    else:
+        state = jax.device_put(state, replicated_sharding(mesh))
+        if preset == "sp":
+            kw["sp_strategy"] = cfg.mesh.sp_strategy
+    step = make_unified_train_step(model, cfg.loss, tx, mesh,
+                                   preset=preset, **kw)
+    plan = comm_plan(state, mesh, preset=preset, zero=zero,
+                     comm_bucket_mb=cfg.parallel.comm_bucket_mb,
+                     grad_compression=cfg.parallel.grad_compression)
+    return state, step, plan

@@ -1,0 +1,312 @@
+"""The partition-rule layer — regex rules over param tree paths → specs.
+
+ROADMAP item 1: DP, TP, and SP were three hand-built step builders that
+every feature had to be threaded through three times.  This module is
+the declarative half of their replacement (parallel/engine.py is the
+step-builder half): a rule is ``(path regex, PartitionSpec)``, a rule
+TABLE is matched first-wins over the '/'-joined tree path of every
+parameter (the SNIPPETS.md [1]/[2] ``TreePathShardingRule`` /
+``FSDPShardingRule`` + ``named_tree_map`` idiom), and the three
+parallelism modes collapse into PRESETS — rule tables plus a little
+metadata the engine threads into ONE traced step:
+
+- ``dp``  — everything replicated over ``model``/``seq``; batch rides
+  ``data`` under shard_map (named-axis SyncBN + explicit grad psum);
+- ``tp``  — the Megatron tables from parallel/tp.py (column/row Dense
+  shards over ``model``), GSPMD jit-with-shardings;
+- ``sp``  — replicated params, batch sharded ``('data', 'seq')``,
+  ring/ulysses attention (vit_sod only).
+
+On top of the tables, two rule TRANSFORMS:
+
+- ``fsdp_fallback_rule`` — FSDP-style auto-sharding of the largest
+  divisible axis for leaves no explicit rule matched (the scalax
+  ``FSDPShardingRule`` recipe);
+- ``zero_state_specs`` — ZeRO-style weight-update sharding (PAPERS.md:
+  arXiv 2004.13336): optimizer moments and EMA shard over ``data`` so
+  each replica stores/updates 1/N of them, generalizing
+  parallel/tp.py's ``_zero1_specs`` to the rules engine's
+  ``parallel.zero`` levels.
+
+Gradient-communication planning lives here too (``grad_buckets``): the
+bucketed, backward-ordered allreduce partitions the flattened gradient
+leaves — reversed, so the latest layers' grads (first available during
+backward) reduce first — into size-targeted buckets, each its own
+``lax.psum`` the engine emits.  Pure functions over shapes; the comm
+ledger (utils/capacity.py) prices the resulting collectives.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .tp import (DEFAULT_TP_RULES, SWIN_TP_RULES, VIT_TP_RULES,  # noqa: F401
+                 _divisible, _leaf_path, _specs_like, to_shardings)
+
+# Matches everything; the explicit spelling of "replicate the rest" so
+# a strict table can end with it and still be total.
+REPLICATE_REST: Tuple[str, P] = (r".*", P())
+
+# Preset → parameter rule table.  DP and SP replicate every parameter
+# (their non-data axes are degenerate / the batch axis does the work);
+# TP is the Megatron layout.  The tables are TOTAL only with the
+# replicate-by-default fallback — strict matching surfaces the holes.
+PRESET_PARAM_RULES = {
+    "dp": (REPLICATE_REST,),
+    "tp": DEFAULT_TP_RULES + (REPLICATE_REST,),
+    "sp": (REPLICATE_REST,),
+}
+
+
+def named_tree_map(fn: Callable[[str, Any], Any], tree, *rest):
+    """``tree_map`` with the '/'-joined key path as the first argument
+    (the scalax/fmengine ``named_tree_map`` idiom): ``fn(path, leaf,
+    *rest_leaves)`` per leaf."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf, *r: fn(_leaf_path(path), leaf, *r),
+        tree, *rest)
+
+
+def tree_paths(tree) -> List[str]:
+    """The '/'-joined path of every leaf, in flatten order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [_leaf_path(path) for path, _ in flat]
+
+
+def fsdp_fallback_rule(mesh: Mesh, axis: str = "data",
+                       min_leaf_size: int = 2 ** 14):
+    """FSDP-style auto-sharding fallback: shard the LARGEST divisible
+    dimension of a leaf over ``axis``; leaves smaller than
+    ``min_leaf_size`` elements (biases, norms — where the sharding tax
+    outweighs the bytes) and leaves with no divisible dim replicate.
+    Returns ``fallback(path, leaf) -> PartitionSpec`` for
+    ``match_partition_rules``."""
+    n = mesh.shape.get(axis, 1)
+
+    def fallback(path: str, leaf) -> P:
+        del path
+        if n <= 1 or int(np.prod(leaf.shape or (1,))) < min_leaf_size:
+            return P()
+        best_dim, best_size = -1, 0
+        for dim, size in enumerate(leaf.shape):
+            if size % n == 0 and size > best_size:
+                best_dim, best_size = dim, size
+        if best_dim < 0:
+            return P()
+        return P(*([None] * best_dim + [axis]))
+
+    return fallback
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], params,
+                          mesh: Mesh, *, strict: bool = False,
+                          fallback: Optional[Callable[[str, Any], P]] = None):
+    """Spec pytree for ``params``: first rule whose regex matches the
+    '/'-joined path wins (``re.search`` semantics, same as
+    parallel/tp.py).  Unmatched leaves go to ``fallback(path, leaf)``
+    when given, else replicate — unless ``strict``, which raises ONE
+    error listing every unmatched path (the loud mode for authoring a
+    new backbone's table).  Specs that exceed a leaf's rank raise at
+    build time; specs whose sharded dims don't divide the mesh axis
+    fall back per-leaf to ``P()`` (same contract the TP rules always
+    had, so any ``model`` degree works)."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    unmatched: List[str] = []
+
+    def assign(path: str, leaf) -> P:
+        for pat, spec in compiled:
+            if pat.search(path):
+                if len(spec) > leaf.ndim:
+                    raise ValueError(
+                        f"rule {pat.pattern!r} spec {spec} exceeds rank "
+                        f"of {path} {leaf.shape}")
+                if _divisible(leaf.shape, spec, mesh):
+                    return spec
+                return P()
+        unmatched.append(path)
+        if fallback is not None:
+            return fallback(path, leaf)
+        return P()
+
+    specs = named_tree_map(assign, params)
+    if strict and unmatched:
+        raise ValueError(
+            f"{len(unmatched)} parameter path(s) matched by NO "
+            f"partition rule (strict mode): {sorted(unmatched)[:8]}"
+            + (" …" if len(unmatched) > 8 else ""))
+    return specs
+
+
+def zero_state_specs(params, param_specs, mesh: Mesh, axis: str = "data"):
+    """ZeRO weight-update sharding specs for params-shaped buffers
+    (optimizer moments, the MultiSteps accumulator, EMA): each leaf
+    takes ``axis`` on its first divisible dim so every replica stores
+    and updates 1/N of the buffer; leaves already sharded by explicit
+    rules keep their layout (the TP Megatron shards ARE the buffer
+    shards there).  Identical math to parallel/tp.py::_zero1_specs,
+    exposed on the rules layer."""
+    n = mesh.shape.get(axis, 1)
+
+    def assign(leaf, spec: P) -> P:
+        if spec != P():
+            return spec
+        for dim, size in enumerate(leaf.shape):
+            if size % n == 0 and size >= n:
+                return P(*([None] * dim + [axis]))
+        return P()
+
+    return jax.tree_util.tree_map(
+        assign, params, param_specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def state_specs(state, mesh: Mesh, *,
+                rules: Sequence[Tuple[str, P]] = DEFAULT_TP_RULES,
+                zero: int = 0, strict: bool = False,
+                fallback: Optional[Callable[[str, Any], P]] = None):
+    """A TrainState-shaped spec tree from a rule table: params per the
+    rules, optimizer buffers matching their parameters (or ZeRO-sharded
+    over ``data`` with ``zero >= 1``), step/batch_stats replicated.
+    The rules-engine generalization of tp.state_partition_specs."""
+    param_specs = match_partition_rules(rules, state.params, mesh,
+                                        strict=strict, fallback=fallback)
+    pdef = jax.tree_util.tree_structure(state.params)
+    buf_specs = (zero_state_specs(state.params, param_specs, mesh)
+                 if zero >= 1 else param_specs)
+    return type(state)(
+        step=P(),
+        params=param_specs,
+        batch_stats=jax.tree_util.tree_map(lambda _: P(),
+                                           state.batch_stats),
+        opt_state=_specs_like(state.opt_state, pdef, buf_specs),
+        ema_params=buf_specs if state.ema_params is not None else None,
+    )
+
+
+def shard_state_by_rules(state, mesh: Mesh, *,
+                         rules: Sequence[Tuple[str, P]] = DEFAULT_TP_RULES,
+                         zero: int = 0):
+    """Place a host/replicated TrainState onto the mesh per the rule
+    table (+ ZeRO buffer sharding); returns (state, state_shardings)."""
+    shardings = to_shardings(
+        state_specs(state, mesh, rules=rules, zero=zero), mesh)
+    return jax.device_put(state, shardings), shardings
+
+
+# -- gradient-communication planning (the bucketed allreduce) ---------
+
+def grad_buckets(shapes_dtypes: Sequence[Tuple[Tuple[int, ...], Any]],
+                 bucket_bytes: int) -> List[List[int]]:
+    """Partition gradient leaves (given as (shape, dtype) in FLATTEN
+    order) into size-targeted buckets in BACKWARD order — reversed
+    flatten order, so the decoder/head grads that finish first during
+    the backward pass land in the first bucket and their allreduce can
+    overlap the encoder's remaining backward compute (the DDP bucketing
+    recipe, PAPERS.md comm papers).
+
+    Invariants (tests/test_sharding_rules.py): every leaf index appears
+    in EXACTLY one bucket; bucket order is strictly descending leaf
+    index at the boundaries; a bucket closes once it reaches
+    ``bucket_bytes`` (so every bucket except possibly the last is at
+    least the target).  ``bucket_bytes <= 0`` → one bucket (the
+    monolithic reduce, spelled through the same code path)."""
+    n = len(shapes_dtypes)
+    if n == 0:
+        return []
+    if bucket_bytes <= 0:
+        return [list(range(n - 1, -1, -1))]
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for idx in range(n - 1, -1, -1):
+        shape, dtype = shapes_dtypes[idx]
+        nbytes = int(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+        cur.append(idx)
+        cur_bytes += nbytes
+        if cur_bytes >= bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucketed_pmean(grads, axis, bucket_bytes: int,
+                   compression: str = "none"):
+    """Gradient mean over ``axis`` as one FUSED ``lax.psum`` per
+    size-targeted bucket (backward-ordered; ``grad_buckets``): each
+    bucket's leaves are raveled and concatenated into ONE flat buffer
+    (the DDP flat-bucket recipe), psum'd, then sliced back — so a
+    B-bucket plan is exactly B 1-D ``all_reduce`` ops in the dumped HLO
+    (the countable signal tools/hlo_guard.py's comm arm checks) instead
+    of one per leaf, and early buckets can overlap remaining backward
+    compute.
+
+    Per element the arithmetic is EXACTLY what ``lax.pmean`` computes —
+    psum then division by ``psum(1, axis)``; ravel/concat/slice touch
+    no values — so with ``compression='none'`` the result is bitwise
+    the monolithic pmean's (asserted in tests/test_sharding_rules.py).
+
+    ``compression='bf16'`` casts each bucket's wire buffer to bfloat16
+    and back after — half the gradient comm bytes, NOT bitwise (gated
+    by tools/grad_comm_gate.py's checked-in baseline).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    flat, treedef = jax.tree_util.tree_flatten(grads)
+    buckets = grad_buckets([(g.shape, g.dtype) for g in flat],
+                           bucket_bytes)
+    denom = lax.psum(1, axis)
+    out: List[Any] = [None] * len(flat)
+    for bucket in buckets:
+        # One flat buffer per (bucket, dtype) — a single buffer on the
+        # homogeneous-f32 zoo; mixed-precision trees fuse per dtype.
+        by_dtype: dict = {}
+        for i in bucket:
+            by_dtype.setdefault(jnp.dtype(flat[i].dtype), []).append(i)
+        for dt, idxs in by_dtype.items():
+            vec = jnp.concatenate([flat[i].reshape(-1) for i in idxs])
+            if compression == "bf16":
+                summed = lax.psum(vec.astype(jnp.bfloat16),
+                                  axis).astype(dt)
+            else:
+                summed = lax.psum(vec, axis)
+            off = 0
+            for i in idxs:
+                n = int(np.prod(flat[i].shape or (1,)))
+                out[i] = (summed[off:off + n].reshape(flat[i].shape)
+                          / denom)
+                off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of a pytree's leaves (host or abstract arrays)."""
+    return sum(int(np.prod(x.shape or (1,))) * np.dtype(x.dtype).itemsize
+               for x in jax.tree_util.tree_leaves(tree))
+
+
+def sharded_tree_bytes(tree, spec_tree, mesh: Mesh) -> int:
+    """Per-device bytes of a pytree under a spec tree: each leaf's
+    bytes divided by the product of its sharded mesh-axis sizes."""
+    total = 0
+    for leaf, spec in zip(
+            jax.tree_util.tree_leaves(tree),
+            jax.tree_util.tree_leaves(
+                spec_tree, is_leaf=lambda x: isinstance(x, P))):
+        nbytes = int(np.prod(leaf.shape or (1,))) * np.dtype(
+            leaf.dtype).itemsize
+        div = 1
+        if isinstance(spec, P):
+            for names in spec:
+                if names is None:
+                    continue
+                names = names if isinstance(names, tuple) else (names,)
+                div *= int(np.prod([mesh.shape[nm] for nm in names]))
+        total += nbytes // max(div, 1)
+    return total

@@ -336,9 +336,24 @@ def fit(
 
     # Step builder: shard_map DP step for the CNN zoo (named-axis
     # SyncBN), the GSPMD step when the mesh has a tensor-parallel axis
-    # and/or ZeRO-1 is on, or the sequence-parallel step when ``seq``
-    # is sharded (ring attention over token blocks, vit_sod only).
-    use_gspmd = mesh.shape.get("model", 1) > 1 or cfg.optim.zero1
+    # and/or any ZeRO level is on, or the sequence-parallel step when
+    # ``seq`` is sharded (ring attention over token blocks, vit_sod
+    # only).  ``parallel.engine=rules`` swaps each branch's hand-built
+    # builder for the SAME preset of the unified rule-driven one
+    # (parallel/engine.py) — bitwise-identical on f32/CPU, asserted in
+    # tests/test_sharding_rules.py and re-proven by tools/t1.sh.
+    from ..configs.base import validate_parallel
+
+    validate_parallel(cfg)
+    use_rules = cfg.parallel.engine == "rules"
+    if use_rules:
+        from ..parallel import engine as engine_mod
+
+        zero_eff = engine_mod.effective_zero(cfg)
+    else:
+        zero_eff = 1 if cfg.optim.zero1 else 0
+    use_gspmd = (mesh.shape.get("model", 1) > 1 or cfg.optim.zero1
+                 or (use_rules and cfg.parallel.zero > 0))
     use_sp = mesh.shape.get("seq", 1) > 1
     if use_sp:
         from ..parallel.sp import make_sp_train_step
@@ -368,6 +383,16 @@ def fit(
         state = jax.device_put(state, replicated_sharding(mesh))
 
         def step_factory(scale_hw):
+            if use_rules:
+                return engine_mod.make_unified_train_step(
+                    model, cfg.loss, tx, mesh, preset="sp",
+                    schedule=schedule, ema_decay=cfg.optim.ema_decay,
+                    donate_batch=True,
+                    sp_strategy=cfg.mesh.sp_strategy,
+                    remat=cfg.model.remat,
+                    remat_policy=cfg.model.remat_policy,
+                    steps_per_dispatch=k,
+                    health=cfg.health_numerics)
             return make_sp_train_step(
                 model, cfg.loss, tx, mesh, schedule=schedule,
                 ema_decay=cfg.optim.ema_decay, donate_batch=True,
@@ -399,10 +424,26 @@ def fit(
                 f"mesh.model={n_model} does not divide the model's "
                 f"{heads} attention heads — pick a model-axis degree "
                 "that divides the head count")
-        state, state_shardings = shard_state(state, mesh,
-                                             zero1=cfg.optim.zero1)
+        if use_rules:
+            from ..parallel.rules import shard_state_by_rules
+
+            state, state_shardings = shard_state_by_rules(
+                state, mesh, zero=zero_eff)
+        else:
+            state, state_shardings = shard_state(state, mesh,
+                                                 zero1=cfg.optim.zero1)
 
         def step_factory(scale_hw):
+            if use_rules:
+                return engine_mod.make_unified_train_step(
+                    model, cfg.loss, tx, mesh, preset="tp",
+                    schedule=schedule, ema_decay=cfg.optim.ema_decay,
+                    scale_hw=scale_hw, donate_batch=True,
+                    remat=cfg.model.remat,
+                    remat_policy=cfg.model.remat_policy,
+                    steps_per_dispatch=k,
+                    health=cfg.health_numerics,
+                    state_shardings=state_shardings, zero=zero_eff)
             return make_tp_train_step(
                 model, cfg.loss, tx, mesh, state_shardings,
                 schedule=schedule, ema_decay=cfg.optim.ema_decay,
@@ -415,6 +456,17 @@ def fit(
         state = jax.device_put(state, replicated_sharding(mesh))
 
         def step_factory(scale_hw):
+            if use_rules:
+                return engine_mod.make_unified_train_step(
+                    model, cfg.loss, tx, mesh, preset="dp",
+                    schedule=schedule, remat=cfg.model.remat,
+                    ema_decay=cfg.optim.ema_decay,
+                    scale_hw=scale_hw, donate_batch=True,
+                    remat_policy=cfg.model.remat_policy,
+                    steps_per_dispatch=k,
+                    health=cfg.health_numerics,
+                    comm_bucket_mb=cfg.parallel.comm_bucket_mb,
+                    grad_compression=cfg.parallel.grad_compression)
             return make_train_step(
                 model, cfg.loss, tx, mesh, schedule=schedule,
                 remat=cfg.model.remat, ema_decay=cfg.optim.ema_decay,
@@ -459,6 +511,21 @@ def fit(
             # the ledger opted in — the cost_analysis()/
             # memory_analysis() of the REAL step program.
             capacity.record_jit(ck, train_step, state, batch)
+            if use_rules:
+                # Comm ledger (ROADMAP item 4): the engine's static
+                # shape-priced plan — per-collective bytes, overlap
+                # estimate, ZeRO HBM saving — under the same program
+                # key.  Guarded like every telemetry touch.
+                try:
+                    capacity.record_comm(ck, engine_mod.comm_plan(
+                        state, mesh,
+                        preset=("sp" if use_sp
+                                else "tp" if use_gspmd else "dp"),
+                        zero=zero_eff,
+                        comm_bucket_mb=cfg.parallel.comm_bucket_mb,
+                        grad_compression=cfg.parallel.grad_compression))
+                except Exception:  # noqa: BLE001 — telemetry only
+                    log.exception("capacity: comm_plan failed for %s", ck)
 
     def _observe_capacity_slo(chunk_start_step: int) -> None:
         """Per completed chunk: fold the measured per-step time into

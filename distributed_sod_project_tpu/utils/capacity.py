@@ -42,6 +42,15 @@ from .logging import get_logger
 # against, so live MFU and the offline roofline share a denominator.
 PEAK_FLOPS = 197e12  # dense bf16 MACs*2
 HBM_BW = 819e9       # bytes/s
+ICI_BW = 2e11        # bytes/s — v5e 1,600 Gbps aggregate ICI per chip
+
+
+def ring_wire_bytes(payload_bytes: float, axis_size: int) -> float:
+    """Bytes each chip moves for a ring allreduce of ``payload_bytes``:
+    ``2(n-1)/n × payload`` (reduce-scatter + all-gather halves).  For
+    n=1 this is 0 — a single-replica 'collective' is free."""
+    n = max(int(axis_size), 1)
+    return 2.0 * (n - 1) / n * float(payload_bytes)
 
 
 def program_cost(compiled) -> Dict[str, float]:
@@ -112,6 +121,10 @@ class CapacityLedger:
         # key → {flops, bytes, peak_hbm_bytes, ewma_ms (None until
         # observed)}
         self._programs: Dict[str, Dict[str, float]] = {}
+        # key → comm plan (parallel/engine.comm_plan dict: collectives
+        # with payload bytes + axis size, overlap estimate, ZeRO HBM
+        # saving) — static shape accounting, no tracing.
+        self._comm: Dict[str, Dict] = {}
         self._log = get_logger()
 
     # -- ingest --------------------------------------------------------
@@ -147,6 +160,19 @@ class CapacityLedger:
             self._log.exception("capacity: cost analysis failed for %s",
                                 key)
             return False
+
+    def record_comm(self, key: str, plan: Dict) -> None:
+        """Record one program's communication plan under ``key`` —
+        ``parallel/engine.comm_plan``'s dict (per-collective payload
+        bytes + axis size, bucket count, structural overlap fraction,
+        ZeRO HBM saving).  Rendered as the ``dsod_capacity_comm_*``
+        families; wire bytes and estimated milliseconds are derived
+        here against ``ICI_BW`` so the constant lives in ONE place."""
+        if not isinstance(plan, dict) or "collectives" not in plan:
+            raise ValueError("record_comm wants a comm_plan dict "
+                             "(missing 'collectives')")
+        with self._lock:
+            self._comm[key] = plan
 
     def observe(self, key: str, device_ms: float, alpha: float = 0.2
                 ) -> None:
@@ -199,6 +225,17 @@ class CapacityLedger:
             }
         snap = {"programs": out,
                 "peak_flops": self.peak_flops, "hbm_bw": self.hbm_bw}
+        with self._lock:
+            comm = {k: dict(p) for k, p in sorted(self._comm.items())}
+        if comm:
+            for plan in comm.values():
+                for c in plan.get("collectives", ()):
+                    wire = ring_wire_bytes(c.get("bytes", 0),
+                                           c.get("axis_size", 1))
+                    c["wire_bytes"] = int(wire)
+                    c["est_ms"] = round(wire / ICI_BW * 1e3, 6)
+            snap["comm"] = comm
+            snap["ici_bw"] = ICI_BW
         if self._share_fn is not None:
             try:
                 snap["stage_share"] = {
@@ -246,6 +283,37 @@ class CapacityLedger:
                 ("dsod_capacity_device_ms", ms),
                 ("dsod_capacity_mfu", mfu),
                 ("dsod_capacity_roofline_util", roof)):
+            if samples:
+                fams.append((name, "gauge", samples))
+        # Comm ledger (ROADMAP item 4): per-collective payload/wire
+        # bytes and the ICI-bandwidth time estimate, plus per-program
+        # overlap + ZeRO-saving gauges.  Rendered only once a plan is
+        # recorded — like the per-program families, `if samples`.
+        with self._lock:
+            comm_rows = [(k, p) for k, p in sorted(self._comm.items())]
+        cb, cw, cms, cov, czs = [], [], [], [], []
+        for k, plan in comm_rows:
+            for c in plan.get("collectives", ()):
+                cl = (f'{pre}program="{k}",collective="{c["name"]}",'
+                      f'axis="{c.get("axis", "")}"')
+                payload = float(c.get("bytes", 0))
+                wire = ring_wire_bytes(payload, c.get("axis_size", 1))
+                cb.append('dsod_capacity_comm_bytes{%s} %g'
+                          % (cl, payload))
+                cw.append('dsod_capacity_comm_wire_bytes{%s} %g'
+                          % (cl, wire))
+                cms.append('dsod_capacity_comm_est_ms{%s} %g'
+                           % (cl, wire / ICI_BW * 1e3))
+            cov.append('dsod_capacity_comm_overlap_frac{%s} %g'
+                       % (plbl(k), plan.get("overlap_frac", 0.0)))
+            czs.append('dsod_capacity_comm_zero_hbm_saved_bytes{%s} %g'
+                       % (plbl(k), plan.get("zero_hbm_saved_bytes", 0)))
+        for name, samples in (
+                ("dsod_capacity_comm_bytes", cb),
+                ("dsod_capacity_comm_wire_bytes", cw),
+                ("dsod_capacity_comm_est_ms", cms),
+                ("dsod_capacity_comm_overlap_frac", cov),
+                ("dsod_capacity_comm_zero_hbm_saved_bytes", czs)):
             if samples:
                 fams.append((name, "gauge", samples))
         # Stage-share attribution (device/queue/host fractions of the

@@ -410,7 +410,14 @@ def test_tenant_budget_exhaustion_429_with_engine_queues_untouched(
         ea = fleet.backends["a"].engine
         assert ea.stats.counter("submitted") == 3
         assert ea.batcher.pending() == 0
-        stats = _get_json(url, "/stats")
+        # The identity is eventually consistent (the router books the
+        # terminal around the response write, so a just-returned 200
+        # can be a hair ahead of the book) — poll briefly, then assert.
+        for _ in range(100):
+            stats = _get_json(url, "/stats")
+            if stats["fleet"]["consistent"]:
+                break
+            time.sleep(0.05)
         assert stats["fleet"]["submitted"] == n
         assert stats["fleet"]["shed"] == n - 3
         assert stats["fleet"]["consistent"] is True

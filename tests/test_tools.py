@@ -198,14 +198,24 @@ def test_checked_in_hlo_baseline_matches_guard_arms():
     assert ckey in base
     assert base[ckey]["conv_xla"]["total"] > 0
     assert base[ckey]["conv_fused"]["total"] > 0
+    # Round-18 gradient-collective arms on the flagship key: bucket
+    # fusion collapses the per-leaf reduces, and the default bucket
+    # size splits the flagship gradient into >= 2 buckets.
+    mkey = "minet_r50_dp@64px-comm"
+    assert mkey in base
+    assert (base[mkey]["comm_mono"]["total"]
+            > base[mkey]["comm_bucketed"]["total"])
+    assert (base[mkey]["comm_bucketed"]["total"]
+            - base[mkey]["comm_flat"]["total"] + 1) >= 2
 
 
 def test_hlo_guard_conv_arms_record_and_gate(tmp_path, capsys,
                                              monkeypatch):
-    """The round-14 conv_impl arms: recorded on first contact under
-    their own -conv key, delta-compared after, --fail-on-increase
-    trips on a regression.  dump paths are stubbed — the real
-    lowerings run in the t1 smoke; this covers the bookkeeping."""
+    """The round-14 conv_impl arms + round-18 comm arms: recorded on
+    first contact under their own -conv/-comm keys, delta-compared
+    after, --fail-on-increase trips on a regression.  dump paths are
+    stubbed — the real lowerings run in the t1 smoke; this covers the
+    bookkeeping."""
     import json
 
     import hlo_guard
@@ -218,12 +228,18 @@ def test_hlo_guard_conv_arms_record_and_gate(tmp_path, capsys,
                          "broadcast_in_dim": 0, "total": 3},
             "conv_fused": {"reshape": 9, "transpose": 1,
                            "broadcast_in_dim": 0, "total": 10}}
+    comm = {"comm_mono": {"all_reduce": 40, "total": 40},
+            "comm_flat": {"all_reduce": 4, "total": 4},
+            "comm_bucketed": {"all_reduce": 8, "total": 8}}
     monkeypatch.setattr(
         hlo_guard, "dump_arm_counts",
         lambda *a, **k: {"fast": dict(fast), "fast_stack": dict(stack)})
     monkeypatch.setattr(
         hlo_guard, "dump_conv_arm_counts",
         lambda *a, **k: {a_: dict(c) for a_, c in conv.items()})
+    monkeypatch.setattr(
+        hlo_guard, "dump_comm_arm_counts",
+        lambda *a, **k: {a_: dict(c) for a_, c in comm.items()})
     baseline = tmp_path / "baseline.json"
     args = ["--config", "cfg", "--out", str(tmp_path / "hlo"),
             "--baseline", str(baseline)]
@@ -231,16 +247,31 @@ def test_hlo_guard_conv_arms_record_and_gate(tmp_path, capsys,
     lines = [json.loads(l) for l
              in capsys.readouterr().out.strip().splitlines()]
     ckey = "minet_vgg16_ref@32px-conv"
-    assert lines[-1]["metric"] == f"hlo_formatting_ops[{ckey}]"
+    mkey = "cfg@64px-comm"
+    assert lines[-2]["metric"] == f"hlo_formatting_ops[{ckey}]"
+    assert lines[-2]["recorded"] is True
+    assert lines[-1]["metric"] == f"hlo_grad_collectives[{mkey}]"
     assert lines[-1]["recorded"] is True
-    assert json.load(open(baseline))[ckey] == conv
+    assert lines[-1]["n_buckets"] == 5  # bucketed - flat + 1
+    recorded = json.load(open(baseline))
+    assert recorded[ckey] == conv
+    assert recorded[mkey] == comm
     # Regression in the fused arm trips the gate.
     conv["conv_fused"]["total"] = 11
     conv["conv_fused"]["reshape"] = 10
     assert hlo_guard.main(args + ["--fail-on-increase"]) == 2
     out = json.loads(
-        capsys.readouterr().out.strip().splitlines()[-1])
+        capsys.readouterr().out.strip().splitlines()[-2])
     assert out["delta_vs_baseline"]["conv_fused"] == 1
+    conv["conv_fused"]["total"] = 10
+    conv["conv_fused"]["reshape"] = 9
+    # A bucketing change that grows the all_reduce count trips too.
+    comm["comm_bucketed"]["total"] = 9
+    comm["comm_bucketed"]["all_reduce"] = 9
+    assert hlo_guard.main(args + ["--fail-on-increase"]) == 2
+    out = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["delta_vs_baseline"]["comm_bucketed"] == 1
 
 
 def test_roofline_fused_resample_ledger(capsys):

@@ -80,11 +80,21 @@ def dump(config_name: str, out_dir: str, n_devices: int = 8,
     if cfg.data.use_depth:
         batch["depth"] = rng.randn(b, hw, hw, 1).astype(np.float32)
     state = create_train_state(jax.random.key(0), model, tx, batch)
-    state = jax.device_put(state, replicated_sharding(mesh))
     dbatch = jax.device_put(batch, batch_sharding(mesh))
 
-    step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
-                           donate=False)
+    if cfg.parallel.engine == "rules":
+        # The unified rules engine (parallel/engine.py): same preset
+        # routing as fit(), so hlo_guard's comm arms can pin
+        # parallel.* overrides and count the bucketed collectives.
+        from distributed_sod_project_tpu.parallel.engine import (
+            prepare_train_step)
+
+        state, step, _plan = prepare_train_step(
+            cfg, model, tx, mesh, sched, state, donate=False)
+    else:
+        state = jax.device_put(state, replicated_sharding(mesh))
+        step = make_train_step(model, cfg.loss, tx, mesh,
+                               schedule=sched, donate=False)
     lowered = step.lower(state, dbatch)
 
     os.makedirs(out_dir, exist_ok=True)

@@ -100,6 +100,32 @@ CONV_ARMS = {
 # reason as ARMS: an inherited A/B export must not contaminate counts.
 _PINNED_ENV = ("DSOD_RESIZE_INTERLEAVE", "DSOD_RESIZE_IMPL")
 
+# Gradient-collective arms (round 18, ISSUE 18 acceptance): the rules
+# engine's bucketed allreduce fuses each backward-ordered bucket into
+# ONE flat 1-D psum (parallel/rules.py::bucketed_pmean), so the
+# ``stablehlo.all_reduce`` count is the countable structure signal —
+# on the FLAGSHIP config (same carrier as ARMS):
+#
+# - ``comm_mono``     — comm_bucket_mb=0: the monolithic ``lax.pmean``
+#                       spelling, one all_reduce PER GRADIENT LEAF in
+#                       pre-opt StableHLO;
+# - ``comm_flat``     — one giant bucket: every grad fused into a
+#                       single flat all_reduce (the bucket-count floor);
+# - ``comm_bucketed`` — the default parallel.comm_bucket_mb: B buckets.
+#
+# Invariants asserted (exit 1): bucketed − flat == B − 1 ≥ 1 (the
+# "≥2 psum buckets at default bucket size" acceptance check — the only
+# all_reduce delta between the two arms IS the extra buckets), and
+# mono > bucketed (bucket fusion actually collapsed the per-leaf
+# reduces).  Counts are recorded in the same baseline with the same
+# never-persist-on-failed-invariant discipline.
+COMM_ARMS = {
+    "comm_mono": ("parallel.engine=rules", "parallel.comm_bucket_mb=0"),
+    "comm_flat": ("parallel.engine=rules",
+                  "parallel.comm_bucket_mb=100000"),
+    "comm_bucketed": ("parallel.engine=rules",),
+}
+
 
 def count_formatting_ops(stablehlo_text: str) -> dict:
     """Count stablehlo data-formatting ops by kind (+ 'total')."""
@@ -171,6 +197,33 @@ def dump_conv_arm_counts(config: str, out_dir: str, n_devices: int,
     return results
 
 
+def dump_comm_arm_counts(config: str, out_dir: str, n_devices: int,
+                         image_size: int) -> dict:
+    """Lower the flagship step once per gradient-collective arm (config
+    overrides on the rules engine) with the resample env pinned unset;
+    return {arm: {'all_reduce': n, 'total': n}}."""
+    from dump_hlo import dump  # tools/ sibling (path set above)
+
+    results = {}
+    saved = {k: os.environ.get(k) for k in _PINNED_ENV}
+    for k in _PINNED_ENV:
+        os.environ.pop(k, None)
+    try:
+        for arm, overrides in COMM_ARMS.items():
+            paths = dump(config, os.path.join(out_dir, arm),
+                         n_devices=n_devices, image_size=image_size,
+                         compile_cost=False, overrides=overrides)
+            with open(paths["stablehlo"]) as f:
+                n = len(re.findall(r"stablehlo\.all_reduce\b",
+                                   f.read()))
+            results[arm] = {"all_reduce": n, "total": n}
+    finally:
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+    return results
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", default="minet_r50_dp",
@@ -197,6 +250,9 @@ def main(argv=None) -> int:
     p.add_argument("--no-conv-arms", action="store_true",
                    help="skip the conv_impl arm dumps (resample arms "
                         "only — the pre-r14 behavior)")
+    p.add_argument("--no-comm-arms", action="store_true",
+                   help="skip the gradient-collective arm dumps "
+                        "(round 18: rules-engine bucketed allreduce)")
     p.add_argument("--baseline", default=_BASELINE)
     p.add_argument("--update-baseline", action="store_true")
     p.add_argument("--fail-on-increase", action="store_true",
@@ -315,6 +371,74 @@ def main(argv=None) -> int:
         "detail": conv_counts,
         "delta_vs_baseline": cdelta,
         **({"recorded": True} if crecorded else {}),
+    }), flush=True)
+
+    if args.no_comm_arms:
+        return rc
+
+    # -- gradient-collective arms (round 18): all_reduce counts per
+    #    bucketing arm of the rules engine on the FLAGSHIP config.
+    tmp3 = None
+    out_dir3 = args.out
+    if out_dir3 is None:
+        import tempfile
+
+        tmp3 = tempfile.TemporaryDirectory(prefix="hlo_guard_comm_")
+        out_dir3 = tmp3.name
+    try:
+        comm_counts = dump_comm_arm_counts(
+            args.config, out_dir3, args.devices, args.image_size)
+    finally:
+        if tmp3 is not None:
+            tmp3.cleanup()
+    mkey = f"{args.config}@{args.image_size}px-comm"
+    n_buckets = (comm_counts["comm_bucketed"]["total"]
+                 - comm_counts["comm_flat"]["total"] + 1)
+    comm_invariant_failed = False
+    if n_buckets < 2:
+        print(f"hlo_guard: bucketed arm emits {n_buckets} psum "
+              "bucket(s) — the default bucket size must split the "
+              "flagship gradient into >= 2 (ISSUE 18 acceptance)",
+              file=sys.stderr)
+        comm_invariant_failed = True
+    if comm_counts["comm_mono"]["total"] <= \
+            comm_counts["comm_bucketed"]["total"]:
+        print("hlo_guard: bucket fusion did NOT reduce the all_reduce "
+              f"count ({comm_counts['comm_mono']['total']} mono vs "
+              f"{comm_counts['comm_bucketed']['total']} bucketed)",
+              file=sys.stderr)
+        comm_invariant_failed = True
+    if comm_invariant_failed:
+        rc = rc or 1
+        print(f"hlo_guard: invariant failed — NOT seeding/updating "
+              f"baseline for {mkey}", file=sys.stderr)
+        print(json.dumps({
+            "metric": f"hlo_grad_collectives[{mkey}]",
+            "arms": {arm: c["total"] for arm, c in comm_counts.items()},
+            "n_buckets": n_buckets,
+            "invariant_failed": True,
+        }), flush=True)
+        return rc
+    if args.update_baseline or mkey not in baseline:
+        baseline[mkey] = comm_counts
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        mrecorded = True
+        mdelta = {arm: 0 for arm in comm_counts}
+    else:
+        mrecorded = False
+        mdelta = {arm: comm_counts[arm]["total"]
+                  - baseline[mkey].get(arm, {}).get("total", 0)
+                  for arm in comm_counts}
+        if args.fail_on_increase and any(d > 0 for d in mdelta.values()):
+            rc = rc or 2
+    print(json.dumps({
+        "metric": f"hlo_grad_collectives[{mkey}]",
+        "arms": {arm: c["total"] for arm, c in comm_counts.items()},
+        "n_buckets": n_buckets,
+        "delta_vs_baseline": mdelta,
+        **({"recorded": True} if mrecorded else {}),
     }), flush=True)
     return rc
 

@@ -78,6 +78,14 @@ def _populated_capacity():
         share_fn=lambda: {"device": 0.5, "queue": 0.25, "host": 0.25})
     cap.record("m/r64b1/fast/f32", _StubCompiled())
     cap.observe("m/r64b1/fast/f32", 1.0)
+    # One synthetic comm plan so the round-18 dsod_capacity_comm_*
+    # families render (they are `if samples`-gated like the per-program
+    # families).
+    cap.record_comm("m/r64b1/fast/f32", {
+        "collectives": [{"name": "grad_bucket_00", "kind": "psum",
+                         "axis": "data", "axis_size": 2, "bytes": 8}],
+        "n_buckets": 1, "overlap_frac": 0.0,
+        "zero_hbm_saved_bytes": 0})
     return cap
 
 

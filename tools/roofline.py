@@ -61,6 +61,8 @@ from dataclasses import dataclass, field
 # v5e per-chip numbers (same sources as bench.py's MFU self-report).
 PEAK_FLOPS = 197e12  # dense bf16 MACs*2
 HBM_BW = 819e9       # bytes/s
+ICI_BW = 2e11        # bytes/s — v5e 1,600 Gbps aggregate ICI per chip
+#                      (same constant as utils/capacity.py's live side)
 
 A = 2  # activation bytes (bf16)
 P = 4  # param / stat / f32 bytes
@@ -455,6 +457,85 @@ def fmt_fused_conv_ledger(b: int, hw: int = 320) -> str:
     return "\n".join(out)
 
 
+def fmt_comm_ledger(b: int, n_dp: int = 8, bucket_mb: float = 25.0,
+                    compression: str = "none") -> str:
+    """Per-step gradient-communication ledger for the flagship
+    (ROADMAP item 4, round 18): the REAL param tree's leaves (abstract
+    init — no arrays allocated) partitioned into the rules engine's
+    backward-ordered buckets (parallel/rules.py::grad_buckets), each
+    priced as a ring allreduce over ``n_dp`` replicas — wire bytes
+    ``2(n-1)/n × payload`` at ``ICI_BW`` — plus the structural overlap
+    estimate (every bucket except the last overlaps remaining backward
+    compute) and the ZeRO per-device HBM saving.  The live twin of this
+    table is the ``dsod_capacity_comm_*`` surface
+    (utils/capacity.py::record_comm); the measured numbers stay
+    tools/tpu_agenda_r17.sh predictions until a TPU window lands them.
+    """
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from distributed_sod_project_tpu.configs import get_config
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.parallel.rules import grad_buckets
+
+    cfg = get_config("minet_r50_dp")
+    model = build_model(cfg.model)
+    # Param shapes are input-size independent for the conv zoo; a 64px
+    # abstract init keeps this instant and allocation-free.
+    variables = jax.eval_shape(
+        lambda k, img: model.init(k, img, None, train=False),
+        jax.random.key(0), jnp.zeros((1, 64, 64, 3), jnp.float32))
+    leaves = jax.tree_util.tree_leaves(variables["params"])
+    shapes = [(x.shape, x.dtype) for x in leaves]
+    sizes = [int(math.prod(s or (1,))) * 4 for s, _ in shapes]  # f32
+    wire_scale = 0.5 if compression == "bf16" else 1.0
+    buckets = grad_buckets(shapes, int(bucket_mb * 2 ** 20))
+    ring = 2.0 * (n_dp - 1) / n_dp
+    out = [f"## comm ledger  b{b}  n_dp={n_dp}  "
+           f"bucket={bucket_mb}MB  compression={compression}",
+           f"param leaves: {len(leaves)}  grad bytes/replica: "
+           f"{sum(sizes) / 1e6:.1f} MB f32",
+           "| bucket | leaves | payload MB | wire MB (ring) | "
+           "ICI ms |",
+           "|---|---|---|---|---|"]
+    tot_wire = 0.0
+    for i, bucket in enumerate(buckets):
+        payload = sum(sizes[j] for j in bucket) * wire_scale
+        wire = ring * payload
+        tot_wire += wire
+        out.append(f"| {i} | {len(bucket)} | {payload / 1e6:.2f} | "
+                   f"{wire / 1e6:.2f} | {wire / ICI_BW * 1e3:.3f} |")
+    last = sum(sizes[j] for j in buckets[-1]) if buckets else 0
+    overlap = (1.0 - last / max(sum(sizes), 1)
+               if len(buckets) > 1 else 0.0)
+    out.append(f"| **total** | **{len(leaves)}** | "
+               f"**{sum(sizes) * wire_scale / 1e6:.2f}** | "
+               f"**{tot_wire / 1e6:.2f}** | "
+               f"**{tot_wire / ICI_BW * 1e3:.3f}** |")
+    _, _, _, t_step = predict(b)
+    exposed = tot_wire / ICI_BW * (1.0 - overlap)
+    out.append(
+        f"overlap estimate (structural): {overlap:.0%} of wire time "
+        f"hides under backward compute; exposed comm "
+        f"~{exposed * 1e3:.3f} ms vs roofline step "
+        f"{t_step * 1e3:.2f} ms")
+    # ZeRO: moments (momentum = 1x params f32) + EMA when on shard
+    # over n_dp — each replica keeps 1/n of the buffer bytes.
+    opt_bytes = sum(sizes)  # SGD momentum: one f32 slot per param
+    saved = opt_bytes * (1.0 - 1.0 / n_dp)
+    out.append(
+        f"ZeRO-1 (parallel.zero=1): optimizer moments "
+        f"{opt_bytes / 1e6:.1f} MB/replica -> "
+        f"{opt_bytes / n_dp / 1e6:.1f} MB sharded; "
+        f"{saved / 1e6:.1f} MB HBM freed per device "
+        f"(+ the same again per EMA tree when ema_decay>0)")
+    return "\n".join(out)
+
+
 # ---------------------------------------------------------------------
 # measured side: bucket a captured trace by result-shape resolution
 # ---------------------------------------------------------------------
@@ -639,6 +720,19 @@ def main(argv=None) -> int:
                         "asserts FLOPs invariance vs the xla arm)")
     p.add_argument("--trace", help="profile dir to reconcile against")
     p.add_argument("--xla-check", action="store_true")
+    p.add_argument("--comm", action="store_true",
+                   help="print the gradient-communication ledger "
+                        "(round 18): real param-tree buckets priced as "
+                        "ring allreduces at ICI bandwidth, overlap "
+                        "estimate, ZeRO HBM saving")
+    p.add_argument("--n-dp", type=int, default=8,
+                   help="with --comm: data-parallel degree the ring "
+                        "is priced for")
+    p.add_argument("--bucket-mb", type=float, default=25.0,
+                   help="with --comm: parallel.comm_bucket_mb arm")
+    p.add_argument("--compression", choices=["none", "bf16"],
+                   default="none",
+                   help="with --comm: parallel.grad_compression arm")
     args = p.parse_args(argv)
 
     if args.xla_check:
@@ -646,6 +740,13 @@ def main(argv=None) -> int:
         return 0 if 0.8 < ratio < 1.25 else 1
 
     batches = [args.batch] if args.batch else [32, 64, 128]
+    if args.comm:
+        for b in batches:
+            print(fmt_comm_ledger(b, n_dp=args.n_dp,
+                                  bucket_mb=args.bucket_mb,
+                                  compression=args.compression))
+            print()
+        return 0
     for b in batches:
         print(fmt_pred(b, remat=args.remat, s2d=args.s2d,
                        resize=args.resize,

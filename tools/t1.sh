@@ -4,9 +4,9 @@
 # pytest's status, so CI and humans invoke the exact same command the
 # roadmap promises (the pytest line below is verbatim ROADMAP.md).
 #
-# Smoke-budget audit (PR 13, re-audited PR 17): the non-gating smokes
-# below carry their own wrappers (420+700+420+300+420+420+420+420+300+
-# 900+720+600+780+600 ≈ 124 min worst case) — far past the 870 s the
+# Smoke-budget audit (PR 13, re-audited PR 18): the non-gating smokes
+# below carry their own wrappers (420+900+420+300+420+420+420+420+420+
+# 420+300+900+720+600+780+600 ≈ 141 min worst case) — far past the 870 s the
 # GATING pytest line gets.  Each wrapper deliberately EXCEEDS its
 # tool's documented internal budget contract (serve_smoke sums to
 # ~300 s under its 420 s wrapper, health 900, fleet 720, slo 600,
@@ -28,8 +28,8 @@ if [ -n "${DSOD_T1_FAST:-}" ]; then
 else
 echo "== host data-plane smoke (recorded, non-gating) =="
 bash tools/bench_data.sh || echo "bench_data smoke failed (non-gating)"
-echo "== HLO relayout guard incl. conv_impl arms (recorded, non-gating) =="
-timeout -k 10 700 env JAX_PLATFORMS=cpu python tools/hlo_guard.py \
+echo "== HLO relayout guard incl. conv_impl + grad-collective comm arms (recorded, non-gating) =="
+timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/hlo_guard.py \
   || echo "hlo_guard smoke failed (non-gating)"
 echo "== fused-conv interpret exactness smoke: kernel vs XLA arm bitwise/1-ulp on CPU (recorded, non-gating; the full suite below gates it) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
@@ -43,12 +43,19 @@ echo "== step-chunking k-equivalence smoke (recorded; the full suite below gates
 timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_step_chunking.py -q -k bitwise_smoke -p no:cacheprovider \
   || echo "step-chunking smoke failed (the main suite below still gates it)"
+echo "== sharding-engine equivalence smoke: rules-vs-legacy DP bitwise incl. bucketed allreduce (recorded; the full suite below gates it) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_sharding_rules.py -q -k rules_smoke -p no:cacheprovider \
+  || echo "sharding-engine smoke failed (the main suite below still gates it)"
 echo "== serve smoke: real-process server @ bf16 arm, one loadgen round-trip, clean SIGTERM drain (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/serve_smoke.py --precision bf16 \
   || echo "serve smoke failed (non-gating; tests/test_serving.py below gates the in-process side)"
 echo "== precision quality gate: per-arm max-Fbeta/MAE deltas vs f32 on the tiny synthetic set (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/precision_gate.py \
   || echo "precision gate smoke failed (non-gating; --fail-on-increase gates locally)"
+echo "== bf16 gradient-compression quality gate: f32-wire vs bf16-wire training trajectory deltas vs the recorded budget (recorded, non-gating) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/grad_comm_gate.py \
+  || echo "grad comm gate smoke failed (non-gating; --fail-on-increase gates locally)"
 echo "== near-dup cache-serving quality gate: near arm max-Fbeta/MAE deltas vs the exact forward on the tiny synthetic set (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/cache_gate.py \
   || echo "cache gate smoke failed (non-gating; --fail-on-increase gates locally)"
