@@ -406,7 +406,7 @@ def _run(args):
     from distributed_sod_project_tpu.parallel.mesh import (
         batch_sharding, make_mesh, replicated_sharding)
     from distributed_sod_project_tpu.train import (
-        build_optimizer, create_train_state, make_train_step)
+        build_optimizer, create_train_state)
 
     n_chips = jax.device_count()
     if _expects_accelerator(args) and jax.default_backend() == "cpu":
@@ -488,26 +488,19 @@ def _run(args):
         # steps_per_dispatch=k (or a config default) must count images
         # and skip the cost model exactly like --steps-per-dispatch k.
         k_spd = cfg.steps_per_dispatch
-        if cfg.parallel.engine == "rules":
-            # The unified rules engine: same preset routing as fit()
-            # (DP / GSPMD+ZeRO / SP), so --set parallel.zero=1 /
-            # parallel.comm_bucket_mb=N sweep arms bench the REAL
-            # program.  Re-places the state (ZeRO shards the optimizer
-            # buffers over `data`); the comm plan is priced offline by
-            # tools/roofline.py --comm, not here.
-            from distributed_sod_project_tpu.parallel.engine import (
-                prepare_train_step)
+        # The unified rules engine (the only engine): same preset
+        # routing as fit() (DP / FSDP / GSPMD+ZeRO / SP), so --set
+        # parallel.preset=fsdp / parallel.zero=1 /
+        # parallel.comm_bucket_mb=N / parallel.grad_compression=int8_ef
+        # sweep arms bench the REAL program.  Re-places the state
+        # (ZeRO/FSDP shard buffers over `data`); the comm plan is
+        # priced offline by tools/roofline.py --comm, not here.
+        from distributed_sod_project_tpu.parallel.engine import (
+            prepare_train_step)
 
-            state, step, _plan = prepare_train_step(
-                cfg, model, tx, mesh, sched, state,
-                steps_per_dispatch=k_spd)
-        else:
-            step = make_train_step(model, cfg.loss, tx, mesh,
-                                   schedule=sched,
-                                   remat=cfg.model.remat,
-                                   remat_policy=cfg.model.remat_policy,
-                                   steps_per_dispatch=k_spd,
-                                   health=cfg.health_numerics)
+        state, step, _plan = prepare_train_step(
+            cfg, model, tx, mesh, sched, state,
+            steps_per_dispatch=k_spd)
         if k_spd > 1:
             # One resident k-stacked batch; each timed "step" below is
             # one dispatch = k train steps (the A/B isolates dispatch

@@ -210,6 +210,15 @@ class MeshConfig:
     # heads with two all-to-alls (needs model heads % seq == 0; lower
     # collective latency, full-sequence tiles for the flash kernel).
     sp_strategy: str = "ring"  # ring | ulysses
+    # Two-level data-axis hierarchy for pod-scale meshes: the ``data``
+    # axis factors as (data_hosts, chips_per_host) with consecutive
+    # device ids on the same host (the make_mesh layout guarantees
+    # this).  >1 routes each gradient bucket's psum through intra-host
+    # reduce-scatter -> inter-host all-reduce on 1/chips_per_host of
+    # the bytes -> intra-host all-gather, so the slow DCN hop carries
+    # only a 1/chips_per_host segment (docs/MULTIHOST.md "Hierarchical
+    # collectives").  Must divide the data axis size.
+    data_hosts: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -218,15 +227,23 @@ class ParallelConfig:
     parallel/engine.py; docs/MULTIHOST.md "Rule presets").
 
     ``engine='rules'`` routes training through ONE rule-driven step
-    builder: DP, TP, and SP become partition-rule presets on the same
-    traced root instead of three hand-built builders.  Bitwise (f32,
-    CPU) equivalence with the legacy builders is asserted per PR
-    (tests/test_sharding_rules.py) and re-proven every t1 round, so
-    recorded baselines replay.  'legacy' (default) keeps the historical
-    builders — the default only flips where bit-identical.
+    builder: DP, TP, SP, and FSDP are partition-rule presets on the
+    same traced root.  The rules engine shipped bitwise-proven against
+    the legacy builders in round 17 and the default flipped in round 18
+    per the bit-identical flip rule; the legacy builders are deleted —
+    'rules' is the only engine.
     """
 
-    engine: str = "legacy"  # legacy | rules
+    engine: str = "rules"  # rules (the legacy builders were removed)
+    # Preset selection: 'auto' derives the preset from the mesh (seq>1
+    # -> sp, model>1 or zero -> tp/gspmd, else dp).  'fsdp' is the only
+    # value that cannot be derived: params themselves shard over
+    # ``data`` (fsdp_fallback_rule picks each leaf's largest divisible
+    # dim), the partitioner all-gathers them just-in-time per layer in
+    # forward/backward and reduce-scatters grads — full ZeRO-3-style
+    # sharding as pure config.  Requires model.sync_bn=false (GSPMD
+    # path, no named axis) and mesh.model == mesh.seq == 1.
+    preset: str = "auto"  # auto | dp | tp | sp | fsdp
     # ZeRO-style cross-replica weight-update sharding (PAPERS.md: arXiv
     # 2004.13336), the rules-engine generalization of optim.zero1:
     #   0 — off (replicated optimizer state)
@@ -251,10 +268,16 @@ class ParallelConfig:
     comm_bucket_mb: float = 25.0
     # Gradient compression arm for the bucketed allreduce: 'bf16' casts
     # each bucket to bfloat16 for the wire and back to f32 after —
-    # halves gradient comm bytes, NOT bitwise.  Quality-gated the
-    # precision_gate way: tools/grad_comm_gate.py keeps a checked-in
-    # delta baseline (tools/grad_comm_baseline.json).
-    grad_compression: str = "none"  # none | bf16
+    # halves gradient comm bytes, NOT bitwise.  'int8_ef' symmetrically
+    # quantizes each bucket to int8 against a shared global scale
+    # (lax.pmax of per-replica amax, so the integer psum is exact) and
+    # carries the quantization error in a persistent error-feedback
+    # residual in the train state (sharded by the ZeRO specs), added
+    # back into the next step's buffer — 1 B/elem achievable wire,
+    # quality-gated exactly like bf16.  Both gated the precision_gate
+    # way: tools/grad_comm_gate.py keeps a checked-in delta baseline
+    # (tools/grad_comm_baseline.json).
+    grad_compression: str = "none"  # none | bf16 | int8_ef
     # Raise on params the rule table does not match (instead of the
     # replicate-by-default fallback) — debugging aid when authoring
     # rules for a new backbone.
@@ -1132,36 +1155,35 @@ def validate_steps_per_dispatch(cfg: ExperimentConfig,
 
 
 def validate_parallel(cfg: ExperimentConfig) -> None:
-    """Loud validation of the sharding-engine knobs (ParallelConfig).
-
-    Every knob below acts only through the rules engine, so a value set
-    with ``engine='legacy'`` would be a silent no-op — raise instead
-    (the optim.zero1 legacy knob stays the legacy path's spelling).
-    """
+    """Loud validation of the sharding-engine knobs (ParallelConfig)."""
     par = cfg.parallel
-    if par.engine not in ("legacy", "rules"):
+    if par.engine == "legacy":
         raise ValueError(
-            f"parallel.engine must be legacy|rules, got {par.engine!r}")
+            "parallel.engine=legacy: the legacy step builders were "
+            "removed in round 18 after the rules engine shipped "
+            "bitwise-proven — parallel.engine=rules is the only engine")
+    if par.engine != "rules":
+        raise ValueError(
+            f"parallel.engine must be rules, got {par.engine!r}")
+    if par.preset not in ("auto", "dp", "tp", "sp", "fsdp"):
+        raise ValueError(
+            "parallel.preset must be auto|dp|tp|sp|fsdp, got "
+            f"{par.preset!r}")
     if par.zero not in (0, 1, 2):
         raise ValueError(f"parallel.zero must be 0|1|2, got {par.zero!r}")
-    if par.grad_compression not in ("none", "bf16"):
+    if par.grad_compression not in ("none", "bf16", "int8_ef"):
         raise ValueError(
-            "parallel.grad_compression must be none|bf16, got "
+            "parallel.grad_compression must be none|bf16|int8_ef, got "
             f"{par.grad_compression!r}")
     if par.comm_bucket_mb < 0:
         raise ValueError(
             f"parallel.comm_bucket_mb must be >= 0, got "
             f"{par.comm_bucket_mb}")
-    if par.engine == "legacy":
-        if par.zero:
-            raise ValueError(
-                "parallel.zero requires parallel.engine=rules (the "
-                "legacy path spells ZeRO-1 as optim.zero1)")
-        if par.grad_compression != "none":
-            raise ValueError(
-                "parallel.grad_compression requires parallel.engine="
-                "rules (the legacy DP step has no bucketed reducer)")
-        return
+    if cfg.mesh.data_hosts < 1:
+        raise ValueError(
+            f"mesh.data_hosts must be >= 1, got {cfg.mesh.data_hosts}"
+            " (divisibility vs the resolved data axis is checked at "
+            "mesh build time — the axis may be -1 here)")
     if par.zero and cfg.optim.zero1:
         raise ValueError(
             "optim.zero1 and parallel.zero are both set — pick ONE "
@@ -1171,6 +1193,17 @@ def validate_parallel(cfg: ExperimentConfig) -> None:
             "parallel.zero routes through the GSPMD preset, which has "
             "no named mesh axis: set model.sync_bn=false (BN stats are "
             "global-batch there, strictly stronger)")
+    if par.preset == "fsdp":
+        if cfg.model.sync_bn:
+            raise ValueError(
+                "parallel.preset=fsdp routes through the GSPMD path, "
+                "which has no named mesh axis: set model.sync_bn=false "
+                "(BN stats are global-batch there, strictly stronger)")
+        if cfg.mesh.model != 1 or cfg.mesh.seq != 1:
+            raise ValueError(
+                "parallel.preset=fsdp shards params over the data axis "
+                "only — set mesh.model=1 and mesh.seq=1 (got model="
+                f"{cfg.mesh.model}, seq={cfg.mesh.seq})")
 
 
 _REGISTRY: Dict[str, Callable[[], ExperimentConfig]] = {}

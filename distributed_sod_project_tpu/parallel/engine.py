@@ -8,28 +8,41 @@ seam (steps_per_dispatch chunking, grad accumulation, EMA,
 skip_nonfinite, PR 10's ``maybe_health_metrics``, PR 11's
 capacity-ledger compile hook via ``.lower``) is threaded exactly ONCE.
 
-Bitwise contract: with ``grad_compression='none'`` the built step is
-bitwise (f32, CPU) identical to the legacy builder of the same preset —
-per-preset RNG folds, metric-dict construction order, the
-``chunked_step_fn`` k==1 identity, and the shard_map/jit wrapping are
-reproduced exactly; the bucketed reducer computes per element exactly
-what ``lax.pmean`` computes (tests/test_sharding_rules.py asserts all
-of it, tools/t1.sh re-proves a smoke every round).  Legacy stays the
-default (``parallel.engine``) for one PR; defaults only flip where
-bit-identical.
+Bitwise contract: with ``grad_compression='none'`` and a flat (single
+level) reduction, the built step is bitwise (f32, CPU) identical to the
+legacy builder of the same preset — proven in round 17 against all
+three, after which the default flipped and the legacy builders were
+deleted (round 18); the bucketed reducer computes per element exactly
+what ``lax.pmean`` computes (tests/test_sharding_rules.py asserts it,
+tools/t1.sh re-proves a smoke every round).
 
 Perf deliverables on top of the rule layer:
 
+- ``parallel.preset=fsdp`` — full parameter sharding as pure config:
+  params shard over ``data`` (``rules.fsdp_fallback_rule`` picks each
+  leaf's largest divisible dim), the GSPMD partitioner all-gathers
+  them just-in-time per layer in forward/backward and reduce-scatters
+  grads; optimizer buffers inherit the param layout, so weight-update
+  sharding comes free at any ``zero`` level.
 - ``parallel.zero=1|2`` — ZeRO-style weight-update sharding: optimizer
-  moments + EMA shard over ``data`` (GSPMD preset; grads reduce-scatter
-  into 1/N updates, params all-gather), level 2 additionally pins the
-  gradient tree to the sharded layout.  HBM saving is priced by
-  ``comm_plan`` and reported through the capacity ledger.
+  moments + EMA shard over ``data`` (GSPMD presets; grads
+  reduce-scatter into 1/N updates, params all-gather), level 2
+  additionally pins the gradient tree to the sharded layout.  HBM
+  saving is priced by ``comm_plan`` and reported through the capacity
+  ledger.
 - ``parallel.comm_bucket_mb`` — bucketed, backward-ordered gradient
   allreduce on the DP preset (``rules.bucketed_pmean``): one
   ``lax.psum`` per size-targeted bucket so early buckets' communication
-  overlaps remaining backward compute; optional bf16 wire compression
-  (``parallel.grad_compression``) gated by tools/grad_comm_gate.py.
+  overlaps remaining backward compute.
+- ``mesh.data_hosts>1`` — two-level ICI x DCN reduction on the DP
+  preset: each bucket's psum becomes intra-host reduce-scatter ->
+  inter-host all-reduce on 1/chips_per_host of the bytes -> intra-host
+  all-gather (``rules._hier_psum``; groups from
+  ``mesh.hier_data_groups``).
+- ``parallel.grad_compression=bf16|int8_ef`` — wire compression on the
+  bucketed reducer; int8_ef carries a persistent error-feedback
+  residual in the train state (``TrainState.comm_residual``, sharded
+  over ``data``).  Both gated by tools/grad_comm_gate.py.
 """
 
 from __future__ import annotations
@@ -51,16 +64,22 @@ from ..train.step import (_loss_kwargs, apply_update, chunk_batch_spec,
                           resolve_remat_policy)
 from ..utils.compat import shard_map
 from . import rules as rules_mod
-from .mesh import batch_sharding, batch_spec, replicated_sharding
+from .mesh import (batch_sharding, batch_spec, hier_data_groups,
+                   replicated_sharding)
 
-PRESETS = ("dp", "tp", "sp")
+PRESETS = ("dp", "tp", "sp", "fsdp")
 
 
 def select_preset(cfg, mesh: Mesh) -> str:
-    """The rules-engine preset for a config+mesh — the SAME routing the
-    legacy loop uses: ``sp`` when the ``seq`` axis is sharded, ``tp``
-    (the GSPMD preset) when the ``model`` axis is sharded or any ZeRO
-    level is on, else ``dp``."""
+    """The rules-engine preset for a config+mesh: an explicit
+    ``parallel.preset`` wins (``fsdp`` can only be asked for — nothing
+    about a mesh implies it); ``auto`` derives the historical routing —
+    ``sp`` when the ``seq`` axis is sharded, ``tp`` (the GSPMD preset)
+    when the ``model`` axis is sharded or any ZeRO level is on, else
+    ``dp``."""
+    explicit = getattr(cfg.parallel, "preset", "auto")
+    if explicit != "auto":
+        return explicit
     if mesh.shape.get("seq", 1) > 1:
         return "sp"
     if (mesh.shape.get("model", 1) > 1 or cfg.optim.zero1
@@ -97,32 +116,43 @@ def make_unified_train_step(
     zero: int = 0,
     comm_bucket_mb: float = 0.0,
     grad_compression: str = "none",
+    data_hosts: int = 1,
     _always_scan: bool = False,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
               Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build ``(state, batch) -> (state, metrics)`` for any preset.
 
-    Sharding contracts (identical to the legacy builder per preset):
-    ``dp`` — state replicated, batch ``P('data')``, shard_map; ``sp`` —
-    state replicated, batch ``P('data', 'seq')``, shard_map (vit_sod
-    only; ``sp_strategy`` picks ring vs ulysses); ``tp`` — GSPMD jit
-    with ``state_shardings`` (required; from
-    ``rules.shard_state_by_rules``), collectives inserted by the
-    partitioner.  ``steps_per_dispatch=k > 1`` scans k steps per
-    dispatch over a new leading stacked axis (``chunked_step_fn``) —
-    the ONE chunking seam all presets share.
+    Sharding contracts: ``dp`` — state replicated (int8_ef's
+    ``comm_residual`` sharded ``P('data')``), batch ``P('data')``,
+    shard_map; ``sp`` — state replicated, batch ``P('data', 'seq')``,
+    shard_map (vit_sod only; ``sp_strategy`` picks ring vs ulysses);
+    ``tp``/``fsdp`` — GSPMD jit with ``state_shardings`` (required;
+    from ``rules.shard_state_by_rules`` — the Megatron tables for tp,
+    the empty-table + ``fsdp_fallback_rule`` layout for fsdp),
+    collectives inserted by the partitioner.  ``steps_per_dispatch=k >
+    1`` scans k steps per dispatch over a new leading stacked axis
+    (``chunked_step_fn``) — the ONE chunking seam all presets share.
+    ``data_hosts>1`` routes each dp bucket through the two-level
+    ICI x DCN reduction (``mesh.hier_data_groups``).
     """
     if preset not in PRESETS:
         raise ValueError(f"preset must be one of {PRESETS}, got {preset!r}")
-    if preset == "tp" and state_shardings is None:
+    gspmd = preset in ("tp", "fsdp")
+    if gspmd and state_shardings is None:
         raise ValueError(
-            "the tp (GSPMD) preset needs state_shardings — build them "
-            "with rules.shard_state_by_rules(state, mesh, zero=...)")
+            f"the {preset} (GSPMD) preset needs state_shardings — build "
+            "them with rules.shard_state_by_rules(state, mesh, "
+            "zero=..., fallback=...)")
     if preset != "dp" and grad_compression != "none":
         raise ValueError(
             "grad_compression applies to the dp preset's bucketed "
             f"reducer only (preset={preset!r}: the GSPMD partitioner / "
             "SP reduction schedule their own collectives)")
+    if preset != "dp" and data_hosts > 1:
+        raise ValueError(
+            "mesh.data_hosts>1 (the two-level ICI x DCN reduction) "
+            f"applies to the dp preset's bucketed reducer only, got "
+            f"preset={preset!r}")
     if preset == "sp":
         from .sp import validate_sp_strategy
 
@@ -138,11 +168,13 @@ def make_unified_train_step(
     lkw = _loss_kwargs(loss_cfg)
     seq = mesh.shape.get("seq", 1)
     bucket_bytes = int(comm_bucket_mb * 2 ** 20)
+    hierarchy = hier_data_groups(mesh, data_hosts)
+    ef = grad_compression == "int8_ef"
     # ZeRO-2: the gradient tree is pinned to the buffer layout so the
     # partitioner reduce-scatters instead of materializing the full
     # replicated tree between reduce and update.
     grad_constraint = None
-    if preset == "tp" and zero >= 2 and state_shardings is not None:
+    if gspmd and zero >= 2 and state_shardings is not None:
         grad_constraint = jax.tree_util.tree_map(
             lambda s: s, state_shardings.params)
 
@@ -220,13 +252,22 @@ def make_unified_train_step(
             state.params)
         return grads, comps, new_stats
 
-    def _reduce(grads, comps):
-        """Per-preset gradient/metric reduction — the comm seam."""
+    def _reduce(grads, comps, residual=None):
+        """Per-preset gradient/metric reduction — the comm seam.
+        Returns ``(grads, comps, new_residual)``; the residual is only
+        live on the dp int8_ef arm."""
         if preset == "dp":
-            if bucket_bytes > 0:
-                grads = rules_mod.bucketed_pmean(
-                    grads, "data", bucket_bytes,
-                    compression=grad_compression)
+            if bucket_bytes > 0 or hierarchy is not None or ef:
+                if ef:
+                    grads, residual = rules_mod.bucketed_pmean(
+                        grads, "data", bucket_bytes,
+                        compression=grad_compression,
+                        hierarchy=hierarchy, residual=residual)
+                else:
+                    grads = rules_mod.bucketed_pmean(
+                        grads, "data", bucket_bytes,
+                        compression=grad_compression,
+                        hierarchy=hierarchy)
             else:
                 grads = lax.pmean(grads, "data")
             comps = lax.pmean(comps, "data")
@@ -237,14 +278,10 @@ def make_unified_train_step(
             comps = lax.pmean(comps, "data")
         elif grad_constraint is not None:
             grads = lax.with_sharding_constraint(grads, grad_constraint)
-        return grads, comps
+        return grads, comps, residual
 
-    def step_fn(state: TrainState, batch):
-        if preset != "sp":
-            batch = rescale_batch(batch, scale_hw)
-        rng = _rng(state.step)
-        grads, comps, new_stats = _forward_loss(state, batch, rng)
-        grads, comps = _reduce(grads, comps)
+    def _finish(state, grads, comps, new_stats):
+        """Optimizer/EMA/metric tail — identical on every preset."""
         new_state = apply_update(state, grads, new_stats, tx,
                                  ema_decay=ema_decay)
         metrics = dict(comps)
@@ -258,13 +295,33 @@ def make_unified_train_step(
             metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
         return new_state, metrics
 
-    body = chunked_step_fn(step_fn, steps_per_dispatch,
+    def step_fn(state: TrainState, batch):
+        if preset != "sp":
+            batch = rescale_batch(batch, scale_hw)
+        rng = _rng(state.step)
+        grads, comps, new_stats = _forward_loss(state, batch, rng)
+        grads, comps, _ = _reduce(grads, comps)
+        return _finish(state, grads, comps, new_stats)
+
+    def step_fn_ef(carry, batch):
+        # int8_ef: the carry is (state-without-residual, residual); the
+        # residual's local block is (1, n_elems) — its replica row.
+        state, residual = carry
+        batch = rescale_batch(batch, scale_hw)
+        rng = _rng(state.step)
+        grads, comps, new_stats = _forward_loss(state, batch, rng)
+        grads, comps, new_res = _reduce(grads, comps, residual[0])
+        new_state, metrics = _finish(state, grads, comps, new_stats)
+        return (new_state, new_res[None]), metrics
+
+    inner_fn = step_fn_ef if ef else step_fn
+    body = chunked_step_fn(inner_fn, steps_per_dispatch,
                            always_scan=_always_scan)
     donated = (0,) if donate else ()
     if donate_batch:  # fit feeds each prefetched batch exactly once
         donated = donated + (1,)
-    if preset == "tp":
-        batch_in = (batch_sharding(mesh) if body is step_fn
+    if gspmd:
+        batch_in = (batch_sharding(mesh) if body is inner_fn
                     else NamedSharding(mesh, chunk_batch_spec(batch_spec())))
         replicated = NamedSharding(mesh, P())
         return jax.jit(
@@ -274,7 +331,32 @@ def make_unified_train_step(
             donate_argnums=donated,
         )
     base = P("data") if preset == "dp" else P("data", "seq")
-    batch_in = base if body is step_fn else chunk_batch_spec(base)
+    batch_in = base if body is inner_fn else chunk_batch_spec(base)
+    if ef:
+        sharded = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=((P(), P("data")), batch_in),
+            out_specs=((P(), P("data")), P()),
+            check_vma=False,
+        )
+        inner = jax.jit(sharded, donate_argnums=donated)
+
+        def step(state: TrainState, batch):
+            # The public contract stays (state, batch) -> (state,
+            # metrics): split the residual out of the state for the
+            # carry tuple and reattach it after.
+            core = state.replace(comm_residual=None)
+            (core, res), metrics = inner((core, state.comm_residual),
+                                         batch)
+            return core.replace(comm_residual=res), metrics
+
+        # .lower keeps the AOT consumers working (capacity record_jit,
+        # tools/dump_hlo.py) — same split, handed to the jit's lower.
+        step.lower = lambda state, batch: inner.lower(
+            (state.replace(comm_residual=None), state.comm_residual),
+            batch)
+        return step
     sharded = shard_map(
         body,
         mesh=mesh,
@@ -289,43 +371,82 @@ def make_unified_train_step(
 
 def comm_plan(state, mesh: Mesh, *, preset: str, zero: int = 0,
               comm_bucket_mb: float = 0.0,
-              grad_compression: str = "none") -> Dict[str, Any]:
+              grad_compression: str = "none",
+              data_hosts: int = 1) -> Dict[str, Any]:
     """Price the step's gradient collectives + ZeRO HBM saving from
-    shapes alone (no tracing): per-collective payload bytes and axis
-    size, the bucket count, a structural overlap estimate, and the
-    per-device optimizer/EMA bytes ZeRO removes.  The capacity ledger
-    (``CapacityLedger.record_comm``) turns this into the
-    ``dsod_capacity_comm_*`` families; tools/roofline.py prices the
-    same plan offline against ICI bandwidth.
+    shapes alone (no tracing): per-collective payload bytes, axis size
+    and link level (``ici``/``dcn``), the bucket count, a structural
+    overlap estimate, and the per-device optimizer/EMA bytes ZeRO
+    removes.  The capacity ledger (``CapacityLedger.record_comm``)
+    turns this into the ``dsod_capacity_comm_*`` families (DCN legs
+    into the ``_dcn_*`` families); tools/roofline.py prices the same
+    plan offline against ICI and DCN bandwidth.
+
+    ``data_hosts>1`` expands each dp bucket into its three hierarchical
+    legs: intra-host reduce-scatter (ici, full payload), inter-host
+    all-reduce (dcn, payload/chips_per_host — the whole point), intra-
+    host all-gather (ici).  int8_ef prices the achievable 1 B/elem wire
+    (0.25 x f32) — XLA transports int32, so this is the contract for
+    a wire-level int8 transport, stated in docs/PERFORMANCE.md.
 
     Overlap estimate is STRUCTURAL, not measured: with backward-ordered
     buckets every bucket except the final one (the earliest layers,
     reduced last) can overlap remaining backward compute, so
     ``overlap_frac = 1 - last_bucket_bytes / total``; a monolithic
-    reduce (or the GSPMD preset, whose schedule the partitioner owns)
+    reduce (or the GSPMD presets, whose schedule the partitioner owns)
     reports 0.  The measured number stays a TPU-window item
-    (tools/tpu_agenda_r17.sh).
+    (tools/tpu_agenda_r18.sh).
     """
     leaves = jax.tree_util.tree_leaves(state.params)
     shapes = [(g.shape, g.dtype) for g in leaves]
     sizes = [int(np.prod(s or (1,))) * np.dtype(d).itemsize
              for s, d in shapes]
-    wire_scale = 0.5 if grad_compression == "bf16" else 1.0
+    wire_scale = {"bf16": 0.5, "int8_ef": 0.25}.get(grad_compression,
+                                                    1.0)
     n_data = mesh.shape.get("data", 1)
     collectives = []
     if preset == "dp":
         bucket_bytes = int(comm_bucket_mb * 2 ** 20)
         buckets = rules_mod.grad_buckets(shapes, bucket_bytes)
+        chips = n_data // data_hosts if data_hosts > 1 else n_data
         for i, bucket in enumerate(buckets):
-            payload = sum(sizes[j] for j in bucket)
-            collectives.append({
-                "name": (f"grad_bucket_{i:02d}" if len(buckets) > 1
-                         else "grad_allreduce"),
-                "kind": "psum", "axis": "data", "axis_size": n_data,
-                "bytes": int(payload * wire_scale)})
+            payload = int(sum(sizes[j] for j in bucket) * wire_scale)
+            stem = (f"grad_bucket_{i:02d}" if len(buckets) > 1
+                    else "grad_allreduce")
+            if data_hosts > 1:
+                collectives.extend([
+                    {"name": f"{stem}_rs", "kind": "reduce_scatter",
+                     "axis": "data", "axis_size": chips, "level": "ici",
+                     "bytes": payload},
+                    {"name": f"{stem}_ar", "kind": "psum",
+                     "axis": "data", "axis_size": data_hosts,
+                     "level": "dcn", "bytes": payload // chips},
+                    {"name": f"{stem}_ag", "kind": "all_gather",
+                     "axis": "data", "axis_size": chips, "level": "ici",
+                     "bytes": payload},
+                ])
+            else:
+                collectives.append({
+                    "name": stem, "kind": "psum", "axis": "data",
+                    "axis_size": n_data, "level": "ici",
+                    "bytes": payload})
         last = sum(sizes[j] for j in buckets[-1]) if buckets else 0
         overlap = (1.0 - last / max(sum(sizes), 1)
                    if len(buckets) > 1 else 0.0)
+    elif preset == "fsdp":
+        # The partitioner all-gathers the sharded params just-in-time
+        # in forward AND backward, and reduce-scatters grads into the
+        # 1/N updates — the textbook FSDP schedule, priced at the param
+        # payload per leg.
+        payload = sum(sizes)
+        for name, kind in (("param_allgather_fwd", "all_gather"),
+                           ("param_allgather_bwd", "all_gather"),
+                           ("grad_reduce_scatter", "reduce_scatter")):
+            collectives.append({
+                "name": name, "kind": kind, "axis": "data",
+                "axis_size": n_data, "level": "ici",
+                "bytes": payload})
+        overlap = 0.0
     elif preset == "sp":
         n = n_data * mesh.shape.get("seq", 1)
         collectives.append({
@@ -341,7 +462,21 @@ def comm_plan(state, mesh: Mesh, *, preset: str, zero: int = 0,
             "axis_size": n_data, "bytes": sum(sizes)})
         overlap = 0.0
     saved = 0
-    if zero and preset == "tp":
+    if preset == "fsdp":
+        # FSDP sharding saves params + optimizer buffers + EMA: the
+        # whole state except batch_stats shards over data.
+        fallback = rules_mod.fsdp_fallback_rule(mesh)
+        specs = rules_mod.state_specs(
+            state, mesh, rules=rules_mod.PRESET_PARAM_RULES["fsdp"],
+            zero=zero, fallback=fallback)
+        for tree, spec in ((state.params, specs.params),
+                           (state.opt_state, specs.opt_state),
+                           (state.ema_params, specs.ema_params)):
+            if tree is None:
+                continue
+            saved += (rules_mod.tree_bytes(tree)
+                      - rules_mod.sharded_tree_bytes(tree, spec, mesh))
+    elif zero and preset == "tp":
         specs = rules_mod.state_specs(state, mesh, zero=zero)
         for tree, spec in ((state.opt_state, specs.opt_state),
                            (state.ema_params, specs.ema_params)):
@@ -349,13 +484,36 @@ def comm_plan(state, mesh: Mesh, *, preset: str, zero: int = 0,
                 continue
             saved += (rules_mod.tree_bytes(tree)
                       - rules_mod.sharded_tree_bytes(tree, spec, mesh))
+    stems = {c["name"].rsplit("_rs", 1)[0].rsplit("_ar", 1)[0]
+             .rsplit("_ag", 1)[0] for c in collectives
+             if c["name"].startswith("grad_bucket")}
     return {
         "collectives": collectives,
-        "n_buckets": sum(1 for c in collectives
-                         if c["name"].startswith("grad_bucket")) or 1,
+        "n_buckets": len(stems) or 1,
         "overlap_frac": round(overlap, 6),
         "zero_hbm_saved_bytes": int(saved),
     }
+
+
+def seed_comm_residual(state, mesh: Mesh) -> TrainState:
+    """Seed the int8_ef error-feedback residual: a zero
+    ``(n_data, n_grad_elems)`` f32 array sharded ``P('data')`` — row r
+    is replica r's accumulated quantization error.  A state that
+    already carries a residual (e.g. restored from a checkpoint) keeps
+    its values; it is only (re)placed onto the mesh."""
+    sharding = NamedSharding(mesh, P("data"))
+    existing = getattr(state, "comm_residual", None)
+    if existing is not None:
+        return state.replace(
+            comm_residual=jax.device_put(jnp.asarray(existing),
+                                         sharding))
+    shapes = [(g.shape, g.dtype)
+              for g in jax.tree_util.tree_leaves(state.params)]
+    n = rules_mod.comm_residual_size(shapes, 0)
+    n_data = mesh.shape.get("data", 1)
+    return state.replace(
+        comm_residual=jax.device_put(jnp.zeros((n_data, n), jnp.float32),
+                                     sharding))
 
 
 def prepare_train_step(cfg, model, tx, mesh: Mesh, schedule, state, *,
@@ -364,15 +522,18 @@ def prepare_train_step(cfg, model, tx, mesh: Mesh, schedule, state, *,
                        donate: bool = True, donate_batch: bool = False):
     """One-call routing for bench.py / tools/dump_hlo.py: select the
     preset, place the state (replicated, or rule/ZeRO-sharded for the
-    GSPMD preset), and build the unified step.  Returns ``(state,
-    step, plan)`` where ``plan`` is ``comm_plan``'s dict.  fit() wires
-    the presets itself (it owns validation + the multi-scale factory)
-    but calls the SAME builder."""
+    GSPMD presets — Megatron tables for tp, empty table +
+    ``fsdp_fallback_rule`` for fsdp), seed the int8_ef residual when
+    asked for, and build the unified step.  Returns ``(state, step,
+    plan)`` where ``plan`` is ``comm_plan``'s dict.  fit() wires the
+    presets itself (it owns validation + the multi-scale factory) but
+    calls the SAME builder."""
     from ..configs.base import validate_parallel
 
     validate_parallel(cfg)
     preset = select_preset(cfg, mesh)
     zero = effective_zero(cfg)
+    data_hosts = getattr(cfg.mesh, "data_hosts", 1)
     kw = dict(schedule=schedule, donate=donate, remat=cfg.model.remat,
               ema_decay=cfg.optim.ema_decay, scale_hw=scale_hw,
               donate_batch=donate_batch,
@@ -380,18 +541,32 @@ def prepare_train_step(cfg, model, tx, mesh: Mesh, schedule, state, *,
               steps_per_dispatch=steps_per_dispatch,
               health=cfg.health_numerics,
               comm_bucket_mb=cfg.parallel.comm_bucket_mb,
-              grad_compression=cfg.parallel.grad_compression, zero=zero)
+              grad_compression=cfg.parallel.grad_compression,
+              data_hosts=data_hosts, zero=zero)
     if preset == "tp":
         state, shardings = rules_mod.shard_state_by_rules(
             state, mesh, zero=zero)
         kw["state_shardings"] = shardings
+    elif preset == "fsdp":
+        state, shardings = rules_mod.shard_state_by_rules(
+            state, mesh, rules=rules_mod.PRESET_PARAM_RULES["fsdp"],
+            zero=zero, fallback=rules_mod.fsdp_fallback_rule(mesh))
+        kw["state_shardings"] = shardings
     else:
-        state = jax.device_put(state, replicated_sharding(mesh))
+        # Replicate first, THEN seed the residual — seeding places the
+        # residual P('data'), which a blanket replicate would undo.
+        residual = getattr(state, "comm_residual", None)
+        state = jax.device_put(state.replace(comm_residual=None),
+                               replicated_sharding(mesh))
+        if cfg.parallel.grad_compression == "int8_ef":
+            state = seed_comm_residual(
+                state.replace(comm_residual=residual), mesh)
         if preset == "sp":
             kw["sp_strategy"] = cfg.mesh.sp_strategy
     step = make_unified_train_step(model, cfg.loss, tx, mesh,
                                    preset=preset, **kw)
     plan = comm_plan(state, mesh, preset=preset, zero=zero,
                      comm_bucket_mb=cfg.parallel.comm_bucket_mb,
-                     grad_compression=cfg.parallel.grad_compression)
+                     grad_compression=cfg.parallel.grad_compression,
+                     data_hosts=data_hosts)
     return state, step, plan

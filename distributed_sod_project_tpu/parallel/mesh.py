@@ -77,6 +77,44 @@ def make_mesh(
     return Mesh(arr, MeshAxes)
 
 
+def hier_data_groups(mesh: Mesh, data_hosts: int):
+    """axis_index_groups for the two-level (ICI x DCN) data reduction.
+
+    Factors the ``data`` axis as ``(data_hosts, chips_per_host)`` —
+    make_mesh's row-major device order puts consecutive data indices on
+    the same host, so host h owns data indices
+    ``[h*chips, ..., (h+1)*chips - 1]``.  Returns
+    ``(intra_groups, inter_groups)``:
+
+    - ``intra_groups`` — one group per host (its chips): the fast ICI
+      legs (reduce-scatter, then the final all-gather).
+    - ``inter_groups`` — one group per chip position (its peers across
+      hosts): the slow DCN all-reduce, carrying only 1/chips_per_host
+      of the bucket bytes after the scatter.
+
+    Returns ``None`` when ``data_hosts <= 1`` (flat single-level psum).
+    """
+    if data_hosts <= 1:
+        return None
+    data = int(mesh.shape.get("data", 1))
+    if data % data_hosts:
+        raise ValueError(
+            f"mesh.data_hosts={data_hosts} does not divide the data "
+            f"axis (size {data}) — the two-level reduction needs equal "
+            "chips_per_host on every host")
+    chips = data // data_hosts
+    if chips == 1:
+        raise ValueError(
+            f"mesh.data_hosts={data_hosts} leaves 1 chip per host — "
+            "the hierarchical reduction degenerates to the flat psum; "
+            "use data_hosts=1")
+    intra = [[h * chips + j for j in range(chips)]
+             for h in range(data_hosts)]
+    inter = [[h * chips + j for h in range(data_hosts)]
+             for j in range(chips)]
+    return intra, inter
+
+
 def batch_spec() -> P:
     """Batch dim sharded over ``data``; everything else replicated."""
     return P("data")

@@ -54,12 +54,17 @@ REPLICATE_REST: Tuple[str, P] = (r".*", P())
 
 # Preset → parameter rule table.  DP and SP replicate every parameter
 # (their non-data axes are degenerate / the batch axis does the work);
-# TP is the Megatron layout.  The tables are TOTAL only with the
+# TP is the Megatron layout.  FSDP's table is EMPTY on purpose: every
+# leaf goes to ``fsdp_fallback_rule`` (largest divisible dim over
+# ``data``), which IS the preset — params shard over data, the
+# partitioner all-gathers them just-in-time per layer and
+# reduce-scatters grads.  The tables are TOTAL only with the
 # replicate-by-default fallback — strict matching surfaces the holes.
 PRESET_PARAM_RULES = {
     "dp": (REPLICATE_REST,),
     "tp": DEFAULT_TP_RULES + (REPLICATE_REST,),
     "sp": (REPLICATE_REST,),
+    "fsdp": (),
 }
 
 
@@ -177,6 +182,13 @@ def state_specs(state, mesh: Mesh, *,
     pdef = jax.tree_util.tree_structure(state.params)
     buf_specs = (zero_state_specs(state.params, param_specs, mesh)
                  if zero >= 1 else param_specs)
+    # The int8_ef error-feedback residual is per-replica by
+    # construction (each replica's quantization error on ITS gradient
+    # contribution): leading replica dim sharded over ``data`` — the
+    # same weight-update-sharding axis the ZeRO buffers use.
+    residual_specs = (P("data")
+                     if getattr(state, "comm_residual", None) is not None
+                     else None)
     return type(state)(
         step=P(),
         params=param_specs,
@@ -184,16 +196,20 @@ def state_specs(state, mesh: Mesh, *,
                                            state.batch_stats),
         opt_state=_specs_like(state.opt_state, pdef, buf_specs),
         ema_params=buf_specs if state.ema_params is not None else None,
+        comm_residual=residual_specs,
     )
 
 
 def shard_state_by_rules(state, mesh: Mesh, *,
                          rules: Sequence[Tuple[str, P]] = DEFAULT_TP_RULES,
-                         zero: int = 0):
+                         zero: int = 0,
+                         fallback: Optional[Callable[[str, Any], P]] = None):
     """Place a host/replicated TrainState onto the mesh per the rule
-    table (+ ZeRO buffer sharding); returns (state, state_shardings)."""
+    table (+ ZeRO buffer sharding; ``fallback`` for FSDP auto-sharding
+    of unmatched leaves); returns (state, state_shardings)."""
     shardings = to_shardings(
-        state_specs(state, mesh, rules=rules, zero=zero), mesh)
+        state_specs(state, mesh, rules=rules, zero=zero,
+                    fallback=fallback), mesh)
     return jax.device_put(state, shardings), shardings
 
 
@@ -235,13 +251,52 @@ def grad_buckets(shapes_dtypes: Sequence[Tuple[Tuple[int, ...], Any]],
     return buckets
 
 
+def comm_residual_size(shapes_dtypes: Sequence[Tuple[Tuple[int, ...], Any]],
+                       bucket_bytes: int) -> int:
+    """Element count of the int8_ef error-feedback residual for a
+    gradient tree: every leaf appears in exactly one bucket's wire
+    buffer, so the residual is one flat f32 vector covering every
+    element once, segments laid out in the deterministic
+    bucket-then-dtype order ``bucketed_pmean`` iterates."""
+    del bucket_bytes  # every leaf appears exactly once regardless
+    return sum(int(np.prod(shape or (1,))) for shape, _ in shapes_dtypes)
+
+
+def _hier_psum(vec, axis, hierarchy):
+    """Two-level reduction of one flat wire buffer: intra-host
+    reduce-scatter -> inter-host all-reduce on 1/chips_per_host of the
+    bytes -> intra-host all-gather (the ICI x DCN recipe; PAPERS.md
+    arXiv 1902.00465).  ``hierarchy`` is ``(intra_groups,
+    inter_groups)`` from ``mesh.hier_data_groups``.  Computes the
+    pair-tree association ``sum_hosts(sum_chips(x))`` — exact (bitwise
+    the flat psum) for integer wire dtypes; for floats the association
+    differs from XLA's flat fold at the last ulp.
+    """
+    from jax import lax
+    import jax.numpy as jnp
+
+    intra, inter = hierarchy
+    chips = len(intra[0])
+    n = vec.shape[0]
+    pad = (-n) % chips
+    if pad:
+        vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+    seg = lax.psum_scatter(vec, axis, scatter_dimension=0,
+                           axis_index_groups=intra, tiled=True)
+    seg = lax.psum(seg, axis, axis_index_groups=inter)
+    full = lax.all_gather(seg, axis, axis_index_groups=intra,
+                          tiled=True)
+    return full[:n] if pad else full
+
+
 def bucketed_pmean(grads, axis, bucket_bytes: int,
-                   compression: str = "none"):
-    """Gradient mean over ``axis`` as one FUSED ``lax.psum`` per
+                   compression: str = "none", *,
+                   hierarchy=None, residual=None):
+    """Gradient mean over ``axis`` as one FUSED reduction per
     size-targeted bucket (backward-ordered; ``grad_buckets``): each
     bucket's leaves are raveled and concatenated into ONE flat buffer
-    (the DDP flat-bucket recipe), psum'd, then sliced back — so a
-    B-bucket plan is exactly B 1-D ``all_reduce`` ops in the dumped HLO
+    (the DDP flat-bucket recipe), reduced, then sliced back — so a
+    B-bucket plan is exactly B 1-D collectives in the dumped HLO
     (the countable signal tools/hlo_guard.py's comm arm checks) instead
     of one per leaf, and early buckets can overlap remaining backward
     compute.
@@ -251,18 +306,47 @@ def bucketed_pmean(grads, axis, bucket_bytes: int,
     no values — so with ``compression='none'`` the result is bitwise
     the monolithic pmean's (asserted in tests/test_sharding_rules.py).
 
+    ``hierarchy=(intra_groups, inter_groups)`` replaces each bucket's
+    flat psum with the two-level intra-host reduce-scatter -> inter-host
+    all-reduce -> intra-host all-gather (``_hier_psum``), putting only
+    1/chips_per_host of the bytes on the slow DCN hop.
+
     ``compression='bf16'`` casts each bucket's wire buffer to bfloat16
-    and back after — half the gradient comm bytes, NOT bitwise (gated
-    by tools/grad_comm_gate.py's checked-in baseline).
+    and back after — half the gradient comm bytes, NOT bitwise.
+    ``compression='int8_ef'`` adds the persistent ``residual`` (one
+    flat f32 vector, segments in this function's bucket-then-dtype
+    iteration order) into the buffer, quantizes symmetrically to int8
+    against a GLOBAL scale (``lax.pmax`` of per-replica amax — a shared
+    scale makes the integer psum exact and order-independent), keeps
+    the per-replica quantization error as the next step's residual, and
+    transports int32 on the wire (int8 payload; the ledger prices the
+    achievable 1 B/elem).  Both gated by tools/grad_comm_gate.py's
+    checked-in baseline.
+
+    Returns the gradient tree, or ``(tree, new_residual)`` when
+    ``residual`` is given (int8_ef error feedback).
     """
     import jax.numpy as jnp
     from jax import lax
+
+    if compression == "int8_ef" and residual is None:
+        raise ValueError(
+            "grad_compression=int8_ef needs the error-feedback "
+            "residual (state.comm_residual) threaded in")
 
     flat, treedef = jax.tree_util.tree_flatten(grads)
     buckets = grad_buckets([(g.shape, g.dtype) for g in flat],
                            bucket_bytes)
     denom = lax.psum(1, axis)
+
+    def reduce_buf(v):
+        if hierarchy is not None:
+            return _hier_psum(v, axis, hierarchy)
+        return lax.psum(v, axis)
+
     out: List[Any] = [None] * len(flat)
+    res_out: List[Any] = []
+    res_off = 0
     for bucket in buckets:
         # One flat buffer per (bucket, dtype) — a single buffer on the
         # homogeneous-f32 zoo; mixed-precision trees fuse per dtype.
@@ -272,17 +356,33 @@ def bucketed_pmean(grads, axis, bucket_bytes: int,
         for dt, idxs in by_dtype.items():
             vec = jnp.concatenate([flat[i].reshape(-1) for i in idxs])
             if compression == "bf16":
-                summed = lax.psum(vec.astype(jnp.bfloat16),
-                                  axis).astype(dt)
+                summed = reduce_buf(vec.astype(jnp.bfloat16)).astype(dt)
+            elif compression == "int8_ef":
+                seg = lax.dynamic_slice_in_dim(
+                    residual, res_off, vec.shape[0])
+                buf = vec.astype(jnp.float32) + seg
+                amax = lax.pmax(jnp.max(jnp.abs(buf)), axis)
+                scale = jnp.where(amax > 0, amax / 127.0,
+                                  jnp.ones((), jnp.float32))
+                q = jnp.clip(jnp.round(buf / scale), -127, 127)
+                res_out.append(buf - q * scale)
+                res_off += vec.shape[0]
+                summed = (reduce_buf(q.astype(jnp.int32))
+                          .astype(jnp.float32) * scale).astype(dt)
             else:
-                summed = lax.psum(vec, axis)
+                summed = reduce_buf(vec)
             off = 0
             for i in idxs:
                 n = int(np.prod(flat[i].shape or (1,)))
                 out[i] = (summed[off:off + n].reshape(flat[i].shape)
                           / denom)
                 off += n
-    return jax.tree_util.tree_unflatten(treedef, out)
+    tree = jax.tree_util.tree_unflatten(treedef, out)
+    if residual is None:
+        return tree
+    new_residual = (jnp.concatenate(res_out) if res_out
+                    else jnp.zeros_like(residual))
+    return tree, new_residual
 
 
 def tree_bytes(tree) -> int:

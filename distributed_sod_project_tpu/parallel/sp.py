@@ -1,4 +1,8 @@
-"""Sequence-parallel training step (SURVEY.md §5 "long-context").
+"""Sequence-parallel building blocks (SURVEY.md §5 "long-context").
+
+The SP train step itself is built by the rules engine
+(parallel/engine.py, ``preset="sp"``) from the loss/apply/eval pieces
+defined here.
 
 The reference has no sequence axis to scale (fixed 320×320 CNNs); this
 is the TPU build's long-context path: ``vit_sod``'s global attention is
@@ -33,18 +37,14 @@ numerics (grad-equivalence asserted in tests/test_vit_sod.py).
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..losses.ssim import _C1, _C2, _blur, gaussian_window
-from ..train.state import TrainState
-from ..train.step import (apply_update, maybe_health_metrics, maybe_remat,
-                          notfinite_count)
 from .ring_attention import ring_attention
 from ..utils.compat import axis_size, shard_map
 
@@ -244,130 +244,3 @@ def make_sp_eval_forward(model, mesh: Mesh, sp_strategy: str = "ring"):
             variables, jax.device_put(b, sp_batch_sharding(mesh)))
 
     return bind
-
-
-def make_sp_train_step(
-    model,
-    loss_cfg,
-    tx: optax.GradientTransformation,
-    mesh: Mesh,
-    schedule: Optional[optax.Schedule] = None,
-    donate: bool = True,
-    ema_decay: float = 0.0,
-    donate_batch: bool = False,
-    sp_strategy: str = "ring",
-    remat: bool = False,
-    remat_policy: str = "none",
-    steps_per_dispatch: int = 1,
-    health: bool = False,
-    _always_scan: bool = False,
-) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
-              Tuple[TrainState, Dict[str, jnp.ndarray]]]:
-    """Build the sequence-parallel ``(state, batch) -> (state, metrics)``.
-
-    Contract: ``state`` replicated; batch leaves ``P('data', 'seq')``
-    (global shapes; each device sees its (batch, rows) tile).  The
-    model must be halo-free over rows with an injectable attention
-    core (``vit_sod``).  ``sp_strategy`` picks ring vs ulysses —
-    see ``_sp_apply``.
-
-    ``steps_per_dispatch=k > 1`` scans k steps in one dispatch over
-    batches stacked on a new leading axis (leaves ``P(None, 'data',
-    'seq')``), stacked per-step metrics out — see
-    ``train.step.chunked_step_fn``.  k == 1 is unchanged.
-    """
-    if getattr(loss_cfg, "fused_kernel", False):
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "loss.fused_kernel is a no-op on the sequence-parallel "
-            "path: the SP loss already psums sufficient statistics "
-            "inline (docs/PERFORMANCE.md)")
-    validate_sp_strategy(model, mesh, sp_strategy)
-    from ..train.step import resolve_remat_policy
-
-    resolve_remat_policy(remat_policy)  # fail fast on typos, remat or not
-    seq = mesh.shape["seq"]
-
-    def step_fn(state: TrainState, batch):
-        rng = jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(0), state.step),
-            lax.axis_index("data") * seq + lax.axis_index("seq"))
-        image, mask = batch["image"], batch["mask"]
-
-        def apply_fn(params, image):
-            return _sp_apply(model, {"params": params}, image,
-                             train=True, rngs={"dropout": rng},
-                             sp_strategy=sp_strategy)
-
-        # The long-context memory lever: at hires SP shapes the
-        # per-block activations dominate; recompute them in the
-        # backward per model.remat_policy.
-        apply_fn = maybe_remat(apply_fn, remat, remat_policy)
-
-        def loss_fn(params):
-            outs = apply_fn(params, image)
-            if not loss_cfg.deep_supervision:
-                outs = outs[:1]  # primary head only, uniform across steps
-            # DP convention (losses/deep_supervision.py): SUM over
-            # levels, per-term components summed for logging.
-            total = jnp.float32(0.0)
-            comps: Dict[str, jnp.ndarray] = {}
-            for level in outs:
-                t, c = _sp_hybrid_loss(
-                    level, mask, bce_w=loss_cfg.bce, iou_w=loss_cfg.iou,
-                    cel_w=loss_cfg.cel)
-                if getattr(loss_cfg, "ssim", 0.0):
-                    c["ssim"] = _sp_ssim_loss(
-                        level, mask,
-                        window_size=getattr(loss_cfg, "ssim_window", 11))
-                    t = t + loss_cfg.ssim * c["ssim"]
-                total = total + t
-                for k, v in c.items():
-                    if k != "total":
-                        comps[k] = comps.get(k, jnp.float32(0.0)) + v
-            comps["total"] = total
-            return total, comps
-
-        grads, comps = jax.grad(loss_fn, has_aux=True)(state.params)
-        # The true grad is the SUM of per-token-block contributions
-        # over ``seq`` — but under shard_map the loss's psum'd
-        # statistics transpose back as psum (no replication tracking,
-        # check_vma=False), so each device's autodiff already carries
-        # an extra ``seq`` factor on its block contribution.  pmean
-        # over ``seq`` therefore recovers exactly that sum; ``data`` is
-        # the usual DP mean.  Grad equivalence vs a single-device step
-        # is asserted to numerics in tests/test_vit_sod.py.
-        grads = lax.pmean(grads, ("data", "seq"))
-        comps = lax.pmean(comps, "data")  # already seq-global
-
-        new_state = apply_update(state, grads, state.batch_stats, tx,
-                                 ema_decay=ema_decay)
-        metrics = dict(comps)
-        metrics["grad_norm"] = optax.global_norm(grads)
-        maybe_health_metrics(metrics, state.params, grads,
-                             new_state.params, health)
-        nfc = notfinite_count(new_state.opt_state)
-        if nfc is not None:
-            metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)
-        if schedule is not None:
-            metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
-        return new_state, metrics
-
-    from ..train.step import chunk_batch_spec, chunked_step_fn
-
-    body = chunked_step_fn(step_fn, steps_per_dispatch,
-                           always_scan=_always_scan)
-    batch_in = (P("data", "seq") if body is step_fn
-                else chunk_batch_spec(P("data", "seq")))
-    sharded = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(), batch_in),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    donated = (0,) if donate else ()
-    if donate_batch:
-        donated = donated + (1,)
-    return jax.jit(sharded, donate_argnums=donated)

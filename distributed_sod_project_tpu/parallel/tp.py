@@ -42,7 +42,7 @@ enforces its ``heads % model == 0`` precondition.
 from __future__ import annotations
 
 import re
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import numpy as np
@@ -208,92 +208,3 @@ def shard_state(state, mesh: Mesh,
     shardings = to_shardings(
         state_partition_specs(state, mesh, rules, zero1=zero1), mesh)
     return jax.device_put(state, shardings), shardings
-
-
-def make_tp_train_step(model, loss_cfg, tx, mesh: Mesh, state_shardings,
-                       schedule=None, donate: bool = True,
-                       ema_decay: float = 0.0,
-                       scale_hw: Optional[Tuple[int, int]] = None,
-                       donate_batch: bool = False,
-                       remat: bool = False, remat_policy: str = "none",
-                       steps_per_dispatch: int = 1,
-                       health: bool = False,
-                       _always_scan: bool = False):
-    """Build the GSPMD train step: ``(state, batch) -> (state, metrics)``.
-
-    Unlike the shard_map DP step there is no explicit ``pmean`` and no
-    named-axis BN: compute is written with *global* semantics and the
-    SPMD partitioner inserts the gradient allreduce over ``data`` and
-    the Megatron pair over ``model`` from the sharding annotations
-    alone.  Requires ``model_cfg.sync_bn=False`` models (the
-    transformer zoo); BN stats here are computed over the global batch
-    by construction, which is strictly stronger than SyncBN.
-
-    ``steps_per_dispatch=k > 1`` scans k steps inside the one program
-    over batches stacked on a new leading axis (leaves sharded
-    ``P(None, 'data')``), per-step metrics stacked on the way out —
-    see ``train.step.chunked_step_fn``.  k == 1 is the historical
-    per-step program, unchanged.
-    """
-    import jax.numpy as jnp
-    import optax
-
-    from ..losses import deep_supervision_loss
-    from ..train.step import (_loss_kwargs, apply_update, chunk_batch_spec,
-                              chunked_step_fn, maybe_health_metrics,
-                              maybe_remat, notfinite_count, rescale_batch,
-                              resolve_remat_policy)
-    from .mesh import batch_sharding, batch_spec
-
-    resolve_remat_policy(remat_policy)  # fail fast on typos, remat or not
-    lkw = _loss_kwargs(loss_cfg)
-
-    def step_fn(state, batch):
-        batch = rescale_batch(batch, scale_hw)
-        rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
-
-        def apply_fn(params, batch_stats, image, depth):
-            return model.apply(
-                {"params": params, "batch_stats": batch_stats},
-                image, depth, train=True,
-                mutable=["batch_stats"], rngs={"dropout": rng})
-
-        apply_fn = maybe_remat(apply_fn, remat, remat_policy)
-
-        def loss_fn(params):
-            outs, mut = apply_fn(params, state.batch_stats,
-                                 batch["image"], batch.get("depth"))
-            if not loss_cfg.deep_supervision:
-                outs = outs[:1]  # primary head only, uniform across steps
-            total, comps = deep_supervision_loss(outs, batch["mask"], **lkw)
-            return total, (comps, mut.get("batch_stats", state.batch_stats))
-
-        grads, (comps, new_stats) = jax.grad(loss_fn, has_aux=True)(
-            state.params)
-        new_state = apply_update(state, grads, new_stats, tx,
-                                 ema_decay=ema_decay)
-        metrics = dict(comps)
-        metrics["grad_norm"] = optax.global_norm(grads)
-        maybe_health_metrics(metrics, state.params, grads,
-                             new_state.params, health)
-        nfc = notfinite_count(new_state.opt_state)
-        if nfc is not None:
-            metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)
-        if schedule is not None:
-            metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
-        return new_state, metrics
-
-    body = chunked_step_fn(step_fn, steps_per_dispatch,
-                           always_scan=_always_scan)
-    batch_in = (batch_sharding(mesh) if body is step_fn
-                else NamedSharding(mesh, chunk_batch_spec(batch_spec())))
-    replicated = NamedSharding(mesh, P())
-    donated = (0,) if donate else ()
-    if donate_batch:  # see make_train_step: fit feeds each batch once
-        donated = donated + (1,)
-    return jax.jit(
-        body,
-        in_shardings=(state_shardings, batch_in),
-        out_shardings=(state_shardings, replicated),
-        donate_argnums=donated,
-    )

@@ -1,13 +1,12 @@
 from .state import TrainState, create_train_state
 from .schedules import build_schedule
 from .optim import build_optimizer
-from .step import make_train_step, make_eval_step
+from .step import make_eval_step
 
 __all__ = [
     "TrainState",
     "create_train_state",
     "build_schedule",
     "build_optimizer",
-    "make_train_step",
     "make_eval_step",
 ]

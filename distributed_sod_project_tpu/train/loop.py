@@ -6,7 +6,7 @@ under ``jax.distributed`` and the mesh spans all chips; there is no
 torchrun/fork step.  Per step the host only feeds its local shard of the
 batch and reads back scalar metrics — everything else (forward, loss,
 backward, cross-replica psum, optimizer) is one compiled XLA program
-(`make_train_step`).
+built by the unified rules engine (`parallel/engine.py`).
 """
 
 from __future__ import annotations
@@ -27,7 +27,7 @@ from ..utils.logging import get_logger, is_primary_process
 from ..utils.timing import StepTimer
 from .optim import build_optimizer
 from .state import create_train_state, param_count
-from .step import make_eval_step, make_train_step
+from .step import make_eval_step
 
 
 def _poll_stop(guard, step: int, sync_every: int) -> bool:
@@ -334,31 +334,25 @@ def fit(
                     "with steps_per_dispatch=1 (or a k dividing "
                     f"{start_step}) until the next aligned checkpoint")
 
-    # Step builder: shard_map DP step for the CNN zoo (named-axis
-    # SyncBN), the GSPMD step when the mesh has a tensor-parallel axis
-    # and/or any ZeRO level is on, or the sequence-parallel step when
-    # ``seq`` is sharded (ring attention over token blocks, vit_sod
-    # only).  ``parallel.engine=rules`` swaps each branch's hand-built
-    # builder for the SAME preset of the unified rule-driven one
-    # (parallel/engine.py) — bitwise-identical on f32/CPU, asserted in
-    # tests/test_sharding_rules.py and re-proven by tools/t1.sh.
+    # Step builder: every preset routes through the unified rule-driven
+    # builder (parallel/engine.py — the only step builder since the
+    # round-18 legacy deletion): shard_map DP for the CNN zoo
+    # (named-axis SyncBN), GSPMD tp/fsdp when the model axis is
+    # sharded, any ZeRO level is on, or parallel.preset=fsdp shards the
+    # params themselves, and the sequence-parallel preset when ``seq``
+    # is sharded (ring attention over token blocks, vit_sod only).
     from ..configs.base import validate_parallel
 
     validate_parallel(cfg)
-    use_rules = cfg.parallel.engine == "rules"
-    if use_rules:
-        from ..parallel import engine as engine_mod
+    from ..parallel import engine as engine_mod
 
-        zero_eff = engine_mod.effective_zero(cfg)
-    else:
-        zero_eff = 1 if cfg.optim.zero1 else 0
-    use_gspmd = (mesh.shape.get("model", 1) > 1 or cfg.optim.zero1
-                 or (use_rules and cfg.parallel.zero > 0))
-    use_sp = mesh.shape.get("seq", 1) > 1
+    zero_eff = engine_mod.effective_zero(cfg)
+    preset = engine_mod.select_preset(cfg, mesh)
+    use_sp = preset == "sp"
+    use_gspmd = preset in ("tp", "fsdp")
     if use_sp:
-        from ..parallel.sp import make_sp_train_step
-
-        if use_gspmd:
+        if (mesh.shape.get("model", 1) > 1 or cfg.optim.zero1
+                or cfg.parallel.zero > 0):
             raise ValueError(
                 "mesh.seq>1 cannot combine with mesh.model>1 / "
                 "optim.zero1 (pick one non-data axis per run)")
@@ -383,32 +377,26 @@ def fit(
         state = jax.device_put(state, replicated_sharding(mesh))
 
         def step_factory(scale_hw):
-            if use_rules:
-                return engine_mod.make_unified_train_step(
-                    model, cfg.loss, tx, mesh, preset="sp",
-                    schedule=schedule, ema_decay=cfg.optim.ema_decay,
-                    donate_batch=True,
-                    sp_strategy=cfg.mesh.sp_strategy,
-                    remat=cfg.model.remat,
-                    remat_policy=cfg.model.remat_policy,
-                    steps_per_dispatch=k,
-                    health=cfg.health_numerics)
-            return make_sp_train_step(
-                model, cfg.loss, tx, mesh, schedule=schedule,
-                ema_decay=cfg.optim.ema_decay, donate_batch=True,
+            return engine_mod.make_unified_train_step(
+                model, cfg.loss, tx, mesh, preset="sp",
+                schedule=schedule, ema_decay=cfg.optim.ema_decay,
+                donate_batch=True,
                 sp_strategy=cfg.mesh.sp_strategy,
                 remat=cfg.model.remat,
                 remat_policy=cfg.model.remat_policy,
                 steps_per_dispatch=k,
                 health=cfg.health_numerics)
     elif use_gspmd:
-        from ..parallel.tp import make_tp_train_step, shard_state
+        from ..parallel.rules import (PRESET_PARAM_RULES,
+                                      fsdp_fallback_rule,
+                                      shard_state_by_rules)
 
         if cfg.model.sync_bn:
             raise ValueError(
-                "mesh.model>1 / optim.zero1 route through the GSPMD step, "
-                "which has no named mesh axis: set model.sync_bn=false "
-                "(BN stats are global-batch there, strictly stronger)")
+                "mesh.model>1 / optim.zero1 / parallel.preset=fsdp "
+                "route through the GSPMD step, which has no named mesh "
+                "axis: set model.sync_bn=false (BN stats are "
+                "global-batch there, strictly stronger)")
         n_model = mesh.shape.get("model", 1)
         # Head-alignment guard — models exposing a scalar ``heads``
         # (vit_sod) promise boundary-aligned column shards; fail loudly
@@ -424,56 +412,46 @@ def fit(
                 f"mesh.model={n_model} does not divide the model's "
                 f"{heads} attention heads — pick a model-axis degree "
                 "that divides the head count")
-        if use_rules:
-            from ..parallel.rules import shard_state_by_rules
-
+        if preset == "fsdp":
+            state, state_shardings = shard_state_by_rules(
+                state, mesh, rules=PRESET_PARAM_RULES["fsdp"],
+                zero=zero_eff, fallback=fsdp_fallback_rule(mesh))
+        else:
             state, state_shardings = shard_state_by_rules(
                 state, mesh, zero=zero_eff)
-        else:
-            state, state_shardings = shard_state(state, mesh,
-                                                 zero1=cfg.optim.zero1)
 
         def step_factory(scale_hw):
-            if use_rules:
-                return engine_mod.make_unified_train_step(
-                    model, cfg.loss, tx, mesh, preset="tp",
-                    schedule=schedule, ema_decay=cfg.optim.ema_decay,
-                    scale_hw=scale_hw, donate_batch=True,
-                    remat=cfg.model.remat,
-                    remat_policy=cfg.model.remat_policy,
-                    steps_per_dispatch=k,
-                    health=cfg.health_numerics,
-                    state_shardings=state_shardings, zero=zero_eff)
-            return make_tp_train_step(
-                model, cfg.loss, tx, mesh, state_shardings,
+            return engine_mod.make_unified_train_step(
+                model, cfg.loss, tx, mesh, preset=preset,
                 schedule=schedule, ema_decay=cfg.optim.ema_decay,
                 scale_hw=scale_hw, donate_batch=True,
                 remat=cfg.model.remat,
                 remat_policy=cfg.model.remat_policy,
                 steps_per_dispatch=k,
-                health=cfg.health_numerics)
+                health=cfg.health_numerics,
+                state_shardings=state_shardings, zero=zero_eff)
     else:
-        state = jax.device_put(state, replicated_sharding(mesh))
+        # Replicate first, THEN seed the residual — seeding places the
+        # residual P('data'), which a blanket replicate would undo.
+        residual = getattr(state, "comm_residual", None)
+        state = jax.device_put(state.replace(comm_residual=None),
+                               replicated_sharding(mesh))
+        if cfg.parallel.grad_compression == "int8_ef":
+            state = engine_mod.seed_comm_residual(
+                state.replace(comm_residual=residual), mesh)
 
         def step_factory(scale_hw):
-            if use_rules:
-                return engine_mod.make_unified_train_step(
-                    model, cfg.loss, tx, mesh, preset="dp",
-                    schedule=schedule, remat=cfg.model.remat,
-                    ema_decay=cfg.optim.ema_decay,
-                    scale_hw=scale_hw, donate_batch=True,
-                    remat_policy=cfg.model.remat_policy,
-                    steps_per_dispatch=k,
-                    health=cfg.health_numerics,
-                    comm_bucket_mb=cfg.parallel.comm_bucket_mb,
-                    grad_compression=cfg.parallel.grad_compression)
-            return make_train_step(
-                model, cfg.loss, tx, mesh, schedule=schedule,
-                remat=cfg.model.remat, ema_decay=cfg.optim.ema_decay,
+            return engine_mod.make_unified_train_step(
+                model, cfg.loss, tx, mesh, preset="dp",
+                schedule=schedule, remat=cfg.model.remat,
+                ema_decay=cfg.optim.ema_decay,
                 scale_hw=scale_hw, donate_batch=True,
                 remat_policy=cfg.model.remat_policy,
                 steps_per_dispatch=k,
-                health=cfg.health_numerics)
+                health=cfg.health_numerics,
+                comm_bucket_mb=cfg.parallel.comm_bucket_mb,
+                grad_compression=cfg.parallel.grad_compression,
+                data_hosts=cfg.mesh.data_hosts)
 
     # Multi-scale training: one compiled step per size in the cycle
     # (each is a distinct static-shape XLA program; the resize happens
@@ -511,21 +489,18 @@ def fit(
             # the ledger opted in — the cost_analysis()/
             # memory_analysis() of the REAL step program.
             capacity.record_jit(ck, train_step, state, batch)
-            if use_rules:
-                # Comm ledger (ROADMAP item 4): the engine's static
-                # shape-priced plan — per-collective bytes, overlap
-                # estimate, ZeRO HBM saving — under the same program
-                # key.  Guarded like every telemetry touch.
-                try:
-                    capacity.record_comm(ck, engine_mod.comm_plan(
-                        state, mesh,
-                        preset=("sp" if use_sp
-                                else "tp" if use_gspmd else "dp"),
-                        zero=zero_eff,
-                        comm_bucket_mb=cfg.parallel.comm_bucket_mb,
-                        grad_compression=cfg.parallel.grad_compression))
-                except Exception:  # noqa: BLE001 — telemetry only
-                    log.exception("capacity: comm_plan failed for %s", ck)
+            # Comm ledger (ROADMAP item 4): the engine's static
+            # shape-priced plan — per-collective bytes and link level,
+            # overlap estimate, ZeRO/FSDP HBM saving — under the same
+            # program key.  Guarded like every telemetry touch.
+            try:
+                capacity.record_comm(ck, engine_mod.comm_plan(
+                    state, mesh, preset=preset, zero=zero_eff,
+                    comm_bucket_mb=cfg.parallel.comm_bucket_mb,
+                    grad_compression=cfg.parallel.grad_compression,
+                    data_hosts=cfg.mesh.data_hosts))
+            except Exception:  # noqa: BLE001 — telemetry only
+                log.exception("capacity: comm_plan failed for %s", ck)
 
     def _observe_capacity_slo(chunk_start_step: int) -> None:
         """Per completed chunk: fold the measured per-step time into

@@ -26,6 +26,12 @@ class TrainState:
     batch_stats: Any             # BatchNorm running stats (f32)
     opt_state: Any               # optax state
     ema_params: Any = None       # EMA of params (None = EMA disabled)
+    # int8_ef error-feedback residual (parallel.grad_compression):
+    # (n_data, n_grad_elems) f32, row r = replica r's accumulated
+    # quantization error, sharded P('data') — per-replica state that
+    # checkpoints with the rest of the pytree.  None when compression
+    # is off (the overwhelmingly common case; pytree shape unchanged).
+    comm_residual: Any = None
 
     def variables(self) -> Dict[str, Any]:
         return {"params": self.params, "batch_stats": self.batch_stats}

@@ -1,22 +1,18 @@
-"""The compiled train/eval steps — the whole hot loop as one XLA program.
+"""Shared step-building blocks + the compiled eval step.
 
 The reference's inner loop (SURVEY.md §3.1: H2D copy → cuDNN forward →
-loss → backward with DDP's bucketed NCCL allreduce → SGD step) is here a
-single ``jit(shard_map(step))`` call: forward, loss, backward, the
-gradient/BN-stat ``pmean`` over the ``data`` mesh axis, and the
-optimizer update all fuse into one compiled program per step, with the
-state donated so parameters update in place in HBM.
-
-``shard_map`` (not bare jit-with-shardings) so the mesh axes are
-*named* inside the step: linen BatchNorm psums its batch statistics
-over ``data`` (cross-replica SyncBN, SURVEY.md §7.3 hard part 3) and
-the gradient ``pmean`` is explicit rather than inferred.
+loss → backward with DDP's bucketed NCCL allreduce → SGD step) compiles
+to ONE XLA program per step — built by the rules engine's unified step
+builder (parallel/engine.py, the only train-step builder since the
+round-18 legacy deletion).  This module keeps the pieces every preset
+shares — remat policy resolution, the optimizer/EMA tail
+(``apply_update``), step chunking (``chunked_step_fn``), multi-scale
+resize, health metrics — plus the forward-only eval step.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,7 +20,6 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..losses import deep_supervision_loss
 from .state import TrainState
 from ..utils.compat import shard_map
 
@@ -90,7 +85,9 @@ def apply_update(state: TrainState, grads, new_stats, tx, *,
             lambda e, p: jnp.where(
                 applied, e * d + p.astype(e.dtype) * (1.0 - d), e),
             new_ema, new_params)
-    return TrainState(
+    # replace() (not a fresh TrainState) so fields this tail does not
+    # touch — the int8_ef comm_residual — ride through unchanged.
+    return state.replace(
         step=state.step + 1,
         params=new_params,
         batch_stats=new_stats,
@@ -180,120 +177,6 @@ def maybe_health_metrics(metrics, params, grads, new_params,
 
     metrics.update(health_step_metrics(params, grads, new_params))
     return metrics
-
-
-def make_train_step(
-    model,
-    loss_cfg,
-    tx: optax.GradientTransformation,
-    mesh: Mesh,
-    schedule: Optional[optax.Schedule] = None,
-    donate: bool = True,
-    remat: bool = False,
-    ema_decay: float = 0.0,
-    scale_hw: Optional[Tuple[int, int]] = None,
-    donate_batch: bool = False,
-    remat_policy: str = "none",
-    steps_per_dispatch: int = 1,
-    health: bool = False,
-    _always_scan: bool = False,
-) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
-    """Build ``(state, batch) -> (state, metrics)``.
-
-    Sharding contract: ``state`` replicated (P()), every ``batch`` leaf
-    batch-sharded (P('data')); metrics come back replicated scalars.
-
-    ``steps_per_dispatch=k > 1`` (cfg.steps_per_dispatch) instead takes
-    batches stacked along a NEW leading k axis (leaves ``P(None,
-    'data')``-sharded) and runs k full train steps as one ``lax.scan``
-    inside the compiled program (``chunked_step_fn``); metrics come
-    back stacked per-step along that axis.  k == 1 is the historical
-    per-step program, byte-for-byte (no scan wrapper).
-
-    ``remat=True`` rematerialises the forward during backward
-    (``jax.checkpoint``): activations are recomputed instead of stored,
-    trading ~⅓ more FLOPs for the activation memory — the standard lever
-    when a bigger per-chip batch is HBM-bound (SURVEY.md "HBM
-    bandwidth" row).  ``remat_policy`` picks what the checkpoint SAVES
-    (``resolve_remat_policy``).
-
-    ``scale_hw`` is the multi-scale training hook: the step resizes
-    image/mask/depth to that (H, W) on-device before the forward, so
-    the loader keeps emitting one static shape and every train size is
-    its own compiled program (no dynamic shapes anywhere).
-
-    ``health=True`` (cfg.health_numerics) additionally emits the
-    model-health numerics scalars — per-group gradient norms,
-    non-finite provenance, update/weight ratio
-    (``maybe_health_metrics``; docs/OBSERVABILITY.md "Model health").
-    """
-    resolve_remat_policy(remat_policy)  # fail fast on typos, remat or not
-    lkw = _loss_kwargs(loss_cfg)
-
-    def step_fn(state: TrainState, batch):
-        batch = rescale_batch(batch, scale_hw)
-        rng = jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(0), state.step),
-            lax.axis_index("data"),
-        )
-
-        def forward(params, batch_stats, image, depth):
-            return model.apply(
-                {"params": params, "batch_stats": batch_stats},
-                image,
-                depth,
-                train=True,
-                mutable=["batch_stats"],
-                rngs={"dropout": rng},
-            )
-
-        forward = maybe_remat(forward, remat, remat_policy)
-
-        def loss_fn(params):
-            outs, mut = forward(params, state.batch_stats,
-                                batch["image"], batch.get("depth"))
-            if not loss_cfg.deep_supervision:
-                outs = outs[:1]  # primary head only, uniform across steps
-            total, comps = deep_supervision_loss(outs, batch["mask"], **lkw)
-            return total, (comps, mut.get("batch_stats", state.batch_stats))
-
-        grads, (comps, new_stats) = jax.grad(loss_fn, has_aux=True)(state.params)
-        # DP allreduce — the reference's NCCL bucketed allreduce, as one
-        # in-program pmean XLA schedules against the backward pass.
-        grads = lax.pmean(grads, "data")
-        comps = lax.pmean(comps, "data")
-
-        new_state = apply_update(state, grads, new_stats, tx,
-                                 ema_decay=ema_decay)
-        metrics = dict(comps)
-        metrics["grad_norm"] = optax.global_norm(grads)
-        maybe_health_metrics(metrics, state.params, grads,
-                             new_state.params, health)
-        nfc = notfinite_count(new_state.opt_state)
-        if nfc is not None:
-            metrics["notfinite_count"] = jnp.asarray(nfc, jnp.float32)
-        if schedule is not None:
-            metrics["lr"] = jnp.asarray(schedule(state.step), jnp.float32)
-        return new_state, metrics
-
-    body = chunked_step_fn(step_fn, steps_per_dispatch,
-                           always_scan=_always_scan)
-    batch_in = (P("data") if body is step_fn
-                else chunk_batch_spec(P("data")))
-    sharded = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(P(), batch_in),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
-    # donate_batch: the training loop feeds each prefetched batch
-    # exactly once, so its HBM can be recycled into activations; OFF by
-    # default because benchmarks/tests re-feed the same buffers.
-    donated = (0,) if donate else ()
-    if donate_batch:
-        donated = donated + (1,)
-    return jax.jit(sharded, donate_argnums=donated)
 
 
 def make_eval_step(model, mesh: Mesh) -> Callable:

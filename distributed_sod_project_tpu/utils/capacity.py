@@ -43,6 +43,8 @@ from .logging import get_logger
 PEAK_FLOPS = 197e12  # dense bf16 MACs*2
 HBM_BW = 819e9       # bytes/s
 ICI_BW = 2e11        # bytes/s — v5e 1,600 Gbps aggregate ICI per chip
+DCN_BW = 12.5e9      # bytes/s — ~100 Gbps per-host DCN NIC (the
+                     # inter-host hop hierarchical collectives price)
 
 
 def ring_wire_bytes(payload_bytes: float, axis_size: int) -> float:
@@ -51,6 +53,27 @@ def ring_wire_bytes(payload_bytes: float, axis_size: int) -> float:
     n=1 this is 0 — a single-replica 'collective' is free."""
     n = max(int(axis_size), 1)
     return 2.0 * (n - 1) / n * float(payload_bytes)
+
+
+def collective_wire_bytes(c: Dict) -> float:
+    """Per-chip wire bytes for ONE comm_plan collective dict: a full
+    allreduce (``psum``/``all_reduce``) moves ``2(n-1)/n × payload``,
+    a lone reduce-scatter or all-gather leg half that — the split the
+    hierarchical ICI×DCN plan needs so each leg prices its own link."""
+    n = max(int(c.get("axis_size", 1)), 1)
+    payload = float(c.get("bytes", 0))
+    kind = c.get("kind", "psum")
+    if kind in ("reduce_scatter", "all_gather"):
+        return (n - 1) / n * payload
+    return 2.0 * (n - 1) / n * payload
+
+
+def collective_link_bw(c: Dict) -> float:
+    """The link bandwidth a collective's wire bytes traverse:
+    ``level='dcn'`` (the inter-host hop of ``mesh.data_hosts>1``
+    plans) prices against ``DCN_BW``, everything else against
+    ``ICI_BW``.  Plans from before the level field default to ici."""
+    return DCN_BW if c.get("level", "ici") == "dcn" else ICI_BW
 
 
 def program_cost(compiled) -> Dict[str, float]:
@@ -166,8 +189,9 @@ class CapacityLedger:
         ``parallel/engine.comm_plan``'s dict (per-collective payload
         bytes + axis size, bucket count, structural overlap fraction,
         ZeRO HBM saving).  Rendered as the ``dsod_capacity_comm_*``
-        families; wire bytes and estimated milliseconds are derived
-        here against ``ICI_BW`` so the constant lives in ONE place."""
+        families (DCN-level legs as ``dsod_capacity_comm_dcn_*``);
+        wire bytes and estimated milliseconds are derived here against
+        ``ICI_BW``/``DCN_BW`` so the constants live in ONE place."""
         if not isinstance(plan, dict) or "collectives" not in plan:
             raise ValueError("record_comm wants a comm_plan dict "
                              "(missing 'collectives')")
@@ -230,12 +254,13 @@ class CapacityLedger:
         if comm:
             for plan in comm.values():
                 for c in plan.get("collectives", ()):
-                    wire = ring_wire_bytes(c.get("bytes", 0),
-                                           c.get("axis_size", 1))
+                    wire = collective_wire_bytes(c)
                     c["wire_bytes"] = int(wire)
-                    c["est_ms"] = round(wire / ICI_BW * 1e3, 6)
+                    c["est_ms"] = round(
+                        wire / collective_link_bw(c) * 1e3, 6)
             snap["comm"] = comm
             snap["ici_bw"] = ICI_BW
+            snap["dcn_bw"] = DCN_BW
         if self._share_fn is not None:
             try:
                 snap["stage_share"] = {
@@ -292,18 +317,30 @@ class CapacityLedger:
         with self._lock:
             comm_rows = [(k, p) for k, p in sorted(self._comm.items())]
         cb, cw, cms, cov, czs = [], [], [], [], []
+        db, dw, dms = [], [], []
         for k, plan in comm_rows:
             for c in plan.get("collectives", ()):
                 cl = (f'{pre}program="{k}",collective="{c["name"]}",'
                       f'axis="{c.get("axis", "")}"')
                 payload = float(c.get("bytes", 0))
-                wire = ring_wire_bytes(payload, c.get("axis_size", 1))
+                wire = collective_wire_bytes(c)
+                est = wire / collective_link_bw(c) * 1e3
+                if c.get("level", "ici") == "dcn":
+                    # The slow hop gets its own families so a dashboard
+                    # can alarm on DCN pressure without parsing labels.
+                    db.append('dsod_capacity_comm_dcn_bytes{%s} %g'
+                              % (cl, payload))
+                    dw.append('dsod_capacity_comm_dcn_wire_bytes{%s} %g'
+                              % (cl, wire))
+                    dms.append('dsod_capacity_comm_dcn_est_ms{%s} %g'
+                               % (cl, est))
+                    continue
                 cb.append('dsod_capacity_comm_bytes{%s} %g'
                           % (cl, payload))
                 cw.append('dsod_capacity_comm_wire_bytes{%s} %g'
                           % (cl, wire))
                 cms.append('dsod_capacity_comm_est_ms{%s} %g'
-                           % (cl, wire / ICI_BW * 1e3))
+                           % (cl, est))
             cov.append('dsod_capacity_comm_overlap_frac{%s} %g'
                        % (plbl(k), plan.get("overlap_frac", 0.0)))
             czs.append('dsod_capacity_comm_zero_hbm_saved_bytes{%s} %g'
@@ -312,6 +349,9 @@ class CapacityLedger:
                 ("dsod_capacity_comm_bytes", cb),
                 ("dsod_capacity_comm_wire_bytes", cw),
                 ("dsod_capacity_comm_est_ms", cms),
+                ("dsod_capacity_comm_dcn_bytes", db),
+                ("dsod_capacity_comm_dcn_wire_bytes", dw),
+                ("dsod_capacity_comm_dcn_est_ms", dms),
                 ("dsod_capacity_comm_overlap_frac", cov),
                 ("dsod_capacity_comm_zero_hbm_saved_bytes", czs)):
             if samples:

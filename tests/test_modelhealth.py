@@ -22,11 +22,14 @@ from distributed_sod_project_tpu.configs.base import (
     OptimConfig,
 )
 from distributed_sod_project_tpu.models.layers import ConvBNAct
-from distributed_sod_project_tpu.parallel import global_batch_array, make_mesh
+from distributed_sod_project_tpu.parallel import (
+    global_batch_array,
+    make_mesh,
+    make_unified_train_step,
+)
 from distributed_sod_project_tpu.train import (
     build_optimizer,
     create_train_state,
-    make_train_step,
 )
 from distributed_sod_project_tpu.utils.alerts import (
     AlertEngine,
@@ -207,9 +210,11 @@ def health_setup(eight_devices):
         OptimConfig(lr=0.1, warmup_steps=0, skip_nonfinite=5), 10)
     state = create_train_state(jax.random.key(0), model, tx, _batch(2))
     lcfg = LossConfig(ssim_window=5)
-    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
+    step = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                   schedule=sched, donate=False,
                            health=True)
-    step_off = make_train_step(model, lcfg, tx, mesh, sched, donate=False)
+    step_off = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                   schedule=sched, donate=False)
     return mesh, state, step, step_off
 
 

@@ -224,10 +224,10 @@ def test_train_metrics_invariant_across_resample_impls():
     from distributed_sod_project_tpu.configs.base import (LossConfig,
                                                           MeshConfig,
                                                           OptimConfig)
-    from distributed_sod_project_tpu.parallel import make_mesh
+    from distributed_sod_project_tpu.parallel import (
+        make_mesh, make_unified_train_step)
     from distributed_sod_project_tpu.train import (build_optimizer,
-                                                   create_train_state,
-                                                   make_train_step)
+                                                   create_train_state)
 
     rng = np.random.RandomState(0)
     batch = {"image": rng.randn(8, 16, 16, 3).astype(np.float32),
@@ -238,8 +238,9 @@ def test_train_metrics_invariant_across_resample_impls():
         model = _MiniDecoder(impl=impl)
         tx, sched = build_optimizer(OptimConfig(lr=0.1, warmup_steps=0), 10)
         state = create_train_state(jax.random.key(0), model, tx, batch)
-        step = make_train_step(model, LossConfig(ssim_window=5), tx, mesh,
-                               sched, donate=False)
+        step = make_unified_train_step(
+            model, LossConfig(ssim_window=5), tx, mesh, preset="dp",
+            schedule=sched, donate=False)
         _, m = step(state, batch)
         metrics[impl] = {k: float(v) for k, v in m.items()}
     for impl in ("xla", "convt", "fused"):

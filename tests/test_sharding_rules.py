@@ -6,12 +6,25 @@ Four contracts, each asserted here:
   over '/'-joined param paths on REAL zoo trees (abstract init — no
   arrays), strict mode loud on unmatched leaves, the FSDP fallback
   sharding the largest divisible axis.
-- **Rules-vs-legacy bitwise** (parallel/engine.py): the ONE rule-driven
-  step builder reproduces each hand-built builder (DP shard_map, GSPMD
-  TP, SP) bit-for-bit on f32/CPU — final state AND per-step metric
-  streams, including accum_steps>1, steps_per_dispatch>1, EMA,
+- **Engine self-consistency bitwise** (parallel/engine.py): the ONE
+  rule-driven step builder (the only builder — ISSUE 19 deleted the
+  legacy trio) agrees with itself across every execution strategy
+  that must not change the arithmetic: bucketed/fused reduction vs
+  monolithic pmean, scan-chunked vs sequential dispatch, rules-table
+  TP shardings vs the hand Megatron layout, SP vs plain DP, and the
+  shipped FSDP preset vs DP at rtol<=2e-6 — final state AND per-step
+  metric streams, including accum_steps>1, steps_per_dispatch>1, EMA,
   skip_nonfinite, and health metrics.  The ``rules_smoke`` subset is
   re-proven every tools/t1.sh round.
+- **Hierarchical ICI×DCN reduction** (``mesh.data_hosts``): the
+  two-level intra-host reduce-scatter → inter-host all-reduce →
+  intra-host all-gather is bitwise the flat psum on integer wire
+  values (including the int8_ef integer wire) and allclose on floats.
+- **int8_ef error feedback** (``parallel.grad_compression``): the
+  residual is required by the builder, seeded by
+  ``seed_comm_residual``, carried across steps, keeps the compressed
+  trajectory within the grad-gate budget, and survives a checkpoint
+  round-trip bitwise.
 - **ZeRO** (``parallel.zero``): optimizer moments + EMA sharded over
   the ``data`` axis (spec correctness + actual placement), priced HBM
   saving positive, and the zero=1 trajectory bitwise the zero=0 GSPMD
@@ -39,7 +52,8 @@ from distributed_sod_project_tpu.configs.base import (
 from distributed_sod_project_tpu.models.layers import ConvBNAct
 from distributed_sod_project_tpu.parallel import make_mesh
 from distributed_sod_project_tpu.parallel.engine import (
-    comm_plan, effective_zero, make_unified_train_step, select_preset)
+    comm_plan, effective_zero, make_unified_train_step,
+    seed_comm_residual, select_preset)
 from distributed_sod_project_tpu.parallel.mesh import (
     batch_sharding, global_batch_array, replicated_sharding)
 from distributed_sod_project_tpu.parallel.rules import (
@@ -48,7 +62,7 @@ from distributed_sod_project_tpu.parallel.rules import (
     sharded_tree_bytes, state_specs, tree_bytes, tree_paths,
     zero_state_specs)
 from distributed_sod_project_tpu.train import (
-    build_optimizer, create_train_state, make_train_step)
+    build_optimizer, create_train_state)
 
 
 class TinyNet(nn.Module):
@@ -248,7 +262,7 @@ def test_bucketed_allreduce_hlo_bucket_count(eight_devices):
     assert mono > bucketed  # fusion collapsed the per-leaf reduces
 
 
-# ----------------------------------------------- rules-vs-legacy DP
+# ------------------------------------------- engine DP contracts
 
 
 def _dp_setup(eight_devices):
@@ -266,18 +280,20 @@ def _dp_setup(eight_devices):
     return mesh, model, tx, sched, state
 
 
-@pytest.mark.parametrize("comm_bucket_mb", [0.0, 0.001])
-def test_dp_rules_vs_legacy_bitwise_rules_smoke(comm_bucket_mb,
+@pytest.mark.parametrize("comm_bucket_mb", [0.001, 1e5])
+def test_dp_bucketed_reduce_bitwise_rules_smoke(comm_bucket_mb,
                                                 eight_devices):
-    """t1.sh sharding-equivalence smoke: the rules engine's DP preset
-    (monolithic AND bucketed reduce) is bitwise the legacy shard_map
-    builder — state and metric streams, rich-optim carries + health
-    metrics on, a NaN batch mid-run exercising skip_nonfinite."""
+    """t1.sh sharding-equivalence smoke: the engine's fused flat-buffer
+    reduction (many small buckets AND one flat bucket) is bitwise the
+    monolithic per-leaf pmean step — state and metric streams,
+    rich-optim carries + health metrics on, a NaN batch mid-run
+    exercising skip_nonfinite."""
     mesh, model, tx, sched, state = _dp_setup(eight_devices)
     lcfg = LossConfig(ssim_window=5)
-    legacy = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
-                             ema_decay=0.5, health=True)
-    rules = make_unified_train_step(
+    mono = make_unified_train_step(
+        model, lcfg, tx, mesh, preset="dp", schedule=sched,
+        donate=False, ema_decay=0.5, health=True)
+    fused = make_unified_train_step(
         model, lcfg, tx, mesh, preset="dp", schedule=sched,
         donate=False, ema_decay=0.5, health=True,
         comm_bucket_mb=comm_bucket_mb)
@@ -287,23 +303,23 @@ def test_dp_rules_vs_legacy_bitwise_rules_smoke(comm_bucket_mb,
         if i == 1:
             host["image"][0, 0, 0, 0] = np.nan  # skip_nonfinite carry
         batch = global_batch_array(host, mesh)
-        sl, ml = legacy(sl, batch)
-        sr, mr = rules(sr, batch)
+        sl, ml = mono(sl, batch)
+        sr, mr = fused(sr, batch)
         _metrics_bitwise(ml, mr, f"DP step {i} (bucket={comm_bucket_mb})")
     assert_trees_bitwise(sl, sr, f"DP state (bucket={comm_bucket_mb})")
 
 
 def test_dp_rules_chunked_bitwise(eight_devices):
     """steps_per_dispatch>1 through the engine: the ONE chunking seam
-    chunks the rules step exactly like the legacy step — scan(2) on
-    both sides, bitwise, metric streams stacked (k,)."""
+    — scan(2) over a stacked chunk is bitwise two dispatches of the
+    degenerate scan(1) program, metric streams stacked (k,)."""
     from distributed_sod_project_tpu.train.step import chunk_batch_spec
 
     mesh, model, tx, sched, state = _dp_setup(eight_devices)
     lcfg = LossConfig(ssim_window=5)
-    legacy = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
-                             ema_decay=0.5, health=True,
-                             steps_per_dispatch=2)
+    ref = make_unified_train_step(
+        model, lcfg, tx, mesh, preset="dp", schedule=sched,
+        donate=False, ema_decay=0.5, health=True, _always_scan=True)
     rules = make_unified_train_step(
         model, lcfg, tx, mesh, preset="dp", schedule=sched,
         donate=False, ema_decay=0.5, health=True, steps_per_dispatch=2)
@@ -312,10 +328,20 @@ def test_dp_rules_chunked_bitwise(eight_devices):
                for k in batches[0]}
     chunk = global_batch_array(stacked, mesh,
                                spec=chunk_batch_spec(P("data")))
-    sl, ml = legacy(state, chunk)
+    sl, ms = state, []
+    for b in batches:
+        one = {k: v[None] for k, v in b.items()}
+        sl, m = ref(sl, global_batch_array(
+            one, mesh, spec=chunk_batch_spec(P("data"))))
+        ms.append(jax.device_get(
+            jax.tree_util.tree_map(lambda x: x[0], m)))
     sr, mr = rules(state, chunk)
     assert np.asarray(jax.device_get(mr)["total"]).shape == (2,)
-    _metrics_bitwise(ml, mr, "DP chunked")
+    mr_host = jax.device_get(mr)
+    for i, m_i in enumerate(ms):
+        _metrics_bitwise(m_i, jax.tree_util.tree_map(
+            lambda x, i=i: np.asarray(x)[i], mr_host),
+            f"DP chunked step {i}")
     assert_trees_bitwise(sl, sr, "DP chunked state")
     # k=1 identity: the engine's unchunked step IS the plain callable
     # (body is step_fn), same as the legacy contract.
@@ -325,12 +351,15 @@ def test_dp_rules_chunked_bitwise(eight_devices):
     assert np.asarray(jax.device_get(m1)["total"]).ndim == 0
 
 
-# -------------------------------------------- rules-vs-legacy TP / SP
+# ---------------------------------------- engine TP / SP contracts
 
 
-def test_tp_rules_vs_legacy_bitwise(eight_devices):
-    from distributed_sod_project_tpu.parallel.tp import (
-        make_tp_train_step, shard_state)
+def test_tp_rules_sharding_paths_bitwise(eight_devices):
+    """The rule table IS the Megatron layout: the SAME engine TP step,
+    started once from tp.shard_state's hand-written shardings and once
+    from shard_state_by_rules' table-driven shardings, is bitwise over
+    a 2-step trajectory — state and metric streams."""
+    from distributed_sod_project_tpu.parallel.tp import shard_state
 
     model = _vit_tiny()
     mesh = make_mesh(MeshConfig(data=2, model=2), eight_devices[:4])
@@ -341,45 +370,54 @@ def test_tp_rules_vs_legacy_bitwise(eight_devices):
     sl, sh_l = shard_state(state0, mesh)
     sr, sh_r = shard_state_by_rules(state0, mesh)
     lcfg = LossConfig(ssim=0.0, ssim_window=5)
-    legacy = make_tp_train_step(model, lcfg, tx, mesh, sh_l,
-                                schedule=sched, donate=False,
-                                health=True)
+    hand = make_unified_train_step(
+        model, lcfg, tx, mesh, preset="tp", schedule=sched,
+        donate=False, health=True, state_shardings=sh_l)
     rules = make_unified_train_step(
         model, lcfg, tx, mesh, preset="tp", schedule=sched,
         donate=False, health=True, state_shardings=sh_r)
     for i in range(2):
         batch = jax.device_put(_batch(4, hw=32, seed=i),
                                batch_sharding(mesh))
-        sl, ml = legacy(sl, batch)
+        sl, ml = hand(sl, batch)
         sr, mr = rules(sr, batch)
         _metrics_bitwise(ml, mr, f"TP step {i}")
     assert_trees_bitwise(sl, sr, "TP state")
 
 
-def test_sp_rules_vs_legacy_bitwise(eight_devices):
-    from distributed_sod_project_tpu.parallel.sp import (
-        make_sp_train_step, sp_batch_sharding)
+def test_sp_rules_vs_dp_parity(eight_devices):
+    """Sequence parallelism is an execution strategy, not a model
+    change: the SP preset on (data=2, seq=4) lands within float
+    tolerance of the plain DP shard_map step on the same global batch
+    (ring attention recomposes exact attention; only associativity
+    moves the last ulps)."""
+    from distributed_sod_project_tpu.parallel.sp import sp_batch_sharding
 
     model = _vit_tiny()
-    mesh = make_mesh(MeshConfig(data=2, seq=4), eight_devices)
+    sp_mesh = make_mesh(MeshConfig(data=2, seq=4), eight_devices)
+    dp_mesh = make_mesh(MeshConfig(data=2), eight_devices[:2])
     tx, sched = build_optimizer(OptimConfig(lr=0.05, warmup_steps=0), 10)
-    state = jax.device_put(
+    state0 = jax.device_get(
         create_train_state(jax.random.key(0), model, tx,
-                           _batch(4, hw=32)),
-        replicated_sharding(mesh))
+                           _batch(4, hw=32)))
     lcfg = LossConfig(bce=1.0, iou=1.0, ssim=0.0)
-    legacy = make_sp_train_step(model, lcfg, tx, mesh, schedule=sched,
-                                donate=False)
-    rules = make_unified_train_step(model, lcfg, tx, mesh, preset="sp",
-                                    schedule=sched, donate=False)
-    sl, sr = state, state
+    sp = make_unified_train_step(model, lcfg, tx, sp_mesh, preset="sp",
+                                 schedule=sched, donate=False)
+    dp = make_unified_train_step(model, lcfg, tx, dp_mesh, preset="dp",
+                                 schedule=sched, donate=False)
+    s_sp = jax.device_put(state0, replicated_sharding(sp_mesh))
+    s_dp = jax.device_put(state0, replicated_sharding(dp_mesh))
     for i in range(2):
-        batch = jax.device_put(_batch(4, hw=32, seed=i),
-                               sp_batch_sharding(mesh))
-        sl, ml = legacy(sl, batch)
-        sr, mr = rules(sr, batch)
-        _metrics_bitwise(ml, mr, f"SP step {i}")
-    assert_trees_bitwise(sl, sr, "SP state")
+        host = _batch(4, hw=32, seed=i)
+        s_sp, m_sp = sp(s_sp, jax.device_put(
+            host, sp_batch_sharding(sp_mesh)))
+        s_dp, m_dp = dp(s_dp, global_batch_array(host, dp_mesh))
+        np.testing.assert_allclose(
+            float(jax.device_get(m_sp["total"])),
+            float(jax.device_get(m_dp["total"])), rtol=1e-5,
+            err_msg=f"SP vs DP loss, step {i}")
+    assert_trees_close(s_sp.params, s_dp.params, "SP vs DP params",
+                       rtol=1e-4, atol=1e-6)
 
 
 # ---------------------------------------------------------------- ZeRO
@@ -496,6 +534,197 @@ def test_bf16_grad_compression_runs_close_not_bitwise(eight_devices):
     np.testing.assert_allclose(b, a, rtol=0.05)
 
 
+# ------------------------------------- FSDP / hierarchical / int8_ef
+
+
+def test_fsdp_fwd_bwd_parity_vs_dp(eight_devices):
+    """ISSUE 19 acceptance: the shipped FSDP preset is the DP
+    computation with a different parameter residency.  On a real zoo
+    tree (ViTSOD) with parameters VISIBLY sharded over ``data`` (small
+    ``min_leaf_size`` so the tiny tree shards), a 2-step FSDP
+    trajectory matches the shard_map DP trajectory at rtol<=2e-6 —
+    forward (loss), backward (grad_norm), and the updated params."""
+    model = _vit_tiny()
+    mesh = make_mesh(MeshConfig(data=4), eight_devices[:4])
+    tx, sched = build_optimizer(OptimConfig(lr=0.05, warmup_steps=0), 10)
+    state0 = jax.device_get(
+        create_train_state(jax.random.key(0), model, tx,
+                           _batch(4, hw=32)))
+    lcfg = LossConfig(ssim=0.0)
+    s_dp = jax.device_put(state0, replicated_sharding(mesh))
+    dp = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                 schedule=sched, donate=False)
+    from distributed_sod_project_tpu.parallel.rules import (
+        PRESET_PARAM_RULES)
+
+    s_f, sh = shard_state_by_rules(
+        state0, mesh, rules=PRESET_PARAM_RULES["fsdp"],
+        fallback=fsdp_fallback_rule(mesh, min_leaf_size=2 ** 8))
+    sharded = [x for x in jax.tree_util.tree_leaves(s_f.params)
+               if "data" in str(x.sharding.spec)]
+    assert sharded, "FSDP layout left every param replicated"
+    fsdp = make_unified_train_step(
+        model, lcfg, tx, mesh, preset="fsdp", schedule=sched,
+        donate=False, state_shardings=sh)
+    for i in range(2):
+        host = _batch(4, hw=32, seed=i)
+        s_dp, m_dp = dp(s_dp, global_batch_array(host, mesh))
+        s_f, m_f = fsdp(s_f, jax.device_put(host, batch_sharding(mesh)))
+        for k in ("total", "grad_norm"):
+            np.testing.assert_allclose(
+                float(jax.device_get(m_dp[k])),
+                float(jax.device_get(m_f[k])), rtol=2e-6,
+                err_msg=f"FSDP vs DP metric {k}, step {i}")
+    assert_trees_close(s_dp.params, s_f.params, "FSDP vs DP params",
+                       rtol=2e-6)
+    # Updated params still live sharded (the preset never gathered the
+    # persistent copy).
+    still = [x for x in jax.tree_util.tree_leaves(s_f.params)
+             if "data" in str(x.sharding.spec)]
+    assert len(still) == len(sharded)
+
+
+def test_hier_psum_bitwise_flat_on_integer_wire(eight_devices):
+    """The two-level ICI×DCN reduction (intra-host reduce-scatter →
+    inter-host all-reduce on 1/chips of the bytes → intra-host
+    all-gather) computes the pair-tree association
+    ``sum_hosts(sum_chips(.))`` — bitwise the flat psum whenever wire
+    values are exactly representable (integer-valued f32, the int8_ef
+    integer wire), allclose on arbitrary floats.  2 hosts × 2 chips on
+    a 4-device CPU mesh; odd leaf sizes exercise the chip-pad path."""
+    from distributed_sod_project_tpu.parallel.mesh import hier_data_groups
+    from distributed_sod_project_tpu.utils.compat import shard_map
+
+    mesh = make_mesh(MeshConfig(data=4), eight_devices[:4])
+    hier = hier_data_groups(mesh, 2)
+    rng = np.random.default_rng(0)
+    ints = {"w": rng.integers(-64, 64, size=(4, 33, 5)
+                              ).astype(np.float32),
+            "b": rng.integers(-8, 8, size=(4, 7)).astype(np.float32)}
+    floats = {"w": rng.normal(size=(4, 257)).astype(np.float32)}
+
+    def run(tree, hierarchy):
+        sharded = {k: jax.device_put(v, NamedSharding(mesh, P("data")))
+                   for k, v in tree.items()}
+        f = lambda t: bucketed_pmean(  # noqa: E731
+            t, "data", 256, hierarchy=hierarchy)
+        return jax.device_get(jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+            check_vma=False))(sharded))
+
+    flat, two = run(ints, None), run(ints, hier)
+    for k in ints:
+        assert np.array_equal(flat[k], two[k]), (
+            f"hier vs flat not bitwise on integer wire, leaf {k}")
+    f_flat, f_two = run(floats, None), run(floats, hier)
+    np.testing.assert_allclose(f_two["w"], f_flat["w"], rtol=2e-6,
+                               err_msg="hier vs flat beyond float tol")
+
+
+def test_hier_int8_ef_step_bitwise_flat_int8_ef(eight_devices):
+    """End-to-end: the int8_ef wire is integers, so routing it through
+    the hierarchical two-level reduction changes NOTHING — params AND
+    residual bitwise vs the flat int8_ef step over a 2-step
+    trajectory (the property that lets a pod turn on data_hosts
+    without re-running the quality gate)."""
+    from distributed_sod_project_tpu.parallel.mesh import hier_data_groups
+
+    mesh = make_mesh(MeshConfig(), eight_devices)
+    hier = hier_data_groups(mesh, 2)
+    model = TinyNet()
+    tx, sched = build_optimizer(OptimConfig(lr=0.05, warmup_steps=0), 10)
+    state = seed_comm_residual(jax.device_put(
+        create_train_state(jax.random.key(0), model, tx, _batch(2)),
+        replicated_sharding(mesh)), mesh)
+    lcfg = LossConfig(ssim_window=5)
+    flat = make_unified_train_step(
+        model, lcfg, tx, mesh, preset="dp", schedule=sched,
+        donate=False, comm_bucket_mb=0.001, grad_compression="int8_ef")
+    two = make_unified_train_step(
+        model, lcfg, tx, mesh, preset="dp", schedule=sched,
+        donate=False, comm_bucket_mb=0.001, grad_compression="int8_ef",
+        data_hosts=2)
+    sa, sb = state, state
+    for i in range(2):
+        batch = global_batch_array(_batch(8, seed=i), mesh)
+        sa, ma = flat(sa, batch)
+        sb, mb = two(sb, batch)
+        _metrics_bitwise(ma, mb, f"int8_ef hier step {i}")
+    assert_trees_bitwise(sa, sb, "int8_ef hier state")
+    assert np.abs(np.asarray(
+        jax.device_get(sb.comm_residual))).max() > 0
+
+
+def test_int8_ef_residual_carry_and_checkpoint_roundtrip(
+        tmp_path, eight_devices):
+    """ISSUE 19 int8_ef contract: the builder REQUIRES the residual;
+    ``seed_comm_residual`` provides it zeroed and P('data')-placed; a
+    compressed k-step trajectory carries a changing nonzero residual
+    while staying within the grad-gate-style budget of the f32
+    trajectory; and the residual survives a checkpoint round-trip
+    bitwise, so resuming continues the exact trajectory."""
+    mesh = make_mesh(MeshConfig(), eight_devices)
+    model = TinyNet()
+    tx, sched = build_optimizer(OptimConfig(lr=0.05, warmup_steps=0), 20)
+    base = jax.device_put(
+        create_train_state(jax.random.key(0), model, tx, _batch(2)),
+        replicated_sharding(mesh))
+    lcfg = LossConfig(ssim_window=5)
+    build = lambda **kw: make_unified_train_step(  # noqa: E731
+        model, lcfg, tx, mesh, preset="dp", schedule=sched,
+        donate=False, comm_bucket_mb=0.001, **kw)
+    ef = build(grad_compression="int8_ef")
+    ref = build()
+
+    # The builder's step refuses a residual-less state.
+    with pytest.raises((ValueError, TypeError, AttributeError)):
+        jax.block_until_ready(
+            ef(base, global_batch_array(_batch(8), mesh)))
+
+    state = seed_comm_residual(base, mesh)
+    assert state.comm_residual.shape[0] == 8
+    assert "data" in str(state.comm_residual.sharding.spec)
+    s32, sef, res_seen = base, state, []
+    for i in range(4):
+        batch = global_batch_array(_batch(8, seed=i), mesh)
+        s32, m32 = ref(s32, batch)
+        sef, mef = ef(sef, batch)
+        res_seen.append(np.asarray(jax.device_get(sef.comm_residual)))
+    assert np.abs(res_seen[0]).max() > 0  # error feedback populated
+    assert not np.array_equal(res_seen[0], res_seen[-1])  # and carried
+    # Grad-gate-style budget on the tiny smoke: trajectory stays close.
+    a = float(jax.device_get(m32["total"]))
+    b = float(jax.device_get(mef["total"]))
+    assert abs(b - a) < 5e-3, f"int8_ef final loss drifted: {a} vs {b}"
+    pn = np.sqrt(sum(float(np.sum(np.square(x))) for x in
+                     jax.tree_util.tree_leaves(
+                         jax.device_get(s32.params))))
+    dn = np.sqrt(sum(float(np.sum(np.square(
+        np.asarray(x) - np.asarray(y)))) for x, y in zip(
+        jax.tree_util.tree_leaves(jax.device_get(s32.params)),
+        jax.tree_util.tree_leaves(jax.device_get(sef.params)))))
+    assert dn / pn < 0.01, f"int8_ef param drift {dn / pn:.4f}"
+
+    # Checkpoint round-trip: residual is state, so it persists.
+    from distributed_sod_project_tpu.ckpt import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    assert mgr.save(4, sef, force=True)
+    mgr.wait()
+    restored = mgr.restore(jax.device_get(sef), step=4)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(sef.comm_residual)),
+        np.asarray(restored.comm_residual),
+        err_msg="comm_residual not bitwise through checkpoint")
+    s_resume = seed_comm_residual(jax.device_put(
+        restored, replicated_sharding(mesh)).replace(
+            comm_residual=restored.comm_residual), mesh)
+    batch = global_batch_array(_batch(8, seed=9), mesh)
+    s_a, _ = ef(sef, batch)
+    s_b, _ = ef(s_resume, batch)
+    assert_trees_bitwise(s_a, s_b, "post-restore int8_ef step")
+
+
 # -------------------------------------------------- config + routing
 
 
@@ -519,11 +748,24 @@ def test_select_preset_and_effective_zero():
 def test_validate_parallel_rejections():
     cfg = get_config("minet_vgg16_ref")
     validate_parallel(cfg)  # defaults fine
-    with pytest.raises(ValueError, match="optim.zero1"):
-        validate_parallel(cfg.replace(parallel=ParallelConfig(zero=1)))
-    with pytest.raises(ValueError, match="engine"):
+    # Round 18: rules is the default AND only engine — zero and
+    # grad_compression are first-class, legacy is a loud error.
+    validate_parallel(cfg.replace(parallel=ParallelConfig(zero=1)))
+    validate_parallel(cfg.replace(
+        parallel=ParallelConfig(grad_compression="bf16")))
+    with pytest.raises(ValueError, match="legacy"):
         validate_parallel(cfg.replace(
-            parallel=ParallelConfig(grad_compression="bf16")))
+            parallel=ParallelConfig(engine="legacy")))
+    with pytest.raises(ValueError, match="preset"):
+        validate_parallel(cfg.replace(
+            parallel=ParallelConfig(preset="pipeline")))
+    with pytest.raises(ValueError, match="data_hosts"):
+        validate_parallel(cfg.replace(
+            mesh=dataclasses.replace(cfg.mesh, data_hosts=0)))
+    with pytest.raises(ValueError, match="fsdp"):
+        validate_parallel(cfg.replace(
+            parallel=ParallelConfig(preset="fsdp"),
+            mesh=dataclasses.replace(cfg.mesh, model=2)))
     with pytest.raises(ValueError):
         validate_parallel(cfg.replace(
             parallel=ParallelConfig(engine="rules", zero=3)))

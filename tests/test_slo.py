@@ -26,6 +26,7 @@ Invariants proven here:
 import io
 import json
 import threading
+import time
 import urllib.request
 
 import flax.linen as nn
@@ -428,7 +429,16 @@ def test_prober_accounting_identity_and_tenant_isolation(tiny_variables):
         assert snap["availability"] == 1.0
         assert 0.0 <= snap["mae_avg"] <= 1.0
         assert 0.0 <= snap["iou_avg"] <= 1.0
-        stats = fleet.stats()
+        # The router books a terminal AFTER the response bytes flush
+        # (so the prober's join can beat the booking) — wait out the
+        # in-flight gap the test_failover._stats way; the final read
+        # is asserted as-is so a REAL hole still fails.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            stats = fleet.stats()
+            if stats["fleet"]["consistent"]:
+                break
+            time.sleep(0.02)
         # Identity holds with probe traffic; all of it under _probe.
         assert stats["fleet"]["consistent"]
         assert stats["fleet"]["submitted"] == 4

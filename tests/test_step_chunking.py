@@ -45,8 +45,10 @@ from distributed_sod_project_tpu.models.layers import ConvBNAct
 from distributed_sod_project_tpu.parallel import make_mesh
 from distributed_sod_project_tpu.parallel.mesh import (
     batch_sharding, global_batch_array, replicated_sharding)
+from distributed_sod_project_tpu.parallel.engine import (
+    make_unified_train_step)
 from distributed_sod_project_tpu.train import (
-    build_optimizer, create_train_state, make_train_step)
+    build_optimizer, create_train_state)
 
 
 class TinyNet(nn.Module):
@@ -127,8 +129,9 @@ def _dp_setup(rich_optim=True):
                                ema=rich_optim)
     lcfg = LossConfig(ssim_window=5)
     ema = 0.5 if rich_optim else 0.0
-    build = lambda **bkw: make_train_step(  # noqa: E731
-        model, lcfg, tx, mesh, sched, donate=False, ema_decay=ema, **bkw)
+    build = lambda **bkw: make_unified_train_step(  # noqa: E731
+        model, lcfg, tx, mesh, preset="dp", schedule=sched, donate=False,
+        ema_decay=ema, **bkw)
     return mesh, state, build
 
 
@@ -240,8 +243,7 @@ def _vit_tiny():
 def test_tp_scan_chunk_bitwise(k, eight_devices):
     """GSPMD TP builder: scan(k) == k x scan(1) bitwise on a
     (data=2, model=2) mesh."""
-    from distributed_sod_project_tpu.parallel.tp import (
-        make_tp_train_step, shard_state)
+    from distributed_sod_project_tpu.parallel.tp import shard_state
 
     model = _vit_tiny()
     mesh = make_mesh(MeshConfig(data=2, model=2), eight_devices[:4])
@@ -250,9 +252,9 @@ def test_tp_scan_chunk_bitwise(k, eight_devices):
         create_train_state(jax.random.key(0), model, tx, _batch(4, hw=32)))
     state, shardings = shard_state(state0, mesh)
     lcfg = LossConfig(ssim=0.0, ssim_window=5)
-    build = lambda **bkw: make_tp_train_step(  # noqa: E731
-        model, lcfg, tx, mesh, shardings, schedule=sched, donate=False,
-        **bkw)
+    build = lambda **bkw: make_unified_train_step(  # noqa: E731
+        model, lcfg, tx, mesh, preset="tp", schedule=sched, donate=False,
+        state_shardings=shardings, **bkw)
     ref = build(steps_per_dispatch=1, _always_scan=True)
     chunk = build(steps_per_dispatch=k)
     chunk_shard = NamedSharding(mesh, P(None, "data"))
@@ -281,8 +283,7 @@ def test_tp_scan_chunk_bitwise(k, eight_devices):
 def test_sp_scan_chunk_bitwise(k, eight_devices):
     """Sequence-parallel builder: scan(k) == k x scan(1) bitwise on a
     (data=2, seq=4) mesh (ring attention, psum'd loss statistics)."""
-    from distributed_sod_project_tpu.parallel.sp import (
-        make_sp_train_step, sp_batch_sharding)
+    from distributed_sod_project_tpu.parallel.sp import sp_batch_sharding
 
     model = _vit_tiny()
     mesh = make_mesh(MeshConfig(data=2, seq=4), eight_devices)
@@ -291,8 +292,9 @@ def test_sp_scan_chunk_bitwise(k, eight_devices):
                                _batch(4, hw=32))
     state = jax.device_put(state, replicated_sharding(mesh))
     lcfg = LossConfig(bce=1.0, iou=1.0, ssim=0.0)
-    build = lambda **bkw: make_sp_train_step(  # noqa: E731
-        model, lcfg, tx, mesh, schedule=sched, donate=False, **bkw)
+    build = lambda **bkw: make_unified_train_step(  # noqa: E731
+        model, lcfg, tx, mesh, preset="sp", schedule=sched, donate=False,
+        **bkw)
     ref = build(steps_per_dispatch=1, _always_scan=True)
     chunk = build(steps_per_dispatch=k)
     chunk_shard = NamedSharding(mesh, P(None, "data", "seq"))
@@ -539,8 +541,10 @@ def test_fit_chunked_counts_dispatches_not_steps(tmp_path,
     """8 steps at k=2 = 4 dispatches of the compiled chunk."""
     from distributed_sod_project_tpu.train import loop as loop_mod
 
+    from distributed_sod_project_tpu.parallel import engine as engine_mod
+
     calls = {"n": 0}
-    real = loop_mod.make_train_step
+    real = engine_mod.make_unified_train_step
 
     def wrapped_builder(*a, **kw):
         step = real(*a, **kw)
@@ -551,7 +555,8 @@ def test_fit_chunked_counts_dispatches_not_steps(tmp_path,
 
         return counting_step
 
-    monkeypatch.setattr(loop_mod, "make_train_step", wrapped_builder)
+    monkeypatch.setattr(engine_mod, "make_unified_train_step",
+                        wrapped_builder)
     cfg = _loop_cfg(tmp_path, steps_per_dispatch=2,
                     checkpoint_every_steps=0)
     out = loop_mod.fit(cfg, max_steps=8)

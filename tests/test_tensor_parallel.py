@@ -19,7 +19,7 @@ from distributed_sod_project_tpu.configs import MeshConfig, get_config
 from distributed_sod_project_tpu.models import build_model
 from distributed_sod_project_tpu.parallel import (
     make_mesh,
-    make_tp_train_step,
+    make_unified_train_step,
     param_partition_specs,
     shard_state,
 )
@@ -61,16 +61,18 @@ def test_tp_step_matches_single_device_step(eight_devices):
     dp_mesh = make_mesh(MeshConfig(data=1, model=1), eight_devices[:1])
     dp_state, dp_shardings = shard_state(state0, dp_mesh)
     dp_batch = jax.device_put(batch, batch_sharding(dp_mesh))
-    dp_step = make_tp_train_step(model, cfg.loss, tx, dp_mesh, dp_shardings,
-                                 schedule=sched)
+    dp_step = make_unified_train_step(
+        model, cfg.loss, tx, dp_mesh, preset="tp", schedule=sched,
+        state_shardings=dp_shardings)
     dp_state, dp_metrics = dp_step(dp_state, dp_batch)
 
     # TP run: data=2, model=2 over the same global batch.
     tp_mesh = make_mesh(MeshConfig(data=2, model=2), eight_devices[:4])
     tp_state, shardings = shard_state(state0, tp_mesh)
     tp_batch = jax.device_put(batch, batch_sharding(tp_mesh))
-    tp_step = make_tp_train_step(model, cfg.loss, tx, tp_mesh, shardings,
-                                 schedule=sched)
+    tp_step = make_unified_train_step(
+        model, cfg.loss, tx, tp_mesh, preset="tp", schedule=sched,
+        state_shardings=shardings)
     tp_state, tp_metrics = tp_step(tp_state, tp_batch)
 
     np.testing.assert_allclose(float(tp_metrics["total"]),
@@ -152,13 +154,17 @@ def test_zero1_shards_opt_state_and_matches_oracle(eight_devices):
     # Oracle: 1-device GSPMD step (global semantics, nothing sharded).
     mesh1 = make_mesh(MeshConfig(data=1), eight_devices[:1])
     s1, sh1 = shard_state(state0, mesh1)
-    step1 = make_tp_train_step(model, lcfg, tx, mesh1, sh1, schedule=sched)
+    step1 = make_unified_train_step(
+        model, lcfg, tx, mesh1, preset="tp", schedule=sched,
+        state_shardings=sh1)
     s1, m1 = step1(s1, jax.device_put(batch, batch_sharding(mesh1)))
 
     # ZeRO-1 over 8 replicas.
     mesh8 = make_mesh(MeshConfig(data=8), eight_devices)
     s8, sh8 = shard_state(state0, mesh8, zero1=True)
-    step8 = make_tp_train_step(model, lcfg, tx, mesh8, sh8, schedule=sched)
+    step8 = make_unified_train_step(
+        model, lcfg, tx, mesh8, preset="tp", schedule=sched,
+        state_shardings=sh8, zero=1)
     s8, m8 = step8(s8, jax.device_put(batch, batch_sharding(mesh8)))
 
     np.testing.assert_allclose(float(m8["total"]), float(m1["total"]),
@@ -231,8 +237,9 @@ def test_tp_step_avoids_qkv_resharding(eight_devices):
     mesh = make_mesh(MeshConfig(data=4, model=2), eight_devices)
     state, shardings = shard_state(state, mesh)
     batch = jax.device_put(batch, batch_sharding(mesh))
-    step = make_tp_train_step(model, cfg.loss, tx, mesh, shardings,
-                              schedule=sched)
+    step = make_unified_train_step(
+        model, cfg.loss, tx, mesh, preset="tp", schedule=sched,
+        state_shardings=shardings)
     hlo = step.lower(state, batch).compile().as_text()
     n_ag = len(re.findall(r"\ball-gather\b", hlo))
     assert n_ag <= 40, (

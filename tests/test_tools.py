@@ -230,7 +230,15 @@ def test_hlo_guard_conv_arms_record_and_gate(tmp_path, capsys,
                            "broadcast_in_dim": 0, "total": 10}}
     comm = {"comm_mono": {"all_reduce": 40, "total": 40},
             "comm_flat": {"all_reduce": 4, "total": 4},
-            "comm_bucketed": {"all_reduce": 8, "total": 8}}
+            "comm_bucketed": {"all_reduce": 8, "total": 8},
+            # n_buckets = 8 - 4 + 1 = 5: hier adds one rs + one ag per
+            # bucket and replaces the bucket psum 1:1 (ar equal).
+            "comm_hier": {"all_reduce": 8, "reduce_scatter": 5,
+                          "all_gather": 5, "total": 8},
+            # post-opt fsdp counts: >=1 all_gather (JIT params),
+            # >=1 reduction; total tracks the all_gather signature.
+            "comm_fsdp": {"all_gather": 12, "all_reduce": 6,
+                          "reduce_scatter": 0, "total": 12}}
     monkeypatch.setattr(
         hlo_guard, "dump_arm_counts",
         lambda *a, **k: {"fast": dict(fast), "fast_stack": dict(stack)})
@@ -266,8 +274,12 @@ def test_hlo_guard_conv_arms_record_and_gate(tmp_path, capsys,
     conv["conv_fused"]["total"] = 10
     conv["conv_fused"]["reshape"] = 9
     # A bucketing change that grows the all_reduce count trips too.
+    # (The hier arm moves with it — per-level invariants are checked
+    # BEFORE the gate, and an inconsistent stub would rc=1 instead.)
     comm["comm_bucketed"]["total"] = 9
     comm["comm_bucketed"]["all_reduce"] = 9
+    comm["comm_hier"].update(all_reduce=9, total=9,
+                             reduce_scatter=6, all_gather=6)
     assert hlo_guard.main(args + ["--fail-on-increase"]) == 2
     out = json.loads(
         capsys.readouterr().out.strip().splitlines()[-1])

@@ -17,12 +17,14 @@ from distributed_sod_project_tpu.parallel import (
     global_batch_array,
     make_mesh,
 )
+from distributed_sod_project_tpu.parallel.engine import (
+    make_unified_train_step,
+)
 from distributed_sod_project_tpu.train import (
     build_optimizer,
     build_schedule,
     create_train_state,
     make_eval_step,
-    make_train_step,
 )
 
 
@@ -56,7 +58,8 @@ def _setup(mesh, total_steps=10, lr=0.1):
     tx, sched = build_optimizer(ocfg, total_steps)
     state = create_train_state(jax.random.key(0), model, tx, _batch(2))
     lcfg = LossConfig(ssim_window=5)
-    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False)
+    step = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                   schedule=sched, donate=False)
     return model, state, step
 
 
@@ -188,7 +191,7 @@ def test_remat_step_matches_baseline(eight_devices):
     from distributed_sod_project_tpu.parallel.mesh import (
         batch_sharding, make_mesh, replicated_sharding)
     from distributed_sod_project_tpu.train import (
-        build_optimizer, create_train_state, make_train_step)
+        build_optimizer, create_train_state)
 
     cfg = get_config("minet_vgg16_ref")
     model = build_model(cfg.model.__class__(
@@ -206,7 +209,8 @@ def test_remat_step_matches_baseline(eight_devices):
              (True, "dots_no_batch")]
     for remat, policy in cases:
         state = jax.device_put(state0, replicated_sharding(mesh))
-        step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched,
+        step = make_unified_train_step(model, cfg.loss, tx, mesh,
+                                       preset="dp", schedule=sched,
                                donate=False, remat=remat,
                                remat_policy=policy)
         db = jax.device_put(batch, batch_sharding(mesh))
@@ -270,7 +274,8 @@ def test_ema_tracks_and_eval_uses_it(eight_devices):
                                ema=True)
     state = jax.device_get(state)
     lcfg = LossConfig(ssim_window=5)
-    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
+    step = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                   schedule=sched, donate=False,
                            ema_decay=0.5)
 
     batch = jax.device_put(_batch(8), batch_sharding(mesh))
@@ -294,7 +299,8 @@ def test_ema_tracks_and_eval_uses_it(eight_devices):
     # Disabled EMA stays None end-to-end.
     state_off = create_train_state(jax.random.key(0), model, tx, _batch(2))
     assert state_off.ema_params is None
-    step_off = make_train_step(model, lcfg, tx, mesh, sched, donate=False)
+    step_off = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                   schedule=sched, donate=False)
     s_off, _ = step_off(jax.device_put(state_off, replicated_sharding(mesh)),
                         batch)
     assert s_off.ema_params is None
@@ -311,7 +317,8 @@ def test_multiscale_step_resizes_on_device(eight_devices):
     tx, sched = build_optimizer(OptimConfig(lr=0.1, warmup_steps=0), 10)
     state = create_train_state(jax.random.key(0), model, tx, _batch(2))
     lcfg = LossConfig(ssim_window=5)
-    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
+    step = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                   schedule=sched, donate=False,
                            scale_hw=(8, 8))
 
     batch = jax.device_put(_batch(8, hw=16), batch_sharding(mesh))
@@ -340,7 +347,8 @@ def test_ema_every_gates_blend_under_accumulation(eight_devices):
         create_train_state(jax.random.key(0), model, tx, _batch(2),
                            ema=True))
     lcfg = LossConfig(ssim_window=5)
-    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
+    step = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                   schedule=sched, donate=False,
                            ema_decay=0.5)
     batch = jax.device_put(_batch(8), batch_sharding(mesh))
 
@@ -396,7 +404,8 @@ def test_skip_nonfinite_step_reports_counter_and_freezes(eight_devices):
         create_train_state(jax.random.key(0), model, tx, _batch(2),
                            ema=True))
     lcfg = LossConfig(ssim_window=5)
-    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False,
+    step = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                   schedule=sched, donate=False,
                            ema_decay=0.5)
 
     bad = _batch(8)
@@ -432,7 +441,8 @@ def test_lars_optimizer_trains(eight_devices):
                     weight_decay=1e-4), 20)
     state = create_train_state(jax.random.key(0), model, tx, _batch(2))
     lcfg = LossConfig(ssim_window=5)
-    step = make_train_step(model, lcfg, tx, mesh, sched, donate=False)
+    step = make_unified_train_step(model, lcfg, tx, mesh, preset="dp",
+                                   schedule=sched, donate=False)
     batch = jax.device_put(_batch(8, seed=5), batch_sharding(mesh))
     s = jax.device_put(state, replicated_sharding(mesh))
     losses = []

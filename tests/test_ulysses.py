@@ -14,8 +14,9 @@ from distributed_sod_project_tpu.models.vit_sod import ViTSOD
 from distributed_sod_project_tpu.parallel.mesh import (
     make_mesh, replicated_sharding)
 from distributed_sod_project_tpu.parallel.ring_attention import full_attention
-from distributed_sod_project_tpu.parallel.sp import (
-    make_sp_train_step, sp_batch_sharding)
+from distributed_sod_project_tpu.parallel.engine import (
+    make_unified_train_step)
+from distributed_sod_project_tpu.parallel.sp import sp_batch_sharding
 from distributed_sod_project_tpu.parallel.ulysses import (
     make_ulysses_attention_fn)
 
@@ -86,8 +87,9 @@ def test_sp_step_ulysses_matches_single_device(eight_devices):
     state = jax.device_put(state, replicated_sharding(mesh))
     dev_batch = jax.device_put(batch, sp_batch_sharding(mesh))
 
-    step = make_sp_train_step(model, LossConfig(bce=1.0, iou=1.0, ssim=0.0),
-                              tx, mesh, donate=False, sp_strategy="ulysses")
+    step = make_unified_train_step(
+        model, LossConfig(bce=1.0, iou=1.0, ssim=0.0), tx, mesh,
+        preset="sp", donate=False, sp_strategy="ulysses")
     _, metrics = step(state, dev_batch)
 
     ref_total, ref_grads = jax.value_and_grad(

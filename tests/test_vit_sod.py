@@ -19,8 +19,9 @@ from distributed_sod_project_tpu.configs import MeshConfig
 from distributed_sod_project_tpu.models.vit_sod import ViTSOD
 from distributed_sod_project_tpu.parallel.mesh import (
     make_mesh, replicated_sharding)
-from distributed_sod_project_tpu.parallel.sp import (
-    make_sp_train_step, sp_batch_sharding)
+from distributed_sod_project_tpu.parallel.engine import (
+    make_unified_train_step)
+from distributed_sod_project_tpu.parallel.sp import sp_batch_sharding
 
 
 def _tiny_model():
@@ -101,8 +102,9 @@ def test_sp_step_matches_single_device(eight_devices):
 
     from distributed_sod_project_tpu.configs import LossConfig
 
-    step = make_sp_train_step(model, LossConfig(bce=1.0, iou=1.0, ssim=0.0),
-                              tx, mesh, donate=False)
+    step = make_unified_train_step(
+        model, LossConfig(bce=1.0, iou=1.0, ssim=0.0), tx, mesh,
+        preset="sp", donate=False)
     new_state, metrics = step(state, dev_batch)
 
     # Reference: identical objective on one device, full batch.
@@ -145,8 +147,9 @@ def test_sp_step_flash_matches_single_device(eight_devices):
 
     from distributed_sod_project_tpu.configs import LossConfig
 
-    step = make_sp_train_step(model, LossConfig(bce=1.0, iou=1.0, ssim=0.0),
-                              tx, mesh, donate=False)
+    step = make_unified_train_step(
+        model, LossConfig(bce=1.0, iou=1.0, ssim=0.0), tx, mesh,
+        preset="sp", donate=False)
     _, metrics = step(state, dev_batch)
 
     ref_total, ref_grads = jax.value_and_grad(
@@ -183,9 +186,9 @@ def test_sp_step_with_ssim_matches_single_device(window, eight_devices):
     state = jax.device_put(state, replicated_sharding(mesh))
     dev_batch = jax.device_put(batch, sp_batch_sharding(mesh))
 
-    step = make_sp_train_step(
+    step = make_unified_train_step(
         model, LossConfig(bce=1.0, iou=1.0, ssim=1.0, ssim_window=window),
-        tx, mesh, donate=False)
+        tx, mesh, preset="sp", donate=False)
     new_state, metrics = step(state, dev_batch)
 
     ref_total, ref_grads = jax.value_and_grad(
@@ -360,9 +363,9 @@ def test_sp_step_remat_matches_baseline(eight_devices):
     outs = {}
     for remat, policy in [(False, "none"), (True, "none"), (True, "dots")]:
         state = jax.device_put(state0, replicated_sharding(mesh))
-        step = make_sp_train_step(
+        step = make_unified_train_step(
             model, LossConfig(bce=1.0, iou=1.0, ssim=1.0), tx, mesh,
-            donate=False, remat=remat, remat_policy=policy)
+            preset="sp", donate=False, remat=remat, remat_policy=policy)
         dev_batch = jax.device_put(batch, sp_batch_sharding(mesh))
         _, metrics = step(state, dev_batch)
         outs[(remat, policy)] = float(metrics["total"])

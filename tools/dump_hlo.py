@@ -29,7 +29,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def dump(config_name: str, out_dir: str, n_devices: int = 8,
          batch_per_device: int = 1, image_size: int = 64,
-         compile_cost: bool = True, overrides=()) -> dict:
+         compile_cost: bool = True, overrides=(),
+         post_opt: bool = False) -> dict:
     """Lower the config's train step; returns {'stablehlo': path, ...}.
 
     ``compile_cost=False`` skips the (slow) compile that only feeds the
@@ -41,6 +42,14 @@ def dump(config_name: str, out_dir: str, n_devices: int = 8,
     programs.  (The ``fast`` resample arm cannot be pinned this way:
     it is the env-subsumed default, so hlo_guard pins its arms via the
     env vars instead.)
+
+    ``post_opt=True`` also compiles and writes the POST-optimization
+    HLO (``<config>.hlo_post.txt``).  GSPMD presets (fsdp/tp) need it:
+    their pre-opt StableHLO carries only sharding annotations — the
+    SPMD partitioner inserts the collectives during compilation, so
+    the JIT all-gathers/reduce-scatters are countable only post-opt.
+    Post-opt text is backend-dependent (do NOT diff it across
+    machines); hlo_guard only counts collective op names in it.
     """
     os.environ.setdefault(
         "XLA_FLAGS",
@@ -57,9 +66,9 @@ def dump(config_name: str, out_dir: str, n_devices: int = 8,
                                                      get_config)
     from distributed_sod_project_tpu.models import build_model
     from distributed_sod_project_tpu.parallel.mesh import (
-        batch_sharding, make_mesh, replicated_sharding)
+        batch_sharding, make_mesh)
     from distributed_sod_project_tpu.train import (
-        build_optimizer, create_train_state, make_train_step)
+        build_optimizer, create_train_state)
 
     cfg = get_config(config_name)
     cfg = apply_overrides(cfg, [
@@ -82,19 +91,15 @@ def dump(config_name: str, out_dir: str, n_devices: int = 8,
     state = create_train_state(jax.random.key(0), model, tx, batch)
     dbatch = jax.device_put(batch, batch_sharding(mesh))
 
-    if cfg.parallel.engine == "rules":
-        # The unified rules engine (parallel/engine.py): same preset
-        # routing as fit(), so hlo_guard's comm arms can pin
-        # parallel.* overrides and count the bucketed collectives.
-        from distributed_sod_project_tpu.parallel.engine import (
-            prepare_train_step)
+    # The unified rules engine (parallel/engine.py, the only engine):
+    # same preset routing as fit(), so hlo_guard's comm arms can pin
+    # parallel.* overrides (preset=fsdp, data_hosts, grad_compression)
+    # and count the lowered collectives.
+    from distributed_sod_project_tpu.parallel.engine import (
+        prepare_train_step)
 
-        state, step, _plan = prepare_train_step(
-            cfg, model, tx, mesh, sched, state, donate=False)
-    else:
-        state = jax.device_put(state, replicated_sharding(mesh))
-        step = make_train_step(model, cfg.loss, tx, mesh,
-                               schedule=sched, donate=False)
+    state, step, _plan = prepare_train_step(
+        cfg, model, tx, mesh, sched, state, donate=False)
     lowered = step.lower(state, dbatch)
 
     os.makedirs(out_dir, exist_ok=True)
@@ -103,6 +108,13 @@ def dump(config_name: str, out_dir: str, n_devices: int = 8,
     with open(shlo, "w") as f:
         f.write(lowered.as_text())
     paths["stablehlo"] = shlo
+
+    if post_opt:
+        compiled = lowered.compile()
+        ppath = os.path.join(out_dir, f"{config_name}.hlo_post.txt")
+        with open(ppath, "w") as f:
+            f.write(compiled.as_text())
+        paths["hlo_post"] = ppath
 
     if not compile_cost:
         return paths

@@ -1,15 +1,18 @@
 #!/usr/bin/env python
-"""bf16 gradient-compression quality gate — CPU-runnable, per-PR.
+"""Gradient wire-compression quality gate — CPU-runnable, per-PR.
 
-The rules engine's bucketed allreduce can put gradients on the wire in
-bfloat16 (``parallel.grad_compression=bf16``): each flat bucket is cast
-to bf16 before the ``psum`` and back after, halving comm bytes.  The
-step-time win is a TPU-window measurement (``tools/tpu_agenda_r17.sh``);
-the QUALITY cost is not — rounding gradients to 8 mantissa bits is a
-pure function of the model/data/optimizer, measurable on CPU at t1
-time.  This tool trains the same model twice from the same init on the
-same deterministic synthetic batches — f32 wire vs bf16 wire — and
-ledgers the trajectory divergence in
+The rules engine's bucketed allreduce can compress the gradient wire:
+``parallel.grad_compression=bf16`` casts each flat bucket to bfloat16
+before the ``psum`` (half the bytes); ``int8_ef`` quantizes to int8
+against a global scale with a persistent error-feedback residual
+(``state.comm_residual``) carrying each replica's rounding error into
+the next step (quarter the achievable bytes).  The step-time win is a
+TPU-window measurement (``tools/tpu_agenda_r18.sh``); the QUALITY cost
+is not — wire rounding is a pure function of the
+model/data/optimizer, measurable on CPU at t1 time.  This tool trains
+the same model from the same init on the same deterministic synthetic
+batches — f32 wire vs each compressed arm — and ledgers the
+trajectory divergence in
 ``tools/grad_comm_baseline.json``, the same discipline as
 ``tools/precision_gate.py`` / ``tools/hlo_guard.py``:
 
@@ -22,12 +25,16 @@ ledgers the trajectory divergence in
 - a run whose own invariants failed (non-finite loss, exploding drift)
   NEVER seeds or updates the ledger.
 
+Each arm ledgers under its own key: the bf16 row keeps the original
+``<config>@<px>-b<batch>-k<steps>-s<seed>`` key (baseline continuity),
+the int8_ef row appends ``-int8_ef``.
+
 Ledgered quantities ("worse" is positive):
 
-- ``delta_final_loss`` — bf16 arm's last-step training loss minus the
-  f32 arm's (positive = compression slowed the descent);
+- ``delta_final_loss`` — the compressed arm's last-step training loss
+  minus the f32 arm's (positive = compression slowed the descent);
 - ``param_rel_drift`` — relative L2 distance between the two final
-  param trees, ‖p_bf16 − p_f32‖ / ‖p_f32‖ (how far the trajectories
+  param trees, ‖p_arm − p_f32‖ / ‖p_f32‖ (how far the trajectories
   separated, magnitude-normalised).
 
 Usage:
@@ -56,8 +63,8 @@ def run_arm(cfg, model, mesh, batches, *, steps: int,
     given wire precision; returns (final params, per-step losses)."""
     import jax
 
-    from distributed_sod_project_tpu.parallel.engine import \
-        make_unified_train_step
+    from distributed_sod_project_tpu.parallel.engine import (
+        make_unified_train_step, seed_comm_residual)
     from distributed_sod_project_tpu.parallel.mesh import (
         global_batch_array, replicated_sharding)
     from distributed_sod_project_tpu.train import (build_optimizer,
@@ -68,6 +75,8 @@ def run_arm(cfg, model, mesh, batches, *, steps: int,
         create_train_state(jax.random.key(cfg.seed), model, tx,
                            batches[0], ema=cfg.optim.ema_decay > 0),
         replicated_sharding(mesh))
+    if grad_compression == "int8_ef":
+        state = seed_comm_residual(state, mesh)
     step = make_unified_train_step(
         model, cfg.loss, tx, mesh, preset="dp", schedule=sched,
         donate=False, ema_decay=cfg.optim.ema_decay,
@@ -80,19 +89,21 @@ def run_arm(cfg, model, mesh, batches, *, steps: int,
     return jax.device_get(state.params), losses
 
 
-def build_report(f32, bf16) -> dict:
+def build_report(f32, comp, arm: str = "bf16") -> dict:
     """Arm deltas + the run's own invariants.  ``invariant_failed``
     means the measurements cannot be trusted — callers must not seed or
-    update the ledger from it."""
+    update the ledger from it.  ``arm`` names the compressed side in
+    the report (the gated delta keys stay arm-independent so every row
+    shares one budget vocabulary)."""
     import jax
     import numpy as np
 
     p32, l32 = f32
-    pbf, lbf = bf16
+    pbf, lbf = comp
     reasons = []
-    for arm, losses in (("f32", l32), ("bf16", lbf)):
+    for label, losses in (("f32", l32), (arm, lbf)):
         if not all(math.isfinite(v) for v in losses):
-            reasons.append(f"{arm} loss stream not finite: {losses}")
+            reasons.append(f"{label} loss stream not finite: {losses}")
     num = math.sqrt(sum(
         float(np.sum((np.asarray(a, np.float64)
                       - np.asarray(b, np.float64)) ** 2))
@@ -105,13 +116,13 @@ def build_report(f32, bf16) -> dict:
     if not math.isfinite(drift):
         reasons.append("param_rel_drift is not finite")
     elif drift > 0.5:
-        # A bf16 WIRE should nudge the trajectory, not replace it —
-        # half the weight norm means the arm is broken, and a broken
-        # arm must not become the recorded budget.
+        # A compressed WIRE should nudge the trajectory, not replace
+        # it — half the weight norm means the arm is broken, and a
+        # broken arm must not become the recorded budget.
         reasons.append(f"param_rel_drift {drift:.3f} > 0.5")
     arms = {
         "final_loss_f32": round(l32[-1], 6),
-        "final_loss_bf16": round(lbf[-1], 6),
+        f"final_loss_{arm}": round(lbf[-1], 6),
         "delta_final_loss": round(lbf[-1] - l32[-1], 6),
         "param_rel_drift": round(drift, 6) if math.isfinite(drift)
         else drift,
@@ -177,6 +188,10 @@ def main(argv=None) -> int:
                         "with no TPU window")
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="PATH=VALUE", help="dotted config override")
+    p.add_argument("--arm", default="both",
+                   choices=["bf16", "int8_ef", "both"],
+                   help="which compressed arm(s) to gate; the f32 "
+                        "reference trains once either way")
     p.add_argument("--baseline", default=_BASELINE)
     p.add_argument("--update-baseline", action="store_true")
     p.add_argument("--fail-on-increase", action="store_true",
@@ -223,30 +238,39 @@ def main(argv=None) -> int:
             batch["depth"] = img.mean(-1, keepdims=True)
         batches.append(batch)
 
-    report = build_report(
-        run_arm(cfg, model, mesh, batches, steps=args.steps,
-                grad_compression="none"),
-        run_arm(cfg, model, mesh, batches, steps=args.steps,
-                grad_compression="bf16"))
+    arms = ["bf16", "int8_ef"] if args.arm == "both" else [args.arm]
+    f32 = run_arm(cfg, model, mesh, batches, steps=args.steps,
+                  grad_compression="none")
 
     baseline = {}
     if os.path.exists(args.baseline):
         with open(args.baseline) as f:
             baseline = json.load(f)
-    key = (f"{cfg.name}@{hw}px-b{args.batch_size}-k{args.steps}"
-           f"-s{args.seed}")
-    rc, new_baseline, summary = apply_baseline(
-        report, baseline, key, update=args.update_baseline,
-        fail_on_increase=args.fail_on_increase,
-        tolerance=args.tolerance)
-    if rc == 1:
-        print(f"grad_comm_gate: invariant failed — NOT seeding/updating "
-              f"baseline for {key}: {report['reasons']}", file=sys.stderr)
-    elif new_baseline is not baseline:
-        with open(args.baseline, "w") as f:
-            json.dump(new_baseline, f, indent=2, sort_keys=True)
-            f.write("\n")
-    print(json.dumps(summary), flush=True)
+    base_key = (f"{cfg.name}@{hw}px-b{args.batch_size}-k{args.steps}"
+                f"-s{args.seed}")
+    rc = 0
+    for arm in arms:
+        report = build_report(
+            f32, run_arm(cfg, model, mesh, batches, steps=args.steps,
+                         grad_compression=arm), arm=arm)
+        # bf16 keeps the pre-int8 key verbatim (ledger continuity);
+        # every other arm gets its own suffixed row.
+        key = base_key if arm == "bf16" else f"{base_key}-{arm}"
+        arm_rc, new_baseline, summary = apply_baseline(
+            report, baseline, key, update=args.update_baseline,
+            fail_on_increase=args.fail_on_increase,
+            tolerance=args.tolerance)
+        if arm_rc == 1:
+            print(f"grad_comm_gate: invariant failed — NOT seeding/"
+                  f"updating baseline for {key}: {report['reasons']}",
+                  file=sys.stderr)
+        elif new_baseline is not baseline:
+            baseline = new_baseline
+            with open(args.baseline, "w") as f:
+                json.dump(baseline, f, indent=2, sort_keys=True)
+                f.write("\n")
+        print(json.dumps(summary), flush=True)
+        rc = max(rc, arm_rc)
     return rc
 
 

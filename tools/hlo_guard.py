@@ -119,12 +119,44 @@ _PINNED_ENV = ("DSOD_RESIZE_INTERLEAVE", "DSOD_RESIZE_IMPL")
 # mono > bucketed (bucket fusion actually collapsed the per-leaf
 # reduces).  Counts are recorded in the same baseline with the same
 # never-persist-on-failed-invariant discipline.
+#
+# Round 18 adds the pod-scale arms:
+#
+# - ``comm_hier``  — mesh.data_hosts=2 on a 4-device virtual mesh:
+#   each bucket's flat psum becomes intra-host reduce-scatter →
+#   inter-host all-reduce → intra-host all-gather
+#   (parallel/rules.py::_hier_psum), so per bucket the pre-opt
+#   StableHLO gains exactly one reduce_scatter and one all_gather
+#   while the all_reduce count stays EQUAL to the bucketed arm's
+#   (the bucket psum is replaced 1:1 by the inter-host psum).
+#   Invariants: rs_hier − rs_bucketed == n_buckets, ag_hier −
+#   ag_bucketed == n_buckets, ar_hier == ar_bucketed.
+# - ``comm_fsdp``  — parallel.preset=fsdp (model.sync_bn=false: GSPMD
+#   has no named BN axis): counted in POST-opt HLO because the SPMD
+#   partitioner inserts the collectives during compilation — the
+#   pre-opt StableHLO of a GSPMD step contains ZERO collectives.
+#   Invariants: ≥1 all-gather (the JIT param gathering that IS FSDP)
+#   and ≥1 reduce-scatter-or-all-reduce (the grad reduction; XLA:CPU
+#   lowers reduce-scatter to all-reduce+slice, so the rs count alone
+#   cannot gate on this backend).
 COMM_ARMS = {
-    "comm_mono": ("parallel.engine=rules", "parallel.comm_bucket_mb=0"),
-    "comm_flat": ("parallel.engine=rules",
-                  "parallel.comm_bucket_mb=100000"),
-    "comm_bucketed": ("parallel.engine=rules",),
+    "comm_mono": ("parallel.comm_bucket_mb=0",),
+    "comm_flat": ("parallel.comm_bucket_mb=100000",),
+    "comm_bucketed": (),
 }
+# All three collective kinds are counted per arm (flat arms lower with
+# zero rs/ag today; the hier invariants difference against them).
+_COLLECTIVES = ("all_reduce", "reduce_scatter", "all_gather")
+COMM_HIER_ARMS = {
+    "comm_hier": ("mesh.data_hosts=2",),
+}
+# data_hosts=2 needs ≥2 chips per host on the virtual mesh.
+_HIER_DEVICES = 4
+COMM_FSDP_ARMS = {
+    "comm_fsdp": ("parallel.preset=fsdp", "model.sync_bn=false"),
+}
+# Post-opt HLO spells collectives with dashes (all-gather, ...).
+_POST_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather")
 
 
 def count_formatting_ops(stablehlo_text: str) -> dict:
@@ -197,11 +229,27 @@ def dump_conv_arm_counts(config: str, out_dir: str, n_devices: int,
     return results
 
 
+def _count_collectives(stablehlo_text: str) -> dict:
+    """Per-kind collective counts in pre-opt StableHLO; 'total' stays
+    the all_reduce count for baseline continuity with the round-17
+    rows (the bucketing invariants are all_reduce deltas)."""
+    counts = {kind: len(re.findall(rf"stablehlo\.{kind}\b",
+                                   stablehlo_text))
+              for kind in _COLLECTIVES}
+    counts["total"] = counts["all_reduce"]
+    return counts
+
+
 def dump_comm_arm_counts(config: str, out_dir: str, n_devices: int,
                          image_size: int) -> dict:
     """Lower the flagship step once per gradient-collective arm (config
     overrides on the rules engine) with the resample env pinned unset;
-    return {arm: {'all_reduce': n, 'total': n}}."""
+    return {arm: {'all_reduce': n, ..., 'total': n}}.  The hierarchical
+    arm lowers on a 4-device virtual mesh (data_hosts=2 needs ≥2 chips
+    per host — main() sizes the device pool up front so this works
+    in-process); op COUNTS in the traced program are device-count
+    independent, so its deltas difference cleanly against the 2-device
+    bucketed arm."""
     from dump_hlo import dump  # tools/ sibling (path set above)
 
     results = {}
@@ -214,9 +262,25 @@ def dump_comm_arm_counts(config: str, out_dir: str, n_devices: int,
                          n_devices=n_devices, image_size=image_size,
                          compile_cost=False, overrides=overrides)
             with open(paths["stablehlo"]) as f:
-                n = len(re.findall(r"stablehlo\.all_reduce\b",
-                                   f.read()))
-            results[arm] = {"all_reduce": n, "total": n}
+                results[arm] = _count_collectives(f.read())
+        for arm, overrides in COMM_HIER_ARMS.items():
+            paths = dump(config, os.path.join(out_dir, arm),
+                         n_devices=max(n_devices, _HIER_DEVICES),
+                         image_size=image_size,
+                         compile_cost=False, overrides=overrides)
+            with open(paths["stablehlo"]) as f:
+                results[arm] = _count_collectives(f.read())
+        for arm, overrides in COMM_FSDP_ARMS.items():
+            paths = dump(config, os.path.join(out_dir, arm),
+                         n_devices=n_devices, image_size=image_size,
+                         compile_cost=False, overrides=overrides,
+                         post_opt=True)
+            with open(paths["hlo_post"]) as f:
+                txt = f.read()
+            counts = {kind.replace("-", "_"): txt.count(f"{kind}(")
+                      for kind in _POST_COLLECTIVES}
+            counts["total"] = counts["all_gather"]
+            results[arm] = counts
     finally:
         for k, v in saved.items():
             if v is not None:
@@ -260,6 +324,17 @@ def main(argv=None) -> int:
                         "baseline (off in shared CI: recorded, not "
                         "gating — the t1.sh posture)")
     args = p.parse_args(argv)
+
+    # The virtual device pool must be sized BEFORE the first dump
+    # initializes jax (dump()'s own setdefault cannot grow an already-
+    # initialized backend): the comm_hier arm needs _HIER_DEVICES even
+    # when every other arm lowers on --devices.  Each dump still
+    # slices jax.devices()[:n], so the smaller-mesh traces are
+    # unchanged by the larger pool.
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        "--xla_force_host_platform_device_count="
+        f"{max(args.devices, _HIER_DEVICES)}")
 
     tmp = None
     out_dir = args.out
@@ -407,6 +482,38 @@ def main(argv=None) -> int:
               f"count ({comm_counts['comm_mono']['total']} mono vs "
               f"{comm_counts['comm_bucketed']['total']} bucketed)",
               file=sys.stderr)
+        comm_invariant_failed = True
+    # Hierarchical arm (round 18): per bucket, one intra-host
+    # reduce_scatter and all_gather appear and the flat bucket psum is
+    # replaced 1:1 by the inter-host psum — per-level counts asserted.
+    hier = comm_counts["comm_hier"]
+    bktd = comm_counts["comm_bucketed"]
+    for kind, expect in (("reduce_scatter", n_buckets),
+                         ("all_gather", n_buckets)):
+        got = hier.get(kind, 0) - bktd.get(kind, 0)
+        if got != expect:
+            print(f"hlo_guard: hierarchical arm {kind} delta vs "
+                  f"bucketed is {got}, expected n_buckets={expect}",
+                  file=sys.stderr)
+            comm_invariant_failed = True
+    if hier.get("all_reduce", 0) != bktd.get("all_reduce", 0):
+        print("hlo_guard: hierarchical arm all_reduce count "
+              f"({hier.get('all_reduce', 0)}) != bucketed arm's "
+              f"({bktd.get('all_reduce', 0)}) — the inter-host psum "
+              "must replace the flat bucket psum 1:1",
+              file=sys.stderr)
+        comm_invariant_failed = True
+    # FSDP arm (round 18, post-opt counts): the JIT param all-gather
+    # is FSDP's signature; grads must reduce (rs, or XLA:CPU's
+    # all-reduce lowering of it).
+    fsdp = comm_counts["comm_fsdp"]
+    if fsdp.get("all_gather", 0) < 1:
+        print("hlo_guard: fsdp arm lowered ZERO all-gathers — params "
+              "are not being gathered just-in-time", file=sys.stderr)
+        comm_invariant_failed = True
+    if fsdp.get("reduce_scatter", 0) + fsdp.get("all_reduce", 0) < 1:
+        print("hlo_guard: fsdp arm lowered no gradient reduction "
+              "(reduce-scatter or all-reduce)", file=sys.stderr)
         comm_invariant_failed = True
     if comm_invariant_failed:
         rc = rc or 1

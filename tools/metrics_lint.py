@@ -80,10 +80,17 @@ def _populated_capacity():
     cap.observe("m/r64b1/fast/f32", 1.0)
     # One synthetic comm plan so the round-18 dsod_capacity_comm_*
     # families render (they are `if samples`-gated like the per-program
-    # families).
+    # families).  The hierarchical legs (round 18) carry a DCN-level
+    # collective so the dsod_capacity_comm_dcn_* split renders too.
     cap.record_comm("m/r64b1/fast/f32", {
-        "collectives": [{"name": "grad_bucket_00", "kind": "psum",
-                         "axis": "data", "axis_size": 2, "bytes": 8}],
+        "collectives": [
+            {"name": "grad_bucket_00_rs", "kind": "reduce_scatter",
+             "axis": "data", "axis_size": 2, "level": "ici", "bytes": 8},
+            {"name": "grad_bucket_00_ar", "kind": "psum",
+             "axis": "data", "axis_size": 2, "level": "dcn", "bytes": 4},
+            {"name": "grad_bucket_00_ag", "kind": "all_gather",
+             "axis": "data", "axis_size": 2, "level": "ici", "bytes": 8},
+        ],
         "n_buckets": 1, "overlap_frac": 0.0,
         "zero_hbm_saved_bytes": 0})
     return cap

@@ -63,6 +63,12 @@ PEAK_FLOPS = 197e12  # dense bf16 MACs*2
 HBM_BW = 819e9       # bytes/s
 ICI_BW = 2e11        # bytes/s — v5e 1,600 Gbps aggregate ICI per chip
 #                      (same constant as utils/capacity.py's live side)
+DCN_BW = 12.5e9      # bytes/s — ~100 Gbps per-host DCN NIC, the
+#                      inter-host leg of a multi-pod mesh (same
+#                      constant as utils/capacity.py's live side);
+#                      16x slower than ICI, which is WHY the
+#                      hierarchical reduction moves only 1/chips of
+#                      the bytes across it
 
 A = 2  # activation bytes (bf16)
 P = 4  # param / stat / f32 bytes
@@ -458,7 +464,7 @@ def fmt_fused_conv_ledger(b: int, hw: int = 320) -> str:
 
 
 def fmt_comm_ledger(b: int, n_dp: int = 8, bucket_mb: float = 25.0,
-                    compression: str = "none") -> str:
+                    compression: str = "none", hosts: int = 1) -> str:
     """Per-step gradient-communication ledger for the flagship
     (ROADMAP item 4, round 18): the REAL param tree's leaves (abstract
     init — no arrays allocated) partitioned into the rules engine's
@@ -466,10 +472,23 @@ def fmt_comm_ledger(b: int, n_dp: int = 8, bucket_mb: float = 25.0,
     priced as a ring allreduce over ``n_dp`` replicas — wire bytes
     ``2(n-1)/n × payload`` at ``ICI_BW`` — plus the structural overlap
     estimate (every bucket except the last overlaps remaining backward
-    compute) and the ZeRO per-device HBM saving.  The live twin of this
-    table is the ``dsod_capacity_comm_*`` surface
-    (utils/capacity.py::record_comm); the measured numbers stay
-    tools/tpu_agenda_r17.sh predictions until a TPU window lands them.
+    compute) and the ZeRO per-device HBM saving.
+
+    ``hosts > 1`` prices the hierarchical two-level schedule
+    (parallel/rules.py::_hier_psum) instead: per bucket, intra-host
+    reduce-scatter ((c−1)/c × payload at ICI, c = chips/host) →
+    inter-host all-reduce (2(h−1)/h × payload/c at DCN — each chip
+    owns 1/c of the bucket, so only that slice crosses the slow leg)
+    → intra-host all-gather ((c−1)/c × payload at ICI).
+
+    ``compression`` scales the wire bytes: bf16 halves them; int8_ef
+    prices the ACHIEVABLE 1 B/elem (wire_scale 0.25) even though the
+    current XLA transport psums int32 — the ledger documents the wire
+    format's information content, the transport honesty note below
+    keeps the gap visible.  The live twin of this table is the
+    ``dsod_capacity_comm_*`` surface (utils/capacity.py::record_comm);
+    the measured numbers stay tools/tpu_agenda_r18.sh predictions
+    until a TPU window lands them.
     """
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), ".."))
@@ -482,6 +501,8 @@ def fmt_comm_ledger(b: int, n_dp: int = 8, bucket_mb: float = 25.0,
     from distributed_sod_project_tpu.models import build_model
     from distributed_sod_project_tpu.parallel.rules import grad_buckets
 
+    if hosts > 1 and n_dp % hosts:
+        raise SystemExit(f"--hosts {hosts} must divide --n-dp {n_dp}")
     cfg = get_config("minet_r50_dp")
     model = build_model(cfg.model)
     # Param shapes are input-size independent for the conv zoo; a 64px
@@ -492,37 +513,77 @@ def fmt_comm_ledger(b: int, n_dp: int = 8, bucket_mb: float = 25.0,
     leaves = jax.tree_util.tree_leaves(variables["params"])
     shapes = [(x.shape, x.dtype) for x in leaves]
     sizes = [int(math.prod(s or (1,))) * 4 for s, _ in shapes]  # f32
-    wire_scale = 0.5 if compression == "bf16" else 1.0
+    wire_scale = {"none": 1.0, "bf16": 0.5, "int8_ef": 0.25}[compression]
     buckets = grad_buckets(shapes, int(bucket_mb * 2 ** 20))
-    ring = 2.0 * (n_dp - 1) / n_dp
-    out = [f"## comm ledger  b{b}  n_dp={n_dp}  "
+    chips = n_dp // hosts if hosts > 1 else n_dp
+    out = [f"## comm ledger  b{b}  n_dp={n_dp}  hosts={hosts}  "
            f"bucket={bucket_mb}MB  compression={compression}",
            f"param leaves: {len(leaves)}  grad bytes/replica: "
-           f"{sum(sizes) / 1e6:.1f} MB f32",
-           "| bucket | leaves | payload MB | wire MB (ring) | "
-           "ICI ms |",
-           "|---|---|---|---|---|"]
-    tot_wire = 0.0
-    for i, bucket in enumerate(buckets):
-        payload = sum(sizes[j] for j in bucket) * wire_scale
-        wire = ring * payload
-        tot_wire += wire
-        out.append(f"| {i} | {len(bucket)} | {payload / 1e6:.2f} | "
-                   f"{wire / 1e6:.2f} | {wire / ICI_BW * 1e3:.3f} |")
+           f"{sum(sizes) / 1e6:.1f} MB f32"]
+    tot_ici = tot_dcn = 0.0
+    if hosts > 1:
+        out += ["| bucket | leaves | payload MB | ICI wire MB "
+                "(rs+ag) | ICI ms | DCN wire MB (ar) | DCN ms |",
+                "|---|---|---|---|---|---|---|"]
+        ici_frac = (chips - 1) / chips           # rs and ag, each
+        dcn_ring = 2.0 * (hosts - 1) / hosts
+        for i, bucket in enumerate(buckets):
+            payload = sum(sizes[j] for j in bucket) * wire_scale
+            ici = 2.0 * ici_frac * payload       # rs + ag
+            dcn = dcn_ring * payload / chips     # 1/chips of the bytes
+            tot_ici += ici
+            tot_dcn += dcn
+            out.append(
+                f"| {i} | {len(bucket)} | {payload / 1e6:.2f} | "
+                f"{ici / 1e6:.2f} | {ici / ICI_BW * 1e3:.3f} | "
+                f"{dcn / 1e6:.2f} | {dcn / DCN_BW * 1e3:.3f} |")
+        out.append(
+            f"| **total** | **{len(leaves)}** | "
+            f"**{sum(sizes) * wire_scale / 1e6:.2f}** | "
+            f"**{tot_ici / 1e6:.2f}** | "
+            f"**{tot_ici / ICI_BW * 1e3:.3f}** | "
+            f"**{tot_dcn / 1e6:.2f}** | "
+            f"**{tot_dcn / DCN_BW * 1e3:.3f}** |")
+        flat_dcn = 2.0 * (n_dp - 1) / n_dp * sum(sizes) * wire_scale
+        out.append(
+            f"flat ring at DCN for comparison: "
+            f"{flat_dcn / 1e6:.2f} MB ~{flat_dcn / DCN_BW * 1e3:.3f} "
+            f"ms — the hierarchy moves {1.0 / chips:.0%} of the bytes "
+            f"over the slow leg")
+    else:
+        out += ["| bucket | leaves | payload MB | wire MB (ring) | "
+                "ICI ms |",
+                "|---|---|---|---|---|"]
+        ring = 2.0 * (n_dp - 1) / n_dp
+        for i, bucket in enumerate(buckets):
+            payload = sum(sizes[j] for j in bucket) * wire_scale
+            wire = ring * payload
+            tot_ici += wire
+            out.append(f"| {i} | {len(bucket)} | {payload / 1e6:.2f} | "
+                       f"{wire / 1e6:.2f} | "
+                       f"{wire / ICI_BW * 1e3:.3f} |")
+        out.append(f"| **total** | **{len(leaves)}** | "
+                   f"**{sum(sizes) * wire_scale / 1e6:.2f}** | "
+                   f"**{tot_ici / 1e6:.2f}** | "
+                   f"**{tot_ici / ICI_BW * 1e3:.3f}** |")
     last = sum(sizes[j] for j in buckets[-1]) if buckets else 0
     overlap = (1.0 - last / max(sum(sizes), 1)
                if len(buckets) > 1 else 0.0)
-    out.append(f"| **total** | **{len(leaves)}** | "
-               f"**{sum(sizes) * wire_scale / 1e6:.2f}** | "
-               f"**{tot_wire / 1e6:.2f}** | "
-               f"**{tot_wire / ICI_BW * 1e3:.3f}** |")
     _, _, _, t_step = predict(b)
-    exposed = tot_wire / ICI_BW * (1.0 - overlap)
+    wire_time = tot_ici / ICI_BW + tot_dcn / DCN_BW
+    exposed = wire_time * (1.0 - overlap)
     out.append(
         f"overlap estimate (structural): {overlap:.0%} of wire time "
         f"hides under backward compute; exposed comm "
         f"~{exposed * 1e3:.3f} ms vs roofline step "
         f"{t_step * 1e3:.2f} ms")
+    if compression == "int8_ef":
+        out.append(
+            "int8_ef transport honesty: XLA's collective carries the "
+            "quantized values as int32 today (4 B/elem on the wire); "
+            "the 0.25 wire scale above prices the 1 B/elem the int8 "
+            "payload CONTAINS — the gap is transport packing, not "
+            "information, and closes with a packed-collective lowering")
     # ZeRO: moments (momentum = 1x params f32) + EMA when on shard
     # over n_dp — each replica keeps 1/n of the buffer bytes.
     opt_bytes = sum(sizes)  # SGD momentum: one f32 slot per param
@@ -645,11 +706,12 @@ def xla_check(b: int = 4, hw: int = 64):
     from distributed_sod_project_tpu.configs import (apply_overrides,
                                                      get_config)
     from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.parallel.engine import (
+        prepare_train_step)
     from distributed_sod_project_tpu.parallel.mesh import (
-        batch_sharding, make_mesh, replicated_sharding)
+        batch_sharding, make_mesh)
     from distributed_sod_project_tpu.train import (build_optimizer,
-                                                   create_train_state,
-                                                   make_train_step)
+                                                   create_train_state)
 
     cfg = get_config("minet_r50_dp")
     cfg = apply_overrides(cfg, [f"data.image_size={hw},{hw}",
@@ -662,9 +724,9 @@ def xla_check(b: int = 4, hw: int = 64):
     batch = {"image": rng.randn(b, hw, hw, 3).astype(np.float32),
              "mask": (rng.rand(b, hw, hw, 1) > 0.5).astype(np.float32)}
     state = create_train_state(jax.random.key(0), model, tx, batch)
-    state = jax.device_put(state, replicated_sharding(mesh))
+    state, step, _plan = prepare_train_step(
+        cfg, model, tx, mesh, sched, state)
     dev_batch = jax.device_put(batch, batch_sharding(mesh))
-    step = make_train_step(model, cfg.loss, tx, mesh, schedule=sched)
     compiled = step.lower(state, dev_batch).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
@@ -730,9 +792,16 @@ def main(argv=None) -> int:
                         "is priced for")
     p.add_argument("--bucket-mb", type=float, default=25.0,
                    help="with --comm: parallel.comm_bucket_mb arm")
-    p.add_argument("--compression", choices=["none", "bf16"],
+    p.add_argument("--compression",
+                   choices=["none", "bf16", "int8_ef"],
                    default="none",
-                   help="with --comm: parallel.grad_compression arm")
+                   help="with --comm: parallel.grad_compression arm "
+                        "(int8_ef prices the achievable 1 B/elem wire)")
+    p.add_argument("--hosts", type=int, default=1,
+                   help="with --comm: mesh.data_hosts — price the "
+                        "hierarchical intra-host rs / inter-host ar / "
+                        "intra-host ag schedule with the ICI and DCN "
+                        "legs separated")
     args = p.parse_args(argv)
 
     if args.xla_check:
@@ -744,7 +813,8 @@ def main(argv=None) -> int:
         for b in batches:
             print(fmt_comm_ledger(b, n_dp=args.n_dp,
                                   bucket_mb=args.bucket_mb,
-                                  compression=args.compression))
+                                  compression=args.compression,
+                                  hosts=args.hosts))
             print()
         return 0
     for b in batches:

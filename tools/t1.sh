@@ -43,7 +43,7 @@ echo "== step-chunking k-equivalence smoke (recorded; the full suite below gates
 timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_step_chunking.py -q -k bitwise_smoke -p no:cacheprovider \
   || echo "step-chunking smoke failed (the main suite below still gates it)"
-echo "== sharding-engine equivalence smoke: rules-vs-legacy DP bitwise incl. bucketed allreduce (recorded; the full suite below gates it) =="
+echo "== sharding-engine equivalence smoke: bucketed/fused DP reduce bitwise the monolithic pmean on the (only) rules engine (recorded; the full suite below gates it) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_sharding_rules.py -q -k rules_smoke -p no:cacheprovider \
   || echo "sharding-engine smoke failed (the main suite below still gates it)"
@@ -53,8 +53,8 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/serve_smoke.py --precision 
 echo "== precision quality gate: per-arm max-Fbeta/MAE deltas vs f32 on the tiny synthetic set (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/precision_gate.py \
   || echo "precision gate smoke failed (non-gating; --fail-on-increase gates locally)"
-echo "== bf16 gradient-compression quality gate: f32-wire vs bf16-wire training trajectory deltas vs the recorded budget (recorded, non-gating) =="
-timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/grad_comm_gate.py \
+echo "== gradient wire-compression quality gate: f32 vs bf16 AND int8_ef (error-feedback) trajectory deltas vs the recorded budgets (recorded, non-gating) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/grad_comm_gate.py --arm both \
   || echo "grad comm gate smoke failed (non-gating; --fail-on-increase gates locally)"
 echo "== near-dup cache-serving quality gate: near arm max-Fbeta/MAE deltas vs the exact forward on the tiny synthetic set (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/cache_gate.py \
