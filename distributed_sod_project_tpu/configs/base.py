@@ -734,6 +734,32 @@ class FleetConfig:
     # forward, off the request path (0 = no shadow scoring).
     cache_shadow_sample: int = 0
 
+    # -- streaming-video sessions (serve/streams.py; docs/SERVING.md
+    #    "Streaming") ----------------------------------------------------
+    # Maximum concurrent per-client stream sessions (the X-Stream-ID
+    # header opens one).  0 (default): streaming fully off — no session
+    # table, no dsod_stream_* families, byte-identical /metrics, and
+    # the batcher never sees a stream key.  A NEW stream past the cap
+    # sheds loudly at the door (429 kind=stream_budget) — existing
+    # sessions are never silently evicted to make room.
+    stream_sessions: int = 0
+    # Idle TTL: a session untouched this long is evicted (LRU order)
+    # and counted into dsod_stream_expired_total.
+    stream_ttl_s: float = 30.0
+    # Temporal-coherence fast path: when a frame's 256-bit phash is
+    # within this many Hamming bits of the stream's previous frame,
+    # serve the previous mask WITHOUT a forward (terminal class
+    # `stream_reuse`).  0 = fast path off (sessions still track state
+    # and pin replicas).  Quality-gated offline by tools/stream_gate.py
+    # (checked-in tools/stream_baseline.json) and online by the cache
+    # shadow monitors.
+    stream_reuse_hamming: int = 0
+    # EMA mask blend for flicker damping: on a FULL forward for a
+    # stream that has a previous mask of the same shape, the response
+    # becomes blend*prev + (1-blend)*new.  0 (default) = off — full
+    # forwards are bitwise the engine's own answer.
+    stream_ema_blend: float = 0.0
+
 
 def fleet_config_from_dict(d: Dict) -> FleetConfig:
     """Build + validate a FleetConfig from its JSON dict (the
@@ -978,6 +1004,32 @@ def validate_fleet_config(fc: FleetConfig) -> FleetConfig:
             "fleet cache_shadow_sample is set but cache_near_dup is "
             "off — only near-dup hits are shadow-scored (exact hits "
             "are bitwise the engine's own answer)")
+    if fc.stream_sessions < 0:
+        raise ValueError(
+            f"fleet stream_sessions must be >= 0 (0 = streaming off), "
+            f"got {fc.stream_sessions}")
+    if fc.stream_sessions > 0 and fc.stream_ttl_s <= 0:
+        raise ValueError(
+            f"fleet stream_ttl_s must be > 0 when streaming is on, got "
+            f"{fc.stream_ttl_s}")
+    if fc.stream_reuse_hamming < 0 or fc.stream_reuse_hamming > 256:
+        raise ValueError(
+            "fleet stream_reuse_hamming must be in [0, 256] (bits over "
+            f"the 256-bit phash), got {fc.stream_reuse_hamming}")
+    if fc.stream_reuse_hamming > 0 and fc.stream_sessions <= 0:
+        raise ValueError(
+            "fleet stream_reuse_hamming is set but stream_sessions is "
+            "0 — the temporal-coherence fast path serves out of a "
+            "stream session (loud beats silent)")
+    if not 0.0 <= fc.stream_ema_blend < 1.0:
+        raise ValueError(
+            f"fleet stream_ema_blend must be in [0, 1), got "
+            f"{fc.stream_ema_blend}")
+    if fc.stream_ema_blend > 0 and fc.stream_sessions <= 0:
+        raise ValueError(
+            "fleet stream_ema_blend is set but stream_sessions is 0 — "
+            "the blend reads a stream session's previous mask (loud "
+            "beats silent)")
     if fc.default_tenant not in tseen:
         low = min((t.priority for t in fc.tenants), default=0)
         fc = dataclasses.replace(

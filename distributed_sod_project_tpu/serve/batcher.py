@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -22,6 +22,11 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .admission import QueueFull
+
+# Per-stream affinity entries are tiny (key string -> bucket tuple) but
+# adversarial stream ids must not grow the map without bound; LRU-cap it
+# well above any realistic concurrent-stream count.
+AFFINITY_CAP = 4096
 
 
 @dataclass
@@ -50,6 +55,18 @@ class Request:
     level: int = 0
     future: Future = field(default_factory=Future)
     dispatch_t: float = 0.0
+    # Per-stream affinity key (serve/streams.py; None for the normal
+    # independent-request path — the batcher then behaves exactly as it
+    # did before streaming existed).  Frames carrying the same stream
+    # key coalesce into the same (res_bucket, precision) program: the
+    # batcher records stream -> bucket_key on every put and the engine
+    # consults it at submit to keep a stream on one compiled program.
+    stream: Optional[str] = None
+    # Optional depth plane riding with an RGB-D request — (res, res, 1)
+    # float32, preprocessed alongside ``tensor`` (satellite: RGB-D
+    # serving).  None for RGB models; the dispatch loop stacks it into
+    # the batch dict only when present.
+    depth: Optional[np.ndarray] = field(default=None, repr=False)
     # Tracing (utils/tracing.py): the request's trace id (propagated
     # from X-Request-ID) and its open root span — None when the trace
     # was not sampled, and every span touch downstream guards on that.
@@ -89,6 +106,12 @@ class DynamicBatcher:
         self._queues: Dict[int, deque] = {}
         self._cv = threading.Condition()
         self._closed = False
+        # stream key -> last bucket_key, LRU-bounded.  Written on every
+        # put of a stream-tagged request; read by the engine at submit
+        # so a stream's next frame preprocesses into the SAME
+        # (res_bucket, precision) program.  Empty (and never touched)
+        # when no request carries a stream key.
+        self._affinity: "OrderedDict[str, Tuple[int, str]]" = OrderedDict()
 
     # -- producer side -------------------------------------------------
 
@@ -106,7 +129,21 @@ class DynamicBatcher:
                     raise QueueFull(
                         f"queue at capacity ({depth}/{self.max_queue})")
             self._queues.setdefault(req.bucket_key, deque()).append(req)
+            if req.stream is not None:
+                self._affinity[req.stream] = req.bucket_key
+                self._affinity.move_to_end(req.stream)
+                while len(self._affinity) > AFFINITY_CAP:
+                    self._affinity.popitem(last=False)
             self._cv.notify_all()
+
+    def affinity_bucket(self, stream: Optional[str]
+                        ) -> Optional[Tuple[int, str]]:
+        """The (res_bucket, precision) program the stream's previous
+        frame coalesced into, or None for an unknown/absent stream."""
+        if stream is None:
+            return None
+        with self._cv:
+            return self._affinity.get(stream)
 
     def pending(self) -> int:
         with self._cv:
@@ -121,6 +158,34 @@ class DynamicBatcher:
                 head = q[0]
         return head
 
+    def _next_group_locked(self, now: float) -> Optional[Tuple[int, str]]:
+        """The bucket key that should dispatch RIGHT NOW, or None.
+
+        A FULL group dispatches immediately — oldest-head-first among
+        full groups — even when the globally-oldest head sits in a
+        different, unfilled group.  Under per-stream affinity a pinned
+        stream can fill its group arbitrarily fast; the pre-affinity
+        rule (only ever examine the oldest head's queue) stalled such a
+        group behind an unrelated older head's max-wait window, growing
+        it toward max_queue sheds.  The max-wait deadline itself is
+        untouched: the oldest head still dispatches no later than its
+        own ``arrival + max_wait_s`` — a stream filling some other
+        group never extends it.
+        """
+        head = self._oldest_head()
+        if head is None:
+            return None
+        full = None
+        for q in self._queues.values():
+            if len(q) >= self.max_batch and (
+                    full is None or q[0].arrival < full[0].arrival):
+                full = q
+        if full is not None:
+            return full[0].bucket_key
+        if (head.arrival + self.max_wait_s) <= now:
+            return head.bucket_key
+        return None
+
     def get_batch(self, idle_timeout_s: float
                   ) -> Optional[Tuple[Tuple[int, str], List[Request]]]:
         """Next coalesced group as ``((res_bucket, precision), reqs)``,
@@ -131,27 +196,23 @@ class DynamicBatcher:
             while True:
                 if self._closed:
                     return None
-                head = self._oldest_head()
                 now = self._clock()
+                key = self._next_group_locked(now)
+                if key is not None:
+                    q = self._queues[key]
+                    n = min(len(q), self.max_batch)
+                    return key, [q.popleft() for _ in range(n)]
+                head = self._oldest_head()
                 if head is None:
                     if now >= idle_deadline:
                         return None
                     self._cv.wait(min(idle_deadline - now, 0.05))
                     continue
-                q = self._queues[head.bucket_key]
                 wait_left = (head.arrival + self.max_wait_s) - now
-                if len(q) >= self.max_batch or wait_left <= 0:
-                    n = min(len(q), self.max_batch)
-                    return head.bucket_key, [q.popleft() for _ in range(n)]
                 self._cv.wait(min(wait_left, 0.05))
 
     def _ready_locked(self, now: float) -> bool:
-        head = self._oldest_head()
-        if head is None:
-            return False
-        q = self._queues[head.bucket_key]
-        return (len(q) >= self.max_batch
-                or (head.arrival + self.max_wait_s) <= now)
+        return self._next_group_locked(now) is not None
 
     def ready(self) -> bool:
         """True when a group would dispatch RIGHT NOW (full bucket, or
@@ -168,12 +229,14 @@ class DynamicBatcher:
         one is ready, else None immediately (never waits on max-wait or
         an empty queue)."""
         with self._cv:
-            if self._closed or not self._ready_locked(self._clock()):
+            if self._closed:
                 return None
-            head = self._oldest_head()
-            q = self._queues[head.bucket_key]
+            key = self._next_group_locked(self._clock())
+            if key is None:
+                return None
+            q = self._queues[key]
             n = min(len(q), self.max_batch)
-            return head.bucket_key, [q.popleft() for _ in range(n)]
+            return key, [q.popleft() for _ in range(n)]
 
     def pick_batch_bucket(self, n: int) -> int:
         """Smallest static batch bucket that fits ``n`` requests (the

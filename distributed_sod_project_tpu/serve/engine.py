@@ -46,27 +46,49 @@ from .precision import (cast_variables, make_precision_forward, step_down,
                         validate_arms)
 
 
-def preprocess_image(image: np.ndarray, res: int, mean, std) -> np.ndarray:
+def preprocess_image(image: np.ndarray, res: int, mean, std, *,
+                     depth: bool = False):
     """Request image → the compiled forward's input row: resize to the
     (res, res) bucket (PIL bilinear, the eval-path convention), scale to
     [0, 1], normalize.  uint8 in; float32 [0,1] arrays are accepted and
     quantized through uint8 so the server and any offline comparator
-    see bit-identical inputs for the same source image."""
+    see bit-identical inputs for the same source image.
+
+    ``depth=True`` (RGB-D models, e.g. HDFNet): the request is an
+    ``(H, W, 4)`` RGBD stack — the first three channels preprocess as
+    above and the fourth splits off as the model's ``depth`` input
+    (resized to the same bucket, scaled to [0, 1], NOT mean/std
+    normalized — the depth-plane convention the data pipeline uses).
+    Returns ``(tensor, depth_plane)`` with depth_plane float32
+    ``(res, res, 1)``; the RGB path keeps its historical single-array
+    return."""
     arr = np.asarray(image)
-    if arr.ndim != 3 or arr.shape[2] != 3:
+    want_c = 4 if depth else 3
+    if arr.ndim != 3 or arr.shape[2] != want_c:
+        kind = "(H, W, 4) RGBD" if depth else "(H, W, 3)"
         raise ValueError(
-            f"expected an (H, W, 3) image, got shape {arr.shape}")
+            f"expected an {kind} image, got shape {arr.shape}")
     if arr.dtype != np.uint8:
         arr = (np.clip(arr, 0.0, 1.0) * 255.0).round().astype(np.uint8)
     from PIL import Image
 
+    dplane = None
+    if depth:
+        d = Image.fromarray(arr[:, :, 3])
+        if d.size != (res, res):
+            d = d.resize((res, res), Image.BILINEAR)
+        dplane = (np.asarray(d, np.float32) / 255.0)[:, :, None]
+        arr = arr[:, :, :3]
     im = Image.fromarray(arr)
     if im.size != (res, res):
         im = im.resize((res, res), Image.BILINEAR)
     x = np.asarray(im, np.float32) / 255.0
     mean = np.asarray(mean, np.float32)
     std = np.asarray(std, np.float32)
-    return ((x - mean) / std).astype(np.float32)
+    tensor = ((x - mean) / std).astype(np.float32)
+    if depth:
+        return tensor, dplane
+    return tensor
 
 
 class InferenceEngine:
@@ -81,11 +103,13 @@ class InferenceEngine:
 
     def __init__(self, cfg, model, state, *, ckpt_dir: Optional[str] = None,
                  stats: Optional[ServeStats] = None, clock=time.monotonic):
-        if cfg.data.use_depth:
-            raise ValueError(
-                "serving the RGB-D (use_depth) configs is not wired up —"
-                " the /predict surface is RGB-only for now")
         self.cfg = cfg
+        # RGB-D zoo members (HDFNet under a use_depth config) demand a
+        # depth plane on every request: /predict payloads are
+        # (H, W, 4) RGBD, split at preprocess; warmup/probe batches
+        # carry a zero depth plane.  The HTTP front ends read this to
+        # 400 channel-mismatched payloads BEFORE submit.
+        self.wants_depth = bool(cfg.data.use_depth)
         self.model = model
         self.ckpt_dir = ckpt_dir
         self.stats = stats or ServeStats()
@@ -337,6 +361,8 @@ class InferenceEngine:
             if self._conv_impl == "fused" and arm in QUANT_ARMS:
                 res = self.res_buckets[0]
                 probe = {"image": np.zeros((1, res, res, 3), np.float32)}
+                if self.wants_depth:
+                    probe["depth"] = np.zeros((1, res, res, 1), np.float32)
                 if sites is None:
                     sites = fused_conv_sites(self.model, variables, probe)
                 view = fused_conv_cast_variables(self.model, variables,
@@ -434,6 +460,9 @@ class InferenceEngine:
                         continue
                     batch = {"image": np.zeros((bb, res, res, 3),
                                                np.float32)}
+                    if self.wants_depth:
+                        batch["depth"] = np.zeros((bb, res, res, 1),
+                                                  np.float32)
                     t0 = time.perf_counter()
                     self.programs[key] = self._fwds[arm].lower(
                         arm_vars[arm], batch).compile()
@@ -534,7 +563,8 @@ class InferenceEngine:
                slo_ms: Optional[float] = None,
                precision: Optional[str] = None,
                trace_id: Optional[str] = None,
-               trace_parent: Optional[str] = None):
+               trace_parent: Optional[str] = None,
+               stream: Optional[str] = None):
         """Enqueue one prediction; returns a ``concurrent.futures.Future``
         resolving to ``(pred, meta)`` — pred float32 (H, W) at the
         request's original resolution.  ``precision`` selects the arm
@@ -577,7 +607,22 @@ class InferenceEngine:
             # spent — precision steps down BEFORE resolution.
             res = self.choose_res_bucket(arr.shape[0], arr.shape[1],
                                          level > self._n_precision_rungs)
-            tensor = preprocess_image(arr, res, self._mean, self._std)
+            # Per-stream affinity (serve/streams.py): a stream's next
+            # frame coalesces into the SAME (res_bucket, precision)
+            # compiled program its previous frame ran on, so warm
+            # state stays on one program.  Only when the arm still
+            # matches (the degraded ladder wins over affinity) and the
+            # bucket is still configured.
+            aff = self.batcher.affinity_bucket(stream)
+            if aff is not None and aff[1] == arm \
+                    and aff[0] in self.res_buckets:
+                res = aff[0]
+            dplane = None
+            if self.wants_depth:
+                tensor, dplane = preprocess_image(
+                    arr, res, self._mean, self._std, depth=True)
+            else:
+                tensor = preprocess_image(arr, res, self._mean, self._std)
             if self.quality is not None:
                 # Input drift histogram (serve/quality.py) — one mean()
                 # over an image preprocess already walked.  Guarded
@@ -612,7 +657,8 @@ class InferenceEngine:
             tensor=tensor, orig_hw=(int(arr.shape[0]), int(arr.shape[1])),
             res_bucket=res, arrival=now, precision=arm,
             deadline=(now + slo / 1000.0) if slo and slo > 0 else None,
-            degraded=level > 0, level=level, trace_id=trace_id, root=root)
+            degraded=level > 0, level=level, trace_id=trace_id, root=root,
+            stream=stream, depth=dplane)
         try:
             # The batcher re-checks the bound under ITS lock (the
             # try_admit above is the cheap pre-preprocess gate; N
@@ -742,8 +788,12 @@ class InferenceEngine:
                 self._inflight_sem.release()
             return True
         bb = self.batcher.pick_batch_bucket(len(live))
-        batch = pad_to_batch(
-            {"image": np.stack([r.tensor for r in live])}, bb)
+        stacked = {"image": np.stack([r.tensor for r in live])}
+        if self.wants_depth:
+            # submit() guarantees every request for a depth model
+            # carries its plane, so the stack is total.
+            stacked["depth"] = np.stack([r.depth for r in live])
+        batch = pad_to_batch(stacked, bb)
         with self._var_lock:
             variables = self._arm_vars[arm]
             step = self._loaded_step
@@ -929,14 +979,16 @@ class InferenceEngine:
                 # arm) — the sampler sees every eligible response.
                 if (r.precision != "f32" and not meta.get("tta")
                         and self.quality.should_shadow()):
-                    self._submit_shadow(r.tensor, row, meta)
+                    self._submit_shadow(r.tensor, row, meta,
+                                        depth=r.depth)
             except Exception:  # noqa: BLE001 — telemetry must not throw
                 self._log.exception("serve: quality monitor failed")
 
     # -- shadow scoring (serve/quality.py) ------------------------------
 
     def _submit_shadow(self, tensor: np.ndarray, row: np.ndarray,
-                       meta: dict) -> None:
+                       meta: dict,
+                       depth: Optional[np.ndarray] = None) -> None:
         """Queue one arm-vs-f32 shadow score on the side lane, or DROP
         (counted) when the lane is full — reference forwards must never
         queue live traffic behind them."""
@@ -946,13 +998,14 @@ class InferenceEngine:
             return
         try:
             self._shadow_pool.submit(self._shadow_score, tensor, row,
-                                     dict(meta))
+                                     dict(meta), depth)
         except RuntimeError:  # pool shut down under us
             self._shadow_sem.release()
             self.quality.record_shadow_dropped()
 
     def _shadow_score(self, tensor: np.ndarray, row: np.ndarray,
-                      meta: dict) -> None:
+                      meta: dict,
+                      depth: Optional[np.ndarray] = None) -> None:
         """Re-run one served input through the f32 reference program
         and record the live disagreement (mean |Δ| + thresholded-mask
         flip rate) for the arm that served it.  A hot reload between
@@ -967,7 +1020,10 @@ class InferenceEngine:
                 return
             res = meta["res_bucket"]
             bb = self.batcher.pick_batch_bucket(1)
-            batch = pad_to_batch({"image": tensor[None]}, bb)
+            stacked = {"image": tensor[None]}
+            if depth is not None:
+                stacked["depth"] = depth[None]
+            batch = pad_to_batch(stacked, bb)
             probs = self._forward(res, bb, "f32", variables, batch,
                                   tta=False)
             ref = np.asarray(probs)[0].astype(np.float32)

@@ -439,14 +439,31 @@ class ReplicaSet:
         with self._lock:
             return set(self._draining)
 
-    def pick(self, exclude: Optional[Set[str]] = None
+    def pick(self, exclude: Optional[Set[str]] = None,
+             prefer: Optional[str] = None
              ) -> Optional[Tuple[str, object, CircuitBreaker]]:
         """The next dispatchable replica ``(rid, backend, breaker)``,
         or None when every member is excluded, unhealthy, or breaker-
         blocked.  Advances the round-robin head past the pick so
-        successive requests spread over the set."""
+        successive requests spread over the set.
+
+        ``prefer`` (stream affinity, serve/streams.py): return that
+        member WITHOUT advancing the round-robin head when it is
+        routable — a pinned stream must not skew the spread the
+        independent traffic sees.  A dead/blocked/unknown preference
+        falls through to the normal rotation (the caller re-homes)."""
         exclude = exclude or set()
         with self._lock:
+            if prefer is not None and prefer not in exclude \
+                    and prefer not in self._draining:
+                for rid, backend in self.members:
+                    if rid != prefer:
+                        continue
+                    if backend.healthy():
+                        breaker = self.breakers[rid]
+                        if breaker.allow():
+                            return rid, backend, breaker
+                    break
             start = self._rr
             n = len(self.members)
             for i in range(n):
@@ -691,6 +708,21 @@ class Fleet:
                 near_dup=cfg.cache_near_dup,
                 near_hamming=cfg.cache_near_dup_hamming,
                 shadow_sample=cfg.cache_shadow_sample)
+        # Streaming-video session table (serve/streams.py;
+        # docs/SERVING.md "Streaming").  None/off by default: no
+        # table, zero threads, X-Stream-ID inert, /metrics
+        # byte-identical.  Armed, the router opens per-stream sessions
+        # at the door, pins frames to the session's home replica, and
+        # may serve the temporal-coherence fast path — booked as the
+        # sixth terminal class ``stream_reuse`` (see :meth:`stats`).
+        self.streams = None
+        if cfg.stream_sessions > 0:
+            from .streams import StreamTable
+
+            self.streams = StreamTable(
+                cfg.stream_sessions, cfg.stream_ttl_s,
+                reuse_hamming=cfg.stream_reuse_hamming,
+                ema_blend=cfg.stream_ema_blend, clock=clock)
         self.dispatcher = FleetDispatcher(
             [b.engine for b in backends if b.kind == "engine"])
         self._started = False
@@ -951,6 +983,8 @@ class Fleet:
             groups.append(self.rollout.stats.prom_families())
         if self.cache is not None:
             groups.append(self.cache.prom_families())
+        if self.streams is not None:
+            groups.append(self.streams.prom_families())
         if self.slo is not None:
             # Router-tier SLO families + their alert rules (the
             # replica-level dsod_alert_* families merge into the same
@@ -1055,25 +1089,31 @@ class Fleet:
         # — the engine's own late terminal is per-replica detail, not
         # fleet book); "cache_hit" (serve/cache.py — exact, near-dup,
         # and coalesced answers served from the router door without a
-        # backend forward) is its own fifth bucket, so the identity
-        # reads served + shed + expired + errors + cache_hit ==
-        # submitted.
+        # backend forward) is its own fifth bucket; "stream_reuse"
+        # (serve/streams.py — the temporal-coherence fast path
+        # replaying a stream's previous mask without a forward) the
+        # sixth, so the identity reads served + shed + expired +
+        # errors + cache_hit + stream_reuse == submitted.
         outcomes = router["outcomes"]
         cls = {"ok": "served", "shed": "shed", "expired": "expired",
-               "timeout": "expired", "cache_hit": "cache_hit"}
+               "timeout": "expired", "cache_hit": "cache_hit",
+               "stream_reuse": "stream_reuse"}
         book = {"served": 0, "shed": router["shed_total"], "expired": 0,
-                "errors": 0, "cache_hit": 0}
+                "errors": 0, "cache_hit": 0, "stream_reuse": 0}
         for outcome, n in outcomes.items():
             book[cls.get(outcome, "errors")] += n
         fleet = dict(book, submitted=router["submitted_total"])
         fleet["terminal"] = (fleet["served"] + fleet["shed"]
                              + fleet["expired"] + fleet["errors"]
-                             + fleet["cache_hit"])
+                             + fleet["cache_hit"]
+                             + fleet["stream_reuse"])
         fleet["consistent"] = fleet["terminal"] == fleet["submitted"]
         out = {"router": router, "models": models, "fleet": fleet,
                "breakers": breakers}
         if self.cache is not None:
             out["cache"] = self.cache.snapshot()
+        if self.streams is not None:
+            out["streams"] = self.streams.snapshot()
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
         if self.probe_stats is not None:

@@ -140,7 +140,8 @@ def wait_ready(base_url: str, timeout_s: float = 60.0,
 def _one(base_url: str, body: bytes, slo_ms: Optional[float],
          timeout_s: float, precision: Optional[str] = None,
          model: Optional[str] = None, tenant: Optional[str] = None,
-         request_id: Optional[str] = None
+         request_id: Optional[str] = None,
+         stream: Optional[str] = None
          ) -> Tuple[str, float, Dict[str, Optional[str]]]:
     """One /predict round-trip → (outcome, latency_ms, info).
     Outcomes: ok | shed | expired | unhealthy | error | transport —
@@ -167,12 +168,17 @@ def _one(base_url: str, body: bytes, slo_ms: Optional[float],
         headers["X-Tenant"] = str(tenant)
     if request_id:
         headers["X-Request-ID"] = str(request_id)
+    if stream:
+        # Per-stream session key (serve/streams.py): frames of one
+        # stream share it, so the router opens a session, pins the
+        # stream to a replica, and may serve the reuse fast path.
+        headers["X-Stream-ID"] = str(stream)
     req = urllib.request.Request(base_url + "/predict", data=body,
                                  headers=headers, method="POST")
     t0 = time.monotonic()
     info: Dict[str, Optional[str]] = {"arm": None, "model": None,
                                       "rid": None, "timing": None,
-                                      "cache": None}
+                                      "cache": None, "reuse": None}
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
             r.read()
@@ -185,6 +191,10 @@ def _one(base_url: str, body: bytes, slo_ms: Optional[float],
                 # exact | near | coalesced on a router-cache hit,
                 # absent on a real forward (serve/cache.py).
                 info["cache"] = r.headers.get("X-Cache")
+                # "1" on a temporal-coherence replay (serve/streams.py),
+                # absent on a full forward — the streaming summary
+                # splits its latency curves on this.
+                info["reuse"] = r.headers.get("X-Stream-Reuse")
     except urllib.error.HTTPError as e:
         e.read()
         out = {429: "shed", 504: "expired", 503: "unhealthy"}.get(
@@ -644,6 +654,169 @@ def run_loadgen(
         if s:
             out["slo"] = s
     return out
+
+
+def stream_frames(rng: np.random.RandomState, h: int, w: int,
+                  n_frames: int, perturb: float = 0.0) -> List[bytes]:
+    """A temporally-coherent pre-encoded frame train for ONE stream:
+    frame i+1 is frame i's scene under a small uniform brightness
+    jitter (bytes differ, the perceptual hash barely moves — the
+    workload the temporal-coherence fast path is built for), and with
+    probability ``perturb`` a SCENE CUT replaces the base image (a cut
+    must miss the reuse gate and force a full forward).  Fully seeded:
+    the same (seed, h, w, n, perturb) always yields the same bytes —
+    the determinism tests/test_streams.py asserts."""
+    if not 0.0 <= float(perturb) <= 1.0:
+        raise ValueError(f"perturb must be in [0, 1], got {perturb}")
+    frames: List[bytes] = []
+    base = structured_image(rng, h, w).astype(np.int16)
+    for i in range(int(n_frames)):
+        if i > 0 and perturb > 0 \
+                and rng.random_sample() < float(perturb):
+            base = structured_image(rng, h, w).astype(np.int16)
+        arr = np.clip(base + int(rng.randint(-2, 3)), 0, 255)
+        frames.append(_encode_arr(arr.astype(np.uint8)))
+    return frames
+
+
+def run_stream_loadgen(
+    base_url: str,
+    streams: int = 4,
+    fps: float = 10.0,
+    duration_s: float = 5.0,
+    sizes: Tuple[Tuple[int, int], ...] = ((320, 320),),
+    seed: int = 0,
+    perturb: float = 0.0,
+    slo_ms: float = 0.0,
+    timeout_s: float = 60.0,
+    precision: Optional[str] = None,
+    model: Optional[str] = None,
+    tenant: Optional[str] = None,
+) -> Dict:
+    """Streaming-video mode (docs/SERVING.md "Streaming"): ``streams``
+    concurrent clients, each pushing a temporally-coherent frame train
+    at a fixed ``fps`` under its own ``X-Stream-ID``.  Frames within a
+    stream are SEQUENTIAL (a video client never races its own frames):
+    each client sends frame i at its scheduled instant ``t0 + i/fps``,
+    waits for the answer, and sleeps until the next slot — a late
+    answer makes the next frame fire immediately, which is exactly the
+    freshness pressure a real stream applies.
+
+    ``perturb`` is the per-frame SCENE-CUT probability (a cut forces a
+    full forward past the reuse gate); between cuts frames carry only
+    a small brightness jitter, the reuse-arm fodder.  Deterministic
+    under ``seed``: payload bytes and schedule are identical across
+    runs (latencies, of course, are not).
+
+    The summary reports the streaming triple the r19 agenda records:
+    **per-stream p99** (each stream's own tail, plus the fleet-worst
+    under ``per_stream_p99_ms``), **inter-frame jitter** (stddev of
+    completion-to-completion intervals per stream, ms), and **reuse
+    rate** (X-Stream-Reuse answers / OK), with the reuse-vs-forward
+    p50 split alongside."""
+    if int(streams) < 1:
+        raise ValueError(f"streams must be >= 1, got {streams}")
+    if float(fps) <= 0:
+        raise ValueError(f"fps must be > 0, got {fps}")
+    n_frames = max(int(float(duration_s) * float(fps)), 1)
+    interval = 1.0 / float(fps)
+    specs = []
+    for si in range(int(streams)):
+        srng = np.random.RandomState((int(seed) * 9973 + si) % (2**31))
+        h, w = sizes[si % len(sizes)]
+        specs.append({
+            "sid": f"lg{int(seed)}-{si}",
+            "frames": stream_frames(srng, h, w, n_frames, perturb)})
+    lock = threading.Lock()
+    outcomes: Dict[str, int] = {"ok": 0, "shed": 0, "expired": 0,
+                                "unhealthy": 0, "error": 0,
+                                "transport": 0}
+    reuse_ms: List[float] = []
+    fwd_ms: List[float] = []
+    rows: List[Dict] = []
+
+    def client(spec: Dict) -> None:
+        lats: List[float] = []
+        done_t: List[float] = []
+        reused = 0
+        t0 = time.monotonic()
+        for i, body in enumerate(spec["frames"]):
+            delay = (t0 + i * interval) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            out, ms, info = _one(base_url, body, slo_ms or None,
+                                 timeout_s, precision=precision,
+                                 model=model, tenant=tenant,
+                                 stream=spec["sid"])
+            with lock:
+                outcomes[out] += 1
+                if out == "ok":
+                    if info.get("reuse") == "1":
+                        reused += 1
+                        reuse_ms.append(ms)
+                    else:
+                        fwd_ms.append(ms)
+            if out == "ok":
+                lats.append(ms)
+                done_t.append(time.monotonic())
+        lats.sort()
+        gaps = [(done_t[k] - done_t[k - 1]) * 1000.0
+                for k in range(1, len(done_t))]
+        jitter = float(np.std(gaps)) if len(gaps) >= 2 else 0.0
+        with lock:
+            rows.append({
+                "stream": spec["sid"],
+                "sent": len(spec["frames"]),
+                "ok": len(lats),
+                "reused": reused,
+                "reuse_rate": (round(reused / len(lats), 4)
+                               if lats else 0.0),
+                "p50_ms": round(_percentile(lats, 0.50), 2),
+                "p99_ms": round(_percentile(lats, 0.99), 2),
+                "jitter_ms": round(jitter, 2),
+            })
+
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=client, args=(s,), daemon=True)
+               for s in specs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t_start
+    all_ms = sorted(reuse_ms + fwd_ms)
+    reuse_ms.sort()
+    fwd_ms.sort()
+    rows.sort(key=lambda r: r["stream"])
+    hits = len(reuse_ms)
+    return {
+        "mode": "streaming",
+        "streams": int(streams),
+        "fps": float(fps),
+        "frames_per_stream": n_frames,
+        "perturb": round(float(perturb), 4),
+        "sent": int(streams) * n_frames,
+        "done": sum(outcomes.values()),
+        "elapsed_s": round(elapsed, 3),
+        "p50_ms": round(_percentile(all_ms, 0.50), 2),
+        "p95_ms": round(_percentile(all_ms, 0.95), 2),
+        "p99_ms": round(_percentile(all_ms, 0.99), 2),
+        "mean_ms": (round(sum(all_ms) / len(all_ms), 2)
+                    if all_ms else 0.0),
+        **outcomes,
+        "reuse": {
+            "hits": hits,
+            "rate": (round(hits / outcomes["ok"], 4)
+                     if outcomes["ok"] else 0.0),
+            "reuse_p50_ms": round(_percentile(reuse_ms, 0.50), 2),
+            "forward_p50_ms": round(_percentile(fwd_ms, 0.50), 2),
+        },
+        "per_stream": rows,
+        "per_stream_p99_ms": (max(r["p99_ms"] for r in rows)
+                              if rows else 0.0),
+        "jitter_ms": (round(sum(r["jitter_ms"] for r in rows)
+                            / len(rows), 2) if rows else 0.0),
+    }
 
 
 def fetch_stats(base_url: str, timeout_s: float = 10.0) -> Dict[str, float]:

@@ -70,6 +70,7 @@ from .failover import pick_hedge_delay
 from .server import (JsonHTTPHandler, ThreadingHTTPServer, _query_int,
                      publish_port, read_predict_body, resolve_request_id,
                      run_predict)
+from .streams import sanitize_stream_id
 
 
 class TokenBucket:
@@ -308,7 +309,10 @@ class RouterStats:
 # Request headers the router forwards to a remote replica verbatim.
 # X-SLO-MS is NOT here: the router forwards the RESIDUAL budget (the
 # original minus elapsed router time and prior attempts) per attempt.
-_FORWARD_HEADERS = ("Content-Type", "X-Precision")
+# X-Stream-ID rides so a remote that is itself a streaming-armed
+# router keeps the session key (a plain single-engine remote ignores
+# it — streaming is a router-tier concern).
+_FORWARD_HEADERS = ("Content-Type", "X-Precision", "X-Stream-ID")
 # Response headers relayed back from a remote replica's answer.
 # X-Timing rides so the stage split (and sampled trace id) a remote
 # computed reaches the client through the router unchanged; the
@@ -427,6 +431,13 @@ class RouterHandler(JsonHTTPHandler):
         # hedge, and backoff below is charged against it.
         t_door = fleet._clock()
         slo_hdr = self.headers.get("X-SLO-MS")
+        # Stream session key (serve/streams.py; docs/SERVING.md
+        # "Streaming"): parsed only while the table is armed — with
+        # streaming off the header is INERT and everything below is
+        # byte-identical to the independent-request path.
+        streams = fleet.streams
+        sid = (sanitize_stream_id(self.headers.get("X-Stream-ID"))
+               if streams is not None else None)
         # From here the request is IN the fleet accounting: every path
         # below terminates it in exactly one router outcome — including
         # a client that disconnects mid-request (the final except
@@ -446,9 +457,14 @@ class RouterHandler(JsonHTTPHandler):
             fleet.rstats.inc_shed(tenant.name, reason)
             fleet.observe_slo(group.name, tenant.name, "shed",
                               (fleet._clock() - t_door) * 1000.0)
+        root_attrs = {"model": group.name, "tenant": tenant.name}
+        if sid is not None:
+            # Stream-tagged trace: every frame of one stream shares
+            # this attr, so /debug/traces can follow a stream's
+            # timeline across its requests.
+            root_attrs["stream"] = sid
         root = fleet.tracer.begin(
-            "request", req_id, t0=t_door, root=True,
-            attrs={"model": group.name, "tenant": tenant.name})
+            "request", req_id, t0=t_door, root=True, attrs=root_attrs)
 
         def end_root(outcome: str) -> None:
             if root is not None:
@@ -473,7 +489,32 @@ class RouterHandler(JsonHTTPHandler):
                         "error": f"X-SLO-MS {slo_hdr!r} is not a number",
                         "kind": "rejected"}, headers=echo)
                     return
-            picked = group.pick()
+            # Stream session open/refresh BEFORE the pick: a table
+            # full of LIVE sessions sheds a NEW stream at the door
+            # (same pre-body posture as tenant admission — no body
+            # read, no probe slot claimed, no engine queue touched).
+            sess = None
+            req_phash = None
+            if sid is not None:
+                verdict, sess = streams.touch(sid)
+                if verdict == "budget":
+                    book_shed("stream")
+                    end_root("shed_stream")
+                    terminal = True
+                    self.close_connection = True
+                    self._guarded_send_json(429, {
+                        "error": f"stream table full "
+                                 f"({streams.max_sessions} live "
+                                 "sessions); retry after a stream "
+                                 "goes idle",
+                        "kind": "stream_budget"}, headers=echo)
+                    return
+            # Replica affinity: frames of a homed stream pin to the
+            # replica holding the session's warm state; a dead home
+            # falls through to the normal rotation and the session
+            # RE-HOMES (counted) once the new replica serves it.
+            picked = group.pick(
+                prefer=sess.home_rid if sess is not None else None)
             if picked is None:
                 # Every replica is dead, probe-flagged, or breaker-
                 # open: terminal at the router, no timeout paid.
@@ -515,6 +556,25 @@ class RouterHandler(JsonHTTPHandler):
                 end_root("rejected")
                 terminal = True
                 return
+            # Temporal-coherence fast path (serve/streams.py): a frame
+            # within the configured Hamming budget of the stream's
+            # previous frame replays the previous mask WITHOUT a
+            # forward — checked BEFORE the cache (cheaper: one
+            # per-session compare vs an LRU walk) and booked as its
+            # own sixth terminal class ``stream_reuse``.
+            if sess is not None and streams.reuse_hamming > 0:
+                from .cache import payload_fingerprint
+
+                fp = payload_fingerprint(body)
+                req_phash = fp[0] if fp is not None else None
+                reuse = streams.reuse_body(sess, req_phash)
+                if reuse is not None:
+                    self._serve_stream_reuse(group, tenant, sess,
+                                             reuse, echo, t_door,
+                                             end_root)
+                    terminal = True
+                    picked[2].release_probe()  # never dispatched
+                    return
             # Router cache (serve/cache.py; docs/SERVING.md "Router
             # cache").  Engine backends only: a remote replica's loaded
             # step is unknown at the router, and a stale mask is worse
@@ -550,23 +610,40 @@ class RouterHandler(JsonHTTPHandler):
                     cache_handle = obj
             fleet.rstats.inc_routed(group.name)
             dispatched = True
-            if cache_handle is None:
-                outcome = self._dispatch(group, picked, body, echo,
-                                         slo_ms, slo_hdr is not None,
-                                         t_door, req_id, root)
-            else:
-                # Coalescing leader: tee the response (whoever writes
-                # it) so followers wake with the same bytes and the
-                # LRU fills; any no-capture path abandons the token so
+            self._served_rid = None
+            cap = None
+            if cache_handle is not None or sess is not None:
+                # Tee the response (whoever writes it): a coalescing
+                # LEADER feeds the cache so followers wake with the
+                # same bytes, and a stream session stores the served
+                # mask as its new warm state — both read ONE capture.
+                # Any no-capture path abandons the cache token so
                 # followers can never hang on a dead leader.
                 cap = []
                 self._send_capture = cap
-                try:
-                    outcome = self._dispatch(group, picked, body, echo,
-                                             slo_ms, slo_hdr is not None,
-                                             t_door, req_id, root)
-                finally:
+            if sess is not None and streams.ema_blend > 0.0:
+                # EMA flicker damping: rewrite the 200 mask body
+                # in-flight (serve/server.py applies this before the
+                # tee, so the client, the cache, and the session all
+                # see the SAME blended bytes).  Off (the default) the
+                # hook stays None and full forwards are bitwise the
+                # engine's own answer.
+                self._send_transform = (
+                    lambda code, b, ctype, hdrs:
+                    streams.blend_body(sess, b)[0]
+                    if code == 200 and ctype == "application/x-npy"
+                    and dict(hdrs).get("X-Degraded", "0") in ("", "0")
+                    else b)
+            try:
+                outcome = self._dispatch(group, picked, body, echo,
+                                         slo_ms, slo_hdr is not None,
+                                         t_door, req_id, root,
+                                         stream=sid)
+            finally:
+                self._send_transform = None
+                if cap is not None:
                     self._send_capture = None
+                if cache_handle is not None:
                     if cap:
                         code, rh, rbody = cap[0]
                         cache.complete(cache_handle, code=code,
@@ -574,6 +651,17 @@ class RouterHandler(JsonHTTPHandler):
                                        model=group.name)
                     else:
                         cache.abandon(cache_handle)
+                if sess is not None and cap:
+                    # Full-forward epilogue: store the served mask +
+                    # the REQUEST frame's fingerprint as the stream's
+                    # warm state (cacheability rule shared with
+                    # RouterCache: non-degraded 200 x-npy only).
+                    self._stream_note(sess, cap[0], req_phash, t_door)
+            if outcome == "ok" and sess is not None:
+                # Pin (or re-home, counted) the session to the replica
+                # that actually served the frame — under failover that
+                # may not be the original pick.
+                streams.pin(sess, self._served_rid or picked[0])
             book_response(outcome)
             end_root(outcome)
             terminal = True
@@ -658,12 +746,64 @@ class RouterHandler(JsonHTTPHandler):
             return tok.entry
         return None
 
+    # -- streaming (serve/streams.py) ----------------------------------
+
+    def _serve_stream_reuse(self, group, tenant, sess, out_body: bytes,
+                            echo, t_door: float, end_root) -> None:
+        """Replay the stream's previous mask for a temporally-coherent
+        frame and book the ``stream_reuse`` terminal — the ONE seam
+        where the fast path enters the router book (registered in
+        dsodlint's BOOKING_SEAMS; serve/fleet.py extends the identity
+        to served+shed+expired+errors+cache_hit+stream_reuse ==
+        submitted).
+
+        Terminal booking first, send guarded after — the same
+        book-then-send order as every other router terminal, so an
+        exception can never book twice or strand the submission."""
+        fleet = self.fleet
+        ms = (fleet._clock() - t_door) * 1000.0
+        fleet.rstats.inc_response(tenant.name, "stream_reuse")
+        fleet.observe_slo(group.name, tenant.name, "stream_reuse", ms)
+        end_root("stream_reuse")
+        fleet.streams.note_reuse(sess, ms)
+        # Replay the stored response surface: the arm/bucket headers
+        # the ORIGINAL forward answered with, plus the reuse marker
+        # loadgen's streaming mode splits its latency curves on.
+        self._guarded_send(200, out_body, sess.content_type,
+                           headers=list(echo) + [
+                               ("X-Stream-Reuse", "1"),
+                               ("X-Degraded", "0"),
+                               ("X-Precision", sess.precision),
+                               ("X-Res-Bucket", sess.res_bucket)])
+
+    def _stream_note(self, sess, captured, req_phash,
+                     t_door: float) -> None:
+        """Store a full forward's captured response as the stream's
+        new warm state — same cacheability rule as RouterCache (a
+        non-degraded 200 x-npy body; anything else leaves the previous
+        warm state in place)."""
+        code, rh, rbody = captured
+        if code != 200 or not rbody:
+            return
+        if rh.get("X-Degraded", "0") not in ("", "0"):
+            return
+        ctype = rh.get("Content-Type", "")
+        if ctype != "application/x-npy":
+            return
+        fleet = self.fleet
+        fleet.streams.note_result(
+            sess, body=rbody, content_type=ctype,
+            precision=rh.get("X-Precision", ""),
+            res_bucket=rh.get("X-Res-Bucket", ""),
+            phash=req_phash,
+            latency_ms=(fleet._clock() - t_door) * 1000.0)
+
     # -- failover dispatch ---------------------------------------------
 
     def _dispatch(self, group, picked, body: bytes, echo,
                   slo_ms: Optional[float], has_slo: bool,
                   t_door: float, req_id: Optional[str] = None,
-                  root=None) -> str:
+                  root=None, stream: Optional[str] = None) -> str:
         """Run one request against a replica set under the fleet's
         retry/hedge/breaker policy and write exactly one response.
         Returns the request's single terminal outcome.  NEVER raises
@@ -698,7 +838,8 @@ class RouterHandler(JsonHTTPHandler):
                 return self._engine_attempt(group, rid, backend, breaker,
                                             body, echo, slo_ms, has_slo,
                                             t_door, req_id, root_sid,
-                                            attempt_n=attempts)
+                                            attempt_n=attempts,
+                                            stream=stream)
             result = self._remote_attempt_maybe_hedged(
                 group, rid, backend, breaker, body, slo_ms, t_door,
                 hedge_allowed=(attempts == 0), excluded=excluded,
@@ -767,7 +908,8 @@ class RouterHandler(JsonHTTPHandler):
                         has_slo: bool, t_door: float,
                         req_id: Optional[str] = None,
                         root_sid: Optional[str] = None,
-                        attempt_n: int = 0) -> str:
+                        attempt_n: int = 0,
+                        stream: Optional[str] = None) -> str:
         fleet = self.fleet
         extra = list(echo) + [("X-Replica", rid)]
         span = None
@@ -786,9 +928,13 @@ class RouterHandler(JsonHTTPHandler):
         outcome = run_predict(self, backend.engine, body,
                               extra_headers=extra, request_id=req_id,
                               trace_parent=span.span_id if span else None,
-                              **kw)
+                              stream=stream, **kw)
         if span is not None:
             span.end(outcome=outcome)
+        if outcome == "ok":
+            # Stream affinity reads which replica ACTUALLY served the
+            # frame (under failover, not necessarily the first pick).
+            self._served_rid = rid
         if outcome in ("stopped", "error"):
             breaker.record_failure()
         else:
@@ -960,6 +1106,8 @@ class RouterHandler(JsonHTTPHandler):
         body) to the client verbatim and classify the outcome."""
         _, status, rheaders, rbody, rid = result
         rh = {k: v for k, v in rheaders}
+        if status == 200:
+            self._served_rid = rid  # stream affinity pins to this
         relay = echo + [("X-Replica", rid)] \
             + [(k, rh[k]) for k in _RELAY_HEADERS if k in rh]
         ctype = rh.get("Content-Type", "application/octet-stream")

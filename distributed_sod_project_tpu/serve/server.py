@@ -116,7 +116,7 @@ _SLO_FROM_HEADER = object()  # sentinel: parse X-SLO-MS off the request
 
 def run_predict(handler, engine, body: bytes, extra_headers=(),
                 slo_ms=_SLO_FROM_HEADER, request_id=None,
-                trace_parent=None) -> str:
+                trace_parent=None, stream=None) -> str:
     """The whole /predict flow against one engine: decode the .npy
     body, validate the precision arm, submit, wait, respond — including
     the full error→status mapping.  Shared by the single-engine
@@ -152,6 +152,22 @@ def run_predict(handler, engine, body: bytes, extra_headers=(),
             send(400, {"error": f"body is not .npy: {e}",
                        "kind": "rejected"})
             return "rejected"
+        # Channel contract BEFORE submit: an (H, W, 3) payload to an
+        # RGB-D model — or (H, W, 4) to an RGB model — is a client
+        # error the engine must never see (accounting untouched), the
+        # same discipline as the malformed-header rejects below.  Other
+        # malformed shapes keep the historical engine-counted 400 path.
+        want_c = 4 if getattr(engine, "wants_depth", False) else 3
+        if getattr(image, "ndim", 0) == 3 \
+                and image.shape[2] in (3, 4) and image.shape[2] != want_c:
+            kind = ("RGB-D: payloads must be (H, W, 4) RGBD"
+                    if want_c == 4
+                    else "RGB: payloads must be (H, W, 3)")
+            send(400, {
+                "error": f"model {engine.cfg.model.name!r} serves "
+                         f"{kind}, got shape {tuple(image.shape)}",
+                "kind": "rejected"})
+            return "rejected"
         precision = handler.headers.get("X-Precision")
         if precision is not None:
             precision = precision.strip().lower()
@@ -184,7 +200,7 @@ def run_predict(handler, engine, body: bytes, extra_headers=(),
                     return "rejected"
         fut = engine.submit(image, slo_ms=slo, precision=precision,
                             trace_id=request_id,
-                            trace_parent=trace_parent)
+                            trace_parent=trace_parent, stream=stream)
         submitted = True
         pred, meta = fut.result(
             timeout=engine.cfg.serve.request_timeout_s)
@@ -260,6 +276,13 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
 
     def _send(self, code: int, body: bytes, content_type: str,
               headers=()) -> None:
+        xform = getattr(self, "_send_transform", None)
+        if xform is not None:
+            # Response rewrite hook (serve/streams.py EMA mask blend):
+            # applied BEFORE the capture tee so the client bytes and
+            # whatever the tee feeds (cache, stream warm state) are the
+            # SAME bytes.  None everywhere streaming is off.
+            body = xform(code, body, content_type, headers)
         cap = getattr(self, "_send_capture", None)
         if cap is not None:
             # Router-cache tee (serve/cache.py): a coalescing LEADER

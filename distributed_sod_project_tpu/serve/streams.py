@@ -1,0 +1,355 @@
+"""Per-stream session state for streaming-video SOD serving
+(docs/SERVING.md "Streaming"; ROADMAP item 5).
+
+The serving stack was built for latency-sensitive sustained traffic,
+yet until this module every request was independent: a client pushing
+30 frames/s of the SAME scene paid a full device forward per frame and
+could land on a different replica every time.  An ``X-Stream-ID``
+header now opens a **stream session** at the router door:
+
+- **bounded + TTL-evicted** — at most ``fleet.stream_sessions``
+  concurrent sessions; a session idle past ``fleet.stream_ttl_s`` is
+  evicted (LRU order).  A NEW stream past the cap sheds loudly at the
+  door (429 ``kind=stream_budget``) — live sessions are never silently
+  evicted to make room, because a session holds client-visible state.
+
+- **warm state** — the previous frame's mask bytes (+ the response
+  headers a replay must reproduce), its 256-bit perceptual hash
+  (serve/cache.py machinery), and per-stream latency/freshness stats.
+
+- **replica affinity** — the session records the replica that served
+  its last frame; the router pins subsequent frames to it so warm
+  state (engine-side batcher affinity, compiled-program residency)
+  never crosses replicas.  When the home replica dies the session
+  RE-HOMES to the next healthy pick and the move is counted
+  (``dsod_stream_rehomed_total``) — failover is visible, not silent.
+
+- **temporal-coherence fast path** — when a frame's phash is within
+  ``fleet.stream_reuse_hamming`` Hamming bits of the stream's previous
+  frame, the previous mask is served WITHOUT a forward: a sixth
+  terminal class ``stream_reuse`` in the router book
+  (``served + shed + expired + errors + cache_hit + stream_reuse ==
+  submitted``).  Quality is gated the precision-arm way: offline by
+  ``tools/stream_gate.py`` (checked-in ``tools/stream_baseline.json``
+  delta ledger over synthetic perturbed sequences) and online by the
+  cache shadow monitors watching temporal MAE.
+
+- **EMA mask blend** — optional flicker damping: a FULL forward for a
+  stream with a previous same-shape mask returns
+  ``blend*prev + (1-blend)*new``.  Off by default so full forwards
+  stay bitwise the engine's own answer.
+
+Everything is off by default (``fleet.stream_sessions = 0``): the
+fleet never constructs a StreamTable, `/metrics` is byte-identical,
+the batcher never sees a stream key, and zero threads exist.
+
+No jax import — this module runs on the router's request threads.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .cache import _decode_mask, _encode_mask, hamming
+
+# X-Stream-ID values become label-ish internal keys; constrain them the
+# way tenant names are constrained so a hostile header can't become an
+# unbounded/binary key.  Longer ids are truncated (prefix keeps
+# per-client uniqueness in practice); empty-after-sanitize ids are
+# treated as "no stream".
+STREAM_ID_MAX = 64
+_STREAM_ID_RE = re.compile(r"[^A-Za-z0-9_.:-]")
+
+
+def sanitize_stream_id(raw: Optional[str]) -> Optional[str]:
+    """A bounded, charset-safe session key from a client header, or
+    None when the header is absent/empty (the request then rides the
+    normal independent path)."""
+    if not raw:
+        return None
+    sid = _STREAM_ID_RE.sub("_", str(raw).strip())[:STREAM_ID_MAX]
+    return sid or None
+
+
+@dataclass
+class StreamSession:
+    """One client stream's warm state.  Mutated only under the owning
+    :class:`StreamTable`'s lock."""
+
+    stream_id: str
+    opened_at: float
+    last_seen: float
+    # Replica currently holding the stream's warm state (batcher
+    # affinity + compiled-program residency); None until first dispatch.
+    home_rid: Optional[str] = None
+    # Previous frame's fingerprint + served mask (the replay a
+    # temporal-coherence hit returns).
+    phash: Optional[int] = None
+    mask_body: Optional[bytes] = field(default=None, repr=False)
+    content_type: str = "application/x-npy"
+    precision: str = ""
+    res_bucket: str = ""
+    # Per-stream stats: frames served, fast-path reuses, re-homes, an
+    # EWMA of end-to-end latency, and the previous frame's wall time
+    # (freshness: how stale a reuse answer can be).
+    frames: int = 0
+    reused: int = 0
+    rehomes: int = 0
+    lat_ewma_ms: float = 0.0
+    last_frame_t: float = 0.0
+
+    def snapshot(self, now: float) -> Dict:
+        return {
+            "stream": self.stream_id,
+            "home": self.home_rid,
+            "frames": self.frames,
+            "reused": self.reused,
+            "rehomes": self.rehomes,
+            "lat_ewma_ms": round(self.lat_ewma_ms, 3),
+            "idle_s": round(max(0.0, now - self.last_seen), 3),
+            "age_s": round(max(0.0, now - self.opened_at), 3),
+        }
+
+
+@dataclass
+class StreamStats:
+    """Lock-guarded aggregate counters → /stats snapshot +
+    dsod_stream_* prom families (rendered by
+    :meth:`StreamTable.prom_families` so the session gauge can read the
+    table's live size)."""
+
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    opened: int = 0
+    expired: int = 0
+    frames: int = 0
+    reused: int = 0
+    rehomed: int = 0
+    budget_shed: int = 0
+    blended: int = 0
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def raw(self) -> Dict:
+        with self._lock:
+            return {
+                "opened": self.opened, "expired": self.expired,
+                "frames": self.frames, "reused": self.reused,
+                "rehomed": self.rehomed,
+                "budget_shed": self.budget_shed,
+                "blended": self.blended,
+            }
+
+
+class StreamTable:
+    """The router-door session table.  Thread-safe; every request-path
+    operation is dict/OrderedDict work under one lock (phash and blend
+    math run OUTSIDE it, on bytes the caller owns).
+
+    Request-path protocol (`RouterHandler.do_POST`):
+
+    - ``touch(stream_id)`` → ``("ok", session)`` (existing or newly
+      opened, LRU-refreshed) or ``("budget", None)`` — the table is
+      full of LIVE sessions, shed 429 ``kind=stream_budget``.
+    - ``reuse_body(session, phash)`` → previous mask bytes when the
+      temporal-coherence fast path applies, else None.
+    - ``note_result(...)`` after a full forward: store the served mask
+      + fingerprint, update latency/freshness stats.
+    - ``pin(session, rid)`` / re-home accounting when failover moves
+      the stream.
+    """
+
+    def __init__(self, max_sessions: int, ttl_s: float, *,
+                 reuse_hamming: int = 0, ema_blend: float = 0.0,
+                 clock=time.monotonic):
+        if max_sessions < 1:
+            raise ValueError(
+                f"StreamTable needs max_sessions >= 1, got {max_sessions}")
+        self.max_sessions = int(max_sessions)
+        self.ttl_s = float(ttl_s)
+        self.reuse_hamming = int(reuse_hamming)
+        self.ema_blend = float(ema_blend)
+        self.stats = StreamStats()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._sessions: "OrderedDict[str, StreamSession]" = OrderedDict()
+
+    # -- session lifecycle ---------------------------------------------
+
+    def _evict_expired_locked(self, now: float) -> None:  # dsodlint: disable=accounting-seams -- StreamStats.expired counts session evictions (dsod_stream_expired_total), not the request-terminal book
+        expired = 0
+        while self._sessions:
+            sid, sess = next(iter(self._sessions.items()))
+            if now - sess.last_seen < self.ttl_s:
+                break
+            del self._sessions[sid]
+            expired += 1
+        if expired:
+            self.stats.inc("expired", expired)
+
+    def touch(self, stream_id: str
+              ) -> Tuple[str, Optional[StreamSession]]:
+        now = self._clock()
+        with self._lock:
+            self._evict_expired_locked(now)
+            sess = self._sessions.get(stream_id)
+            if sess is not None:
+                sess.last_seen = now
+                self._sessions.move_to_end(stream_id)
+                return "ok", sess
+            if len(self._sessions) >= self.max_sessions:
+                self.stats.inc("budget_shed")
+                return "budget", None
+            sess = StreamSession(stream_id=stream_id, opened_at=now,
+                                 last_seen=now)
+            self._sessions[stream_id] = sess
+            self.stats.inc("opened")
+            return "ok", sess
+
+    def get(self, stream_id: str) -> Optional[StreamSession]:
+        with self._lock:
+            return self._sessions.get(stream_id)
+
+    # -- replica affinity ----------------------------------------------
+
+    def pin(self, sess: StreamSession, rid: str) -> None:
+        """Record (or move) the stream's home replica.  A move on an
+        already-homed session is a RE-HOME (failover) and is counted."""
+        with self._lock:
+            if sess.home_rid is not None and sess.home_rid != rid:
+                sess.rehomes += 1
+                self.stats.inc("rehomed")
+            sess.home_rid = rid
+
+    # -- temporal-coherence fast path ----------------------------------
+
+    def reuse_body(self, sess: StreamSession,
+                   phash: Optional[int]) -> Optional[bytes]:
+        """The previous mask bytes when the frame is temporally
+        coherent with the stream's previous frame, else None.  The
+        caller books ``stream_reuse`` and replays the stored headers."""
+        if self.reuse_hamming <= 0 or phash is None:
+            return None
+        with self._lock:
+            if sess.phash is None or sess.mask_body is None:
+                return None
+            if hamming(sess.phash, phash) > self.reuse_hamming:
+                return None
+            return sess.mask_body
+
+    def note_reuse(self, sess: StreamSession, latency_ms: float) -> None:
+        now = self._clock()
+        with self._lock:
+            sess.frames += 1
+            sess.reused += 1
+            sess.last_frame_t = now
+            sess.lat_ewma_ms = (latency_ms if sess.lat_ewma_ms == 0.0
+                                else 0.8 * sess.lat_ewma_ms
+                                + 0.2 * latency_ms)
+        self.stats.inc("frames")
+        self.stats.inc("reused")
+
+    # -- full-forward epilogue -----------------------------------------
+
+    def blend_body(self, sess: StreamSession,
+                   body: bytes) -> Tuple[bytes, bool]:
+        """EMA mask blend for flicker damping: ``blend*prev +
+        (1-blend)*new`` when armed and the previous mask has the same
+        shape.  Returns ``(body, blended?)`` — the returned body is
+        what the client gets AND what the session stores, so the EMA
+        compounds across frames the way flicker damping needs."""
+        if self.ema_blend <= 0.0:
+            return body, False
+        with self._lock:
+            prev = sess.mask_body
+        if prev is None:
+            return body, False
+        try:
+            new = _decode_mask(body)
+            old = _decode_mask(prev)
+            if new.shape != old.shape:
+                return body, False
+            a = np.float32(self.ema_blend)
+            out = _encode_mask(a * old + (np.float32(1.0) - a) * new)
+        except Exception:  # noqa: BLE001 — damping must not lose a frame
+            return body, False
+        self.stats.inc("blended")
+        return out, True
+
+    def note_result(self, sess: StreamSession, *, body: bytes,
+                    content_type: str, precision: str, res_bucket: str,
+                    phash: Optional[int], latency_ms: float) -> None:
+        """Store a full forward's served mask as the stream's new warm
+        state (only non-degraded 200 x-npy bodies reach here — the
+        caller applies the same cacheability rule as RouterCache)."""
+        now = self._clock()
+        with self._lock:
+            sess.mask_body = bytes(body)
+            sess.content_type = str(content_type)
+            sess.precision = str(precision)
+            sess.res_bucket = str(res_bucket)
+            sess.phash = phash
+            sess.frames += 1
+            sess.last_frame_t = now
+            sess.lat_ewma_ms = (latency_ms if sess.lat_ewma_ms == 0.0
+                                else 0.8 * sess.lat_ewma_ms
+                                + 0.2 * latency_ms)
+        self.stats.inc("frames")
+
+    # -- observability -------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        now = self._clock()
+        out = dict(self.stats.raw())
+        with self._lock:
+            out["sessions"] = len(self._sessions)
+            out["max_sessions"] = self.max_sessions
+            out["ttl_s"] = self.ttl_s
+            out["reuse_hamming"] = self.reuse_hamming
+            out["per_stream"] = [s.snapshot(now) for s in
+                                 list(self._sessions.values())[-16:]]
+        return out
+
+    def prom_families(self, labels: str = ""):
+        """dsod_stream_* families for the fleet /metrics render —
+        appended by `Fleet._router_families` ONLY when streaming is
+        armed, so the off-path rendering stays byte-identical."""
+        from ..utils.observability import _merge_labels
+
+        raw = self.stats.raw()
+        with self._lock:
+            live = len(self._sessions)
+
+        def line(name, value, extra=""):
+            lbl = _merge_labels(labels, extra)
+            if lbl:
+                return f"{name}{{{lbl}}} {value}"
+            return f"{name} {value}"
+
+        return [
+            ("dsod_stream_sessions", "gauge",
+             [line("dsod_stream_sessions", live)]),
+            ("dsod_stream_opened_total", "counter",
+             [line("dsod_stream_opened_total", raw["opened"])]),
+            ("dsod_stream_expired_total", "counter",
+             [line("dsod_stream_expired_total", raw["expired"])]),
+            ("dsod_stream_frames_total", "counter",
+             [line("dsod_stream_frames_total", raw["frames"])]),
+            ("dsod_stream_reused_total", "counter",
+             [line("dsod_stream_reused_total", raw["reused"])]),
+            ("dsod_stream_rehomed_total", "counter",
+             [line("dsod_stream_rehomed_total", raw["rehomed"])]),
+            ("dsod_stream_budget_shed_total", "counter",
+             [line("dsod_stream_budget_shed_total",
+                   raw["budget_shed"])]),
+            ("dsod_stream_blended_total", "counter",
+             [line("dsod_stream_blended_total", raw["blended"])]),
+        ]

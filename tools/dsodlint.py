@@ -104,6 +104,11 @@ BOOKING_SEAMS: Set[Tuple[str, str]] = {
     # cache_hit terminal class — the fifth identity bucket
     # (served+shed+expired+errors+cache_hit == submitted).
     (f"{PKG}/serve/router.py", "RouterHandler._serve_cache_hit"),
+    # Stream booking seam (serve/streams.py): the ONE place the
+    # temporal-coherence fast path enters the router book as the
+    # stream_reuse terminal class — the sixth identity bucket
+    # (served+shed+expired+errors+cache_hit+stream_reuse == submitted).
+    (f"{PKG}/serve/router.py", "RouterHandler._serve_stream_reuse"),
     # Control-plane decision seams: every autoscale/rollout counter
     # moves through ONE _record per plane, which also emits the
     # flight-recorder event — book and evidence cannot drift apart.

@@ -28,7 +28,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from distributed_sod_project_tpu.serve.loadgen import (  # noqa: E402
-    fetch_stats, run_loadgen, wait_ready)
+    fetch_stats, run_loadgen, run_stream_loadgen, wait_ready)
 
 
 def parse_args(argv=None):
@@ -74,7 +74,20 @@ def parse_args(argv=None):
                         "resize-perturbed re-encodes of their catalog "
                         "image (same content, nearby resolution — "
                         "misses the exact cache arm, exercises the "
-                        "near-dup arm)")
+                        "near-dup arm); with --streams: the per-frame "
+                        "SCENE-CUT probability (a cut forces a full "
+                        "forward past the reuse gate)")
+    p.add_argument("--streams", type=int, default=0, metavar="N",
+                   help="streaming-video mode (docs/SERVING.md "
+                        "\"Streaming\"): N concurrent clients, each "
+                        "pushing a temporally-coherent frame train at "
+                        "--fps under its own X-Stream-ID.  The summary "
+                        "reports per-stream p99, inter-frame jitter, "
+                        "and the reuse rate/latency split from "
+                        "X-Stream-Reuse.  Overrides --mode; uses "
+                        "--duration for the train length")
+    p.add_argument("--fps", type=float, default=10.0,
+                   help="streaming mode: frames/sec per stream")
     p.add_argument("--slo-ms", type=float, default=0.0,
                    help="per-request deadline sent as X-SLO-MS (0=none)")
     p.add_argument("--precision", default=None,
@@ -171,15 +184,24 @@ def main(argv=None) -> int:
         if not sep:
             raise SystemExit(f"--zipf {args.zipf!r} is not S:CATALOG")
         zipf = (float(s), int(cat))
-    summary = run_loadgen(
-        url, mode=args.mode, concurrency=args.concurrency,
-        requests=args.requests, rps=args.rps, duration_s=args.duration,
-        sizes=sizes, seed=args.seed, slo_ms=args.slo_ms,
-        timeout_s=args.timeout, precision=args.precision,
-        model=args.model, tenant=args.tenant, mix=mix,
-        slowest=args.slowest, quality=args.quality, slo=args.slo,
-        ramp=ramp, bursts=bursts or None, zipf=zipf,
-        perturb=args.perturb)
+    if args.streams > 0:
+        summary = run_stream_loadgen(
+            url, streams=args.streams, fps=args.fps,
+            duration_s=args.duration, sizes=sizes, seed=args.seed,
+            perturb=args.perturb, slo_ms=args.slo_ms,
+            timeout_s=args.timeout, precision=args.precision,
+            model=args.model, tenant=args.tenant)
+    else:
+        summary = run_loadgen(
+            url, mode=args.mode, concurrency=args.concurrency,
+            requests=args.requests, rps=args.rps,
+            duration_s=args.duration,
+            sizes=sizes, seed=args.seed, slo_ms=args.slo_ms,
+            timeout_s=args.timeout, precision=args.precision,
+            model=args.model, tenant=args.tenant, mix=mix,
+            slowest=args.slowest, quality=args.quality, slo=args.slo,
+            ramp=ramp, bursts=bursts or None, zipf=zipf,
+            perturb=args.perturb)
     if args.server_stats:
         try:
             summary["server"] = fetch_stats(url)

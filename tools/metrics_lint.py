@@ -190,7 +190,8 @@ def fleet_inventory() -> dict:
         prober_interval_s=1.0, controller=True,
         rollout_ckpt_dir="/nonexistent-dsod-lint",
         cache_bytes=1 << 20, cache_near_dup=True,
-        cache_near_dup_hamming=8, cache_shadow_sample=1))
+        cache_near_dup_hamming=8, cache_shadow_sample=1,
+        stream_sessions=4, stream_reuse_hamming=8))
     fleet.slo.observe_outcome("ok", 1.0, model="m")
     fleet.slo.observe_outcome("error", 1.0, model="m")
     fleet.probe_stats.record("m", True, 1.0, mae=0.01, iou=0.9)
@@ -225,6 +226,12 @@ def fleet_inventory() -> dict:
     ca.inc_evictions()
     ca.record_shadow(0.01)
     ca.record_shadow_dropped()
+    # Stream session families (serve/streams.py) render only while
+    # streaming is armed (off-path /metrics stays byte-identical);
+    # the StreamTable ctor is threadless by design.
+    _, sess = fleet.streams.touch("s1")
+    fleet.streams.pin(sess, "m")
+    fleet.streams.note_reuse(sess, 1.0)
     from distributed_sod_project_tpu.utils.observability import \
         parse_prom_text
 

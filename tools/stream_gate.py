@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Temporal-coherence stream-serving quality gate — CPU-runnable,
+per-PR (docs/SERVING.md "Streaming").
+
+The streaming fast path (`serve/streams.py`) answers a frame with the
+PREVIOUS frame's mask when the two frames' perceptual hashes agree
+within `fleet.stream_reuse_hamming` — a deliberate quality trade, and
+like the near-dup cache arm (`tools/cache_gate.py`) the trade is
+measurable on CPU at t1 time: replay frame i-1's exact mask for frame
+i of a jittered synthetic frame train and score it against the exact
+forward on frame i.  The optional EMA mask blend
+(`fleet.stream_ema_blend`) is scored the same way as a second arm:
+the compounded `blend*prev + (1-blend)*new` mask vs the exact forward.
+This tool does that over a fixed set of synthetic streams and
+maintains a checked-in delta ledger, `tools/stream_baseline.json`, in
+the hlo_guard/precision_gate discipline:
+
+- every run prints ONE JSON line with the reuse/ema deltas and the
+  delta against the recorded ledger;
+- `--fail-on-increase` exits 2 when an arm's quality delta exceeds
+  its recorded budget by more than `--tolerance` (off in shared CI:
+  the t1.sh posture is recorded, non-gating);
+- `--update-baseline` re-seeds after an intentional change;
+- a run whose own invariants failed (non-finite metrics, short set, a
+  consecutive frame pair that would NOT actually reuse-hit within the
+  Hamming budget) NEVER seeds or updates the ledger.
+
+The ledger's reference row is named ``f32`` by the shared helper —
+here that is literally accurate: the reference IS the exact f32
+forward on the current frame.  Deltas are signed so "worse" is
+positive; the Fβ/MAE reference is the exact forward binarized at 0.5,
+so the reuse row's delta against the exact row is pure temporal-replay
+error.
+
+Usage:
+    python tools/stream_gate.py                      # print deltas
+    python tools/stream_gate.py --update-baseline    # re-seed
+    python tools/stream_gate.py --fail-on-increase   # gate locally
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import precision_gate  # noqa: E402 — shared ledger discipline
+
+_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "stream_baseline.json")
+
+
+def run_gate(model, variables, cfg, *, image_size: int, num_streams: int,
+             num_frames: int, seed: int, hamming_budget: int,
+             ema_blend: float) -> dict:
+    """Score temporal-replay (and EMA-blend) serving vs the exact
+    forward on synthetic frame trains → ``(report, extras)`` where
+    report is the shared-ledger shape and extras carries the gate's own
+    observables (max inter-frame Hamming distance seen, direct
+    served-vs-exact pixel dMAE)."""
+    import numpy as np
+
+    from distributed_sod_project_tpu.eval.inference import (_resize_pred,
+                                                            make_forward)
+    from distributed_sod_project_tpu.metrics import SODMetrics
+    from distributed_sod_project_tpu.serve.cache import (hamming,
+                                                         payload_fingerprint)
+    from distributed_sod_project_tpu.serve.engine import preprocess_image
+    from distributed_sod_project_tpu.serve.loadgen import stream_frames
+
+    rng = np.random.RandomState(seed)
+    mean = np.asarray(cfg.data.normalize_mean, np.float32)
+    std = np.asarray(cfg.data.normalize_std, np.float32)
+    hw = image_size
+    fwd = make_forward(model)
+    agg_exact = SODMetrics(compute_structure=False)
+    agg_reuse = SODMetrics(compute_structure=False)
+    agg_ema = SODMetrics(compute_structure=False)
+    reasons, max_ham, dmaes = [], 0, []
+    a = np.float32(ema_blend)
+    expected = num_streams * (num_frames - 1)
+    for si in range(num_streams):
+        # perturb=0: jitter-only trains, every consecutive pair is the
+        # workload the fast path serves (a scene cut would forward —
+        # a path the gate must not dilute the ledger with).
+        bodies = stream_frames(rng, hw, hw, num_frames, perturb=0.0)
+        arrs = [np.load(io.BytesIO(b)) for b in bodies]
+        hashes = []
+        for b in bodies:
+            fp = payload_fingerprint(b)
+            hashes.append(fp[0] if fp is not None else None)
+        batch = np.stack([preprocess_image(f, hw, mean, std)
+                          for f in arrs])
+        masks = np.asarray(fwd(variables, {"image": batch}))
+        preds = [_resize_pred(m, (hw, hw)) for m in masks]
+        ema = preds[0]
+        for i in range(1, num_frames):
+            ham = (hamming(hashes[i - 1], hashes[i])
+                   if hashes[i - 1] is not None
+                   and hashes[i] is not None else 257)
+            max_ham = max(max_ham, ham)
+            if ham > hamming_budget:
+                # The gate must measure what the session would actually
+                # DO: a pair outside the budget would forward, so its
+                # score belongs to the exact path, not the ledger.
+                reasons.append(
+                    f"stream {si} frame {i}: Hamming {ham} > budget "
+                    f"{hamming_budget} — would not reuse-hit")
+                continue
+            exact = preds[i]
+            served = preds[i - 1]
+            ema = a * ema + (np.float32(1.0) - a) * exact
+            ref = (exact > 0.5).astype(np.float32)
+            agg_exact.add(exact, ref)
+            agg_reuse.add(served, ref)
+            agg_ema.add(ema, ref)
+            dmaes.append(float(np.mean(np.abs(served - exact))))
+
+    report = precision_gate.build_report(
+        {"f32": agg_exact.results(), "reuse": agg_reuse.results(),
+         "ema": agg_ema.results()},
+        expected_images=expected)
+    if reasons:
+        report["invariant_failed"] = True
+        report["reasons"] = report["reasons"] + reasons
+    extras = {
+        "hamming_budget": hamming_budget,
+        "max_hamming": max_ham,
+        "ema_blend": ema_blend,
+        "dmae_mean": round(float(np.mean(dmaes)), 6) if dmaes else None,
+        "dmae_max": round(float(np.max(dmaes)), 6) if dmaes else None,
+    }
+    return report, extras
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="minet_vgg16_ref",
+                   help="registered config (weights are random-init — "
+                        "the temporal-replay error is a serving-path "
+                        "effect measurable on any weights)")
+    p.add_argument("--image-size", type=int, default=64,
+                   help="frame resolution (small keeps the CPU gate "
+                        "fast)")
+    p.add_argument("--num-streams", type=int, default=4,
+                   help="synthetic frame trains (deterministic per "
+                        "seed)")
+    p.add_argument("--num-frames", type=int, default=6,
+                   help="frames per train (scores n-1 consecutive "
+                        "pairs each)")
+    p.add_argument("--hamming", type=int, default=16,
+                   help="reuse Hamming budget under test (mirror of "
+                        "fleet stream_reuse_hamming; part of the "
+                        "ledger key)")
+    p.add_argument("--ema-blend", type=float, default=0.5,
+                   help="EMA blend factor for the ema arm (mirror of "
+                        "fleet stream_ema_blend; part of the ledger "
+                        "key)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="train + weight seed (part of the ledger key)")
+    p.add_argument("--device", default="cpu", choices=["tpu", "cpu"],
+                   help="cpu by default — the gate must run at t1 time "
+                        "with no TPU window")
+    p.add_argument("--baseline", default=_BASELINE)
+    p.add_argument("--update-baseline", action="store_true")
+    p.add_argument("--fail-on-increase", action="store_true",
+                   help="exit 2 when an arm exceeds its recorded "
+                        "quality budget by more than --tolerance (off "
+                        "in shared CI: recorded, not gating — the "
+                        "t1.sh posture)")
+    p.add_argument("--tolerance", type=float, default=0.003,
+                   help="slack on the recorded delta before a breach "
+                        "(metric units; covers CPU ulp noise)")
+    args = p.parse_args(argv)
+
+    from distributed_sod_project_tpu.utils.platform import select_platform
+
+    select_platform(args.device)
+
+    import jax
+    import numpy as np
+
+    from distributed_sod_project_tpu.configs import (apply_overrides,
+                                                     get_config)
+    from distributed_sod_project_tpu.models import build_model
+    from distributed_sod_project_tpu.train import (build_optimizer,
+                                                   create_train_state)
+
+    hw = args.image_size
+    cfg = apply_overrides(get_config(args.config),
+                          [f"data.image_size={hw},{hw}",
+                           f"seed={args.seed}"])
+    model = build_model(cfg.model)
+    tx, _ = build_optimizer(cfg.optim, 1)
+    probe = {"image": np.zeros((1, hw, hw, 3), np.float32)}
+    if cfg.data.use_depth:
+        probe["depth"] = np.zeros((1, hw, hw, 1), np.float32)
+    state = create_train_state(jax.random.key(cfg.seed), model, tx,
+                               probe, ema=cfg.optim.ema_decay > 0)
+
+    report, extras = run_gate(
+        model, state.eval_variables(), cfg, image_size=hw,
+        num_streams=args.num_streams, num_frames=args.num_frames,
+        seed=args.seed, hamming_budget=args.hamming,
+        ema_blend=args.ema_blend)
+
+    baseline = {}
+    if os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    key = (f"{cfg.name}@{hw}px-t{args.num_streams}x{args.num_frames}"
+           f"-s{args.seed}-h{args.hamming}-e{args.ema_blend}")
+    rc, new_baseline, summary = precision_gate.apply_baseline(
+        report, baseline, key, update=args.update_baseline,
+        fail_on_increase=args.fail_on_increase,
+        tolerance=args.tolerance)
+    summary["metric"] = f"stream_gate[{key}]"
+    summary["stream_reuse"] = extras
+    if rc == 1:
+        print(f"stream_gate: invariant failed — NOT seeding/updating "
+              f"baseline for {key}: {report['reasons']}", file=sys.stderr)
+    elif new_baseline is not baseline:
+        with open(args.baseline, "w") as f:
+            json.dump(new_baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(summary), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Streaming smoke for tools/t1.sh: start a REAL one-model/two-replica
+fleet (both replicas are serve.py subprocesses behind real sockets),
+arm streaming (sessions + the temporal-coherence reuse fast path),
+push two concurrent frame trains through the router under their own
+X-Stream-ID, and assert the streaming contract end to end: sessions
+open and pin to distinct replicas, jitter frames serve from the reuse
+fast path (X-Stream-Reuse answers, booked ``stream_reuse``), and the
+SIX-term fleet accounting identity
+``served + shed + expired + errors + cache_hit + stream_reuse ==
+submitted`` balances EXACTLY.  Then SIGKILL the home replica of one
+live stream mid-session and push a scene-cut train (every frame a
+full forward): the orphaned session must RE-HOME to the survivor
+(``rehomed`` counted, frames keep completing) and the book must still
+balance through the kill.  Finally SIGTERM the fleet and assert a
+CLEAN drain (exit 0).  Prints one JSON line; exits non-zero on any
+broken link.
+
+Budget contract: the internal deadlines — 150 s replica bind (both
+replicas warm in PARALLEL) + 150 s fleet bind + 60 s healthz + the
+stream legs at their worst-case per-frame timeouts (round 1: 2
+streams x 12 frames, but only the non-reuse frames forward, x 45 s
+cap ≈ bounded by the round's own 120 s guard; kill leg: 20 s
+unhealthy poll + round 2 same guard) + 60 s drain — sum to ~560 s,
+under the t1.sh wrapper's 720 s, so a stall always reports its OWN
+JSON diagnostic instead of dying to the outer timeout mid-wait.
+
+Deliberately out-of-process (the fleet_smoke posture): replica
+affinity and re-homing are only meaningful across real process
+boundaries — an in-process "replica" cannot die the way the session
+table must survive.  tests/test_streams.py covers the in-process
+side (table semantics, reuse gate, booking identity with a fake
+clock).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_sod_project_tpu.serve.loadgen import (  # noqa: E402
+    run_stream_loadgen, wait_ready)
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+
+# One REAL zoo architecture, shrunk to smoke size: 64 px, two batch
+# buckets, f32 only (each extra arm is another AOT program per replica).
+SMOKE_OVERRIDES = [
+    "data.image_size=64,64", "serve.resolution_buckets=64",
+    "serve.batch_buckets=1,2", "serve.precision_arms=f32",
+    "serve.precision=f32"]
+
+
+def fleet_config(urls) -> dict:
+    return {
+        "default_tenant": "free",
+        "tenants": [{"name": "free", "priority": 0}],
+        # TWO replicas under one routing key — the re-home vehicle.
+        "models": [{"name": "minet", "urls": list(urls)}],
+        # Streaming armed: sessions + the reuse fast path.  TTL is
+        # generous (sessions must survive the kill leg's poll window);
+        # the Hamming budget matches the stream_gate default.
+        "stream_sessions": 8,
+        "stream_ttl_s": 120,
+        "stream_reuse_hamming": 16,
+        # Tight health window so the SIGKILL leg's flip is observable
+        # within the smoke budget.
+        "health_poll_s": 0.5,
+        "retry_backoff_ms": 5,
+    }
+
+
+def _get_json(url: str, path: str, timeout: float = 10):
+    try:
+        with urllib.request.urlopen(url + path, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read().decode())
+
+
+def main(argv=None) -> int:
+    argparse.ArgumentParser(description=__doc__).parse_args(argv)
+    port_file = tempfile.mktemp(prefix="dsod_stream_port_")
+    rep_port_files = [tempfile.mktemp(prefix=f"dsod_stream_rep_{i}_")
+                      for i in range(2)]
+    fleet_file = tempfile.mktemp(prefix="dsod_stream_cfg_", suffix=".json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    replicas = []
+    for pf in rep_port_files:
+        cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
+               "--config", "minet_vgg16_ref", "--init-random",
+               "--device", "cpu", "--port", "0", "--port-file", pf]
+        for ov in SMOKE_OVERRIDES:
+            cmd += ["--set", ov]
+        replicas.append(subprocess.Popen(cmd, env=env))
+    proc = None
+    try:
+        urls = []
+        deadline = time.monotonic() + 150
+        for i, pf in enumerate(rep_port_files):
+            while not os.path.exists(pf):
+                if replicas[i].poll() is not None:
+                    print(json.dumps(
+                        {"error": f"replica {i} died before binding",
+                         "rc": replicas[i].returncode}), flush=True)
+                    return 1
+                if time.monotonic() > deadline:
+                    print(json.dumps(
+                        {"error": f"replica {i} never bound a port"}),
+                        flush=True)
+                    return 1
+                time.sleep(0.25)
+            with open(pf) as f:
+                urls.append(f"http://127.0.0.1:{int(f.read().strip())}")
+        with open(fleet_file, "w") as f:
+            json.dump(fleet_config(urls), f)
+        cmd = [sys.executable, os.path.join(TOOLS, "serve.py"),
+               "--fleet-config", fleet_file, "--device", "cpu",
+               "--port", "0", "--port-file", port_file]
+        proc = subprocess.Popen(cmd, env=env)
+        deadline = time.monotonic() + 150
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                print(json.dumps({"error": "fleet died before binding",
+                                  "rc": proc.returncode}), flush=True)
+                return 1
+            if time.monotonic() > deadline:
+                print(json.dumps({"error": "fleet never bound a port"}),
+                      flush=True)
+                return 1
+            time.sleep(0.25)
+        with open(port_file) as f:
+            url = f"http://127.0.0.1:{int(f.read().strip())}"
+        if not wait_ready(url, timeout_s=60):
+            print(json.dumps({"error": "fleet never became healthy"}),
+                  flush=True)
+            return 1
+
+        # -- round 1: jitter-only trains → reuse fast path -------------
+        # Frame 1 of each stream forwards (round-robin spreads the two
+        # concurrent streams onto DISTINCT replicas); every later
+        # jitter frame should replay from the session without a
+        # forward.
+        round1 = run_stream_loadgen(
+            url, streams=2, fps=8.0, duration_s=1.5,
+            sizes=((48, 56),), seed=0, perturb=0.0, timeout_s=45)
+        stats1 = _get_json(url, "/stats")
+        st1 = stats1.get("streams", {})
+        homes = {r["stream"]: r["home"]
+                 for r in st1.get("per_stream", [])}
+
+        # -- SIGKILL the home replica of a LIVE stream -----------------
+        victim_rid = homes.get("lg0-0")
+        kill = {"homes": homes, "victim": victim_rid}
+        victim_idx = None
+        if victim_rid and "#" in victim_rid:
+            victim_idx = int(victim_rid.rsplit("#", 1)[1])
+        if victim_idx is not None:
+            replicas[victim_idx].kill()
+            replicas[victim_idx].wait(timeout=30)
+            # The background prober (0.5 s window) must flip the
+            # member's routability verdict on /healthz.
+            flipped = False
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                health = _get_json(url, "/healthz")
+                if health.get("replicas", {}).get(victim_rid,
+                                                  "ok") != "ok":
+                    flipped = True
+                    break
+                time.sleep(0.25)
+            kill["unhealthy_flipped"] = flipped
+        # Round 2: SAME stream ids (seed 0 → lg0-*), scene cut every
+        # frame (perturb=1.0) so nothing reuses — every frame is a full
+        # forward that must re-home the orphaned session to the
+        # survivor and keep completing.
+        round2 = run_stream_loadgen(
+            url, streams=2, fps=8.0, duration_s=1.0,
+            sizes=((48, 56),), seed=0, perturb=1.0, timeout_s=45)
+        stats2 = _get_json(url, "/stats")
+        st2 = stats2.get("streams", {})
+        kill["homes_after"] = {r["stream"]: r["home"]
+                               for r in st2.get("per_stream", [])}
+        kill["rehomed"] = st2.get("rehomed", 0)
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        fleet2 = stats2.get("fleet", {})
+        summary = {
+            "round1": round1, "round2": round2, "kill_leg": kill,
+            "streams": {k: st2.get(k) for k in
+                        ("sessions", "opened", "frames", "reused",
+                         "rehomed", "expired", "budget_shed")},
+            "fleet": fleet2, "server_rc": rc,
+        }
+        print(json.dumps(summary), flush=True)
+        ok = (
+            # Round 1: every frame terminated, the fast path fired,
+            # and both sessions opened on DISTINCT replicas.
+            round1.get("done") == round1.get("sent") == 24
+            and round1.get("ok") == 24
+            and round1["reuse"]["hits"] >= 8
+            and len(set(homes.values())) == 2
+            # Kill leg: the victim's verdict flipped, the orphaned
+            # session re-homed (counted), and the survivor kept every
+            # scene-cut frame completing.
+            and kill.get("unhealthy_flipped") is True
+            and kill["rehomed"] >= 1
+            and round2.get("done") == round2.get("sent") == 16
+            and round2.get("ok", 0) >= 1
+            # The six-term book balances EXACTLY through the kill, and
+            # the router's stream_reuse bucket matches the session
+            # table's own reuse count.
+            and fleet2.get("consistent") is True
+            and fleet2.get("submitted")
+            == round1["sent"] + round2["sent"]
+            and fleet2.get("stream_reuse") == st2.get("reused")
+            # Clean drain.
+            and rc == 0)
+        return 0 if ok else 1
+    finally:
+        for pr in [proc] + replicas:
+            if pr is not None and pr.poll() is None:
+                pr.kill()
+                pr.wait(timeout=30)
+        for f in [port_file, fleet_file] + rep_port_files:
+            if os.path.exists(f):
+                os.unlink(f)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,13 +4,14 @@
 # pytest's status, so CI and humans invoke the exact same command the
 # roadmap promises (the pytest line below is verbatim ROADMAP.md).
 #
-# Smoke-budget audit (PR 13, re-audited PR 18): the non-gating smokes
+# Smoke-budget audit (PR 13, re-audited PR 20): the non-gating smokes
 # below carry their own wrappers (420+900+420+300+420+420+420+420+420+
-# 420+300+900+720+600+780+600 ≈ 141 min worst case) — far past the 870 s the
-# GATING pytest line gets.  Each wrapper deliberately EXCEEDS its
-# tool's documented internal budget contract (serve_smoke sums to
-# ~300 s under its 420 s wrapper, health 900, fleet 720, slo 600,
-# chaos 780, ctrl 600): a stalled smoke must die to its OWN deadline
+# 420+420+300+900+720+720+600+780+600 ≈ 160 min worst case) — far past the
+# 870 s the GATING pytest line gets.  Each wrapper deliberately EXCEEDS
+# its tool's documented internal budget contract (serve_smoke sums to
+# ~300 s under its 420 s wrapper, health 900, fleet 720, stream ~560
+# under 720, slo 600, chaos 780, ctrl 600): a stalled smoke must die to
+# its OWN deadline
 # with its own JSON diagnostic, never to the outer timeout — so the
 # wrappers must not be trimmed below the contracts.
 # The starvation fix is the gate instead: set DSOD_T1_FAST=1 and every
@@ -59,6 +60,9 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/grad_comm_gate.py --arm bot
 echo "== near-dup cache-serving quality gate: near arm max-Fbeta/MAE deltas vs the exact forward on the tiny synthetic set (recorded, non-gating) =="
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/cache_gate.py \
   || echo "cache gate smoke failed (non-gating; --fail-on-increase gates locally)"
+echo "== stream-serving quality gate: temporal-replay + EMA-blend max-Fbeta/MAE deltas vs the exact forward on synthetic frame trains (recorded, non-gating) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/stream_gate.py \
+  || echo "stream gate smoke failed (non-gating; --fail-on-increase gates locally)"
 echo "== metrics-family inventory lint: fleet + trainer /metrics surfaces + flight-recorder ring schema vs tools/metrics_inventory.json (recorded, non-gating) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/metrics_lint.py \
   && timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/metrics_lint.py --ring-selftest \
@@ -69,6 +73,9 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python tools/health_smoke.py \
 echo "== fleet smoke: real-process router + remote replica, mixed-tenant loadgen, SIGKILL-mid-fleet degraded health, fleet accounting, clean SIGTERM drain (recorded, non-gating) =="
 timeout -k 10 720 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py \
   || echo "fleet smoke failed (non-gating; tests/test_fleet.py below gates the in-process side)"
+echo "== stream smoke: real two-replica fleet with streaming armed — per-stream sessions on distinct replicas, temporal-coherence reuse serving, SIGKILL the home replica mid-session → counted re-home, exact six-term accounting, clean SIGTERM drain (recorded, non-gating) =="
+timeout -k 10 720 env JAX_PLATFORMS=cpu python tools/stream_smoke.py \
+  || echo "stream smoke failed (non-gating; tests/test_streams.py below gates the in-process side)"
 echo "== slo smoke: real router + always-500 remote replica, synthetic prober detects the outage via burn-rate alert at ZERO live traffic, /slo consistent with the router book, capacity ledger live on the replica (recorded, non-gating) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/slo_smoke.py \
   || echo "slo smoke failed (non-gating; tests/test_slo.py + tests/test_capacity.py below gate the in-process side)"

@@ -98,8 +98,8 @@ case "$1" in
     ;;
 esac
 
-AGENDA=${AGENDA:-tools/tpu_agenda_r18.sh}
-RDIR=${RDIR:-tpu_results18}
+AGENDA=${AGENDA:-tools/tpu_agenda_r19.sh}
+RDIR=${RDIR:-tpu_results19}
 mkdir -p "$RDIR"
 MAX_HOURS=${MAX_HOURS:-11}
 MAX_FIRINGS=${MAX_FIRINGS:-3}
